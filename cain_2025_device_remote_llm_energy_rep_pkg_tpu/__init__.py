@@ -1,0 +1,50 @@
+"""TPU-native experiment framework for on-device vs. remote LLM energy studies.
+
+A ground-up rebuild of the capabilities of the CAIN 2025 replication package
+``S2-group/cain-2025-device-remote-llm-energy-rep-pkg`` (reference layer map in
+``SURVEY.md`` §1), designed TPU-first:
+
+- ``runner``     — the experiment kernel: factorial run tables, lifecycle event
+                   bus, config-as-code contract, atomic CSV persistence,
+                   AST-hash resume, per-run process isolation.
+                   (reference: ``experiment-runner/`` L1–L5)
+- ``profilers``  — three-phase measurement plugins: TPU power→Joules, host
+                   CPU/mem, RAPL, synthetic. (reference: ``Plugins/Profilers`` L6)
+- ``models``     — decoder-only transformer family covering the reference's 7
+                   Ollama models, as pure-JAX pytrees.
+- ``ops``        — RoPE / norms / attention, incl. a Pallas TPU decode kernel.
+- ``engine``     — generation backends: jit ``lax.scan`` decode engine + a
+                   deterministic fake backend for hermetic tests.
+                   (reference L8: external Ollama server)
+- ``parallel``   — mesh/sharding rules, tensor-parallel decode, sharded train
+                   step, multi-host helpers. (no reference equivalent; mandated
+                   by BASELINE.json's north star)
+- ``analysis``   — the statistics pipeline (IQR filter, Wilcoxon, Cliff's
+                   delta, Spearman). (reference L9: R notebook)
+- ``experiments``— the study config: 7 models × 2 locations × 3 lengths.
+                   (reference L7: ``experiment/RunnerConfig.py``)
+
+The package root imports only the hardware-free experiment kernel so the
+orchestration layer works without JAX present; accelerator modules import JAX
+lazily on first use.
+"""
+
+__version__ = "0.1.0"
+
+from .runner.config import ExperimentConfig, OperationType
+from .runner.context import RunContext
+from .runner.events import EventBus, LifecycleEvent
+from .runner.factors import Factor, RunTableModel
+from .runner.progress import RunProgress
+
+__all__ = [
+    "ExperimentConfig",
+    "OperationType",
+    "RunContext",
+    "EventBus",
+    "LifecycleEvent",
+    "Factor",
+    "RunTableModel",
+    "RunProgress",
+    "__version__",
+]

@@ -1,0 +1,25 @@
+"""Measurement plugins with a three-phase contract (start/stop/collect).
+
+Reference: ``experiment-runner/Plugins/Profilers/`` — the CodeCarbon energy
+wrapper (CodecarbonWrapper.py) and the WattsUpPro serial meter (WattsUpPro.py)
+— plus the inline psutil/powermetrics sampling the reference's experiment does
+by hand (experiment/RunnerConfig.py:135-178). Here every sampler implements
+the same :class:`~.base.Profiler` interface and is attached via the config's
+``profilers`` list instead of decorators/hand-rolled loops.
+
+Only hardware-free profilers are exported eagerly; TPU profilers import JAX
+lazily.
+"""
+
+from .base import Profiler, SamplingProfiler
+from .host import HostResourceProfiler
+from .rapl import RaplEnergyProfiler
+from .synthetic import SyntheticPowerProfiler
+
+__all__ = [
+    "Profiler",
+    "SamplingProfiler",
+    "HostResourceProfiler",
+    "RaplEnergyProfiler",
+    "SyntheticPowerProfiler",
+]

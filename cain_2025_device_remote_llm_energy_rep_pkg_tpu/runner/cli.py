@@ -1,0 +1,169 @@
+"""CLI entry point: run a config file, or scaffold/describe one.
+
+Reference: ``experiment-runner/__main__.py`` (config-file dispatch :52-79,
+dynamic import :19-25, AST md5 :27-49) and
+``ConfigValidator/CLIRegister/CLIRegister.py`` (command registry: config-create
+/ prepare / help, :105-125). Usage::
+
+    python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu <config.py>
+    python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu config-create [dir]
+    python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu help
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import inspect
+import multiprocessing
+import sys
+import uuid
+from pathlib import Path
+from typing import List, Optional, Type
+
+from . import term
+from .config import ExperimentConfig
+from .controller import ExperimentController
+from .errors import CommandError, ConfigLoadError, ExperimentError
+
+_TEMPLATE = '''"""Experiment config scaffold (edit every TODO)."""
+
+from pathlib import Path
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu import (
+    ExperimentConfig,
+    Factor,
+    RunTableModel,
+)
+
+
+class MyExperiment(ExperimentConfig):
+    name = "new_runner_experiment"
+    results_output_path = Path("experiments_output")
+    time_between_runs_in_ms = 1000
+
+    def create_run_table_model(self) -> RunTableModel:
+        return RunTableModel(
+            factors=[
+                Factor("example_factor", ["treatment_a", "treatment_b"]),
+            ],
+            repetitions=1,
+            data_columns=["example_metric"],
+        )
+
+    def start_run(self, context):
+        pass  # TODO: start the measured activity
+
+    def interact(self, context):
+        pass  # TODO: wait for the activity to finish
+
+    def populate_run_data(self, context):
+        return {"example_metric": 0}  # TODO: report measurements
+'''
+
+
+def load_config_class(path: Path) -> Type[ExperimentConfig]:
+    """Import a config module and find its ExperimentConfig subclass.
+
+    The reference requires the class be named exactly ``RunnerConfig``
+    (__main__.py:62-71); any single subclass is accepted here, with the name
+    ``RunnerConfig`` preferred when several are defined.
+    """
+    spec = importlib.util.spec_from_file_location(f"_expconfig_{path.stem}", path)
+    if spec is None or spec.loader is None:
+        raise ConfigLoadError(f"cannot import config file: {path}")
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    candidates: List[Type[ExperimentConfig]] = [
+        obj
+        for _, obj in inspect.getmembers(module, inspect.isclass)
+        if issubclass(obj, ExperimentConfig)
+        and obj is not ExperimentConfig
+        and obj.__module__ == module.__name__
+    ]
+    if not candidates:
+        raise ConfigLoadError(f"no ExperimentConfig subclass found in {path}")
+    if len(candidates) > 1:
+        named = [c for c in candidates if c.__name__ == "RunnerConfig"]
+        if len(named) == 1:
+            return named[0]
+        raise ConfigLoadError(
+            f"multiple ExperimentConfig subclasses in {path}: "
+            f"{[c.__name__ for c in candidates]}; name one 'RunnerConfig'"
+        )
+    return candidates[0]
+
+
+def run_config_file(path: Path) -> None:
+    if not path.exists():
+        raise CommandError(f"config file does not exist: {path}")
+    # Children must inherit the wired event bus and config state.
+    if multiprocessing.get_start_method(allow_none=True) != "fork":
+        multiprocessing.set_start_method("fork", force=True)
+    cls = load_config_class(path)
+    config = cls()
+    controller = ExperimentController(config, config_source=path.read_text())
+    controller.do_experiment()
+
+
+def config_create(target_dir: Optional[Path]) -> Path:
+    """Scaffold a fresh config file (reference CLIRegister.py:14-61)."""
+    target = target_dir or Path("examples")
+    target.mkdir(parents=True, exist_ok=True)
+    out = target / f"RunnerConfig-{uuid.uuid1()}.py"
+    out.write_text(_TEMPLATE)
+    term.log_ok(f"created config scaffold: {out}")
+    return out
+
+
+HELP = """usage: python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu <command|config.py>
+
+commands:
+  <config.py>          run the experiment defined by the config file
+  config-create [dir]  scaffold a new config file (default dir: examples/)
+  prepare              validate the environment (JAX devices, RAPL access)
+  help                 show this message
+"""
+
+
+def prepare() -> None:
+    """Environment self-check (the reference's ``prepare`` is an empty stub,
+    CLIRegister.py:77-78)."""
+    term.log(f"python: {sys.version.split()[0]}")
+    try:
+        import jax
+
+        term.log_ok(f"jax {jax.__version__}; devices: {jax.devices()}")
+    except Exception as exc:  # noqa: BLE001
+        term.log_warn(f"jax unavailable: {exc}")
+    from ..profilers.rapl import RaplEnergyProfiler
+
+    rapl = RaplEnergyProfiler()
+    if rapl.available:
+        term.log_ok("RAPL host energy counters readable")
+    else:
+        term.log_warn("RAPL host energy counters not readable (host_energy_J will be None)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or args[0] in ("help", "--help", "-h"):
+        print(HELP)
+        return 0
+    cmd = args[0]
+    try:
+        if cmd == "config-create":
+            config_create(Path(args[1]) if len(args) > 1 else None)
+        elif cmd == "prepare":
+            prepare()
+        elif cmd.endswith(".py"):
+            run_config_file(Path(cmd))
+        else:
+            raise CommandError(f"unrecognised command: {cmd!r}\n{HELP}")
+    except CommandError as exc:
+        term.log_fail(str(exc))
+        return 2
+    except ExperimentError as exc:
+        term.log_fail(f"{type(exc).__name__}: {exc}")
+        return 1
+    return 0

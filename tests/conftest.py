@@ -1,0 +1,15 @@
+"""Test env: force JAX onto 8 virtual CPU devices before jax is imported.
+
+SURVEY.md §4: multi-chip paths are tested with
+``--xla_force_host_platform_device_count`` virtual devices rather than real
+slices; the accelerator-free kernel tests don't touch JAX at all.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,85 @@
+"""CLI: config scaffolding, config-class discovery, end-to-end file run."""
+
+import textwrap
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+    config_create,
+    load_config_class,
+    main,
+    run_config_file,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.errors import ConfigLoadError
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.persistence import RunTableStore
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.progress import RunProgress
+
+
+def test_config_create_scaffold_is_loadable(tmp_path):
+    path = config_create(tmp_path)
+    assert path.exists()
+    cls = load_config_class(path)
+    assert cls.__name__ == "MyExperiment"
+
+
+def test_load_rejects_configless_module(tmp_path):
+    f = tmp_path / "empty.py"
+    f.write_text("x = 1\n")
+    with pytest.raises(ConfigLoadError, match="no ExperimentConfig subclass"):
+        load_config_class(f)
+
+
+def test_load_prefers_runnerconfig_name_on_ambiguity(tmp_path):
+    f = tmp_path / "multi.py"
+    f.write_text(
+        textwrap.dedent(
+            """
+            from cain_2025_device_remote_llm_energy_rep_pkg_tpu import ExperimentConfig
+
+            class Other(ExperimentConfig):
+                pass
+
+            class RunnerConfig(ExperimentConfig):
+                pass
+            """
+        )
+    )
+    assert load_config_class(f).__name__ == "RunnerConfig"
+
+
+def test_run_config_file_end_to_end(tmp_path):
+    config_py = tmp_path / "exp.py"
+    config_py.write_text(
+        textwrap.dedent(
+            f"""
+            from pathlib import Path
+            from cain_2025_device_remote_llm_energy_rep_pkg_tpu import (
+                ExperimentConfig, Factor, RunTableModel,
+            )
+
+            class RunnerConfig(ExperimentConfig):
+                name = "cli_e2e"
+                results_output_path = Path({str(tmp_path)!r})
+                isolate_runs = False
+
+                def create_run_table_model(self):
+                    return RunTableModel(
+                        factors=[Factor("n", [1, 2, 3])],
+                        data_columns=["square"],
+                    )
+
+                def populate_run_data(self, context):
+                    return {{"square": context.factor("n") ** 2}}
+            """
+        )
+    )
+    run_config_file(config_py)
+    rows = RunTableStore(tmp_path / "cli_e2e").read()
+    assert [r["square"] for r in rows] == [1, 4, 9]
+    assert all(r["__done"] == RunProgress.DONE for r in rows)
+
+
+def test_main_help_and_unknown_command(capsys):
+    assert main(["help"]) == 0
+    assert "usage" in capsys.readouterr().out
+    assert main(["definitely-not-a-command"]) == 2

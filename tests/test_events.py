@@ -1,0 +1,59 @@
+"""Event bus: ordered multi-subscriber dispatch and data merging."""
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.events import (
+    EventBus,
+    LifecycleEvent as E,
+)
+
+
+def test_multi_subscriber_order_preserved():
+    bus = EventBus()
+    calls = []
+    bus.subscribe(E.START_RUN, lambda: calls.append("first"))
+    bus.subscribe(E.START_RUN, lambda: calls.append("second"))
+    results = bus.raise_event(E.START_RUN)
+    assert calls == ["first", "second"]
+    assert len(results) == 2
+
+
+def test_unsubscribed_event_returns_empty_list():
+    assert EventBus().raise_event(E.INTERACT) == []
+
+
+def test_args_passed_through():
+    bus = EventBus()
+    seen = []
+    bus.subscribe(E.BEFORE_RUN, lambda ctx: seen.append(ctx))
+    bus.raise_event(E.BEFORE_RUN, "ctx-sentinel")
+    assert seen == ["ctx-sentinel"]
+
+
+def test_unsubscribe():
+    bus = EventBus()
+    cb = lambda: "x"  # noqa: E731
+    bus.subscribe(E.INTERACT, cb)
+    bus.unsubscribe(E.INTERACT, cb)
+    assert bus.raise_event(E.INTERACT) == []
+
+
+def test_raise_and_merge_later_wins():
+    bus = EventBus()
+    bus.subscribe(E.POPULATE_RUN_DATA, lambda: {"a": 1, "b": 1})
+    bus.subscribe(E.POPULATE_RUN_DATA, lambda: None)
+    bus.subscribe(E.POPULATE_RUN_DATA, lambda: {"b": 2})
+    assert bus.raise_and_merge(E.POPULATE_RUN_DATA) == {"a": 1, "b": 2}
+
+
+def test_raise_and_merge_all_none_is_none():
+    bus = EventBus()
+    bus.subscribe(E.POPULATE_RUN_DATA, lambda: None)
+    assert bus.raise_and_merge(E.POPULATE_RUN_DATA) is None
+
+
+def test_raise_and_merge_rejects_non_dict():
+    bus = EventBus()
+    bus.subscribe(E.POPULATE_RUN_DATA, lambda: 42)
+    with pytest.raises(TypeError, match="expected dict"):
+        bus.raise_and_merge(E.POPULATE_RUN_DATA)

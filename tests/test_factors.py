@@ -1,0 +1,107 @@
+"""Run-table algebra: factors, full factorial, exclusions, repetitions, shuffle."""
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.errors import RunTableError
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.factors import (
+    DONE_COLUMN,
+    RUN_ID_COLUMN,
+    Factor,
+    RunTableModel,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.progress import RunProgress
+
+
+def test_factor_rejects_duplicate_treatments():
+    with pytest.raises(RunTableError, match="duplicate treatment"):
+        Factor("model", ["a", "a"])
+
+
+def test_factor_rejects_empty_and_dunder_names():
+    with pytest.raises(RunTableError):
+        Factor("", ["a"])
+    with pytest.raises(RunTableError):
+        Factor("__run_id", ["a"])
+    with pytest.raises(RunTableError, match="no treatments"):
+        Factor("model", [])
+
+
+def test_full_factorial_counts():
+    model = RunTableModel(
+        factors=[Factor("a", [1, 2, 3]), Factor("b", ["x", "y"])],
+        repetitions=4,
+    )
+    rows = model.generate()
+    assert len(rows) == 3 * 2 * 4
+    ids = [r[RUN_ID_COLUMN] for r in rows]
+    assert len(set(ids)) == len(ids)
+    assert all(r[DONE_COLUMN] == RunProgress.TODO for r in rows)
+
+
+def test_run_id_format_matches_reference():
+    # reference RunTableModel.py:87: run_{i}_repetition_{j}
+    model = RunTableModel(factors=[Factor("a", [1, 2])], repetitions=2)
+    ids = [r[RUN_ID_COLUMN] for r in model.generate()]
+    assert ids == [
+        "run_0_repetition_0",
+        "run_1_repetition_0",
+        "run_0_repetition_1",
+        "run_1_repetition_1",
+    ]
+
+
+def test_exclusions_are_conjunctive_within_disjunctive_across():
+    model = RunTableModel(
+        factors=[Factor("loc", ["local", "remote"]), Factor("len", [100, 500])],
+        exclusions=[{"loc": ["remote"], "len": [500]}, {"len": [100]}],
+    )
+    variations = model.variations()
+    assert {"loc": "local", "len": 500} in variations
+    assert {"loc": "remote", "len": 500} not in variations
+    assert all(v["len"] != 100 for v in variations)
+
+
+def test_all_excluded_raises():
+    model = RunTableModel(
+        factors=[Factor("a", [1])], exclusions=[{"a": [1]}]
+    )
+    with pytest.raises(RunTableError, match="empty run table"):
+        model.generate()
+
+
+def test_exclusion_unknown_factor_rejected():
+    with pytest.raises(RunTableError, match="unknown factors"):
+        RunTableModel(factors=[Factor("a", [1])], exclusions=[{"nope": [1]}])
+
+
+def test_shuffle_is_seeded_and_deterministic():
+    kw = dict(factors=[Factor("a", list(range(10)))], repetitions=3)
+    r1 = RunTableModel(shuffle=True, shuffle_seed=7, **kw).generate()
+    r2 = RunTableModel(shuffle=True, shuffle_seed=7, **kw).generate()
+    r3 = RunTableModel(shuffle=True, shuffle_seed=8, **kw).generate()
+    assert [r[RUN_ID_COLUMN] for r in r1] == [r[RUN_ID_COLUMN] for r in r2]
+    assert [r[RUN_ID_COLUMN] for r in r1] != [r[RUN_ID_COLUMN] for r in r3]
+    unshuffled = RunTableModel(**kw).generate()
+    assert sorted(r[RUN_ID_COLUMN] for r in r1) == sorted(
+        r[RUN_ID_COLUMN] for r in unshuffled
+    )
+
+
+def test_data_columns_and_plugin_append():
+    model = RunTableModel(
+        factors=[Factor("a", [1])], data_columns=["tokens", "time_s"]
+    )
+    model.add_data_columns(["energy_J"])
+    row = model.generate()[0]
+    assert row["tokens"] is None and row["energy_J"] is None
+    with pytest.raises(RunTableError, match="already exists"):
+        model.add_data_columns(["tokens"])
+
+
+def test_column_collisions_rejected():
+    with pytest.raises(RunTableError, match="collide"):
+        RunTableModel(factors=[Factor("a", [1])], data_columns=["a"])
+    with pytest.raises(RunTableError, match="duplicate factor names"):
+        RunTableModel(factors=[Factor("a", [1]), Factor("a", [2])])
+    with pytest.raises(RunTableError, match="repetitions"):
+        RunTableModel(factors=[Factor("a", [1])], repetitions=0)

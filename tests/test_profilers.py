@@ -1,0 +1,119 @@
+"""Profiler plugins: sampling thread, power integration, host/RAPL/synthetic."""
+
+import time
+from pathlib import Path
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.base import (
+    integrate_power_to_joules,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.host import (
+    HostResourceProfiler,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.rapl import (
+    RaplEnergyProfiler,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.synthetic import (
+    SyntheticPowerProfiler,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.context import RunContext
+
+
+def _ctx(tmp_path) -> RunContext:
+    run_dir = tmp_path / "run_0"
+    run_dir.mkdir(parents=True, exist_ok=True)
+    return RunContext(
+        run_id="run_0",
+        run_nr=1,
+        total_runs=1,
+        variation={},
+        run_dir=run_dir,
+        experiment_dir=tmp_path,
+    )
+
+
+def test_integrate_constant_power():
+    samples = [{"t_s": float(t), "power_W": 10.0} for t in range(5)]
+    assert integrate_power_to_joules(samples, "power_W") == 40.0  # 10 W × 4 s
+
+
+def test_integrate_handles_missing_and_short():
+    assert integrate_power_to_joules([], "p") == 0.0
+    assert integrate_power_to_joules([{"t_s": 0, "p": 5}], "p") == 0.0
+    samples = [
+        {"t_s": 0.0, "p": 10.0},
+        {"t_s": 1.0, "p": None},
+        {"t_s": 2.0, "p": 10.0},
+    ]
+    assert integrate_power_to_joules(samples, "p") == 20.0
+
+
+def test_synthetic_profiler_energy_close_to_expected(tmp_path):
+    prof = SyntheticPowerProfiler(period_s=0.005, base_w=100.0)
+    ctx = _ctx(tmp_path)
+    prof.on_start(ctx)
+    time.sleep(0.12)
+    prof.on_stop(ctx)
+    data = prof.collect(ctx)
+    # constant 100 W over ~0.12 s → ~12 J (loose tolerance: thread scheduling)
+    assert 5.0 < data["energy_J"] < 25.0
+    assert abs(data["avg_power_W"] - 100.0) < 1.0
+    # artifact written (reference convention: raw trace in run_dir)
+    assert (ctx.run_dir / "synthetic_power.csv").exists()
+
+
+def test_sampling_profiler_final_sample_even_for_short_window(tmp_path):
+    prof = SyntheticPowerProfiler(period_s=10.0, base_w=50.0)
+    ctx = _ctx(tmp_path)
+    prof.on_start(ctx)
+    prof.on_stop(ctx)  # window far shorter than the period
+    data = prof.collect(ctx)
+    assert data["avg_power_W"] == 50.0  # falls back to base on single sample
+
+
+def test_host_profiler_reports_cpu_and_memory(tmp_path):
+    prof = HostResourceProfiler(period_s=0.02)
+    ctx = _ctx(tmp_path)
+    prof.on_start(ctx)
+    time.sleep(0.08)
+    prof.on_stop(ctx)
+    data = prof.collect(ctx)
+    assert set(data) == {"cpu_usage", "memory_usage"}
+    assert 0.0 <= data["memory_usage"] <= 100.0
+    assert (ctx.run_dir / "cpu_mem_usage.csv").exists()
+
+
+def test_rapl_profiler_graceful_without_counters(tmp_path):
+    prof = RaplEnergyProfiler(rapl_glob=str(tmp_path / "no-such-rapl:*"))
+    assert not prof.available
+    ctx = _ctx(tmp_path)
+    prof.on_start(ctx)
+    prof.on_stop(ctx)
+    assert prof.collect(ctx) == {"host_energy_J": None, "host_avg_power_W": None}
+
+
+def test_rapl_profiler_reads_fake_counters(tmp_path):
+    dom = tmp_path / "intel-rapl:0"
+    dom.mkdir()
+    (dom / "energy_uj").write_text("1000000")
+    (dom / "max_energy_range_uj").write_text("262143328850")
+    prof = RaplEnergyProfiler(rapl_glob=str(tmp_path / "intel-rapl:*"))
+    assert prof.available
+    ctx = _ctx(tmp_path)
+    prof.on_start(ctx)
+    (dom / "energy_uj").write_text("3500000")  # +2.5 J
+    prof.on_stop(ctx)
+    data = prof.collect(ctx)
+    assert data["host_energy_J"] == 2.5
+
+
+def test_rapl_wraparound_corrected(tmp_path):
+    dom = tmp_path / "intel-rapl:0"
+    dom.mkdir()
+    (dom / "energy_uj").write_text("9000000")
+    (dom / "max_energy_range_uj").write_text("10000000")
+    prof = RaplEnergyProfiler(rapl_glob=str(tmp_path / "intel-rapl:*"))
+    ctx = _ctx(tmp_path)
+    prof.on_start(ctx)
+    (dom / "energy_uj").write_text("1000000")  # wrapped: +2 J given 10 J range
+    prof.on_stop(ctx)
+    assert prof.collect(ctx)["host_energy_J"] == 2.0

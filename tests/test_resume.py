@@ -1,0 +1,111 @@
+"""AST-hash invariance and run-table reconciliation on restart."""
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.errors import (
+    AllRunsCompletedError,
+    ResumeError,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.progress import RunProgress
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.resume import (
+    config_ast_hash,
+    reconcile_run_tables,
+)
+
+BASE_SRC = '''
+class Config:
+    """Docstring v1."""
+    name = "exp"
+
+    def hook(self):
+        # a comment
+        return 1 + 2
+'''
+
+COSMETIC_SRC = '''
+
+class Config:
+    """Totally different docstring."""
+
+    name = "exp"
+    def hook(self):
+        return 1 + 2   # comment moved and lines shifted
+'''
+
+SUBSTANTIVE_SRC = BASE_SRC.replace("1 + 2", "1 + 3")
+
+
+def test_ast_hash_ignores_comments_docstrings_whitespace():
+    # reference __main__.py:27-49: cosmetic edits must not invalidate resume
+    assert config_ast_hash(BASE_SRC) == config_ast_hash(COSMETIC_SRC)
+
+
+def test_ast_hash_detects_substantive_change():
+    assert config_ast_hash(BASE_SRC) != config_ast_hash(SUBSTANTIVE_SRC)
+
+
+def _gen(n=3, extra=None):
+    rows = []
+    for i in range(n):
+        row = {
+            "__run_id": f"run_{i}_repetition_0",
+            "__done": RunProgress.TODO,
+            "model": f"m{i}",
+            "energy_J": None,
+        }
+        if extra:
+            row.update(extra)
+        rows.append(row)
+    return rows
+
+
+def test_reconcile_copies_done_and_data():
+    stored = _gen()
+    stored[1]["__done"] = RunProgress.DONE
+    stored[1]["energy_J"] = 9.5
+    merged = reconcile_run_tables(_gen(), stored)
+    assert merged[1]["__done"] == RunProgress.DONE
+    assert merged[1]["energy_J"] == 9.5
+    assert merged[0]["__done"] == RunProgress.TODO
+
+
+def test_reconcile_preserves_stored_order():
+    stored = list(reversed(_gen()))
+    stored[0]["__done"] = RunProgress.DONE  # run_2
+    merged = reconcile_run_tables(_gen(), stored)
+    assert [r["__run_id"] for r in merged] == [r["__run_id"] for r in stored]
+
+
+def test_reconcile_retries_failed_when_asked():
+    stored = _gen()
+    stored[0]["__done"] = RunProgress.FAILED
+    stored[1]["__done"] = RunProgress.DONE
+    merged = reconcile_run_tables(_gen(), stored, retry_failed=True)
+    assert merged[0]["__done"] == RunProgress.TODO
+    merged = reconcile_run_tables(_gen(), stored, retry_failed=False)
+    assert merged[0]["__done"] == RunProgress.FAILED
+
+
+def test_reconcile_rejects_column_change():
+    with pytest.raises(ResumeError, match="columns changed"):
+        reconcile_run_tables(_gen(extra={"new_col": None}), _gen())
+
+
+def test_reconcile_rejects_run_id_change():
+    with pytest.raises(ResumeError, match="run ids changed"):
+        reconcile_run_tables(_gen(n=2), _gen(n=3))
+
+
+def test_reconcile_rejects_factor_value_drift():
+    stored = _gen()
+    stored[0]["model"] = "different"
+    with pytest.raises(ResumeError, match="factor value changed"):
+        reconcile_run_tables(_gen(), stored)
+
+
+def test_all_done_raises():
+    stored = _gen()
+    for r in stored:
+        r["__done"] = RunProgress.DONE
+    with pytest.raises(AllRunsCompletedError):
+        reconcile_run_tables(_gen(), stored)
