@@ -1,0 +1,235 @@
+"""End-to-end analysis of a completed experiment's ``run_table.csv``.
+
+Mirrors the reference notebook's flow (SURVEY.md §3.5): load → IQR outlier
+removal per metric (cell 11) → subsets location × length (cell 13) →
+descriptives (cell 15) → H1 Wilcoxon + Cliff's delta per length (cell 37) →
+H2 Spearman energy vs the other metrics (cell 42). Emits
+``analysis_report.json`` and ``analysis_report.md`` (the notebook emits LaTeX
+tables + inline plots; plots here live in ``plots.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..runner.persistence import RunTableStore
+from .stats import (
+    cliffs_delta,
+    descriptives,
+    iqr_mask,
+    shapiro_wilk,
+    significance_stars,
+    spearman,
+    wilcoxon_rank_sum,
+)
+
+DEFAULT_METRICS = (
+    "energy_J",
+    "execution_time_s",
+    "cpu_usage",
+    "memory_usage",
+    "tokens_per_s",
+)
+LENGTH_LABELS = {100: "short", 500: "medium", 1000: "long"}
+
+
+def load_rows(experiment_dir: Path) -> List[Dict[str, Any]]:
+    return RunTableStore(Path(experiment_dir)).read()
+
+
+def apply_iqr_filter(
+    rows: List[Dict[str, Any]], metrics: Sequence[str], k: float = 1.5
+) -> List[Dict[str, Any]]:
+    """Drop a row when ANY metric value is an IQR outlier (nb cell 11 applies
+    the filter metric-by-metric over the whole table). Rows with a missing
+    value for a metric are NOT dropped for that metric — missing ≠ outlier;
+    descriptives/tests skip missing values themselves."""
+    import numpy as np
+
+    keep = [True] * len(rows)
+    for metric in metrics:
+        values = [
+            row.get(metric) if row.get(metric) is not None else math.nan
+            for row in rows
+        ]
+        arr = np.asarray(values, dtype=float)
+        if np.isnan(arr).all():
+            continue
+        mask = iqr_mask(values, k=k) | np.isnan(arr)
+        keep = [k_ and bool(m) for k_, m in zip(keep, mask)]
+    return [row for row, k_ in zip(rows, keep) if k_]
+
+
+def _subset(
+    rows: List[Dict[str, Any]], **conditions: Any
+) -> List[Dict[str, Any]]:
+    return [
+        row for row in rows if all(row.get(k) == v for k, v in conditions.items())
+    ]
+
+
+def _values(rows: List[Dict[str, Any]], metric: str) -> List[float]:
+    return [row[metric] for row in rows if row.get(metric) is not None]
+
+
+def analyze(
+    rows: List[Dict[str, Any]],
+    metrics: Sequence[str] = DEFAULT_METRICS,
+    location_factor: str = "location",
+    length_factor: str = "length",
+    energy_metric: str = "energy_J",
+    iqr_k: float = 1.5,
+) -> Dict[str, Any]:
+    metrics = [m for m in metrics if any(r.get(m) is not None for r in rows)]
+    filtered = apply_iqr_filter(rows, metrics, k=iqr_k)
+    locations = sorted({r[location_factor] for r in filtered})
+    lengths = sorted({r[length_factor] for r in filtered})
+
+    report: Dict[str, Any] = {
+        "n_rows": len(rows),
+        "n_after_iqr": len(filtered),
+        "metrics": list(metrics),
+        "descriptives": {},
+        "normality": {},
+        "h1_energy_by_length": {},
+        "h2_spearman": {},
+    }
+
+    for loc in locations:
+        for length in lengths:
+            sub = _subset(filtered, **{location_factor: loc, length_factor: length})
+            key = f"{loc}|{length}"
+            report["descriptives"][key] = {
+                m: descriptives(_values(sub, m)).as_dict() for m in metrics
+            }
+            if energy_metric in metrics:
+                vals = _values(sub, energy_metric)
+                if len(vals) >= 3 and len(set(vals)) > 1:
+                    try:
+                        w, p = shapiro_wilk(vals)
+                        report["normality"][key] = {"W": w, "p": p}
+                    except RuntimeError:
+                        pass
+
+    # H1 (nb cell 37): on-device vs remote energy per content length.
+    if len(locations) == 2 and energy_metric in metrics:
+        loc_a, loc_b = locations
+        for length in lengths:
+            a = _values(
+                _subset(filtered, **{location_factor: loc_a, length_factor: length}),
+                energy_metric,
+            )
+            b = _values(
+                _subset(filtered, **{location_factor: loc_b, length_factor: length}),
+                energy_metric,
+            )
+            if not a or not b:
+                continue
+            try:
+                u, p = wilcoxon_rank_sum(a, b)
+            except RuntimeError:
+                u, p = math.nan, math.nan
+            delta, magnitude = cliffs_delta(a, b)
+            mean_a = sum(a) / len(a)
+            mean_b = sum(b) / len(b)
+            report["h1_energy_by_length"][str(length)] = {
+                "label": LENGTH_LABELS.get(length, str(length)),
+                "compare": f"{loc_a} vs {loc_b}",
+                "U": u,
+                "p": p,
+                "stars": significance_stars(p),
+                "cliffs_delta": delta,
+                "magnitude": magnitude,
+                "mean_ratio": mean_a / mean_b if mean_b else math.nan,
+            }
+
+    # H2 (nb cell 42): what correlates with energy, per location.
+    if energy_metric in metrics:
+        for loc in locations:
+            sub = _subset(filtered, **{location_factor: loc})
+            energy = [r.get(energy_metric) for r in sub]
+            report["h2_spearman"][loc] = {}
+            for m in metrics:
+                if m == energy_metric:
+                    continue
+                other = [r.get(m) for r in sub]
+                rho, p = spearman(energy, other)
+                report["h2_spearman"][loc][m] = {
+                    "rho": rho,
+                    "p": p,
+                    "stars": significance_stars(p),
+                }
+    return report
+
+
+def render_markdown(report: Dict[str, Any]) -> str:
+    lines = ["# Experiment analysis", ""]
+    lines.append(
+        f"Rows: {report['n_rows']} → {report['n_after_iqr']} after IQR filtering."
+    )
+    lines.append("")
+    lines.append("## Descriptives (mean / median / SD)")
+    lines.append("")
+    lines.append("| subset | " + " | ".join(report["metrics"]) + " |")
+    lines.append("|" + "---|" * (len(report["metrics"]) + 1))
+    for key, per_metric in sorted(report["descriptives"].items()):
+        cells = []
+        for m in report["metrics"]:
+            d = per_metric[m]
+            if d["n"] == 0 or math.isnan(d["mean"]):
+                cells.append("—")
+            else:
+                cells.append(f"{d['mean']:.2f} / {d['median']:.2f} / {d['sd']:.2f}")
+        lines.append(f"| {key} | " + " | ".join(cells) + " |")
+    if report["h1_energy_by_length"]:
+        lines += ["", "## H1: energy, on-device vs remote", ""]
+        lines.append("| length | U | p | Cliff's δ | magnitude | mean ratio |")
+        lines.append("|---|---|---|---|---|---|")
+        for length, h in sorted(report["h1_energy_by_length"].items()):
+            lines.append(
+                f"| {h['label']} | {h['U']:.1f} | {h['p']:.2e}{h['stars']} "
+                f"| {h['cliffs_delta']:.3f} | {h['magnitude']} "
+                f"| {h['mean_ratio']:.2f}× |"
+            )
+    if report["h2_spearman"]:
+        lines += ["", "## H2: Spearman correlations with energy", ""]
+        for loc, per_metric in sorted(report["h2_spearman"].items()):
+            lines.append(f"### {loc}")
+            lines.append("")
+            lines.append("| metric | ρ | p |")
+            lines.append("|---|---|---|")
+            for m, h in per_metric.items():
+                rho = "—" if math.isnan(h["rho"]) else f"{h['rho']:.3f}"
+                p = "—" if math.isnan(h["p"]) else f"{h['p']:.2e}{h['stars']}"
+                lines.append(f"| {m} | {rho} | {p} |")
+            lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def analyze_experiment(
+    experiment_dir: Path,
+    out_dir: Optional[Path] = None,
+    metrics: Sequence[str] = DEFAULT_METRICS,
+    energy_metric: Optional[str] = None,
+    make_plots: bool = False,
+) -> Dict[str, Any]:
+    """Load, analyze, and write ``analysis_report.{json,md}`` (+plots)."""
+    experiment_dir = Path(experiment_dir)
+    out_dir = Path(out_dir) if out_dir else experiment_dir
+    rows = load_rows(experiment_dir)
+    if energy_metric is None:
+        energy_metric = next(
+            (m for m in metrics if "energy" in m), DEFAULT_METRICS[0]
+        )
+    report = analyze(rows, metrics=metrics, energy_metric=energy_metric)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    (out_dir / "analysis_report.json").write_text(json.dumps(report, indent=2))
+    (out_dir / "analysis_report.md").write_text(render_markdown(report))
+    if make_plots:
+        from .plots import plot_experiment
+
+        plot_experiment(rows, out_dir, metrics=metrics)
+    return report

@@ -1,0 +1,154 @@
+"""Plots mirroring the reference notebook's figure set, via matplotlib.
+
+Reference (data-analysis/analysis-visualization.ipynb): violin+density per
+metric (cells 21-26), QQ plots (cell 28), scatter + linear fit (cells 39-40).
+All functions no-op with a warning when matplotlib is missing (nothing may be
+pip-installed in this environment).
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+from typing import Any, Dict, List, Sequence
+
+try:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+except ImportError:  # pragma: no cover
+    plt = None
+
+from ..runner import term
+
+
+def _groups(
+    rows: List[Dict[str, Any]], metric: str, by: str
+) -> Dict[Any, List[float]]:
+    out: Dict[Any, List[float]] = {}
+    for row in rows:
+        v = row.get(metric)
+        if v is None or (isinstance(v, float) and math.isnan(v)):
+            continue
+        out.setdefault(row.get(by), []).append(float(v))
+    return dict(sorted(out.items(), key=lambda kv: str(kv[0])))
+
+
+def violin_by(
+    rows: List[Dict[str, Any]],
+    metric: str,
+    by: str,
+    out_path: Path,
+    title: str = "",
+) -> bool:
+    """Violin plot of ``metric`` grouped by factor ``by`` (nb cells 21-26)."""
+    if plt is None:
+        term.log_warn("matplotlib unavailable; skipping violin plot")
+        return False
+    groups = _groups(rows, metric, by)
+    groups = {k: v for k, v in groups.items() if len(v) >= 2}
+    if not groups:
+        return False
+    fig, ax = plt.subplots(figsize=(1.8 * len(groups) + 2, 4))
+    ax.violinplot(list(groups.values()), showmedians=True)
+    ax.set_xticks(range(1, len(groups) + 1))
+    ax.set_xticklabels([str(k) for k in groups], rotation=30, ha="right")
+    ax.set_ylabel(metric)
+    ax.set_title(title or f"{metric} by {by}")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return True
+
+
+def qq_plot(values: Sequence[float], out_path: Path, title: str = "") -> bool:
+    """Normal QQ plot (nb cell 28)."""
+    if plt is None:
+        term.log_warn("matplotlib unavailable; skipping QQ plot")
+        return False
+    import numpy as np
+
+    vals = np.sort(np.asarray([v for v in values if v is not None], dtype=float))
+    if vals.size < 3:
+        return False
+    # Normal quantiles via the probit approximation (Acklam/Beasley-Springer).
+    try:
+        from scipy import stats as scipy_stats
+
+        theo = scipy_stats.norm.ppf((np.arange(vals.size) + 0.5) / vals.size)
+    except ImportError:  # pragma: no cover
+        return False
+    fig, ax = plt.subplots(figsize=(4, 4))
+    ax.scatter(theo, vals, s=8)
+    mu, sd = float(np.mean(vals)), float(np.std(vals))
+    ax.plot(theo, mu + sd * theo, "r-", linewidth=1)
+    ax.set_xlabel("theoretical quantiles")
+    ax.set_ylabel("sample quantiles")
+    ax.set_title(title or "QQ plot")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return True
+
+
+def scatter_lm(
+    rows: List[Dict[str, Any]],
+    x_metric: str,
+    y_metric: str,
+    out_path: Path,
+    title: str = "",
+) -> bool:
+    """Scatter with least-squares line (nb cells 39-40)."""
+    if plt is None:
+        term.log_warn("matplotlib unavailable; skipping scatter plot")
+        return False
+    import numpy as np
+
+    pts = [
+        (row[x_metric], row[y_metric])
+        for row in rows
+        if row.get(x_metric) is not None and row.get(y_metric) is not None
+    ]
+    if len(pts) < 3:
+        return False
+    xs, ys = map(np.asarray, zip(*pts))
+    fig, ax = plt.subplots(figsize=(5, 4))
+    ax.scatter(xs, ys, s=10, alpha=0.6)
+    slope, intercept = np.polyfit(xs, ys, 1)
+    grid = np.linspace(xs.min(), xs.max(), 50)
+    ax.plot(grid, slope * grid + intercept, "r-", linewidth=1)
+    ax.set_xlabel(x_metric)
+    ax.set_ylabel(y_metric)
+    ax.set_title(title or f"{y_metric} vs {x_metric}")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return True
+
+
+def plot_experiment(
+    rows: List[Dict[str, Any]],
+    out_dir: Path,
+    metrics: Sequence[str] = ("energy_J", "execution_time_s"),
+    location_factor: str = "location",
+    model_factor: str = "model",
+) -> List[Path]:
+    """The notebook's figure set for one experiment."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written: List[Path] = []
+    for metric in metrics:
+        for by in (location_factor, model_factor):
+            path = out_dir / f"violin_{metric}_by_{by}.png"
+            if violin_by(rows, metric, by, path):
+                written.append(path)
+        vals = [r.get(metric) for r in rows if r.get(metric) is not None]
+        path = out_dir / f"qq_{metric}.png"
+        if qq_plot(vals, path, title=f"QQ: {metric}"):
+            written.append(path)
+    if len(metrics) >= 2:
+        path = out_dir / f"scatter_{metrics[1]}_vs_{metrics[0]}.png"
+        if scatter_lm(rows, metrics[0], metrics[1], path):
+            written.append(path)
+    return written

@@ -1,0 +1,19 @@
+"""Generation backends.
+
+Reference layer L8 is an external Ollama server reached over HTTP
+(experiment/RunnerConfig.py:128-131). Here generation is in-process and
+native: :class:`~.jax_engine.JaxEngine` (jit ``lax.scan`` decode over a KV
+cache) is the real backend; :class:`~.fake.FakeBackend` is the deterministic
+stand-in that lets the full experiment lifecycle run hermetically (SURVEY.md
+§4's "fake generation backend").
+"""
+
+from .backend import GenerationBackend, GenerationRequest, GenerationResult
+from .fake import FakeBackend
+
+__all__ = [
+    "GenerationBackend",
+    "GenerationRequest",
+    "GenerationResult",
+    "FakeBackend",
+]

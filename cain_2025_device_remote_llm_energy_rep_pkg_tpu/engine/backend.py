@@ -1,0 +1,61 @@
+"""The generation-backend contract.
+
+Equivalent of the reference's HTTP request/response with Ollama
+(``POST /api/generate`` with ``{model, prompt, stream:false}``,
+experiment/RunnerConfig.py:128-131): a request names a model, a prompt and a
+token budget; the result carries the generated tokens plus the timing
+breakdown the energy analysis needs (the reference only gets a wall-clock
+around curl; we split prefill vs decode and report tokens/s).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationRequest:
+    model: str
+    prompt: str
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    stop_at_eos: bool = True
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    request: GenerationRequest
+    tokens: List[int]  # generated token ids (prompt excluded)
+    text: str
+    prompt_tokens: int
+    generated_tokens: int
+    prefill_s: float
+    decode_s: float
+    total_s: float
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.generated_tokens / self.decode_s if self.decode_s > 0 else 0.0
+
+
+class GenerationBackend:
+    """Abstract backend: load models, serve generation requests."""
+
+    def load_model(self, model: str) -> None:
+        """Make ``model`` servable (weights into HBM for the JAX engine)."""
+        raise NotImplementedError
+
+    def generate(self, request: GenerationRequest) -> GenerationResult:
+        raise NotImplementedError
+
+    def warmup(self, request: GenerationRequest) -> None:
+        """Bring the backend to steady state for this request shape (weights
+        loaded, kernels compiled) so a following ``generate`` measures pure
+        serving work — the reference's Ollama server is likewise warm before
+        the measurement window opens. Default: no-op."""
+
+    def unload_all(self) -> None:
+        """Release model state (between treatments)."""

@@ -1,0 +1,49 @@
+"""Deterministic fake backend for hermetic lifecycle tests.
+
+SURVEY.md §4: the reference has no fakes at all (its "remote" treatment needs
+a real second machine); this backend makes the full experiment — run table,
+hooks, profilers, persistence, analysis — testable with no accelerator and no
+network. Token ids and timings are pure functions of the request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Dict
+
+from .backend import GenerationBackend, GenerationRequest, GenerationResult
+
+
+class FakeBackend(GenerationBackend):
+    def __init__(self, tokens_per_s: float = 1000.0, simulate_delay: bool = False):
+        self.tokens_per_s = tokens_per_s
+        self.simulate_delay = simulate_delay
+        self.loaded: Dict[str, bool] = {}
+
+    def load_model(self, model: str) -> None:
+        self.loaded[model] = True
+
+    def generate(self, request: GenerationRequest) -> GenerationResult:
+        if request.model not in self.loaded:
+            self.load_model(request.model)
+        digest = hashlib.sha256(
+            f"{request.model}|{request.prompt}|{request.seed}".encode()
+        ).digest()
+        n = request.max_new_tokens
+        tokens = [digest[i % len(digest)] + 3 for i in range(n)]
+        decode_s = n / self.tokens_per_s
+        prefill_s = 0.001
+        if self.simulate_delay:
+            time.sleep(decode_s + prefill_s)
+        text = "".join(chr(97 + (t % 26)) for t in tokens)
+        return GenerationResult(
+            request=request,
+            tokens=tokens,
+            text=text,
+            prompt_tokens=len(request.prompt.encode("utf-8")) + 1,
+            generated_tokens=n,
+            prefill_s=prefill_s,
+            decode_s=decode_s,
+            total_s=prefill_s + decode_s,
+        )

@@ -1,0 +1,266 @@
+"""The JAX/XLA generation engine: jit prefill + ``lax.scan`` decode.
+
+Replaces the reference's Ollama server (experiment/RunnerConfig.py:128-131)
+with an in-process TPU-native engine:
+
+- Weights random-init straight into HBM as bfloat16 (see models/transformer).
+- Prompts pad to power-of-two buckets and generation lengths round up to
+  buckets, so the number of distinct compilations is O(log max_len) — the
+  anti-recompilation discipline SURVEY.md §7 lists as risk #3.
+- The decode loop is a single ``lax.scan`` over the token budget: no
+  per-token Python, no host↔device chatter inside the loop; EOS is handled
+  with a done-mask so shapes stay static.
+- An optional ``decode_attention`` kernel (the Pallas one) can be injected;
+  default is the fused-by-XLA jnp path.
+
+Timings split prefill vs decode via ``block_until_ready`` fences — the
+reference can only clock the whole curl subprocess.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import MODEL_REGISTRY, ModelConfig, get_model_config
+from ..models.tokenizer import ByteTokenizer
+from ..models.transformer import (
+    DecodeAttentionFn,
+    Transformer,
+    forward,
+    logits_for,
+)
+from ..ops.sampling import sample_token
+from .backend import GenerationBackend, GenerationRequest, GenerationResult
+
+PROMPT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
+GEN_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
+
+
+def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds the largest bucket {buckets[-1]}")
+
+
+class JaxEngine(GenerationBackend):
+    """In-process generation over the model registry.
+
+    ``registry`` maps model name → ModelConfig; pass tiny() configs for
+    hermetic tests. ``decode_attention`` lets callers swap in the Pallas
+    kernel ('auto' uses it on TPU platforms, None forces the jnp path).
+    """
+
+    def __init__(
+        self,
+        registry: Optional[Dict[str, ModelConfig]] = None,
+        dtype: jnp.dtype = jnp.bfloat16,
+        decode_attention: "str | DecodeAttentionFn | None" = None,
+        seed: int = 0,
+    ) -> None:
+        self.registry = dict(registry) if registry is not None else dict(MODEL_REGISTRY)
+        self.dtype = dtype
+        self.seed = seed
+        self.tokenizer = ByteTokenizer()
+        self._models: Dict[str, Transformer] = {}
+        self._prefill_cache: Dict[Tuple, Callable] = {}
+        self._decode_cache: Dict[Tuple, Callable] = {}
+        self._warmed: set = set()
+        if decode_attention == "auto":
+            decode_attention = self._auto_decode_attention()
+        self.decode_attention: Optional[DecodeAttentionFn] = decode_attention  # type: ignore[assignment]
+
+    @staticmethod
+    def _auto_decode_attention() -> Optional[DecodeAttentionFn]:
+        if jax.default_backend() in ("tpu", "axon"):
+            from ..ops.pallas_attention import pallas_decode_attention
+
+            return pallas_decode_attention
+        return None
+
+    # -- model management -----------------------------------------------------
+    def load_model(self, model: str) -> None:
+        if model in self._models:
+            return
+        cfg = (
+            self.registry[model]
+            if model in self.registry
+            else get_model_config(model)
+        )
+        t0 = time.monotonic()
+        tf = Transformer.initialise(cfg, seed=self.seed, dtype=self.dtype)
+        jax.block_until_ready(tf.params)
+        self._load_s = time.monotonic() - t0
+        self._models[model] = tf
+
+    def unload_all(self) -> None:
+        self._models.clear()
+        self._prefill_cache.clear()
+        self._decode_cache.clear()
+        self._warmed.clear()  # a fresh load must re-warm outside the window
+
+    def _place_cache(self, k_cache, v_cache, cfg: ModelConfig):
+        """Placement hook: the TP engine overrides this to shard the KV cache
+        over the mesh; the single-device engine leaves it on the default
+        device."""
+        return k_cache, v_cache
+
+    def warmup(self, request: GenerationRequest) -> None:
+        """Compile this request's prefill/decode buckets outside any
+        measurement window (once per (model, buckets, top_k) shape)."""
+        key = (
+            request.model,
+            _bucket(len(self.tokenizer.encode(request.prompt)), PROMPT_BUCKETS),
+            _bucket(request.max_new_tokens, GEN_BUCKETS),
+            request.top_k,
+        )
+        if key in self._warmed:
+            return
+        self.generate(request)
+        self._warmed.add(key)
+
+    # -- compiled stages ------------------------------------------------------
+    def _prefill_fn(self, model: str, s_bucket: int, cache_len: int) -> Callable:
+        key = (model, s_bucket, cache_len)
+        if key in self._prefill_cache:
+            return self._prefill_cache[key]
+        tf = self._models[model]
+        cfg = tf.cfg
+
+        @jax.jit
+        def prefill(params, tokens, last_index, k_cache, v_cache):
+            hidden, k_cache, v_cache = forward(
+                params, cfg, tokens, jnp.int32(0), k_cache, v_cache, None
+            )
+            last_hidden = jnp.take_along_axis(
+                hidden, last_index[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            logits = logits_for(params, cfg, last_hidden)
+            return logits, k_cache, v_cache
+
+        self._prefill_cache[key] = prefill
+        return prefill
+
+    def _decode_fn(self, model: str, n_steps: int, top_k: int) -> Callable:
+        key = (model, n_steps, top_k)
+        if key in self._decode_cache:
+            return self._decode_cache[key]
+        tf = self._models[model]
+        cfg = tf.cfg
+        decode_attention = self.decode_attention
+        eos = ByteTokenizer.EOS_ID
+
+        @jax.jit
+        def decode(
+            params, first_token, start_offset, k_cache, v_cache, temperature, rng, n_real
+        ):
+            """Runs exactly ``n_real`` steps (≤ the compiled bucket ``n_steps``)
+            and stops early when every sequence hit EOS — so the measured
+            decode window never pays for unrequested tokens. ``n_real`` is
+            traced; one compiled fn serves every length in the bucket."""
+            b = first_token.shape[0]
+
+            def cond(carry):
+                _, _, _, _, _, done, i, _ = carry
+                return (i < n_real) & ~jnp.all(done)
+
+            def body(carry):
+                token, offset, kc, vc, rng, done, i, out = carry
+                hidden, kc, vc = forward(
+                    params, cfg, token[:, None], offset, kc, vc, decode_attention
+                )
+                logits = logits_for(params, cfg, hidden[:, 0])
+                rng, sub = jax.random.split(rng)
+                nxt = sample_token(logits, sub, temperature, top_k)
+                nxt = jnp.where(done, jnp.int32(eos), nxt)
+                done = done | (nxt == eos)
+                out = out.at[:, i].set(nxt)
+                return (nxt, offset + 1, kc, vc, rng, done, i + 1, out)
+
+            out0 = jnp.full((b, n_steps), eos, dtype=jnp.int32)
+            init = (
+                first_token,
+                start_offset,
+                k_cache,
+                v_cache,
+                rng,
+                jnp.zeros((b,), dtype=bool),
+                jnp.int32(0),
+                out0,
+            )
+            *_, n_done, out_tokens = jax.lax.while_loop(cond, body, init)
+            return out_tokens, n_done
+
+        self._decode_cache[key] = decode
+        return decode
+
+    # -- generation -----------------------------------------------------------
+    def generate(self, request: GenerationRequest) -> GenerationResult:
+        self.load_model(request.model)
+        tf = self._models[request.model]
+        cfg = tf.cfg
+
+        prompt_ids = self.tokenizer.encode(request.prompt)
+        s_real = len(prompt_ids)
+        s_bucket = _bucket(s_real, PROMPT_BUCKETS)
+        g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
+        cache_len = s_bucket + g_bucket
+        if cache_len > cfg.max_seq_len:
+            raise ValueError(
+                f"{request.model}: prompt bucket {s_bucket} + generation "
+                f"bucket {g_bucket} exceeds max_seq_len {cfg.max_seq_len}; "
+                "shorten the prompt or max_new_tokens"
+            )
+
+        tokens = jnp.asarray(
+            [prompt_ids + [ByteTokenizer.PAD_ID] * (s_bucket - s_real)],
+            dtype=jnp.int32,
+        )
+        k_cache, v_cache = tf.init_cache(1, cache_len, dtype=self.dtype)
+        k_cache, v_cache = self._place_cache(k_cache, v_cache, cfg)
+
+        t0 = time.monotonic()
+        prefill = self._prefill_fn(request.model, s_bucket, cache_len)
+        logits, k_cache, v_cache = prefill(
+            tf.params, tokens, jnp.asarray([s_real - 1]), k_cache, v_cache
+        )
+        rng = jax.random.PRNGKey(request.seed)
+        rng, sub = jax.random.split(rng)
+        first = sample_token(
+            logits, sub, jnp.float32(request.temperature), request.top_k
+        )
+        jax.block_until_ready(first)
+        t1 = time.monotonic()
+
+        decode = self._decode_fn(request.model, g_bucket, request.top_k)
+        out, n_done = decode(
+            tf.params,
+            first,
+            jnp.int32(s_real),
+            k_cache,
+            v_cache,
+            jnp.float32(request.temperature),
+            rng,
+            jnp.int32(request.max_new_tokens - 1),  # first token already sampled
+        )
+        out = jax.block_until_ready(out)
+        t2 = time.monotonic()
+
+        generated = [int(first[0])] + [int(t) for t in out[0][: int(n_done)]]
+        if request.stop_at_eos and ByteTokenizer.EOS_ID in generated:
+            generated = generated[: generated.index(ByteTokenizer.EOS_ID)]
+
+        return GenerationResult(
+            request=request,
+            tokens=generated,
+            text=self.tokenizer.decode(generated),
+            prompt_tokens=s_real,
+            generated_tokens=len(generated),
+            prefill_s=t1 - t0,
+            decode_s=t2 - t1,
+            total_s=t2 - t0,
+        )

@@ -1,0 +1,22 @@
+"""The 7 reference model families as pure-JAX decoder-only transformers.
+
+Reference: ``experiment/RunnerConfig.py:80`` — the experiment sweeps
+``qwen2:1.5b, gemma:2b, phi3:3.8b, gemma:7b, qwen2:7b, mistral:7b,
+llama3.1:8b`` served by Ollama. Here each family is an architectural config
+(true hyperparameters) over one shared transformer implementation; weights
+are random-initialised into HBM (the energy/latency profile depends on the
+architecture, not the trained values).
+"""
+
+from .config import MODEL_REGISTRY, ModelConfig, get_model_config
+from .tokenizer import ByteTokenizer
+from .transformer import Transformer, init_params
+
+__all__ = [
+    "MODEL_REGISTRY",
+    "ModelConfig",
+    "get_model_config",
+    "ByteTokenizer",
+    "Transformer",
+    "init_params",
+]

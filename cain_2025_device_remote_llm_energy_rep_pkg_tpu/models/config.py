@@ -1,0 +1,181 @@
+"""Architectural configs for the 7 reference model families.
+
+Hyperparameters follow the public model cards of the checkpoints Ollama
+serves in the reference experiment (experiment/RunnerConfig.py:80). ``tiny()``
+derives a structure-preserving miniature (same head grouping, activation,
+norm style) for CPU tests and the virtual-mesh dry run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_head: int
+    d_ff: int
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-6
+    activation: str = "silu"  # "silu" (SwiGLU) or "gelu" (GeGLU, gemma)
+    gemma_norm: bool = False  # (1 + w) RMSNorm gain + sqrt(d_model) embed scale
+    tie_embeddings: bool = False
+    qkv_bias: bool = False  # qwen2 uses attention biases
+    max_seq_len: int = 8192
+
+    def __post_init__(self) -> None:
+        if self.n_heads % self.n_kv_heads != 0:
+            raise ValueError(
+                f"{self.name}: n_heads {self.n_heads} not divisible by "
+                f"n_kv_heads {self.n_kv_heads}"
+            )
+        if self.d_head % 2 != 0:
+            raise ValueError(f"{self.name}: d_head must be even for RoPE")
+
+    @property
+    def params_count(self) -> int:
+        """Approximate parameter count (embeddings + blocks + norms)."""
+        embed = self.vocab_size * self.d_model * (1 if self.tie_embeddings else 2)
+        q = self.d_model * self.n_heads * self.d_head
+        kv = 2 * self.d_model * self.n_kv_heads * self.d_head
+        o = self.n_heads * self.d_head * self.d_model
+        mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        return embed + self.n_layers * (q + kv + o + mlp + norms) + self.d_model
+
+    def flops_per_token(self, context_len: int) -> float:
+        """Approx. forward FLOPs for one decoded token at the given context:
+        2·(matmul params) for the dense path + 4·L·T·Hq·Dh for attention
+        (QKᵀ and PV each 2·T·Hq·Dh multiply-adds)."""
+        q = self.d_model * self.n_heads * self.d_head
+        kv = 2 * self.d_model * self.n_kv_heads * self.d_head
+        o = self.n_heads * self.d_head * self.d_model
+        mlp = 3 * self.d_model * self.d_ff
+        logits = self.d_model * self.vocab_size
+        dense = 2 * (self.n_layers * (q + kv + o + mlp) + logits)
+        attn = 4 * self.n_layers * context_len * self.n_heads * self.d_head
+        return float(dense + attn)
+
+    def tiny(self, vocab_size: int = 512, max_seq_len: int = 256) -> "ModelConfig":
+        """Structure-preserving miniature for hermetic tests."""
+        group = self.n_heads // self.n_kv_heads
+        n_kv = max(1, min(2, self.n_kv_heads))
+        return dataclasses.replace(
+            self,
+            name=f"{self.name}-tiny",
+            vocab_size=vocab_size,
+            d_model=64,
+            n_layers=2,
+            n_heads=n_kv * group if n_kv * group <= 8 else 4,
+            n_kv_heads=n_kv if n_kv * group <= 8 else 2,
+            d_head=16,
+            d_ff=128,
+            max_seq_len=max_seq_len,
+        )
+
+
+# The 7 Ollama models of the reference sweep (experiment/RunnerConfig.py:80),
+# mapped to the checkpoints Ollama serves for those tags.
+MODEL_REGISTRY: Dict[str, ModelConfig] = {
+    cfg.name: cfg
+    for cfg in [
+        ModelConfig(
+            name="qwen2:1.5b",  # Qwen2-1.5B-Instruct
+            vocab_size=151_936,
+            d_model=1536,
+            n_layers=28,
+            n_heads=12,
+            n_kv_heads=2,
+            d_head=128,
+            d_ff=8960,
+            rope_theta=1e6,
+            qkv_bias=True,
+            tie_embeddings=True,
+        ),
+        ModelConfig(
+            name="gemma:2b",  # Gemma-2B-it
+            vocab_size=256_000,
+            d_model=2048,
+            n_layers=18,
+            n_heads=8,
+            n_kv_heads=1,
+            d_head=256,
+            d_ff=16_384,
+            activation="gelu",
+            gemma_norm=True,
+            tie_embeddings=True,
+        ),
+        ModelConfig(
+            name="phi3:3.8b",  # Phi-3-mini-4k-instruct
+            vocab_size=32_064,
+            d_model=3072,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=32,
+            d_head=96,
+            d_ff=8192,
+        ),
+        ModelConfig(
+            name="gemma:7b",  # Gemma-7B-it
+            vocab_size=256_000,
+            d_model=3072,
+            n_layers=28,
+            n_heads=16,
+            n_kv_heads=16,
+            d_head=256,
+            d_ff=24_576,
+            activation="gelu",
+            gemma_norm=True,
+            tie_embeddings=True,
+        ),
+        ModelConfig(
+            name="qwen2:7b",  # Qwen2-7B-Instruct
+            vocab_size=152_064,
+            d_model=3584,
+            n_layers=28,
+            n_heads=28,
+            n_kv_heads=4,
+            d_head=128,
+            d_ff=18_944,
+            rope_theta=1e6,
+            qkv_bias=True,
+        ),
+        ModelConfig(
+            name="mistral:7b",  # Mistral-7B-Instruct-v0.3
+            vocab_size=32_768,
+            d_model=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=8,
+            d_head=128,
+            d_ff=14_336,
+            rope_theta=1e6,
+        ),
+        ModelConfig(
+            name="llama3.1:8b",  # Llama-3.1-8B-Instruct
+            vocab_size=128_256,
+            d_model=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=8,
+            d_head=128,
+            d_ff=14_336,
+            rope_theta=5e5,
+        ),
+    ]
+}
+
+
+def get_model_config(name: str) -> ModelConfig:
+    if name not in MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; known: {sorted(MODEL_REGISTRY)}"
+        )
+    return MODEL_REGISTRY[name]

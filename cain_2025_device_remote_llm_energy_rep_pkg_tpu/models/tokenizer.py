@@ -1,0 +1,35 @@
+"""Byte-level tokenizer.
+
+The reference delegates tokenisation to Ollama's server-side tokenizers. For
+an energy study with randomly-initialised weights, what matters is token
+*count* and shape discipline, so a dependency-free byte tokenizer (256 byte
+ids + specials) is used. Vocab ids: 0=PAD, 1=BOS, 2=EOS, bytes at 3..258.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class ByteTokenizer:
+    PAD_ID = 0
+    BOS_ID = 1
+    EOS_ID = 2
+    _OFFSET = 3
+
+    vocab_size = 256 + _OFFSET
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = [b + self._OFFSET for b in text.encode("utf-8")]
+        return ([self.BOS_ID] if add_bos else []) + ids
+
+    def decode(self, ids: List[int]) -> str:
+        # Ids above the byte range can occur when a model's vocab is larger
+        # than the tokenizer's (random-weight models sample the full vocab);
+        # they carry no text and are skipped.
+        data = bytes(
+            i - self._OFFSET
+            for i in ids
+            if self._OFFSET <= i < self._OFFSET + 256
+        )
+        return data.decode("utf-8", errors="replace")

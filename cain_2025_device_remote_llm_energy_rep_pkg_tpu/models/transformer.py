@@ -1,0 +1,221 @@
+"""Shared decoder-only transformer over a plain-pytree parameter dict.
+
+TPU-first design choices:
+- Layer parameters are *stacked* along a leading [L, ...] axis and the block
+  loop is a ``lax.scan`` over layers — one traced block regardless of depth,
+  so a 32-layer model compiles as fast as a 2-layer one and XLA pipelines
+  HBM weight streaming.
+- bfloat16 weights/activations with float32 softmax/norm accumulation (MXU
+  native dtype).
+- One unified ``forward`` serves prefill (S tokens, offset 0) and decode
+  (S=1 at offset t): current K/V are written into the fixed-size cache with
+  ``dynamic_update_slice`` and attention masks by absolute position
+  ``kpos <= qpos``, so no separate length bookkeeping is needed.
+
+The reference has no model code at all (generation is delegated to the
+external Ollama server, experiment/RunnerConfig.py:128-131); this module is
+the TPU-native replacement mandated by BASELINE.json's north star.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_angles
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+# Signature: (q[B,Hq,D], k_cache[B,Hkv,T,D], v_cache[B,Hkv,T,D], lengths[B]) -> [B,Hq,D]
+DecodeAttentionFn = Callable[
+    [jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray
+]
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random-init weights directly on the default device (HBM)."""
+    keys = jax.random.split(key, 12)
+    d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+
+    def mat(k, shape, fan_in):
+        return (
+            jax.random.normal(k, shape, dtype=jnp.float32) / math.sqrt(fan_in)
+        ).astype(dtype)
+
+    params: Params = {
+        "embed": (
+            jax.random.normal(keys[0], (cfg.vocab_size, d), dtype=jnp.float32) * 0.02
+        ).astype(dtype),
+        "attn_norm": jnp.ones((l, d), dtype=dtype)
+        if not cfg.gemma_norm
+        else jnp.zeros((l, d), dtype=dtype),
+        "wq": mat(keys[1], (l, d, hq * dh), d),
+        "wk": mat(keys[2], (l, d, hkv * dh), d),
+        "wv": mat(keys[3], (l, d, hkv * dh), d),
+        "wo": mat(keys[4], (l, hq * dh, d), hq * dh),
+        "mlp_norm": jnp.ones((l, d), dtype=dtype)
+        if not cfg.gemma_norm
+        else jnp.zeros((l, d), dtype=dtype),
+        "w_gate": mat(keys[5], (l, d, f), d),
+        "w_up": mat(keys[6], (l, d, f), d),
+        "w_down": mat(keys[7], (l, f, d), f),
+        "final_norm": jnp.ones((d,), dtype=dtype)
+        if not cfg.gemma_norm
+        else jnp.zeros((d,), dtype=dtype),
+    }
+    if cfg.qkv_bias:
+        params["bq"] = jnp.zeros((l, hq * dh), dtype=dtype)
+        params["bk"] = jnp.zeros((l, hkv * dh), dtype=dtype)
+        params["bv"] = jnp.zeros((l, hkv * dh), dtype=dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = mat(keys[8], (d, cfg.vocab_size), d)
+    return params
+
+
+def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.activation == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _attention_block(
+    cfg: ModelConfig,
+    x: jnp.ndarray,  # [B,S,D]
+    layer: Params,
+    k_cache: jnp.ndarray,  # [B,Hkv,T,Dh] — T-contiguous per head for DMA-friendly decode
+    v_cache: jnp.ndarray,
+    offset: jnp.ndarray,  # scalar int32: write position of token 0
+    cos: jnp.ndarray,  # [B,S,half]
+    sin: jnp.ndarray,
+    decode_attention: Optional[DecodeAttentionFn],
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    b, s, d = x.shape
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+    t = k_cache.shape[2]
+
+    q = jnp.einsum("bsd,dh->bsh", x, layer["wq"])
+    k = jnp.einsum("bsd,dh->bsh", x, layer["wk"])
+    v = jnp.einsum("bsd,dh->bsh", x, layer["wv"])
+    if cfg.qkv_bias:
+        q = q + layer["bq"]
+        k = k + layer["bk"]
+        v = v + layer["bv"]
+    q = q.reshape(b, s, hq, dh)
+    k = k.reshape(b, s, hkv, dh)
+    v = v.reshape(b, s, hkv, dh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, offset, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, offset, 0)
+    )
+
+    scale = 1.0 / math.sqrt(dh)
+    if s == 1 and decode_attention is not None:
+        lengths = jnp.full((b,), offset + 1, dtype=jnp.int32)
+        out = decode_attention(q[:, 0], k_cache, v_cache, lengths)  # [B,Hq,Dh]
+        out = out[:, None]  # [B,1,Hq,Dh]
+    else:
+        group = hq // hkv
+        qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)
+        kf = k_cache.astype(jnp.float32)
+        vf = v_cache.astype(jnp.float32)
+        scores = jnp.einsum("bskgd,bktd->bkgst", qg, kf) * scale
+        qpos = offset + jnp.arange(s)[:, None]
+        kpos = jnp.arange(t)[None, :]
+        mask = kpos <= qpos  # causal + only-written-prefix, in one predicate
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bkgst,bktd->bskgd", probs, vf).reshape(b, s, hq, dh)
+
+    out = out.astype(x.dtype).reshape(b, s, hq * dh)
+    return jnp.einsum("bsh,hd->bsd", out, layer["wo"]), k_cache, v_cache
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B,S] int32
+    offset: jnp.ndarray,  # scalar int32
+    k_cache: jnp.ndarray,  # [L,B,Hkv,T,Dh]
+    v_cache: jnp.ndarray,
+    decode_attention: Optional[DecodeAttentionFn] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run the stack over S tokens starting at ``offset``.
+
+    Returns (hidden [B,S,D], new_k_cache, new_v_cache). Logits are computed
+    separately (``logits_for``) so prefill never materialises [B,S,vocab].
+    """
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    if cfg.gemma_norm:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), dtype=x.dtype)
+
+    positions = offset + jnp.arange(s, dtype=jnp.int32)[None, :]  # [1,S]
+    positions = jnp.broadcast_to(positions, (b, s))
+    cos, sin = rope_angles(positions, cfg.d_head, cfg.rope_theta)
+
+    layer_keys = [k for k in params if k not in ("embed", "final_norm", "lm_head")]
+    stacked = {k: params[k] for k in layer_keys}
+
+    def block(x, scanned):
+        layer, kc, vc = scanned
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
+        attn_out, kc, vc = _attention_block(
+            cfg, h, layer, kc, vc, offset, cos, sin, decode_attention
+        )
+        x = x + attn_out
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
+        gate = _activation(cfg, jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
+        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
+        mlp_out = jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
+        return x + mlp_out, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(block, x, (stacked, k_cache, v_cache))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
+    return x, new_k, new_v
+
+
+def logits_for(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
+    """Project hidden states [..., D] to vocab logits in float32."""
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum(
+        "...d,dv->...v", hidden.astype(jnp.float32), head.astype(jnp.float32)
+    )
+
+
+@dataclasses.dataclass
+class Transformer:
+    """Config + params bundle with convenience entry points."""
+
+    cfg: ModelConfig
+    params: Params
+
+    @classmethod
+    def initialise(
+        cls, cfg: ModelConfig, seed: int = 0, dtype: jnp.dtype = jnp.bfloat16
+    ) -> "Transformer":
+        return cls(cfg=cfg, params=init_params(cfg, jax.random.PRNGKey(seed), dtype))
+
+    def init_cache(
+        self, batch: int, max_len: int, dtype: jnp.dtype = jnp.bfloat16
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        shape = (self.cfg.n_layers, batch, self.cfg.n_kv_heads, max_len, self.cfg.d_head)
+        return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
+
+    def __call__(self, tokens, offset, k_cache, v_cache, decode_attention=None):
+        return forward(
+            self.params, self.cfg, tokens, offset, k_cache, v_cache, decode_attention
+        )

@@ -1,0 +1,22 @@
+"""Core numeric ops for the decode path: norms, RoPE, attention, sampling.
+
+The reference delegates all numerics to the external Ollama server (llama.cpp;
+SURVEY.md §0) — these modules are the TPU-native replacement. Everything is
+functional, static-shaped, and jit-friendly; the Pallas decode-attention
+kernel lives in ``pallas_attention`` with a pure-jnp fallback in
+``attention``.
+"""
+
+from .attention import decode_attention_reference, prefill_attention
+from .norms import rms_norm
+from .rope import apply_rope, rope_angles
+from .sampling import sample_token
+
+__all__ = [
+    "decode_attention_reference",
+    "prefill_attention",
+    "rms_norm",
+    "apply_rope",
+    "rope_angles",
+    "sample_token",
+]

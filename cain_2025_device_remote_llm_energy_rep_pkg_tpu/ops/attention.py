@@ -1,0 +1,75 @@
+"""Reference (pure-jnp) attention: causal prefill and single-step decode.
+
+These are the numerically-trusted implementations the Pallas kernel
+(``pallas_attention.py``) is validated against (SURVEY.md §7 names that
+correctness check as risk #1). Both handle grouped-query attention (every
+reference model family except phi3/gemma:7b uses GQA).
+
+Layouts (head-dim last for the MXU; the cache keeps each head's KV rows
+contiguous in T so decode's HBM reads are sequential bursts):
+  q (prefill): [B, S, Hq, D]     q (decode): [B, Hq, D]
+  k/v cache:   [B, Hkv, T, D]    lengths:    [B] int32 (valid cache prefix)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _group_heads(q: jnp.ndarray, n_kv_heads: int) -> jnp.ndarray:
+    """[..., Hq, D] → [..., Hkv, G, D] where G = Hq // Hkv."""
+    *lead, hq, d = q.shape
+    group = hq // n_kv_heads
+    return q.reshape(*lead, n_kv_heads, group, d)
+
+
+def prefill_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Full self-attention over a prompt. q:[B,S,Hq,D] k,v:[B,S,Hkv,D]."""
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    qg = _group_heads(q, hkv).astype(jnp.float32)  # [B,S,Hkv,G,D]
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # scores: [B,Hkv,G,S,S']
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, kf) * scale
+    if causal:
+        qpos = jnp.arange(s)[:, None]
+        kpos = jnp.arange(s)[None, :]
+        scores = jnp.where(kpos <= qpos, scores, -jnp.inf)
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, vf)
+    return out.reshape(b, s, hq, d).astype(q.dtype)
+
+
+def decode_attention_reference(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """One decode step against the KV cache.
+
+    q:[B,Hq,D], caches:[B,Hkv,T,D], lengths:[B] — positions >= length are
+    masked out (the cache is a fixed-size buffer, only a prefix is valid).
+    """
+    b, hq, d = q.shape
+    hkv, t = k_cache.shape[1], k_cache.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    qg = _group_heads(q, hkv).astype(jnp.float32)  # [B,Hkv,G,D]
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bktd->bkgt", qg, kf) * scale  # [B,Hkv,G,T]
+    valid = jnp.arange(t)[None, None, None, :] < lengths[:, None, None, None]
+    scores = jnp.where(valid, scores, -jnp.inf)
+    probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bkgt,bktd->bkgd", probs, vf)
+    return out.reshape(b, hq, d).astype(q.dtype)

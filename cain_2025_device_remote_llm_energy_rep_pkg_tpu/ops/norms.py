@@ -1,0 +1,25 @@
+"""Normalisation layers (RMSNorm — used by all 7 reference model families)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-6,
+    *,
+    gemma_style: bool = False,
+) -> jnp.ndarray:
+    """RMSNorm in float32 accumulation, cast back to the input dtype.
+
+    ``gemma_style`` multiplies by ``(1 + weight)`` (Gemma initialises the gain
+    around zero); the Llama/Qwen/Mistral/Phi families use ``weight`` directly.
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    gain = (1.0 + weight.astype(jnp.float32)) if gemma_style else weight.astype(jnp.float32)
+    return (normed * gain).astype(dtype)

@@ -1,0 +1,161 @@
+"""Pallas TPU flash-decode attention over the KV cache.
+
+The hot op of autoregressive decode (BASELINE.json north star: "Pallas
+paged-KV attention"). One query token attends to the cache's valid prefix,
+processed in T-blocks ("pages") with an online-softmax accumulator so only
+one [block_t, D] tile of K and V is resident in VMEM at a time:
+
+  grid = (B, Hkv, T/block_t)   # T innermost → sequential accumulation
+  per block: s = q·kᵀ (MXU, f32 acc) → masked online softmax →
+             acc = acc·α + p·v; final block writes acc/l.
+
+Decode is HBM-bandwidth-bound (every step streams the whole cache), which is
+why the cache layout keeps each head's T rows contiguous ([B,Hkv,T,D]) —
+block DMAs are pure sequential bursts.
+
+Correctness is pinned to ``ops.attention.decode_attention_reference`` (the
+validation SURVEY.md §7 lists as risk #1). On non-TPU backends the kernel
+runs in interpret mode, so the same code path is exercised by CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_block_t(t: int, preferred: int = 512) -> int:
+    """Largest power-of-two divisor of t, capped at ``preferred``."""
+    block = 1
+    while t % (block * 2) == 0 and block * 2 <= preferred:
+        block *= 2
+    return block
+
+
+def _decode_kernel(
+    lengths_ref,  # SMEM [B] int32 (scalar-prefetched)
+    q_ref,  # VMEM [1,1,G,D]
+    k_ref,  # VMEM [1,1,block_t,D]
+    v_ref,  # VMEM [1,1,block_t,D]
+    o_ref,  # VMEM [1,1,G,D]
+    m_ref,  # VMEM scratch [G,128] f32 (running max, lane-replicated)
+    l_ref,  # VMEM scratch [G,128] f32 (running denominator)
+    acc_ref,  # VMEM scratch [G,D] f32
+    *,
+    block_t: int,
+    n_blocks: int,
+    scale: float,
+):
+    b_i = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[b_i]
+    block_start = j * block_t
+
+    @pl.when(block_start < length)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G,D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [Tb,D]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # [G,Tb]
+        idx = block_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(idx < length, s, -jnp.inf)
+
+        m_prev = m_ref[:, :1]  # [G,1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [G,Tb]
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)  # [Tb,D]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [G,D]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_blocks - 1)
+    def _finalise():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def pallas_decode_attention(
+    q: jnp.ndarray,  # [B,Hq,D]
+    k_cache: jnp.ndarray,  # [B,Hkv,T,D]
+    v_cache: jnp.ndarray,  # [B,Hkv,T,D]
+    lengths: jnp.ndarray,  # [B] int32
+    *,
+    block_t: int = 512,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash-decode attention; drop-in for ``decode_attention_reference``."""
+    b, hq, d = q.shape
+    _, hkv, t, _ = k_cache.shape
+    group = hq // hkv
+    scale = 1.0 / math.sqrt(d)  # pre-padding head dim sets the scale
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    # Lane-align the head dim: zero-pad D to a multiple of 128 (zeros add
+    # nothing to q·k and project to zero output columns, sliced off below).
+    d_pad = (-d) % 128
+    if d_pad:
+        pad4 = ((0, 0), (0, 0), (0, 0), (0, d_pad))
+        q = jnp.pad(q.reshape(b, hkv, group, d), pad4)
+        k_cache = jnp.pad(k_cache, pad4)
+        v_cache = jnp.pad(v_cache, pad4)
+        dp = d + d_pad
+    else:
+        q = q.reshape(b, hkv, group, d)
+        dp = d
+
+    bt = min(_pick_block_t(t, block_t), t)
+    n_blocks = t // bt
+
+    kernel = functools.partial(
+        _decode_kernel, block_t=bt, n_blocks=n_blocks, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv, n_blocks),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, dp), lambda b_i, h, j, L: (b_i, h, 0, 0)),
+                pl.BlockSpec((1, 1, bt, dp), lambda b_i, h, j, L: (b_i, h, j, 0)),
+                pl.BlockSpec((1, 1, bt, dp), lambda b_i, h, j, L: (b_i, h, j, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, group, dp), lambda b_i, h, j, L: (b_i, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, dp), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, dp), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q, k_cache, v_cache)
+
+    if d_pad:
+        out = out[..., :d]
+    return out.reshape(b, hq, d)

@@ -1,0 +1,41 @@
+"""Rotary position embeddings (GPT-NeoX half-rotation layout).
+
+All 7 reference model families use RoPE with per-family ``rope_theta``
+(e.g. llama3.1 5e5, qwen2 1e6). Angles are computed in float32 and applied as
+a half-split rotation: x = [x1, x2] → [x1·cos − x2·sin, x2·cos + x1·sin].
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def rope_angles(
+    positions: jnp.ndarray, d_head: int, theta: float
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for integer positions; shapes [..., d_head//2]."""
+    half = d_head // 2
+    freqs = jnp.exp(
+        -jnp.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate the head dimension. x: [..., n_heads, d_head]; cos/sin broadcast
+    over the head axis as [..., 1, d_head//2]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    x1f = x1.astype(jnp.float32)
+    x2f = x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1f * cos - x2f * sin, x2f * cos + x1f * sin], axis=-1
+    )
+    return out.astype(x.dtype)

@@ -1,0 +1,35 @@
+"""Token sampling: greedy, temperature, top-k — all jit/scan-safe.
+
+Static-shape friendly: every path returns an int32 token id and the branch is
+selected by traced values only (temperature == 0 → greedy via lax.select), so
+one compiled decode loop serves all sampling settings.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray | float,
+    top_k: int = 0,
+) -> jnp.ndarray:
+    """Sample the next token id from ``logits`` [..., vocab].
+
+    ``temperature`` may be a traced scalar; 0 (or <1e-6) means greedy.
+    ``top_k`` is a *static* int (0 disables) because it changes the lattice of
+    the computation.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temperature = jnp.asarray(temperature, dtype=jnp.float32)
+    safe_t = jnp.maximum(temperature, 1e-6)
+    scaled = logits / safe_t
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jax.lax.select(temperature < 1e-6, greedy, sampled)

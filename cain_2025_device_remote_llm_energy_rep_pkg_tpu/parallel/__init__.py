@@ -1,0 +1,24 @@
+"""Parallelism: device meshes, sharding rules, TP serving, sharded training.
+
+The reference has **no** parallelism or collective backend (SURVEY.md §2
+"Parallelism & communication"): its "remote" treatment is one HTTP POST to an
+Ollama server. BASELINE.json's north star replaces that with a tensor-parallel
+TPU slice: ``jax.sharding.Mesh`` + NamedSharding placement lets XLA insert
+all-gather/reduce-scatter over ICI for the same model code, and
+``jax.distributed`` covers the multi-host/DCN hop the reference's LAN HTTP
+request represented.
+
+Everything here is mesh-shape-agnostic: tests and the driver's dry run use
+``--xla_force_host_platform_device_count=8`` virtual CPU devices.
+"""
+
+from .mesh import MeshSpec, build_mesh
+from .sharding import param_shardings, cache_shardings, shard_model
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "param_shardings",
+    "cache_shardings",
+    "shard_model",
+]

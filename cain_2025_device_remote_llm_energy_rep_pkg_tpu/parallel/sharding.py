@@ -1,0 +1,93 @@
+"""Sharding rules for the transformer parameter/cache pytrees.
+
+Megatron-style tensor parallelism expressed as GSPMD placement: annotate the
+weights with NamedSharding over the ``tp`` axis and jit the *unchanged*
+forward function — XLA inserts the all-gather/reduce-scatter collectives over
+ICI (the scaling-book recipe: pick a mesh, annotate, let XLA do the rest).
+
+Layout (param leaves carry a leading stacked-layer axis L):
+  wq/wk/wv  [L, D, H·Dh]   → shard the head (output) dim over tp
+  wo        [L, H·Dh, D]   → shard the head (input) dim over tp  (psum after)
+  w_gate/up [L, D, F]      → shard F over tp
+  w_down    [L, F, D]      → shard F over tp                      (psum after)
+  embed     [V, D]         → shard V over tp (logits gather over vocab shards)
+  KV cache  [L, B, Hkv, T, Dh] → shard Hkv over tp when divisible, else
+                                  replicate (MQA/small-GQA caches are tiny)
+  norms / biases           → replicated
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+
+def _tp_size(mesh: Mesh) -> int:
+    return mesh.shape.get("tp", 1)
+
+
+def param_specs(cfg: ModelConfig, mesh: Mesh) -> Dict[str, P]:
+    """PartitionSpec per parameter leaf (leading axis L is never sharded)."""
+    tp = _tp_size(mesh)
+
+    def div(n: int) -> bool:
+        return tp > 1 and n % tp == 0
+
+    specs: Dict[str, P] = {
+        "embed": P("tp", None) if div(cfg.vocab_size) else P(),
+        "attn_norm": P(),
+        "mlp_norm": P(),
+        "final_norm": P(),
+        "wq": P(None, None, "tp") if div(cfg.n_heads * cfg.d_head) else P(),
+        "wk": P(None, None, "tp") if div(cfg.n_kv_heads * cfg.d_head) else P(),
+        "wv": P(None, None, "tp") if div(cfg.n_kv_heads * cfg.d_head) else P(),
+        "wo": P(None, "tp", None) if div(cfg.n_heads * cfg.d_head) else P(),
+        "w_gate": P(None, None, "tp") if div(cfg.d_ff) else P(),
+        "w_up": P(None, None, "tp") if div(cfg.d_ff) else P(),
+        "w_down": P(None, "tp", None) if div(cfg.d_ff) else P(),
+    }
+    if cfg.qkv_bias:
+        specs["bq"] = P(None, "tp") if div(cfg.n_heads * cfg.d_head) else P()
+        specs["bk"] = P(None, "tp") if div(cfg.n_kv_heads * cfg.d_head) else P()
+        specs["bv"] = P(None, "tp") if div(cfg.n_kv_heads * cfg.d_head) else P()
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp") if div(cfg.vocab_size) else P()
+    return specs
+
+
+def param_shardings(
+    cfg: ModelConfig, mesh: Mesh
+) -> Dict[str, NamedSharding]:
+    return {
+        name: NamedSharding(mesh, spec)
+        for name, spec in param_specs(cfg, mesh).items()
+    }
+
+
+def cache_spec(cfg: ModelConfig, mesh: Mesh, batch_axis: str | None = None) -> P:
+    """KV cache [L, B, Hkv, T, Dh]: heads over tp, optionally batch over dp."""
+    tp = _tp_size(mesh)
+    head_axis = "tp" if tp > 1 and cfg.n_kv_heads % tp == 0 else None
+    return P(None, batch_axis, head_axis, None, None)
+
+
+def cache_shardings(
+    cfg: ModelConfig, mesh: Mesh, batch_axis: str | None = None
+) -> NamedSharding:
+    return NamedSharding(mesh, cache_spec(cfg, mesh, batch_axis))
+
+
+def shard_model(params: Dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Dict[str, Any]:
+    """Place an existing params pytree onto the mesh per the TP rules."""
+    shardings = param_shardings(cfg, mesh)
+    return {
+        name: jax.device_put(leaf, shardings[name]) for name, leaf in params.items()
+    }
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,53 @@
+"""Tensor-parallel generation engine over a device mesh.
+
+BASELINE.json's "remote" treatment: where the reference POSTs to an Ollama
+server on a second machine (experiment/RunnerConfig.py:122-131), here the
+request is served by a TPU slice running Megatron-style TP decode. The model
+code is unchanged — params/caches carry NamedShardings (rules in
+``sharding.py``) and jit's SPMD partitioner inserts the ICI collectives.
+
+On the single-chip (or CPU) dev environment the same class runs with a 1- or
+8-virtual-device mesh, so the treatment is exercised everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..engine.jax_engine import JaxEngine
+from ..models.config import ModelConfig
+from .mesh import MeshSpec, build_mesh
+from .sharding import cache_shardings, shard_model
+
+
+class TensorParallelEngine(JaxEngine):
+    """JaxEngine with params and KV caches sharded over the mesh's ``tp`` axis."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, **kwargs) -> None:
+        super().__init__(**kwargs)
+        self.mesh = mesh if mesh is not None else build_mesh(MeshSpec.tp_only())
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def load_model(self, model: str) -> None:
+        already = model in self._models
+        super().load_model(model)
+        if not already:
+            tf = self._models[model]
+            tf.params = shard_model(tf.params, tf.cfg, self.mesh)
+            jax.block_until_ready(tf.params)
+
+    def _place_cache(
+        self, k_cache: jnp.ndarray, v_cache: jnp.ndarray, cfg: ModelConfig
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        sharding = cache_shardings(cfg, self.mesh)
+        return (
+            jax.device_put(k_cache, sharding),
+            jax.device_put(v_cache, sharding),
+        )

@@ -1,0 +1,91 @@
+"""Sharded training step (dp × tp) for the transformer.
+
+The reference never trains (it measures inference energy), but the framework
+is mandated to scale like a real TPU framework (task brief: the driver
+dry-runs the FULL training step over an n-device mesh). The step is plain
+next-token cross-entropy + Adam, jitted with NamedSharding-annotated params
+(tp rules from ``sharding.py``) and batch sharded over ``dp`` — XLA turns the
+dp axis into gradient psums and the tp axis into Megatron-style
+all-gather/reduce-scatter over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.transformer import forward, logits_for
+from .sharding import param_shardings, shard_model
+
+Params = Dict[str, Any]
+
+
+def next_token_loss(
+    params: Params, cfg: ModelConfig, tokens: jnp.ndarray, k0, v0
+) -> jnp.ndarray:
+    """Mean cross-entropy of predicting tokens[:,1:] from tokens[:,:-1]."""
+    inputs = tokens[:, :-1]
+    targets = tokens[:, 1:]
+    hidden, _, _ = forward(params, cfg, inputs, jnp.int32(0), k0, v0, None)
+    logits = logits_for(params, cfg, hidden)  # [B,S-1,V] f32
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    learning_rate: float = 1e-4,
+    remat: bool = True,
+):
+    """Returns (init_fn, step_fn) with shardings baked in.
+
+    ``remat`` wraps the loss in ``jax.checkpoint`` — the standard
+    FLOPs-for-HBM trade for long sequences.
+    """
+    optimizer = optax.adam(learning_rate)
+    p_shardings = param_shardings(cfg, mesh)
+    batch_sharding = NamedSharding(mesh, P("dp" if "dp" in mesh.shape else None, None))
+
+    loss_fn = next_token_loss
+    if remat:
+        loss_fn = jax.checkpoint(
+            functools.partial(next_token_loss), static_argnums=(1,)
+        )
+
+    def init_fn(params: Params) -> Tuple[Params, Any]:
+        params = shard_model(params, cfg, mesh)
+        opt_state = jax.jit(
+            optimizer.init,
+        )(params)
+        return params, opt_state
+
+    @functools.partial(
+        jax.jit,
+        donate_argnums=(0, 1),
+    )
+    def step_fn(params: Params, opt_state, tokens: jnp.ndarray):
+        # Empty caches: training attends within the sequence only. Cache T
+        # equals the input length so the causal mask covers exactly S tokens.
+        b, s = tokens.shape
+        cache_shape = (cfg.n_layers, b, cfg.n_kv_heads, s - 1, cfg.d_head)
+        k0 = jnp.zeros(cache_shape, dtype=jnp.bfloat16)
+        v0 = jnp.zeros(cache_shape, dtype=jnp.bfloat16)
+        loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens, k0, v0)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        params = jax.lax.with_sharding_constraint(params, p_shardings)
+        return params, opt_state, loss
+
+    def step(params, opt_state, tokens):
+        tokens = jax.device_put(tokens, batch_sharding)
+        return step_fn(params, opt_state, tokens)
+
+    return init_fn, step

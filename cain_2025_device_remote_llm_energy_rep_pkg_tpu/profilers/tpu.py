@@ -1,0 +1,128 @@
+"""TPU energy profilers.
+
+The reference measures client-side Joules with CodeCarbon and GPU utilisation
+with macOS powermetrics (experiment/RunnerConfig.py:135-178). On Cloud TPU
+there is no userspace power file, so two profilers are provided:
+
+- :class:`TpuPowerCounterProfiler` — samples real device power when a counter
+  source is available (libtpu's metric service / ``tpu-info``-style sources),
+  degrading to None columns when it isn't (this tunneled single-chip
+  environment exposes none).
+- :class:`TpuEnergyModelProfiler` — a deterministic first-principles model:
+  the workload records its achieved FLOPs and wall-time into
+  ``context.scratch['generation_stats']`` and energy is
+  ``P_idle·t + (util)·(P_peak−P_idle)·t`` with utilisation = achieved/peak
+  FLOP/s. Explicitly labelled ``energy_model_J`` so modelled Joules are never
+  confused with measured ones.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..runner.context import RunContext
+from .base import Profiler, SamplingProfiler, integrate_power_to_joules
+
+# Public v5e figures: 394 bf16 TFLOP/s peak per chip; chip power envelope in
+# the low-200s W under load, tens of W idling. Overridable per instance.
+V5E_PEAK_BF16_TFLOPS = 394.0
+V5E_PEAK_W = 200.0
+V5E_IDLE_W = 55.0
+
+
+def _try_read_power_w() -> Optional[float]:
+    """Attempt to read instantaneous device power in Watts. Returns None when
+    no source exists (the common case off-Borg; kept as the single place a
+    real counter source plugs into)."""
+    try:  # pragma: no cover - environment-dependent
+        from tpu_info import metrics  # type: ignore
+
+        readings = metrics.get_chip_power()
+        if readings:
+            return float(sum(readings))
+    except Exception:
+        pass
+    return None
+
+
+class TpuPowerCounterProfiler(SamplingProfiler):
+    """Real power sampling at ``period_s`` when a counter source exists."""
+
+    data_columns = ("tpu_energy_J", "tpu_avg_power_W")
+    artifact_name = "tpu_power"
+
+    def __init__(self, period_s: float = 0.1) -> None:
+        super().__init__(period_s=period_s)
+
+    @property
+    def available(self) -> bool:
+        return _try_read_power_w() is not None
+
+    def sample(self) -> Dict[str, Any]:
+        return {"power_W": _try_read_power_w()}
+
+    def summarise(self, samples: List[Dict[str, Any]]) -> Dict[str, Any]:
+        joules = integrate_power_to_joules(samples, "power_W")
+        if joules == 0.0 and not any(s.get("power_W") for s in samples):
+            return {"tpu_energy_J": None, "tpu_avg_power_W": None}
+        span = samples[-1]["t_s"] - samples[0]["t_s"] if len(samples) > 1 else 0.0
+        return {
+            "tpu_energy_J": round(joules, 4),
+            "tpu_avg_power_W": round(joules / span, 3) if span > 0 else None,
+        }
+
+
+class TpuEnergyModelProfiler(Profiler):
+    """Deterministic modelled energy from the run's generation stats.
+
+    The workload must put ``{"flops": float, "duration_s": float,
+    "generated_tokens": int}`` into ``context.scratch["generation_stats"]``
+    before POPULATE_RUN_DATA (the experiment config does this from the
+    engine's GenerationResult).
+    """
+
+    data_columns = ("energy_model_J", "joules_per_token", "tpu_util_est")
+
+    def __init__(
+        self,
+        peak_tflops: float = V5E_PEAK_BF16_TFLOPS,
+        peak_w: float = V5E_PEAK_W,
+        idle_w: float = V5E_IDLE_W,
+        n_chips: int = 1,
+    ) -> None:
+        self.peak_flops = peak_tflops * 1e12
+        self.peak_w = peak_w
+        self.idle_w = idle_w
+        self.n_chips = n_chips
+        self._t0 = 0.0
+        self._window_s = 0.0
+
+    def on_start(self, context: RunContext) -> None:
+        self._t0 = time.monotonic()
+
+    def on_stop(self, context: RunContext) -> None:
+        self._window_s = time.monotonic() - self._t0
+
+    def collect(self, context: RunContext) -> Dict[str, Any]:
+        stats = context.scratch.get("generation_stats")
+        if not stats:
+            return {
+                "energy_model_J": None,
+                "joules_per_token": None,
+                "tpu_util_est": None,
+            }
+        duration = float(stats.get("duration_s") or self._window_s)
+        flops = float(stats.get("flops", 0.0))
+        tokens = int(stats.get("generated_tokens", 0))
+        peak = self.peak_flops * self.n_chips
+        util = min(flops / (peak * duration), 1.0) if duration > 0 else 0.0
+        energy = (
+            self.idle_w * self.n_chips * duration
+            + util * (self.peak_w - self.idle_w) * self.n_chips * duration
+        )
+        return {
+            "energy_model_J": round(energy, 4),
+            "joules_per_token": round(energy / tokens, 4) if tokens else None,
+            "tpu_util_est": round(util, 4),
+        }

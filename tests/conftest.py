@@ -7,9 +7,27 @@ slices; the accelerator-free kernel tests don't touch JAX at all.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): this environment globally sets JAX_PLATFORMS=axon
+# (the real-TPU tunnel); tests must run on virtual CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# XLA:CPU compiles each distinct op shape at ~0.5-1 s in this environment;
+# a warm persistent cache cuts the suite from minutes to seconds.
+import jax  # noqa: E402
+
+# The axon sitecustomize force-selects jax_platforms="axon,cpu" (real-TPU
+# tunnel) regardless of the env var; the config update below beats it so
+# tests run on the 8 virtual CPU devices and never touch the one real chip.
+jax.config.update("jax_platforms", "cpu")
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# This environment's default matmul precision truncates f32 matmul inputs to
+# bf16 (observed ~6e-3 abs error on unit-scale data), which would drown the
+# numerical parity tests; force full f32 for tests only.
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,160 @@
+"""Statistics primitives and the analysis pipeline."""
+
+import math
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.analysis.pipeline import (
+    analyze,
+    analyze_experiment,
+    apply_iqr_filter,
+    render_markdown,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.analysis.stats import (
+    cliffs_delta,
+    descriptives,
+    iqr_mask,
+    significance_stars,
+    spearman,
+    wilcoxon_rank_sum,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.persistence import (
+    RunTableStore,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.progress import RunProgress
+
+scipy = pytest.importorskip("scipy")
+
+
+def test_iqr_mask_flags_outliers():
+    values = [10.0] * 20 + [1000.0]
+    mask = iqr_mask(values)
+    assert mask[:-1].all() and not mask[-1]
+
+
+def test_descriptives():
+    d = descriptives([1.0, 2.0, 3.0, 4.0, None])
+    assert d.n == 4 and d.mean == 2.5 and d.median == 2.5
+    assert d.minimum == 1.0 and d.maximum == 4.0
+    empty = descriptives([])
+    assert empty.n == 0 and math.isnan(empty.mean)
+
+
+def test_cliffs_delta_extremes_and_labels():
+    delta, mag = cliffs_delta([10, 11, 12], [1, 2, 3])
+    assert delta == 1.0 and mag == "large"
+    delta, mag = cliffs_delta([1, 2, 3], [10, 11, 12])
+    assert delta == -1.0 and mag == "large"
+    delta, mag = cliffs_delta([1, 2, 3, 4], [1, 2, 3, 4])
+    assert delta == 0.0 and mag == "negligible"
+
+
+def test_cliffs_delta_matches_bruteforce():
+    import random
+
+    rng = random.Random(0)
+    a = [rng.gauss(0, 1) for _ in range(40)]
+    b = [rng.gauss(0.5, 1) for _ in range(30)]
+    delta, _ = cliffs_delta(a, b)
+    brute = sum(
+        (1 if x > y else -1 if x < y else 0) for x in a for y in b
+    ) / (len(a) * len(b))
+    assert delta == pytest.approx(brute, abs=1e-12)
+
+
+def test_wilcoxon_detects_shift():
+    a = [i + 100 for i in range(30)]
+    b = list(range(30))
+    _, p = wilcoxon_rank_sum(a, b)
+    assert p < 1e-6
+
+
+def test_spearman_monotone():
+    xs = list(range(20))
+    ys = [x**2 for x in xs]
+    rho, p = spearman(xs, ys)
+    assert rho == pytest.approx(1.0)
+    assert p < 1e-6
+    rho, _ = spearman([1, None, 3], [1, 2, None])
+    assert math.isnan(rho)
+
+
+def test_significance_stars():
+    assert significance_stars(0.0001) == "***"
+    assert significance_stars(0.004) == "**"
+    assert significance_stars(0.04) == "*"
+    assert significance_stars(0.5) == ""
+
+
+def _synthetic_rows(n_per_cell=20):
+    import random
+
+    # Cell means stay within one global IQR fence of each other (the pipeline
+    # filters per metric over the whole table, like notebook cell 11).
+    rng = random.Random(7)
+    rows = []
+    i = 0
+    for location, base in (("on_device", 100.0), ("remote", 50.0)):
+        for length in (100, 200):
+            for _ in range(n_per_cell):
+                energy = base * (length / 100) * rng.uniform(0.9, 1.1)
+                rows.append(
+                    {
+                        "__run_id": f"run_{i}_repetition_0",
+                        "__done": RunProgress.DONE,
+                        "model": "m",
+                        "location": location,
+                        "length": length,
+                        "energy_J": round(energy, 3),
+                        "execution_time_s": round(energy / 10, 3),
+                        "cpu_usage": rng.uniform(1, 5),
+                        "memory_usage": 50.0,
+                        "tokens_per_s": 100.0,
+                    }
+                )
+                i += 1
+    return rows
+
+
+def test_analyze_h1_recovers_energy_ratio():
+    rows = _synthetic_rows()
+    report = analyze(rows)
+    h1 = report["h1_energy_by_length"]
+    assert set(h1) == {"100", "200"}
+    for h in h1.values():
+        assert h["p"] < 1e-4
+        assert h["magnitude"] == "large"
+        assert h["mean_ratio"] == pytest.approx(2.0, rel=0.1)
+    # energy correlates with exec time perfectly (it's energy/10)
+    assert report["h2_spearman"]["on_device"]["execution_time_s"]["rho"] == pytest.approx(1.0)
+
+
+def test_apply_iqr_filter_drops_rows():
+    rows = _synthetic_rows(n_per_cell=10)
+    rows[0]["energy_J"] = 1e9
+    filtered = apply_iqr_filter(rows, ["energy_J"])
+    assert len(filtered) == len(rows) - 1
+
+
+def test_apply_iqr_filter_keeps_rows_with_missing_values():
+    rows = _synthetic_rows(n_per_cell=10)
+    rows[3]["energy_J"] = None  # missing ≠ outlier
+    filtered = apply_iqr_filter(rows, ["energy_J"])
+    assert len(filtered) == len(rows)
+
+
+def test_analyze_experiment_writes_reports(tmp_path):
+    rows = _synthetic_rows(n_per_cell=8)
+    store = RunTableStore(tmp_path)
+    store.write(rows)
+    report = analyze_experiment(tmp_path)
+    assert (tmp_path / "analysis_report.json").exists()
+    md = (tmp_path / "analysis_report.md").read_text()
+    assert "H1: energy" in md and "Spearman" in md
+    assert report["n_rows"] == len(rows)
+
+
+def test_render_markdown_handles_empty_subsets():
+    report = analyze(_synthetic_rows(n_per_cell=5))
+    md = render_markdown(report)
+    assert md.startswith("# Experiment analysis")

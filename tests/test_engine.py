@@ -1,0 +1,130 @@
+"""Generation backends: fake determinism, JAX engine end-to-end on tiny models."""
+
+import jax.numpy as jnp
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import FakeBackend
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+    GEN_BUCKETS,
+    PROMPT_BUCKETS,
+    JaxEngine,
+    _bucket,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+
+
+def test_fake_backend_is_deterministic():
+    be = FakeBackend()
+    req = GenerationRequest(model="m", prompt="hello", max_new_tokens=16)
+    r1, r2 = be.generate(req), be.generate(req)
+    assert r1.tokens == r2.tokens and r1.text == r2.text
+    r3 = be.generate(
+        GenerationRequest(model="m", prompt="hello", max_new_tokens=16, seed=1)
+    )
+    assert r3.tokens != r1.tokens
+    assert r1.generated_tokens == 16
+    assert r1.tokens_per_s > 0
+
+
+def test_bucket_rounding():
+    assert _bucket(1, PROMPT_BUCKETS) == 32
+    assert _bucket(33, PROMPT_BUCKETS) == 64
+    assert _bucket(2048, GEN_BUCKETS) == 2048
+    with pytest.raises(ValueError, match="exceeds"):
+        _bucket(99999, GEN_BUCKETS)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    registry = {
+        "tiny-a": get_model_config("qwen2:1.5b").tiny(),
+        "tiny-gemma": get_model_config("gemma:2b").tiny(),
+    }
+    return JaxEngine(registry=registry, dtype=jnp.float32)
+
+
+def test_jax_engine_generates(engine):
+    req = GenerationRequest(model="tiny-a", prompt="hello tpu", max_new_tokens=12)
+    result = engine.generate(req)
+    assert result.generated_tokens <= 12
+    assert len(result.tokens) == result.generated_tokens
+    assert result.prompt_tokens == len("hello tpu".encode()) + 1
+    assert result.prefill_s > 0 and result.decode_s > 0
+    assert all(0 <= t < engine.registry["tiny-a"].vocab_size for t in result.tokens)
+
+
+def test_jax_engine_greedy_is_deterministic(engine):
+    req = GenerationRequest(model="tiny-a", prompt="abc", max_new_tokens=10)
+    assert engine.generate(req).tokens == engine.generate(req).tokens
+
+
+def test_jax_engine_seed_changes_sampled_output(engine):
+    r0 = engine.generate(
+        GenerationRequest("tiny-a", "abc", 24, temperature=1.5, seed=0)
+    )
+    r1 = engine.generate(
+        GenerationRequest("tiny-a", "abc", 24, temperature=1.5, seed=1)
+    )
+    assert r0.tokens != r1.tokens
+
+
+def test_jax_engine_compile_cache_reused(engine):
+    # same buckets → same compiled callables
+    engine.generate(GenerationRequest("tiny-a", "xy", 10))
+    n_prefill = len(engine._prefill_cache)
+    n_decode = len(engine._decode_cache)
+    engine.generate(GenerationRequest("tiny-a", "different prompt!", 12))
+    assert len(engine._prefill_cache) == n_prefill
+    assert len(engine._decode_cache) == n_decode
+    # a not-yet-seen generation bucket compiles one more decode fn
+    engine.generate(GenerationRequest("tiny-a", "xy", 60))
+    assert len(engine._decode_cache) == n_decode + 1
+
+
+def test_jax_engine_multiple_families(engine):
+    r = engine.generate(GenerationRequest("tiny-gemma", "hi", 8))
+    assert r.generated_tokens <= 8
+
+
+def test_jax_engine_generates_exactly_max_new_without_eos(engine):
+    """The decode loop must run exactly the requested steps, not the bucket
+    (timing/energy would otherwise include unrequested work)."""
+    r = engine.generate(
+        GenerationRequest("tiny-a", "count", 11, stop_at_eos=False)
+    )
+    assert r.generated_tokens == 11
+
+
+def test_jax_engine_rejects_overflowing_cache(engine):
+    with pytest.raises(ValueError, match="max_seq_len"):
+        # tiny max_seq_len is 256; 32-prompt + 256-gen buckets exceed it
+        engine.generate(GenerationRequest("tiny-a", "x", 250))
+
+
+def test_warmup_compiles_once_and_resets_on_unload():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config as gmc,
+    )
+
+    eng = JaxEngine(registry={"t": gmc("qwen2:1.5b").tiny()}, dtype=jnp.float32)
+    req = GenerationRequest("t", "warm me", 10)
+    eng.warmup(req)
+    assert len(eng._warmed) == 1
+    eng.warmup(req)  # no-op
+    assert len(eng._warmed) == 1
+    eng.unload_all()
+    assert len(eng._warmed) == 0  # fresh load must re-warm
+
+
+def test_jax_engine_unload(engine_factory=None):
+    registry = {"tiny-a": get_model_config("qwen2:1.5b").tiny()}
+    eng = JaxEngine(registry=registry, dtype=jnp.float32)
+    eng.generate(GenerationRequest("tiny-a", "x", 8))
+    assert eng._models
+    eng.unload_all()
+    assert not eng._models and not eng._decode_cache

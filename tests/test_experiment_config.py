@@ -1,0 +1,114 @@
+"""The LLM-energy study config, run hermetically on the fake backend."""
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import FakeBackend
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+    LlmEnergyConfig,
+    MODELS,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.topics import (
+    TOPICS,
+    pick_topic,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.tpu import (
+    TpuEnergyModelProfiler,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.context import RunContext
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.controller import (
+    ExperimentController,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.persistence import (
+    RunTableStore,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.progress import RunProgress
+
+
+def test_topics_pool_and_seeded_pick():
+    assert len(TOPICS) >= 100
+    assert len(set(TOPICS)) == len(TOPICS)
+    assert pick_topic(seed=42) == pick_topic(seed=42)
+    assert any(pick_topic(seed=i) != pick_topic(seed=0) for i in range(1, 10))
+
+
+def test_default_sweep_shape():
+    config = LlmEnergyConfig()
+    model = config.create_run_table_model()
+    # 7 models × 2 locations × 3 lengths (experiment/RunnerConfig.py:80-88)
+    assert len(model.variations()) == 7 * 2 * 3
+    assert len(MODELS) == 7
+    assert config.time_between_runs_in_ms == 90_000
+
+
+def test_energy_model_profiler_math(tmp_path):
+    prof = TpuEnergyModelProfiler(peak_tflops=100.0, peak_w=200.0, idle_w=50.0)
+    ctx = RunContext("r", 1, 1, {}, tmp_path, tmp_path)
+    ctx.scratch["generation_stats"] = {
+        "flops": 50.0e12,  # half of peak over 1 s → util 0.5
+        "duration_s": 1.0,
+        "generated_tokens": 100,
+    }
+    prof.on_start(ctx)
+    prof.on_stop(ctx)
+    data = prof.collect(ctx)
+    # 50 W idle + 0.5·150 W active = 125 J over 1 s
+    assert data["energy_model_J"] == pytest.approx(125.0)
+    assert data["joules_per_token"] == pytest.approx(1.25)
+    assert data["tpu_util_est"] == 0.5
+
+
+def test_energy_model_profiler_without_stats(tmp_path):
+    prof = TpuEnergyModelProfiler()
+    ctx = RunContext("r", 1, 1, {}, tmp_path, tmp_path)
+    prof.on_start(ctx)
+    prof.on_stop(ctx)
+    assert prof.collect(ctx)["energy_model_J"] is None
+
+
+def _hermetic_config(tmp_path, **kw):
+    fake = FakeBackend(tokens_per_s=5000.0)
+    return LlmEnergyConfig(
+        models=["qwen2:1.5b", "gemma:2b"],
+        locations=["on_device", "remote"],
+        lengths=[100],
+        repetitions=2,
+        results_output_path=tmp_path,
+        cooldown_ms=0,
+        backends={"on_device": fake, "remote": fake},
+        shuffle=True,
+        **kw,
+    )
+
+
+def test_full_study_lifecycle_on_fake_backend(tmp_path):
+    config = _hermetic_config(tmp_path)
+    ExperimentController(config, echo=False).do_experiment()
+    rows = RunTableStore(tmp_path / "llm_energy_tpu").read()
+    assert len(rows) == 2 * 2 * 1 * 2
+    assert all(r["__done"] == RunProgress.DONE for r in rows)
+    for row in rows:
+        assert row["topic"] in TOPICS
+        assert row["generated_tokens"] == 134  # ceil(100 * 4/3)
+        assert row["execution_time_s"] > 0
+        assert row["tokens_per_s"] > 0
+        assert row["cpu_usage"] is not None  # host profiler columns present
+    # analysis report written by after_experiment
+    assert (tmp_path / "llm_energy_tpu" / "analysis_report.json").exists()
+
+
+def test_study_resume_reuses_topic(tmp_path):
+    config = _hermetic_config(tmp_path)
+    ctrl = ExperimentController(config, echo=False)
+    first_id = ctrl.rows[0]["__run_id"]
+    ctrl.do_experiment()
+    stored = {r["__run_id"]: r["topic"] for r in ctrl.store.read()}
+    # same run id → same seeded topic on a fresh config instance
+    import zlib
+
+    config2 = _hermetic_config(tmp_path)
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.topics import (
+        pick_topic as pick,
+    )
+
+    topic2 = pick(seed=zlib.crc32(f"{config2.seed}|{first_id}".encode()))
+    assert stored[first_id] == topic2

@@ -1,0 +1,125 @@
+"""Numeric ops: RoPE, RMSNorm, attention reference implementations."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.attention import (
+    decode_attention_reference,
+    prefill_attention,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.norms import rms_norm
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.rope import (
+    apply_rope,
+    rope_angles,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.sampling import sample_token
+
+
+def test_rope_identity_at_position_zero():
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 4, 16))
+    cos, sin = rope_angles(jnp.zeros((1, 1), dtype=jnp.int32), 16, 10_000.0)
+    np.testing.assert_allclose(apply_rope(x, cos, sin), x, atol=1e-6)
+
+
+def test_rope_preserves_norm():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 4, 32))
+    pos = jnp.arange(3, dtype=jnp.int32)[None, :].repeat(2, axis=0)
+    cos, sin = rope_angles(pos, 32, 10_000.0)
+    rotated = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        jnp.linalg.norm(rotated, axis=-1),
+        jnp.linalg.norm(x, axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_rope_relative_phase():
+    """q·k after RoPE depends only on relative distance."""
+    d = 16
+    q = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, d))
+    k = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, d))
+
+    def dot_at(p_q, p_k):
+        cq, sq = rope_angles(jnp.array([[p_q]], dtype=jnp.int32), d, 10_000.0)
+        ck, sk = rope_angles(jnp.array([[p_k]], dtype=jnp.int32), d, 10_000.0)
+        return float(jnp.sum(apply_rope(q, cq, sq) * apply_rope(k, ck, sk)))
+
+    assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-5)
+    assert dot_at(5, 3) != pytest.approx(dot_at(5, 4), rel=1e-3)
+
+
+def test_rms_norm_unit_variance():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 64)) * 5.0
+    out = rms_norm(x, jnp.ones((64,)))
+    rms = jnp.sqrt(jnp.mean(out**2, axis=-1))
+    np.testing.assert_allclose(rms, 1.0, rtol=1e-3)
+
+
+def test_rms_norm_gemma_style_zero_weight_is_identity_gain():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+    plain = rms_norm(x, jnp.ones((64,)))
+    gemma = rms_norm(x, jnp.zeros((64,)), gemma_style=True)
+    np.testing.assert_allclose(plain, gemma, atol=1e-6)
+
+
+def test_prefill_attention_is_causal():
+    """Changing a future token must not change earlier outputs."""
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (1, 6, 4, 16)) for i in range(3))
+    out1 = prefill_attention(q, k, v)
+    k2 = k.at[:, -1].set(99.0)
+    v2 = v.at[:, -1].set(99.0)
+    out2 = prefill_attention(q, k2, v2)
+    np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], atol=1e-5)
+    assert not np.allclose(out1[:, -1], out2[:, -1])
+
+
+def test_decode_matches_prefill_last_position():
+    """Single-step decode vs the cache == last row of full prefill."""
+    b, s, hq, hkv, d = 2, 5, 8, 2, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, hq, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d))
+    full = prefill_attention(q, k, v)
+    # cache layout [B,Hkv,T,D]: s valid entries, padded to a bigger buffer
+    t = 12
+    as_cache = lambda x: jnp.pad(  # noqa: E731
+        x.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, t - s), (0, 0))
+    )
+    single = decode_attention_reference(
+        q[:, -1], as_cache(k), as_cache(v), jnp.full((b,), s, dtype=jnp.int32)
+    )
+    np.testing.assert_allclose(single, full[:, -1], atol=1e-5)
+
+
+def test_decode_attention_ignores_cache_garbage():
+    b, hq, hkv, d, t = 1, 4, 4, 8, 10
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, hq, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, hkv, t, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, hkv, t, d))
+    lengths = jnp.array([4], dtype=jnp.int32)
+    out1 = decode_attention_reference(q, k, v, lengths)
+    k2 = k.at[:, :, 4:].set(1e6)
+    v2 = v.at[:, :, 4:].set(-1e6)
+    out2 = decode_attention_reference(q, k2, v2, lengths)
+    np.testing.assert_allclose(out1, out2, atol=1e-6)
+
+
+def test_sample_token_greedy_and_temperature():
+    logits = jnp.array([[0.1, 5.0, 0.2, 0.0]])
+    key = jax.random.PRNGKey(0)
+    assert int(sample_token(logits, key, 0.0)[0]) == 1
+    # high temperature: over many keys, should not always pick argmax
+    sampler = jax.jit(lambda k: sample_token(logits, k, 5.0))
+    picks = {int(sampler(jax.random.PRNGKey(i))[0]) for i in range(20)}
+    assert len(picks) > 1
+    # top_k=1 is greedy regardless of temperature
+    assert int(sample_token(logits, key, 5.0, top_k=1)[0]) == 1
+
+
+def test_sample_token_jit_with_traced_temperature():
+    f = jax.jit(lambda lg, k, t: sample_token(lg, k, t))
+    logits = jnp.array([[0.0, 3.0]])
+    assert int(f(logits, jax.random.PRNGKey(0), jnp.float32(0.0))[0]) == 1

@@ -1,0 +1,89 @@
+"""Pallas decode kernel vs the jnp reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.attention import (
+    decode_attention_reference,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_attention import (
+    _pick_block_t,
+    pallas_decode_attention,
+)
+
+
+def _mk(b, hq, hkv, t, d, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, hq, d), dtype=dtype)
+    k = jax.random.normal(ks[1], (b, hkv, t, d), dtype=dtype)
+    v = jax.random.normal(ks[2], (b, hkv, t, d), dtype=dtype)
+    return q, k, v
+
+
+def test_pick_block_t():
+    assert _pick_block_t(4096) == 512
+    assert _pick_block_t(48) == 16
+    assert _pick_block_t(33) == 1
+    assert _pick_block_t(256, preferred=128) == 128
+
+
+@pytest.mark.parametrize(
+    "b,hq,hkv,t,d,length",
+    [
+        (1, 8, 2, 64, 16, 10),  # GQA, d needs lane padding
+        (1, 8, 1, 128, 128, 128),  # MQA, full cache, aligned d
+        (2, 4, 4, 96, 64, 33),  # MHA, batch 2, ragged block
+        (1, 4, 4, 256, 96, 200),  # phi3-style d=96
+    ],
+)
+def test_pallas_matches_reference(b, hq, hkv, t, d, length):
+    q, k, v = _mk(b, hq, hkv, t, d)
+    lengths = jnp.full((b,), length, dtype=jnp.int32)
+    ref = decode_attention_reference(q, k, v, lengths)
+    out = pallas_decode_attention(q, k, v, lengths, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_pallas_per_batch_lengths():
+    q, k, v = _mk(2, 4, 2, 64, 32)
+    lengths = jnp.array([5, 50], dtype=jnp.int32)
+    ref = decode_attention_reference(q, k, v, lengths)
+    out = pallas_decode_attention(q, k, v, lengths, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_pallas_ignores_garbage_beyond_length():
+    q, k, v = _mk(1, 4, 2, 64, 32)
+    lengths = jnp.array([7], dtype=jnp.int32)
+    out1 = pallas_decode_attention(q, k, v, lengths, interpret=True)
+    k2 = k.at[:, :, 7:].set(1e9)
+    v2 = v.at[:, :, 7:].set(-1e9)
+    out2 = pallas_decode_attention(q, k2, v2, lengths, interpret=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-6)
+
+
+def test_pallas_bf16_inputs():
+    q, k, v = _mk(1, 8, 2, 128, 64, dtype=jnp.bfloat16)
+    lengths = jnp.array([100], dtype=jnp.int32)
+    ref = decode_attention_reference(q, k, v, lengths)
+    out = pallas_decode_attention(q, k, v, lengths, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(ref, dtype=np.float32),
+        atol=3e-2,
+    )
+
+
+def test_pallas_inside_jit_and_grad_free_scan():
+    """The kernel must be traceable under jit with traced lengths."""
+    q, k, v = _mk(1, 4, 2, 64, 32)
+
+    @jax.jit
+    def f(q, k, v, lengths):
+        return pallas_decode_attention(q, k, v, lengths, interpret=True)
+
+    out = f(q, k, v, jnp.array([30], dtype=jnp.int32))
+    ref = decode_attention_reference(q, k, v, jnp.array([30], dtype=jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -1,0 +1,133 @@
+"""Model configs and the shared transformer: shapes, variants, prefill/decode parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    MODEL_REGISTRY,
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.tokenizer import ByteTokenizer
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+    Transformer,
+    forward,
+    logits_for,
+)
+
+REFERENCE_MODELS = [
+    "qwen2:1.5b",
+    "gemma:2b",
+    "phi3:3.8b",
+    "gemma:7b",
+    "qwen2:7b",
+    "mistral:7b",
+    "llama3.1:8b",
+]
+
+
+def test_registry_covers_the_reference_sweep():
+    # experiment/RunnerConfig.py:80 — the 7 Ollama models
+    assert set(MODEL_REGISTRY) == set(REFERENCE_MODELS)
+
+
+def test_param_counts_near_nameplate():
+    """Architectural sanity: param counts should be close to the model names."""
+    expected_b = {
+        "qwen2:1.5b": 1.5,
+        "gemma:2b": 2.5,
+        "phi3:3.8b": 3.8,
+        "gemma:7b": 8.5,
+        "qwen2:7b": 7.6,
+        "mistral:7b": 7.2,
+        "llama3.1:8b": 8.0,
+    }
+    for name, exp in expected_b.items():
+        got = get_model_config(name).params_count / 1e9
+        assert abs(got - exp) / exp < 0.25, f"{name}: {got:.2f}B vs ~{exp}B"
+
+
+def test_unknown_model_raises():
+    with pytest.raises(KeyError, match="unknown model"):
+        get_model_config("gpt5:900b")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_model_config("qwen2:1.5b").tiny()
+    return Transformer.initialise(cfg, seed=0, dtype=jnp.float32)
+
+
+def test_forward_shapes_and_cache_update(tiny):
+    cfg = tiny.cfg
+    k_cache, v_cache = tiny.init_cache(1, 32, dtype=jnp.float32)
+    tokens = jnp.array([[1, 5, 9, 13]], dtype=jnp.int32)
+    hidden, k_cache, v_cache = tiny(tokens, jnp.int32(0), k_cache, v_cache)
+    assert hidden.shape == (1, 4, cfg.d_model)
+    assert k_cache.shape == (cfg.n_layers, 1, cfg.n_kv_heads, 32, cfg.d_head)
+    # cache slots 0..3 written, rest untouched (zeros)
+    assert not np.allclose(np.asarray(k_cache[:, :, :, :4]), 0.0)
+    np.testing.assert_allclose(np.asarray(k_cache[:, :, :, 4:]), 0.0)
+    logits = logits_for(tiny.params, cfg, hidden[:, -1])
+    assert logits.shape == (1, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_incremental_decode_matches_full_prefill(tiny):
+    """The golden parity test: feeding tokens one at a time through the cache
+    must reproduce the hidden states of a single full-prompt pass."""
+    cfg = tiny.cfg
+    toks = jnp.array([[3, 7, 11, 2, 19, 23]], dtype=jnp.int32)
+    s = toks.shape[1]
+
+    k_full, v_full = tiny.init_cache(1, 16, dtype=jnp.float32)
+    hidden_full, _, _ = tiny(toks, jnp.int32(0), k_full, v_full)
+
+    k_inc, v_inc = tiny.init_cache(1, 16, dtype=jnp.float32)
+    last_hidden = []
+    for i in range(s):
+        h, k_inc, v_inc = tiny(toks[:, i : i + 1], jnp.int32(i), k_inc, v_inc)
+        last_hidden.append(h[:, 0])
+    np.testing.assert_allclose(
+        np.stack([np.asarray(h) for h in last_hidden], axis=1),
+        np.asarray(hidden_full),
+        atol=1e-4,
+    )
+
+
+def test_chunked_prefill_matches_full(tiny):
+    """Prefill in two chunks (offset continuation) == one-shot prefill."""
+    toks = jnp.array([[3, 7, 11, 2, 19, 23, 29, 31]], dtype=jnp.int32)
+    k1, v1 = tiny.init_cache(1, 16, dtype=jnp.float32)
+    full, _, _ = tiny(toks, jnp.int32(0), k1, v1)
+    k2, v2 = tiny.init_cache(1, 16, dtype=jnp.float32)
+    h_a, k2, v2 = tiny(toks[:, :5], jnp.int32(0), k2, v2)
+    h_b, k2, v2 = tiny(toks[:, 5:], jnp.int32(5), k2, v2)
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(h_a), np.asarray(h_b)], axis=1),
+        np.asarray(full),
+        atol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("name", ["gemma:2b", "mistral:7b", "qwen2:7b"])
+def test_all_family_variants_run(name):
+    """Each family's structural quirks (GQA/MQA, gelu, gemma norm, qkv bias,
+    tied embeddings) execute and produce finite outputs."""
+    cfg = get_model_config(name).tiny()
+    tf = Transformer.initialise(cfg, seed=1, dtype=jnp.float32)
+    k_cache, v_cache = tf.init_cache(1, 8, dtype=jnp.float32)
+    tokens = jnp.array([[1, 2, 3]], dtype=jnp.int32)
+    hidden, k_cache, v_cache = tf(tokens, jnp.int32(0), k_cache, v_cache)
+    logits = logits_for(tf.params, cfg, hidden[:, -1])
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_tokenizer_round_trip():
+    tok = ByteTokenizer()
+    text = "In 100 words, please give me information about TPUs. émojis: ✨"
+    ids = tok.encode(text)
+    assert ids[0] == ByteTokenizer.BOS_ID
+    assert tok.decode(ids) == text
+    assert max(ids) < tok.vocab_size

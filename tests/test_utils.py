@@ -1,0 +1,40 @@
+"""Dotenv loader."""
+
+import os
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.env import (
+    load_dotenv,
+    parse_dotenv,
+)
+
+
+def test_parse_dotenv():
+    text = """
+# comment
+SERVER_IP=10.0.0.5
+export QUOTED="hello world"
+SINGLE='x'
+EMPTY=
+BROKEN LINE
+"""
+    values = parse_dotenv(text)
+    assert values == {
+        "SERVER_IP": "10.0.0.5",
+        "QUOTED": "hello world",
+        "SINGLE": "x",
+        "EMPTY": "",
+    }
+
+
+def test_load_dotenv_respects_existing(tmp_path, monkeypatch):
+    env_file = tmp_path / ".env"
+    env_file.write_text("TEST_DOTENV_VAR=from_file\n")
+    monkeypatch.setenv("TEST_DOTENV_VAR", "preexisting")
+    load_dotenv(env_file)
+    assert os.environ["TEST_DOTENV_VAR"] == "preexisting"
+    load_dotenv(env_file, override=True)
+    assert os.environ["TEST_DOTENV_VAR"] == "from_file"
+
+
+def test_load_dotenv_missing_file(tmp_path):
+    assert load_dotenv(tmp_path / "nope.env") == {}
