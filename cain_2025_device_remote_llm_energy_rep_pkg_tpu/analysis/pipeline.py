@@ -33,7 +33,34 @@ DEFAULT_METRICS = (
     "memory_usage",
     "tokens_per_s",
 )
+# Every metric column the framework's profilers/workloads can emit; used by
+# ``detect_metrics`` to analyse whatever table it is handed.
+KNOWN_METRIC_COLUMNS = (
+    "energy_J",
+    "energy_model_J",
+    "tpu_energy_J",
+    "host_energy_J",
+    "joules_per_token",
+    "execution_time_s",
+    "prefill_s",
+    "decode_s",
+    "tokens_per_s",
+    "cpu_usage",
+    "memory_usage",
+    "tpu_util_est",
+    "tpu_avg_power_W",
+    "host_avg_power_W",
+)
 LENGTH_LABELS = {100: "short", 500: "medium", 1000: "long"}
+
+
+def detect_metrics(rows: List[Dict[str, Any]]) -> List[str]:
+    """The known metric columns that actually carry data in this table."""
+    return [
+        m
+        for m in KNOWN_METRIC_COLUMNS
+        if any(r.get(m) is not None for r in rows)
+    ]
 
 
 def load_rows(experiment_dir: Path) -> List[Dict[str, Any]]:

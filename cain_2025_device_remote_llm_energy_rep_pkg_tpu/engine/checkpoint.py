@@ -1,0 +1,80 @@
+"""Model-weight checkpointing (save once, reload across runs/restarts).
+
+SURVEY.md §5 (checkpoint/resume): "add model-weight caching per run so
+resume doesn't re-download". The reference relies on Ollama's own model
+store; here weights checkpoint through **Orbax** (the standard JAX
+checkpointer) so a resumed experiment reuses identical weights instead of
+re-initialising, and trained params from ``parallel.train`` persist the same
+way. Sharded arrays round-trip with their shardings when restored under the
+same mesh.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+try:
+    import orbax.checkpoint as ocp
+except ImportError:  # pragma: no cover - orbax is baked into the image
+    ocp = None
+
+
+def save_params(params: Dict[str, Any], path: Path) -> Path:
+    """Write a params pytree; overwrites an existing checkpoint at ``path``."""
+    if ocp is None:
+        raise RuntimeError("orbax-checkpoint is unavailable")
+    path = Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, params, force=True)
+    return path
+
+
+def load_params(
+    path: Path, like: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
+    """Restore a params pytree. ``like`` (an abstract/concrete pytree of the
+    same structure) restores with matching dtypes/shardings; without it the
+    stored layout is used."""
+    if ocp is None:
+        raise RuntimeError("orbax-checkpoint is unavailable")
+    path = Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            import jax
+
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+                if hasattr(x, "shape")
+                else x,
+                like,
+            )
+            return ckptr.restore(path, abstract)
+        return ckptr.restore(path)
+
+
+class WeightCache:
+    """Engine-facing cache: ``get_or_init(name, init_fn)`` checkpoints the
+    first initialisation and restores it afterwards."""
+
+    def __init__(self, cache_dir: Path) -> None:
+        self.cache_dir = Path(cache_dir)
+        self.cache_dir.mkdir(parents=True, exist_ok=True)
+
+    def path_for(self, model: str, seed: int, fingerprint: str = "") -> Path:
+        safe = model.replace(":", "_").replace("/", "_")
+        suffix = f"-{fingerprint}" if fingerprint else ""
+        return self.cache_dir / f"{safe}-seed{seed}{suffix}"
+
+    def get_or_init(
+        self, model: str, seed: int, init_fn, fingerprint: str = ""
+    ) -> Dict[str, Any]:
+        """``fingerprint`` must encode everything that shapes the params
+        (config hyperparameters, dtype) — a stale checkpoint for a different
+        architecture/dtype must miss, not silently restore."""
+        path = self.path_for(model, seed, fingerprint)
+        if path.exists():
+            return load_params(path)
+        params = init_fn()
+        save_params(params, path)
+        return params

@@ -61,10 +61,18 @@ class JaxEngine(GenerationBackend):
         dtype: jnp.dtype = jnp.bfloat16,
         decode_attention: "str | DecodeAttentionFn | None" = None,
         seed: int = 0,
+        weight_cache_dir: "Optional[str]" = None,
     ) -> None:
         self.registry = dict(registry) if registry is not None else dict(MODEL_REGISTRY)
         self.dtype = dtype
         self.seed = seed
+        # Optional on-disk weight cache (SURVEY.md §5: resume shouldn't
+        # re-initialise weights; equivalent of Ollama's model store).
+        self._weight_cache = None
+        if weight_cache_dir:
+            from .checkpoint import WeightCache
+
+            self._weight_cache = WeightCache(weight_cache_dir)
         self.tokenizer = ByteTokenizer()
         self._models: Dict[str, Transformer] = {}
         self._prefill_cache: Dict[Tuple, Callable] = {}
@@ -92,7 +100,26 @@ class JaxEngine(GenerationBackend):
             else get_model_config(model)
         )
         t0 = time.monotonic()
-        tf = Transformer.initialise(cfg, seed=self.seed, dtype=self.dtype)
+        if self._weight_cache is not None:
+            import hashlib
+
+            from ..models.transformer import init_params
+
+            # The fingerprint keys the checkpoint to this exact architecture
+            # + dtype; a tiny() test config or a dtype change must not
+            # restore a mismatched checkpoint.
+            fingerprint = hashlib.sha256(
+                f"{cfg!r}|{jnp.dtype(self.dtype).name}".encode()
+            ).hexdigest()[:12]
+            params = self._weight_cache.get_or_init(
+                model,
+                self.seed,
+                lambda: init_params(cfg, jax.random.PRNGKey(self.seed), self.dtype),
+                fingerprint=fingerprint,
+            )
+            tf = Transformer(cfg=cfg, params=params)
+        else:
+            tf = Transformer.initialise(cfg, seed=self.seed, dtype=self.dtype)
         jax.block_until_ready(tf.params)
         self._load_s = time.monotonic() - t0
         self._models[model] = tf

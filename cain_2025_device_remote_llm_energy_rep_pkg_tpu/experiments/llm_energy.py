@@ -95,9 +95,16 @@ class LlmEnergyConfig(ExperimentConfig):
         self.profilers = [
             # one model-energy profiler; per-run chip count set in before_run
             self._energy_profilers[self.locations[0]],
-            HostResourceProfiler(period_s=0.5),
-            RaplEnergyProfiler(),
         ]
+        from ..profilers.native_host import NativeHostProfiler
+
+        native = NativeHostProfiler(period_us=1000)
+        if native.available:
+            # C++ kHz sampler covers host energy + cpu + memory in one thread
+            self.profilers.append(native)
+        else:
+            self.profilers.append(HostResourceProfiler(period_s=0.5))
+            self.profilers.append(RaplEnergyProfiler())
         if counter.available:  # real counters, when the platform has them
             self.profilers.insert(0, counter)
 

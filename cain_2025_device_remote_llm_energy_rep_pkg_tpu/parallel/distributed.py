@@ -1,0 +1,81 @@
+"""Multi-host (DCN) process-group helpers.
+
+The reference's only cross-machine mechanism is an HTTP POST to an Ollama
+server whose address comes from ``.env SERVER_IP``
+(experiment/RunnerConfig.py:122-131). The TPU-native equivalent is a
+``jax.distributed`` process group: the measuring host and the serving slice
+join one runtime, XLA collectives ride ICI within a slice and DCN across
+hosts. The same ``.env`` convention configures the coordinator.
+
+Env keys (``.env`` or process env):
+  COORDINATOR_ADDRESS  host:port of process 0       (reference: SERVER_IP)
+  NUM_PROCESSES        total process count
+  PROCESS_ID           this process's index
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+from ..runner import term
+from ..utils.env import load_dotenv
+
+
+def distributed_config_from_env(
+    dotenv_path: Optional[Path] = None,
+) -> Optional[dict]:
+    """Read coordinator settings; None when not configured (single host)."""
+    load_dotenv(dotenv_path)
+    addr = os.environ.get("COORDINATOR_ADDRESS")
+    if not addr:
+        return None
+    return {
+        "coordinator_address": addr,
+        "num_processes": int(os.environ.get("NUM_PROCESSES", "1")),
+        "process_id": int(os.environ.get("PROCESS_ID", "0")),
+    }
+
+
+def initialize_distributed(dotenv_path: Optional[Path] = None) -> bool:
+    """``jax.distributed.initialize`` from env; no-op single-host fallback.
+
+    Returns True when a multi-process runtime was joined. Safe to call twice
+    (already-initialized is detected and ignored).
+    """
+    config = distributed_config_from_env(dotenv_path)
+    if config is None:
+        return False
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=config["coordinator_address"],
+            num_processes=config["num_processes"],
+            process_id=config["process_id"],
+        )
+    except RuntimeError as exc:
+        if "already initialized" in str(exc).lower():
+            return True
+        raise
+    term.log_ok(
+        f"joined distributed runtime: process {config['process_id']}/"
+        f"{config['num_processes']} via {config['coordinator_address']}"
+    )
+    return True
+
+
+def is_coordinator() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def global_device_summary() -> str:
+    import jax
+
+    return (
+        f"{jax.process_count()} process(es), {jax.device_count()} global / "
+        f"{jax.local_device_count()} local device(s)"
+    )

@@ -24,7 +24,11 @@ def _mean(values: List[float]) -> float:
 
 
 class HostResourceProfiler(SamplingProfiler):
-    data_columns = ("cpu_usage", "memory_usage")
+    # Same column set as NativeHostProfiler's cpu/mem/rate subset so swapping
+    # implementations never changes the run-table schema (resume's
+    # column-equality check would otherwise refuse a restart on a host where
+    # the native sampler's availability flipped).
+    data_columns = ("cpu_usage", "memory_usage", "host_sample_rate_hz")
     artifact_name = "cpu_mem_usage"
 
     def __init__(self, period_s: float = 0.5) -> None:
@@ -43,7 +47,10 @@ class HostResourceProfiler(SamplingProfiler):
     def summarise(self, samples: List[Dict[str, Any]]) -> Dict[str, Any]:
         cpu = [s["cpu_percent"] for s in samples if s["cpu_percent"] is not None]
         mem = [s["memory_percent"] for s in samples if s["memory_percent"] is not None]
+        span = samples[-1]["t_s"] - samples[0]["t_s"] if len(samples) > 1 else 0.0
+        rate = round((len(samples) - 1) / span, 1) if span > 0 else None
         return {
             "cpu_usage": round(_mean(cpu), 3),
             "memory_usage": round(_mean(mem), 3),
+            "host_sample_rate_hz": rate,
         }

@@ -1,0 +1,50 @@
+"""Per-run ``jax.profiler`` trace plugin.
+
+SURVEY.md §5 (tracing): the TPU equivalent of the reference's per-run raw
+artifacts (powermetrics.txt, cpu_mem_usage.csv) for *device* activity is an
+XLA trace. Wraps the measurement window in
+``jax.profiler.start_trace/stop_trace`` writing into
+``<run_dir>/jax_trace/`` — inspectable with TensorBoard/XProf offline.
+Opt-in (traces are large; attach for debugging runs, not the 1,260-run
+sweep).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..runner import term
+from ..runner.context import RunContext
+from .base import Profiler
+
+
+class JaxTraceProfiler(Profiler):
+    data_columns = ("trace_dir",)
+
+    def __init__(self) -> None:
+        self._active = False
+        self._dir: str = ""
+
+    def on_start(self, context: RunContext) -> None:
+        import jax
+
+        self._dir = str(context.run_dir / "jax_trace")
+        try:
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+        except Exception as exc:  # pragma: no cover - backend-dependent
+            term.log_warn(f"jax trace unavailable: {exc}")
+            self._active = False
+
+    def on_stop(self, context: RunContext) -> None:
+        if not self._active:
+            return
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._active = False
+
+    def collect(self, context: RunContext) -> Dict[str, Any]:
+        return {"trace_dir": self._dir if self._dir else None}

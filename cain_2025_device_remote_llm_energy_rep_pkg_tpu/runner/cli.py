@@ -121,9 +121,26 @@ HELP = """usage: python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu <comma
 commands:
   <config.py>          run the experiment defined by the config file
   config-create [dir]  scaffold a new config file (default dir: examples/)
+  analyze <exp_dir>    (re)run the statistics pipeline over an experiment's
+                       run_table.csv, writing analysis_report.{json,md} + plots
   prepare              validate the environment (JAX devices, RAPL access)
   help                 show this message
 """
+
+
+def analyze_command(experiment_dir: Path) -> None:
+    """Standalone analysis pass (reference equivalent: opening the R notebook
+    on run_table.csv, data-analysis/analysis-visualization.ipynb)."""
+    if not (experiment_dir / "run_table.csv").exists():
+        raise CommandError(f"no run_table.csv under {experiment_dir}")
+    from ..analysis.pipeline import analyze_experiment, detect_metrics, load_rows
+
+    metrics = detect_metrics(load_rows(experiment_dir))
+    report = analyze_experiment(experiment_dir, metrics=metrics, make_plots=True)
+    term.log_ok(
+        f"analysis written to {experiment_dir}/analysis_report.md "
+        f"({report['n_after_iqr']}/{report['n_rows']} rows after IQR)"
+    )
 
 
 def prepare() -> None:
@@ -154,6 +171,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if cmd == "config-create":
             config_create(Path(args[1]) if len(args) > 1 else None)
+        elif cmd == "analyze":
+            if len(args) < 2:
+                raise CommandError("analyze requires an experiment directory")
+            analyze_command(Path(args[1]))
         elif cmd == "prepare":
             prepare()
         elif cmd.endswith(".py"):

@@ -1,0 +1,188 @@
+"""Auxiliary subsystems: weight checkpointing, distributed helpers, trace
+profiler, analyze CLI."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.checkpoint import (
+    WeightCache,
+    load_params,
+    save_params,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+    Transformer,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.distributed import (
+    distributed_config_from_env,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import main
+
+
+def test_params_checkpoint_round_trip(tmp_path):
+    cfg = get_model_config("qwen2:1.5b").tiny()
+    tf = Transformer.initialise(cfg, seed=3, dtype=jnp.float32)
+    path = save_params(tf.params, tmp_path / "ckpt")
+    restored = load_params(path)
+    np.testing.assert_array_equal(
+        np.asarray(restored["wq"]), np.asarray(tf.params["wq"])
+    )
+    assert set(restored) == set(tf.params)
+
+
+def test_weight_cache_initialises_once(tmp_path):
+    cfg = get_model_config("qwen2:1.5b").tiny()
+    calls = []
+
+    def init_fn():
+        calls.append(1)
+        return Transformer.initialise(cfg, seed=0, dtype=jnp.float32).params
+
+    cache = WeightCache(tmp_path)
+    p1 = cache.get_or_init("qwen2:1.5b", 0, init_fn)
+    p2 = cache.get_or_init("qwen2:1.5b", 0, init_fn)
+    assert len(calls) == 1  # second call restored from disk
+    np.testing.assert_array_equal(np.asarray(p1["wq"]), np.asarray(p2["wq"]))
+
+
+def test_engine_uses_weight_cache(tmp_path):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+
+    registry = {"t": get_model_config("qwen2:1.5b").tiny()}
+    eng1 = JaxEngine(
+        registry=registry, dtype=jnp.float32, weight_cache_dir=str(tmp_path)
+    )
+    r1 = eng1.generate(GenerationRequest("t", "cached weights", 8))
+    eng2 = JaxEngine(
+        registry=registry, dtype=jnp.float32, weight_cache_dir=str(tmp_path)
+    )
+    r2 = eng2.generate(GenerationRequest("t", "cached weights", 8))
+    assert r1.tokens == r2.tokens  # identical weights from the cache
+
+
+def test_distributed_config_absent(monkeypatch, tmp_path):
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.chdir(tmp_path)  # no .env here
+    assert distributed_config_from_env() is None
+
+
+def test_distributed_config_from_dotenv(tmp_path, monkeypatch):
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    env = tmp_path / ".env"
+    env.write_text("COORDINATOR_ADDRESS=10.0.0.1:1234\nNUM_PROCESSES=4\nPROCESS_ID=2\n")
+    config = distributed_config_from_env(env)
+    assert config == {
+        "coordinator_address": "10.0.0.1:1234",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+
+
+def test_analyze_cli(tmp_path):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.persistence import (
+        RunTableStore,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.progress import (
+        RunProgress,
+    )
+
+    rows = []
+    for i, (loc, e) in enumerate(
+        [("on_device", 100.0), ("on_device", 110.0), ("remote", 20.0), ("remote", 22.0)]
+        * 3
+    ):
+        rows.append(
+            {
+                "__run_id": f"run_{i}_repetition_0",
+                "__done": RunProgress.DONE,
+                "model": "m",
+                "location": loc,
+                "length": 100,
+                "energy_model_J": e + i * 0.1,  # the study's actual column
+                "execution_time_s": e / 10,
+            }
+        )
+    exp = tmp_path / "exp"
+    RunTableStore(exp).write(rows)
+    assert main(["analyze", str(exp)]) == 0
+    report = (exp / "analysis_report.md").read_text()
+    # detected metrics include the modelled-energy column → H1 present
+    assert "energy_model_J" in report
+    assert "H1: energy" in report
+    assert main(["analyze", str(tmp_path / "nothing")]) == 2
+
+
+def test_weight_cache_keyed_by_config_and_dtype(tmp_path):
+    """A checkpoint for one architecture/dtype must never restore for another."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+
+    tiny = get_model_config("qwen2:1.5b").tiny()
+    smaller = get_model_config("qwen2:1.5b").tiny(vocab_size=256)
+    e1 = JaxEngine(
+        registry={"m": tiny}, dtype=jnp.float32, weight_cache_dir=str(tmp_path)
+    )
+    e1.load_model("m")
+    e2 = JaxEngine(
+        registry={"m": smaller}, dtype=jnp.float32, weight_cache_dir=str(tmp_path)
+    )
+    e2.load_model("m")  # different config → fresh init, not the cached one
+    assert e2._models["m"].params["embed"].shape[0] == 256
+    # and a dtype change also misses the cache
+    e3 = JaxEngine(
+        registry={"m": tiny}, dtype=jnp.bfloat16, weight_cache_dir=str(tmp_path)
+    )
+    e3.load_model("m")
+    assert e3._models["m"].params["wq"].dtype == jnp.bfloat16
+
+
+def test_host_profiler_columns_stable_across_implementations():
+    """Native and Python host profilers must offer the same column union so
+    resume's column-equality check survives availability flips."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.host import (
+        HostResourceProfiler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.native_host import (
+        NativeHostProfiler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.rapl import (
+        RaplEnergyProfiler,
+    )
+
+    python_cols = set(HostResourceProfiler.data_columns) | set(
+        RaplEnergyProfiler.data_columns
+    )
+    assert set(NativeHostProfiler.data_columns) == python_cols
+
+
+def test_jax_trace_profiler_graceful(tmp_path):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.jax_trace import (
+        JaxTraceProfiler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.context import (
+        RunContext,
+    )
+
+    run_dir = tmp_path / "r"
+    run_dir.mkdir()
+    ctx = RunContext("r", 1, 1, {}, run_dir, tmp_path)
+    prof = JaxTraceProfiler()
+    prof.on_start(ctx)
+    _ = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+    prof.on_stop(ctx)
+    data = prof.collect(ctx)
+    assert "trace_dir" in data

@@ -66,7 +66,9 @@ def test_energy_model_profiler_without_stats(tmp_path):
 
 
 def _hermetic_config(tmp_path, **kw):
-    fake = FakeBackend(tokens_per_s=5000.0)
+    # simulate_delay gives each run a real ~30 ms measurement window so the
+    # sampling profilers observe a nonzero span.
+    fake = FakeBackend(tokens_per_s=5000.0, simulate_delay=True)
     return LlmEnergyConfig(
         models=["qwen2:1.5b", "gemma:2b"],
         locations=["on_device", "remote"],

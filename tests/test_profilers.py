@@ -77,8 +77,9 @@ def test_host_profiler_reports_cpu_and_memory(tmp_path):
     time.sleep(0.08)
     prof.on_stop(ctx)
     data = prof.collect(ctx)
-    assert set(data) == {"cpu_usage", "memory_usage"}
+    assert set(data) == {"cpu_usage", "memory_usage", "host_sample_rate_hz"}
     assert 0.0 <= data["memory_usage"] <= 100.0
+    assert data["host_sample_rate_hz"] is None or data["host_sample_rate_hz"] > 0
     assert (ctx.run_dir / "cpu_mem_usage.csv").exists()
 
 
