@@ -50,6 +50,9 @@ KNOWN_METRIC_COLUMNS = (
     "tpu_util_est",
     "tpu_avg_power_W",
     "host_avg_power_W",
+    "wall_energy_J",
+    "wall_avg_power_W",
+    "host_sample_rate_hz",
 )
 LENGTH_LABELS = {100: "short", 500: "medium", 1000: "long"}
 
@@ -239,14 +242,20 @@ def render_markdown(report: Dict[str, Any]) -> str:
 def analyze_experiment(
     experiment_dir: Path,
     out_dir: Optional[Path] = None,
-    metrics: Sequence[str] = DEFAULT_METRICS,
+    metrics: Optional[Sequence[str]] = None,
     energy_metric: Optional[str] = None,
     make_plots: bool = False,
 ) -> Dict[str, Any]:
-    """Load, analyze, and write ``analysis_report.{json,md}`` (+plots)."""
+    """Load, analyze, and write ``analysis_report.{json,md}`` (+plots).
+
+    ``metrics=None`` auto-detects the populated metric columns from the
+    table (single parse — callers should not pre-load for detection).
+    """
     experiment_dir = Path(experiment_dir)
     out_dir = Path(out_dir) if out_dir else experiment_dir
     rows = load_rows(experiment_dir)
+    if metrics is None:
+        metrics = detect_metrics(rows)
     if energy_metric is None:
         energy_metric = next(
             (m for m in metrics if "energy" in m), DEFAULT_METRICS[0]

@@ -62,7 +62,11 @@ class JaxEngine(GenerationBackend):
         decode_attention: "str | DecodeAttentionFn | None" = None,
         seed: int = 0,
         weight_cache_dir: "Optional[str]" = None,
+        quantize: Optional[str] = None,  # None | "int8" (weight-only)
     ) -> None:
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unsupported quantize mode: {quantize!r}")
+        self.quantize = quantize
         self.registry = dict(registry) if registry is not None else dict(MODEL_REGISTRY)
         self.dtype = dtype
         self.seed = seed
@@ -120,6 +124,10 @@ class JaxEngine(GenerationBackend):
             tf = Transformer(cfg=cfg, params=params)
         else:
             tf = Transformer.initialise(cfg, seed=self.seed, dtype=self.dtype)
+        if self.quantize == "int8":
+            from ..models.quantize import quantize_params
+
+            tf = Transformer(cfg=cfg, params=quantize_params(tf.params))
         jax.block_until_ready(tf.params)
         self._load_s = time.monotonic() - t0
         self._models[model] = tf

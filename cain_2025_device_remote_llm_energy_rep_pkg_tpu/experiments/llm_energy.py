@@ -28,8 +28,6 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from ..engine.backend import GenerationBackend, GenerationRequest
-from ..profilers.host import HostResourceProfiler
-from ..profilers.rapl import RaplEnergyProfiler
 from ..profilers.tpu import TpuEnergyModelProfiler, TpuPowerCounterProfiler
 from ..runner.config import ExperimentConfig
 from ..runner.context import RunContext
@@ -92,19 +90,16 @@ class LlmEnergyConfig(ExperimentConfig):
             for loc in self.locations
         }
         counter = TpuPowerCounterProfiler()
+        from ..profilers.native_host import NativeHostProfiler
+
         self.profilers = [
             # one model-energy profiler; per-run chip count set in before_run
             self._energy_profilers[self.locations[0]],
+            # C++ kHz sampler for host energy/cpu/memory; it transparently
+            # falls back to the psutil+RAPL Python pair (same columns) when
+            # the native library can't build or load at runtime
+            NativeHostProfiler(period_us=1000),
         ]
-        from ..profilers.native_host import NativeHostProfiler
-
-        native = NativeHostProfiler(period_us=1000)
-        if native.available:
-            # C++ kHz sampler covers host energy + cpu + memory in one thread
-            self.profilers.append(native)
-        else:
-            self.profilers.append(HostResourceProfiler(period_s=0.5))
-            self.profilers.append(RaplEnergyProfiler())
         if counter.available:  # real counters, when the platform has them
             self.profilers.insert(0, counter)
 

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_angles
 from .config import ModelConfig
+from .quantize import maybe_dequant
 
 Params = Dict[str, Any]
 
@@ -102,9 +103,9 @@ def _attention_block(
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
     t = k_cache.shape[2]
 
-    q = jnp.einsum("bsd,dh->bsh", x, layer["wq"])
-    k = jnp.einsum("bsd,dh->bsh", x, layer["wk"])
-    v = jnp.einsum("bsd,dh->bsh", x, layer["wv"])
+    q = jnp.einsum("bsd,dh->bsh", x, maybe_dequant(layer["wq"], x.dtype))
+    k = jnp.einsum("bsd,dh->bsh", x, maybe_dequant(layer["wk"], x.dtype))
+    v = jnp.einsum("bsd,dh->bsh", x, maybe_dequant(layer["wv"], x.dtype))
     if cfg.qkv_bias:
         q = q + layer["bq"]
         k = k + layer["bk"]
@@ -141,7 +142,11 @@ def _attention_block(
         out = jnp.einsum("bkgst,bktd->bskgd", probs, vf).reshape(b, s, hq, dh)
 
     out = out.astype(x.dtype).reshape(b, s, hq * dh)
-    return jnp.einsum("bsh,hd->bsd", out, layer["wo"]), k_cache, v_cache
+    return (
+        jnp.einsum("bsh,hd->bsd", out, maybe_dequant(layer["wo"], x.dtype)),
+        k_cache,
+        v_cache,
+    )
 
 
 def forward(
@@ -178,9 +183,13 @@ def forward(
         )
         x = x + attn_out
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
-        gate = _activation(cfg, jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
-        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
-        mlp_out = jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
+        gate = _activation(
+            cfg, jnp.einsum("bsd,df->bsf", h, maybe_dequant(layer["w_gate"], h.dtype))
+        )
+        up = jnp.einsum("bsd,df->bsf", h, maybe_dequant(layer["w_up"], h.dtype))
+        mlp_out = jnp.einsum(
+            "bsf,fd->bsd", gate * up, maybe_dequant(layer["w_down"], h.dtype)
+        )
         return x + mlp_out, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(block, x, (stacked, k_cache, v_cache))

@@ -121,7 +121,12 @@ struct Sampler {
       uint64_t i = count.load(std::memory_order_relaxed);
       ring[i % ring.size()] = r;
       count.store(i + 1, std::memory_order_release);
+      // Resync after stalls: if sampling ever overruns the period (busy
+      // machine — exactly when we're measuring), don't try to amortise the
+      // deficit by spinning at max rate; skip the missed slots.
       next += period;
+      auto now = std::chrono::steady_clock::now();
+      if (next < now) next = now;
       std::this_thread::sleep_until(next);
     }
   }
@@ -193,6 +198,22 @@ long sampler_read(void* h, double* out, long max_rows) {
     out[i * 5 + 4] = r.mem_avail_kb;
   }
   return static_cast<long>(n);
+}
+
+// Synchronous one-shot reading (5 doubles) — lets the binding snapshot the
+// window edges independently of the ring buffer, so cumulative-counter
+// deltas (energy, jiffies) survive a ring wrap on long runs.
+void sampler_snapshot(void* h, double* out5) {
+  // Only meaningful between sampler_start and sampler_destroy (t0 is set by
+  // start); callers snapshot right after start and right after stop.
+  auto* s = static_cast<Sampler*>(h);
+  if (!s || !out5) return;
+  Row r = s->sample_once();
+  out5[0] = r.t_s;
+  out5[1] = r.energy_uj;
+  out5[2] = r.cpu_busy;
+  out5[3] = r.cpu_total;
+  out5[4] = r.mem_avail_kb;
 }
 
 int sampler_has_rapl(void* h) {

@@ -82,11 +82,31 @@ def cache_shardings(
 
 
 def shard_model(params: Dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Dict[str, Any]:
-    """Place an existing params pytree onto the mesh per the TP rules."""
-    shardings = param_shardings(cfg, mesh)
-    return {
-        name: jax.device_put(leaf, shardings[name]) for name, leaf in params.items()
-    }
+    """Place an existing params pytree onto the mesh per the TP rules.
+
+    Int8-quantized leaves (``{"q", "s"}``, see models/quantize.py) shard the
+    int8 tensor with the weight's spec; the per-channel scale has size 1 on
+    the reduced input axis (-2), so that axis's sharding is dropped for it.
+    """
+    from ..models.quantize import is_quantized
+
+    specs = param_specs(cfg, mesh)
+    out: Dict[str, Any] = {}
+    for name, leaf in params.items():
+        spec = specs[name]
+        if is_quantized(leaf):
+            parts = list(spec) + [None] * (leaf["q"].ndim - len(spec))
+            scale_parts = list(parts)
+            scale_parts[-2] = None
+            out[name] = {
+                "q": jax.device_put(leaf["q"], NamedSharding(mesh, P(*parts))),
+                "s": jax.device_put(
+                    leaf["s"], NamedSharding(mesh, P(*scale_parts))
+                ),
+            }
+        else:
+            out[name] = jax.device_put(leaf, NamedSharding(mesh, spec))
+    return out
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

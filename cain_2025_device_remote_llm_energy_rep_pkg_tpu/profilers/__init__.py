@@ -13,13 +13,20 @@ lazily.
 
 from .base import Profiler, SamplingProfiler
 from .host import HostResourceProfiler
+from .native_host import NativeHostProfiler
 from .rapl import RaplEnergyProfiler
+from .serial_power import SerialPowerMeterProfiler
 from .synthetic import SyntheticPowerProfiler
+from .tpu import TpuEnergyModelProfiler, TpuPowerCounterProfiler
 
 __all__ = [
     "Profiler",
     "SamplingProfiler",
     "HostResourceProfiler",
+    "NativeHostProfiler",
     "RaplEnergyProfiler",
+    "SerialPowerMeterProfiler",
     "SyntheticPowerProfiler",
+    "TpuEnergyModelProfiler",
+    "TpuPowerCounterProfiler",
 ]

@@ -3,15 +3,21 @@
 Drop-in upgrade over :class:`~.host.HostResourceProfiler` +
 :class:`~.rapl.RaplEnergyProfiler`: one native thread samples RAPL energy
 counters, /proc/stat and /proc/meminfo at sub-millisecond capable rates into
-a ring buffer; Python touches the data only at window close. Falls back to
-reporting None columns when the toolchain or counters are absent.
+a ring buffer; Python touches the data only at window close. Cumulative
+counters (energy, jiffies) are differenced between *snapshots* taken at the
+window edges, so a ring-buffer wrap on a long run cannot truncate them.
+
+If the native library can't build or load at runtime, the profiler
+transparently falls back to the psutil + RAPL Python implementations — the
+column schema is identical either way, so run tables stay resumable across
+hosts with and without a toolchain.
 """
 
 from __future__ import annotations
 
 import csv
 import ctypes
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from ..native.build import load_sampler_library
 from ..runner.context import RunContext
@@ -33,7 +39,7 @@ class NativeHostProfiler(Profiler):
     def __init__(
         self,
         period_us: int = 1000,  # 1 kHz; the reference's Python loop: ~0.9 Hz
-        capacity: int = 600_000,  # 10 min at 1 kHz
+        capacity: int = 600_000,  # 10 min of ring retention at 1 kHz
         rapl_glob: str = "",
         write_artifact: bool = False,  # kHz traces are big; opt-in
     ) -> None:
@@ -48,23 +54,42 @@ class NativeHostProfiler(Profiler):
         self._ensured = False
         self.write_artifact = write_artifact
         self._rows: Any = None
+        self._start_snap: Optional[List[float]] = None
+        self._stop_snap: Optional[List[float]] = None
+        self._fallback: Optional[List[Profiler]] = None
 
     def _ensure(self) -> bool:
         if not self._ensured:
             self._ensured = True
             self._lib = load_sampler_library()
             if self._lib is not None:
-                self._handle = self._lib.sampler_create(
-                    self._period_us, self._capacity, self._rapl_glob.encode()
-                )
-                if not self._handle:
-                    self._lib = None
+                if not hasattr(self._lib, "sampler_snapshot"):
+                    self._lib = None  # stale prebuilt library without snapshot
+                else:
+                    self._lib.sampler_snapshot.argtypes = [
+                        ctypes.c_void_p,
+                        ctypes.POINTER(ctypes.c_double),
+                    ]
+                    self._handle = self._lib.sampler_create(
+                        self._period_us, self._capacity, self._rapl_glob.encode()
+                    )
+                    if not self._handle:
+                        self._lib = None
+            if self._handle is None:
+                # Runtime fallback: same columns, Python implementations.
+                from .host import HostResourceProfiler
+                from .rapl import RaplEnergyProfiler
+
+                self._fallback = [
+                    HostResourceProfiler(period_s=0.2),
+                    RaplEnergyProfiler(),
+                ]
         return self._handle is not None
 
     @property
     def available(self) -> bool:
         """Cheap probe: a toolchain or a prebuilt library exists. The real
-        build is deferred to first use."""
+        build is deferred to first use (and failure falls back to Python)."""
         if self._ensured:
             return self._handle is not None
         import shutil
@@ -73,24 +98,36 @@ class NativeHostProfiler(Profiler):
 
         return bool(shutil.which("g++")) or any(_BUILD_DIR.glob("*.so"))
 
+    def _snapshot(self) -> List[float]:
+        buf = (ctypes.c_double * 5)()
+        self._lib.sampler_snapshot(self._handle, buf)
+        return list(buf)
+
     def on_start(self, context: RunContext) -> None:
         self._rows = None
+        self._start_snap = self._stop_snap = None
         if self._ensure():
             self._lib.sampler_start(self._handle)
+            self._start_snap = self._snapshot()
+        else:
+            for p in self._fallback:
+                p.on_start(context)
 
     def on_stop(self, context: RunContext) -> None:
-        if not self._handle:
+        if self._handle is None:
+            for p in self._fallback or []:
+                p.on_stop(context)
             return
         self._lib.sampler_stop(self._handle)
+        self._stop_snap = self._snapshot()
         n = self._lib.sampler_count(self._handle)
-        if n <= 0:
-            return
-        buf = (ctypes.c_double * (n * 5))()
-        got = self._lib.sampler_read(self._handle, buf, n)
-        self._rows = [
-            {f: buf[i * 5 + j] for j, f in enumerate(_ROW_FIELDS)}
-            for i in range(got)
-        ]
+        if n > 0:
+            buf = (ctypes.c_double * (n * 5))()
+            got = self._lib.sampler_read(self._handle, buf, n)
+            self._rows = [
+                {f: buf[i * 5 + j] for j, f in enumerate(_ROW_FIELDS)}
+                for i in range(got)
+            ]
         if self.write_artifact and self._rows:
             path = context.run_dir / f"{self.artifact_name}.csv"
             with path.open("w", newline="") as f:
@@ -100,31 +137,36 @@ class NativeHostProfiler(Profiler):
 
     def collect(self, context: RunContext) -> Dict[str, Any]:
         none: Dict[str, Any] = {c: None for c in self.data_columns}
-        rows = self._rows
-        if not rows or len(rows) < 2:
+        if self._handle is None:
+            out = dict(none)
+            for p in self._fallback or []:
+                out.update(p.collect(context))
+            return out
+        if self._start_snap is None or self._stop_snap is None:
             return none
-        first, last = rows[0], rows[-1]
+        first = dict(zip(_ROW_FIELDS, self._start_snap))
+        last = dict(zip(_ROW_FIELDS, self._stop_snap))
         span = last["t_s"] - first["t_s"]
         out = dict(none)
-        if span > 0:
-            out["host_sample_rate_hz"] = round((len(rows) - 1) / span, 1)
-        # RAPL cumulative counter: Joules = ΔuJ / 1e6 (wrap → negative Δ: drop)
+        rows = self._rows or []
+        if len(rows) > 1:
+            ring_span = rows[-1]["t_s"] - rows[0]["t_s"]
+            if ring_span > 0:
+                out["host_sample_rate_hz"] = round((len(rows) - 1) / ring_span, 1)
+        # Cumulative counters come from the window-edge snapshots — immune to
+        # ring wrap (RAPL counter wrap → negative delta: drop the column).
         if first["energy_uj"] >= 0 and last["energy_uj"] >= first["energy_uj"]:
             joules = (last["energy_uj"] - first["energy_uj"]) / 1e6
             out["host_energy_J"] = round(joules, 4)
             if span > 0:
                 out["host_avg_power_W"] = round(joules / span, 3)
-        # CPU%: busy jiffies over total jiffies across the window. A window
-        # shorter than the jiffy granularity (10 ms) legitimately observes no
-        # movement → 0.0, not missing.
         if first["cpu_total"] >= 0 and last["cpu_total"] >= first["cpu_total"]:
             busy = last["cpu_busy"] - first["cpu_busy"]
             total = last["cpu_total"] - first["cpu_total"]
             out["cpu_usage"] = round(100.0 * busy / total, 3) if total > 0 else 0.0
-        # Memory%: mean used fraction needs total; report availability-based
-        # usage from the first sample's baseline instead (MemAvailable is the
-        # kernel's own "usable without swapping" estimate).
         avail = [r["mem_avail_kb"] for r in rows if r["mem_avail_kb"] >= 0]
+        if not avail and last["mem_avail_kb"] >= 0:
+            avail = [last["mem_avail_kb"]]
         if avail:
             try:
                 with open("/proc/meminfo") as f:

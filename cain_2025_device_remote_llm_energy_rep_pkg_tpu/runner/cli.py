@@ -133,10 +133,9 @@ def analyze_command(experiment_dir: Path) -> None:
     on run_table.csv, data-analysis/analysis-visualization.ipynb)."""
     if not (experiment_dir / "run_table.csv").exists():
         raise CommandError(f"no run_table.csv under {experiment_dir}")
-    from ..analysis.pipeline import analyze_experiment, detect_metrics, load_rows
+    from ..analysis.pipeline import analyze_experiment
 
-    metrics = detect_metrics(load_rows(experiment_dir))
-    report = analyze_experiment(experiment_dir, metrics=metrics, make_plots=True)
+    report = analyze_experiment(experiment_dir, make_plots=True)
     term.log_ok(
         f"analysis written to {experiment_dir}/analysis_report.md "
         f"({report['n_after_iqr']}/{report['n_rows']} rows after IQR)"

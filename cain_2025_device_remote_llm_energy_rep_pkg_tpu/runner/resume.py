@@ -58,11 +58,21 @@ def reconcile_run_tables(
         raise ResumeError("stored run table is empty")
     gen_cols = set(generated[0].keys())
     stored_cols = set(stored[0].keys())
-    if gen_cols != stored_cols:
+    removed = stored_cols - gen_cols
+    if removed:
         raise ResumeError(
-            "run table columns changed since the stored experiment: "
-            f"added={sorted(gen_cols - stored_cols)} "
-            f"removed={sorted(stored_cols - gen_cols)}"
+            "run table columns were removed since the stored experiment: "
+            f"{sorted(removed)} (data would be dropped; refusing)"
+        )
+    added = gen_cols - stored_cols
+    if added:
+        # New data columns (e.g. a profiler upgrade) must not strand a
+        # half-finished sweep: completed rows get None for the new columns.
+        from . import term
+
+        term.log_warn(
+            f"resuming with new data columns {sorted(added)}; completed runs "
+            "will have empty values for them"
         )
     by_id = {row[RUN_ID_COLUMN]: row for row in generated}
     if len(by_id) != len(generated):

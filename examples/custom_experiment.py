@@ -1,0 +1,61 @@
+"""A custom experiment showing the framework's general-purpose surface:
+any measured activity, any factors, any profilers — not just LLM energy.
+
+Measures matrix-multiply throughput across sizes and dtypes:
+    python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu examples/custom_experiment.py
+"""
+
+import time
+from pathlib import Path
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu import (
+    ExperimentConfig,
+    Factor,
+    RunTableModel,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers import (
+    HostResourceProfiler,
+)
+
+
+class RunnerConfig(ExperimentConfig):
+    name = "matmul_throughput"
+    results_output_path = Path("experiments_output")
+    time_between_runs_in_ms = 1000
+    isolate_runs = False  # keep the jit cache warm across runs
+    profilers = [HostResourceProfiler(period_s=0.2)]
+
+    def create_run_table_model(self) -> RunTableModel:
+        return RunTableModel(
+            factors=[
+                Factor("size", [512, 1024, 2048]),
+                Factor("dtype", ["float32", "bfloat16"]),
+            ],
+            repetitions=3,
+            data_columns=["tflops", "wall_s"],
+            shuffle=True,
+        )
+
+    def interact(self, context):
+        import jax
+        import jax.numpy as jnp
+
+        n = context.factor("size")
+        dtype = jnp.dtype(context.factor("dtype"))
+        x = jax.random.normal(jax.random.PRNGKey(0), (n, n)).astype(dtype)
+        f = jax.jit(lambda a: a @ a)
+        f(x).block_until_ready()  # compile outside the timed region
+        t0 = time.monotonic()
+        iters = 10
+        for _ in range(iters):
+            y = f(x)
+        y.block_until_ready()
+        wall = time.monotonic() - t0
+        context.scratch["wall_s"] = wall
+        context.scratch["tflops"] = 2 * n**3 * iters / wall / 1e12
+
+    def populate_run_data(self, context):
+        return {
+            "tflops": round(context.scratch["tflops"], 3),
+            "wall_s": round(context.scratch["wall_s"], 4),
+        }

@@ -1,0 +1,33 @@
+"""Smoke-test variant of the energy study: 2 tiny models, 1 length, 2 reps.
+
+Runs in a couple of minutes on CPU or a single chip:
+    python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu examples/llm_energy_smoke.py
+"""
+
+from pathlib import Path
+
+import jax.numpy as jnp
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import JaxEngine
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+    LlmEnergyConfig,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+
+_MODELS = ["qwen2:1.5b", "gemma:2b"]
+_REGISTRY = {name: get_model_config(name).tiny(max_seq_len=1024) for name in _MODELS}
+_ENGINE = JaxEngine(registry=_REGISTRY, dtype=jnp.float32)
+
+
+class RunnerConfig(LlmEnergyConfig):
+    def __init__(self):
+        super().__init__(
+            models=_MODELS,
+            lengths=[100],
+            repetitions=2,
+            cooldown_ms=500,
+            results_output_path=Path("experiments_output"),
+            backends={"on_device": _ENGINE, "remote": _ENGINE},
+        )

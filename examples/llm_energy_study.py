@@ -1,0 +1,26 @@
+"""The flagship study: on-device vs remote LLM generation energy on TPU.
+
+Run with:
+    python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu examples/llm_energy_study.py
+
+This is the full 7-model × 2-location × 3-length × 30-repetition sweep of the
+reference (experiment/RunnerConfig.py:77-88) on the JAX engine: "on_device"
+serves from a single chip, "remote" from a tensor-parallel mesh over all
+visible devices. Expect many hours on real hardware (90 s cooldown × 1260
+runs, like the original study). For a quick smoke test see
+``llm_energy_smoke.py``.
+"""
+
+from pathlib import Path
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+    LlmEnergyConfig,
+)
+
+
+class RunnerConfig(LlmEnergyConfig):
+    def __init__(self):
+        super().__init__(
+            repetitions=30,
+            results_output_path=Path("experiments_output"),
+        )

@@ -1,0 +1,121 @@
+"""Int8 weight-only quantization: accuracy, size, engine + TP integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import JaxEngine
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+    DEFAULT_QUANT_KEYS,
+    is_quantized,
+    maybe_dequant,
+    params_nbytes,
+    quantize_params,
+    quantize_tensor,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+    Transformer,
+    forward,
+    logits_for,
+)
+
+
+def test_quantize_tensor_round_trip_accuracy():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128)) * 0.05
+    q = quantize_tensor(w)
+    assert q["q"].dtype == jnp.int8
+    deq = maybe_dequant(q, jnp.float32)
+    # symmetric int8: relative error bounded by ~1/127 of the channel max
+    err = np.abs(np.asarray(deq) - np.asarray(w))
+    per_channel_max = np.abs(np.asarray(w)).max(axis=0)
+    assert (err <= per_channel_max / 127.0 * 1.01 + 1e-8).all()
+
+
+def test_maybe_dequant_passthrough():
+    w = jnp.ones((4, 4))
+    assert maybe_dequant(w) is w
+
+
+def test_quantize_params_halves_size():
+    cfg = get_model_config("qwen2:1.5b").tiny()
+    tf = Transformer.initialise(cfg, seed=0, dtype=jnp.bfloat16)
+    qparams = quantize_params(tf.params)
+    for key in DEFAULT_QUANT_KEYS:
+        assert is_quantized(qparams[key])
+    assert qparams["embed"] is tf.params["embed"]  # untouched
+    # matmul weights dominate; expect a substantial overall shrink
+    assert params_nbytes(qparams) < 0.8 * params_nbytes(tf.params)
+
+
+def test_quantized_forward_close_to_full_precision():
+    cfg = get_model_config("mistral:7b").tiny()
+    tf = Transformer.initialise(cfg, seed=1, dtype=jnp.float32)
+    toks = jnp.array([[3, 7, 11, 2]], dtype=jnp.int32)
+    k0, v0 = tf.init_cache(1, 8, dtype=jnp.float32)
+    hidden_fp, _, _ = forward(tf.params, cfg, toks, jnp.int32(0), k0, v0)
+    logits_fp = logits_for(tf.params, cfg, hidden_fp[:, -1])
+    qparams = quantize_params(tf.params)
+    hidden_q, _, _ = forward(qparams, cfg, toks, jnp.int32(0), k0, v0)
+    logits_q = logits_for(qparams, cfg, hidden_q[:, -1])
+    # int8 weight noise shifts logits slightly; ranking of the top token is
+    # a weak ask for random weights, so compare the distributions
+    corr = np.corrcoef(
+        np.asarray(logits_fp).ravel(), np.asarray(logits_q).ravel()
+    )[0, 1]
+    assert corr > 0.99
+
+
+def test_engine_int8_generates_and_shrinks():
+    registry = {"t": get_model_config("qwen2:1.5b").tiny()}
+    fp = JaxEngine(registry=registry, dtype=jnp.float32)
+    q8 = JaxEngine(registry=registry, dtype=jnp.float32, quantize="int8")
+    r = q8.generate(GenerationRequest("t", "quantized", 10))
+    assert r.generated_tokens <= 10
+    fp.load_model("t")
+    assert params_nbytes(q8._models["t"].params) < params_nbytes(
+        fp._models["t"].params
+    )
+
+
+def test_engine_rejects_unknown_quantize():
+    with pytest.raises(ValueError, match="unsupported quantize"):
+        JaxEngine(quantize="fp4")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_tp_engine_with_int8():
+    import dataclasses
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.mesh import (
+        MeshSpec,
+        build_mesh,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.tp import (
+        TensorParallelEngine,
+    )
+
+    cfg = dataclasses.replace(
+        get_model_config("mistral:7b").tiny(),
+        n_heads=8,
+        n_kv_heads=8,
+        d_ff=128,
+        d_model=64,
+        d_head=16,
+    )
+    registry = {"t8": cfg}
+    single = JaxEngine(registry=registry, dtype=jnp.float32, quantize="int8")
+    tp = TensorParallelEngine(
+        mesh=build_mesh(MeshSpec.tp_only()),
+        registry=registry,
+        dtype=jnp.float32,
+        quantize="int8",
+    )
+    req = GenerationRequest("t8", "int8 tensor parallel", max_new_tokens=10)
+    assert single.generate(req).tokens == tp.generate(req).tokens

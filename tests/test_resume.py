@@ -86,9 +86,20 @@ def test_reconcile_retries_failed_when_asked():
     assert merged[0]["__done"] == RunProgress.FAILED
 
 
-def test_reconcile_rejects_column_change():
-    with pytest.raises(ResumeError, match="columns changed"):
-        reconcile_run_tables(_gen(extra={"new_col": None}), _gen())
+def test_reconcile_tolerates_added_columns():
+    """A profiler upgrade adding data columns must not strand a half-finished
+    sweep; completed rows carry None for the new column."""
+    stored = _gen()
+    stored[0]["__done"] = RunProgress.DONE
+    stored[0]["energy_J"] = 5.0
+    merged = reconcile_run_tables(_gen(extra={"new_col": None}), stored)
+    assert merged[0]["new_col"] is None
+    assert merged[0]["energy_J"] == 5.0
+
+
+def test_reconcile_rejects_removed_columns():
+    with pytest.raises(ResumeError, match="removed"):
+        reconcile_run_tables(_gen(), _gen(extra={"old_col": None}))
 
 
 def test_reconcile_rejects_run_id_change():

@@ -1,0 +1,61 @@
+"""Serial power meter profiler with an injected fake reader (no hardware)."""
+
+import time
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.serial_power import (
+    SerialPowerMeterProfiler,
+    parse_wattsup_frame,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.context import RunContext
+
+
+def test_parse_wattsup_frames():
+    assert parse_wattsup_frame("#d,-,3,1205,1187,412,x;") == {
+        "power_W": 120.5,
+        "volts_V": 118.7,
+        "amps_A": 0.412,
+    }
+    assert parse_wattsup_frame("#h,header,stuff") is None
+    assert parse_wattsup_frame("#d,too,short") is None
+    assert parse_wattsup_frame("#d,a,b,notanumber,1,2") is None
+
+
+class FakeSerial:
+    """Emits one 100 W frame every ~10 ms."""
+
+    def __init__(self):
+        self.closed = False
+
+    def readline(self):
+        time.sleep(0.01)
+        return b"#d,-,3,1000,1200,500,0;\r\n"
+
+    def close(self):
+        self.closed = True
+
+
+def test_profiler_integrates_fake_meter(tmp_path):
+    run_dir = tmp_path / "r"
+    run_dir.mkdir()
+    ctx = RunContext("r", 1, 1, {}, run_dir, tmp_path)
+    fake = FakeSerial()
+    prof = SerialPowerMeterProfiler(reader_factory=lambda: fake)
+    prof.on_start(ctx)
+    time.sleep(0.15)
+    prof.on_stop(ctx)
+    data = prof.collect(ctx)
+    assert fake.closed
+    assert data["wall_avg_power_W"] == 100.0
+    assert data["wall_energy_J"] > 0
+    assert (run_dir / "wall_power.csv").exists()
+
+
+def test_profiler_graceful_without_reader(tmp_path):
+    ctx = RunContext("r", 1, 1, {}, tmp_path, tmp_path)
+    prof = SerialPowerMeterProfiler(reader_factory=lambda: None)
+    prof.on_start(ctx)
+    prof.on_stop(ctx)
+    assert prof.collect(ctx) == {
+        "wall_energy_J": None,
+        "wall_avg_power_W": None,
+    }
