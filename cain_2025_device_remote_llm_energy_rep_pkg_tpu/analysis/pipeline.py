@@ -52,7 +52,6 @@ KNOWN_METRIC_COLUMNS = (
     "host_avg_power_W",
     "wall_energy_J",
     "wall_avg_power_W",
-    "host_sample_rate_hz",
 )
 LENGTH_LABELS = {100: "short", 500: "medium", 1000: "long"}
 

@@ -28,6 +28,7 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from ..engine.backend import GenerationBackend, GenerationRequest
+from ..serve.client import RemoteHTTPBackend
 from ..profilers.tpu import TpuEnergyModelProfiler, TpuPowerCounterProfiler
 from ..runner.config import ExperimentConfig
 from ..runner.context import RunContext
@@ -67,6 +68,7 @@ class LlmEnergyConfig(ExperimentConfig):
         results_output_path: Optional[Path] = None,
         cooldown_ms: Optional[int] = None,
         backends: Optional[Dict[str, GenerationBackend]] = None,
+        remote_url: Optional[str] = None,
         remote_tp: int = -1,
         shuffle: bool = True,
         seed: int = 0,
@@ -83,6 +85,7 @@ class LlmEnergyConfig(ExperimentConfig):
         if cooldown_ms is not None:
             self.time_between_runs_in_ms = cooldown_ms
         self._backends = backends  # None → built lazily in before_experiment
+        self._remote_url = remote_url
         self._remote_tp = remote_tp
         chips = n_chips_by_location or {"on_device": 1, "remote": 8}
         self._energy_profilers = {
@@ -136,7 +139,20 @@ class LlmEnergyConfig(ExperimentConfig):
 
             self._backends = {"on_device": JaxEngine(decode_attention="auto")}
             if "remote" in self.locations:
-                if len(jax.devices()) > 1:
+                from ..serve.client import backend_from_env
+
+                http_backend = (
+                    RemoteHTTPBackend(self._remote_url)
+                    if self._remote_url
+                    else backend_from_env()
+                )
+                if http_backend is not None:
+                    # True machine boundary, as in the reference: the remote
+                    # treatment fetches over HTTP from a serving host named
+                    # by remote_url / the .env SERVER_IP convention
+                    # (experiment/RunnerConfig.py:122-131).
+                    self._backends["remote"] = http_backend
+                elif len(jax.devices()) > 1:
                     mesh = build_mesh(MeshSpec.tp_only(self._remote_tp))
                     self._backends["remote"] = TensorParallelEngine(
                         mesh=mesh, decode_attention="auto"
@@ -190,10 +206,15 @@ class LlmEnergyConfig(ExperimentConfig):
         request: GenerationRequest = context.scratch["request"]
         result = backend.generate(request)
         context.scratch["result"] = result
-        cfg = None
+        # Architecture comes from the local registry, not the backend: an
+        # HTTP backend has no registry, but the FLOPs estimate (→ modelled
+        # utilisation/energy of the serving chips) must not degrade to idle.
         registry = getattr(backend, "registry", None)
-        if registry:
-            cfg = registry.get(request.model)
+        cfg = registry.get(request.model) if registry else None
+        if cfg is None:
+            from ..models.config import MODEL_REGISTRY
+
+            cfg = MODEL_REGISTRY.get(request.model)
         flops = (
             cfg.flops_per_token(result.prompt_tokens + result.generated_tokens)
             * result.generated_tokens

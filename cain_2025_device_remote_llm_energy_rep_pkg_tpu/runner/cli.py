@@ -124,8 +124,63 @@ commands:
   analyze <exp_dir>    (re)run the statistics pipeline over an experiment's
                        run_table.csv, writing analysis_report.{json,md} + plots
   prepare              validate the environment (JAX devices, RAPL access)
+  serve [opts]         start the HTTP generation server (the framework-native
+                       Ollama-equivalent): --port N (default 11434),
+                       --backend jax|jax-tp|fake, --tp N, --models a,b,c
   help                 show this message
 """
+
+
+def serve_command(args: List[str]) -> None:
+    """Run the generation server — the "remote" machine's side of the study
+    (reference: a separately-installed Ollama server on the remote host,
+    README.md:29-31; here it is part of the framework)."""
+    port = None
+    backend_kind = "jax"
+    tp = -1
+    models: Optional[List[str]] = None
+    it = iter(args)
+    for arg in it:
+        if arg == "--port":
+            port = int(next(it, "11434"))
+        elif arg == "--backend":
+            backend_kind = next(it, "jax")
+        elif arg == "--tp":
+            tp = int(next(it, "-1"))
+        elif arg == "--models":
+            models = [m for m in next(it, "").split(",") if m]
+        else:
+            raise CommandError(f"serve: unrecognised option {arg!r}")
+
+    from ..serve.protocol import DEFAULT_PORT
+    from ..serve.server import GenerationServer
+
+    if backend_kind == "fake":
+        from ..engine.fake import FakeBackend
+
+        backend = FakeBackend()
+    elif backend_kind == "jax-tp":
+        from ..parallel.mesh import MeshSpec, build_mesh
+        from ..parallel.tp import TensorParallelEngine
+
+        backend = TensorParallelEngine(
+            mesh=build_mesh(MeshSpec.tp_only(tp)), decode_attention="auto"
+        )
+    elif backend_kind == "jax":
+        from ..engine.jax_engine import JaxEngine
+
+        backend = JaxEngine(decode_attention="auto")
+    else:
+        raise CommandError(f"serve: unknown backend {backend_kind!r}")
+
+    if models is None and backend_kind != "fake":
+        from ..models.config import MODEL_REGISTRY
+
+        models = sorted(MODEL_REGISTRY)
+    server = GenerationServer(
+        backend, port=DEFAULT_PORT if port is None else port, models=models
+    )
+    server.serve_forever()
 
 
 def analyze_command(experiment_dir: Path) -> None:
@@ -176,6 +231,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             analyze_command(Path(args[1]))
         elif cmd == "prepare":
             prepare()
+        elif cmd == "serve":
+            serve_command(args[1:])
         elif cmd.endswith(".py"):
             run_config_file(Path(cmd))
         else:

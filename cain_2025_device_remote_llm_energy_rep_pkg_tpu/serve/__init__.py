@@ -1,0 +1,17 @@
+"""HTTP serving layer — the framework's own equivalent of the reference's L8.
+
+The reference delegates generation to an external Ollama server reached via
+``curl POST http://<host>:11434/api/generate`` (experiment/RunnerConfig.py:
+128-131; README.md:29-31). This package makes that capability part of the
+framework itself: ``GenerationServer`` exposes the same wire protocol backed
+by any :class:`~..engine.backend.GenerationBackend` (the JAX engine on a TPU
+slice, the TP mesh engine, or the fake), and ``RemoteHTTPBackend`` is the
+client side, so the experiment's "remote" treatment fetches over a genuine
+machine boundary exactly as the reference's did.
+"""
+
+from .client import RemoteHTTPBackend
+from .protocol import DEFAULT_PORT
+from .server import GenerationServer
+
+__all__ = ["GenerationServer", "RemoteHTTPBackend", "DEFAULT_PORT"]

@@ -1,0 +1,152 @@
+"""HTTP client backend — the experiment-side of the machine boundary.
+
+Replaces the reference's ``curl`` subprocess (experiment/RunnerConfig.py:
+128-131) with an in-process stdlib HTTP client that implements the
+:class:`~..engine.backend.GenerationBackend` contract, so the experiment's
+"remote" treatment is just another backend: the client blocks on the POST
+exactly as the reference blocked on curl, and the host-side profilers see
+the same network-wait workload. Speaks the Ollama wire format, so it can
+also point at a real Ollama server for cross-framework comparison runs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from ..engine.backend import GenerationBackend, GenerationRequest, GenerationResult
+from . import protocol
+
+
+class RemoteServerError(RuntimeError):
+    """The generation server answered with an error status."""
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"server returned {status}: {message}")
+        self.status = status
+
+
+class RemoteHTTPBackend(GenerationBackend):
+    def __init__(
+        self,
+        base_url: str,
+        timeout_s: float = 600.0,
+        load_timeout_s: float = 1800.0,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.load_timeout_s = load_timeout_s  # weight load + jit compile
+
+    def _post(self, path: str, payload: dict, timeout_s: float) -> dict:
+        body = json.dumps(payload).encode("utf-8")
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as exc:
+            try:
+                message = json.loads(exc.read().decode("utf-8")).get("error", "")
+            except Exception:  # noqa: BLE001
+                message = exc.reason
+            raise RemoteServerError(exc.code, str(message)) from exc
+
+    def health(self) -> bool:
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}{protocol.HEALTH_PATH}", timeout=5.0
+            ) as resp:
+                return resp.status == 200
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def list_models(self) -> list:
+        with urllib.request.urlopen(
+            f"{self.base_url}{protocol.TAGS_PATH}", timeout=self.timeout_s
+        ) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+        return [m["name"] for m in body.get("models", [])]
+
+    def load_model(self, model: str) -> None:
+        try:
+            self._post(protocol.LOAD_PATH, {"model": model}, self.load_timeout_s)
+        except RemoteServerError as exc:
+            if exc.status != 404:
+                raise
+            # A real Ollama server has no /api/load; a 1-token generate
+            # forces the weight load there instead.
+            self._ollama_touch(model)
+
+    def warmup(self, request: GenerationRequest) -> None:
+        """Server-side load + compile for this request shape, outside the
+        measurement window (the reference's Ollama is likewise warm before
+        curl fires)."""
+        try:
+            self._post(
+                protocol.LOAD_PATH,
+                {
+                    "model": request.model,
+                    "x_warmup": protocol.request_to_wire(request),
+                },
+                self.load_timeout_s,
+            )
+        except RemoteServerError as exc:
+            if exc.status != 404:
+                raise
+            self._ollama_touch(request.model)
+
+    def _ollama_touch(self, model: str) -> None:
+        """Warm a plain-Ollama server (404 on our /api/load extension) by
+        generating a single token, which loads the model server-side."""
+        self._post(
+            protocol.GENERATE_PATH,
+            protocol.request_to_wire(
+                GenerationRequest(model=model, prompt="hi", max_new_tokens=1)
+            ),
+            self.load_timeout_s,
+        )
+
+    def generate(self, request: GenerationRequest) -> GenerationResult:
+        t0 = time.monotonic()
+        body = self._post(
+            protocol.GENERATE_PATH,
+            protocol.request_to_wire(request),
+            self.timeout_s,
+        )
+        wall_s = time.monotonic() - t0
+        result = protocol.result_from_wire(body, request)
+        # Client-side wall time is the measured quantity (the energy of
+        # *fetching*): keep the server's prefill/decode split but make
+        # total_s the client's wait, network included, matching what the
+        # reference's curl wall-clock captured.
+        result.total_s = wall_s
+        return result
+
+    def unload_all(self) -> None:  # nothing held client-side
+        return None
+
+
+def backend_from_env(
+    env_var: str = "SERVER_IP", port: Optional[int] = None
+) -> Optional[RemoteHTTPBackend]:
+    """Build a client from the reference's ``.env`` convention: ``SERVER_IP``
+    names the serving host (experiment/RunnerConfig.py:125-126). Accepts a
+    bare IP/host (reference form) or a full ``http://host:port`` URL."""
+    import os
+
+    from ..utils.env import load_dotenv
+
+    load_dotenv()
+    value = os.environ.get(env_var)
+    if not value:
+        return None
+    if not value.startswith("http"):
+        value = f"http://{value}:{port or protocol.DEFAULT_PORT}"
+    return RemoteHTTPBackend(value)

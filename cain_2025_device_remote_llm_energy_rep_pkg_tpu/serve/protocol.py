@@ -1,0 +1,87 @@
+"""Wire protocol for the generation server.
+
+Field names follow the API the reference's experiment speaks — the Ollama
+REST surface it curls (experiment/RunnerConfig.py:128-131): request
+``{"model", "prompt", "stream": false}`` with sampling knobs under
+``options`` (``num_predict``, ``temperature``, ``top_k``, ``seed``);
+response ``{"model", "response", "done", "eval_count", "eval_duration", …}``
+with durations in nanoseconds. A client written against the reference's
+server works against ours unchanged; our extensions ride under ``x_*`` keys.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..engine.backend import GenerationRequest, GenerationResult
+
+DEFAULT_PORT = 11434  # the port the reference's curl targets (README.md:31)
+
+GENERATE_PATH = "/api/generate"
+TAGS_PATH = "/api/tags"
+LOAD_PATH = "/api/load"  # extension: explicit weight-load outside the window
+HEALTH_PATH = "/healthz"
+
+
+def request_to_wire(request: GenerationRequest) -> Dict[str, Any]:
+    return {
+        "model": request.model,
+        "prompt": request.prompt,
+        "stream": False,
+        "options": {
+            "num_predict": request.max_new_tokens,
+            "temperature": request.temperature,
+            "top_k": request.top_k,
+            "seed": request.seed,
+        },
+        "x_stop_at_eos": request.stop_at_eos,
+    }
+
+
+def request_from_wire(body: Dict[str, Any]) -> GenerationRequest:
+    if "model" not in body or "prompt" not in body:
+        raise ValueError("generate request requires 'model' and 'prompt'")
+    options = body.get("options") or {}
+    return GenerationRequest(
+        model=str(body["model"]),
+        prompt=str(body["prompt"]),
+        max_new_tokens=int(options.get("num_predict", 128)),
+        temperature=float(options.get("temperature", 0.0)),
+        top_k=int(options.get("top_k", 0)),
+        seed=int(options.get("seed", 0)),
+        stop_at_eos=bool(body.get("x_stop_at_eos", True)),
+    )
+
+
+def result_to_wire(result: GenerationResult) -> Dict[str, Any]:
+    ns = 1_000_000_000
+    return {
+        "model": result.request.model,
+        "response": result.text,
+        "done": True,
+        "prompt_eval_count": result.prompt_tokens,
+        "prompt_eval_duration": int(result.prefill_s * ns),
+        "eval_count": result.generated_tokens,
+        "eval_duration": int(result.decode_s * ns),
+        "total_duration": int(result.total_s * ns),
+        "x_tokens": list(result.tokens),
+    }
+
+
+def result_from_wire(
+    body: Dict[str, Any], request: GenerationRequest
+) -> GenerationResult:
+    ns = 1_000_000_000
+    prefill_s = float(body.get("prompt_eval_duration", 0)) / ns
+    decode_s = float(body.get("eval_duration", 0)) / ns
+    total_s = float(body.get("total_duration", 0)) / ns or (prefill_s + decode_s)
+    return GenerationResult(
+        request=request,
+        tokens=[int(t) for t in body.get("x_tokens", [])],
+        text=str(body.get("response", "")),
+        prompt_tokens=int(body.get("prompt_eval_count", 0)),
+        generated_tokens=int(body.get("eval_count", 0)),
+        prefill_s=prefill_s,
+        decode_s=decode_s,
+        total_s=total_s,
+    )

@@ -1,0 +1,170 @@
+"""Threaded HTTP generation server.
+
+The framework-native replacement for the external Ollama server the
+reference depends on (README.md:29-31): the same REST surface
+(``POST /api/generate``, ``GET /api/tags``) served from any
+:class:`~..engine.backend.GenerationBackend`. Generation requests are
+serialised through a lock — one accelerator, one in-flight generation, which
+also matches the measurement model (the client's wait *is* the treatment).
+
+Stdlib-only (``http.server``); no web framework in the image and none
+needed: the reference's entire protocol is one JSON POST.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..engine.backend import GenerationBackend
+from ..runner import term
+from . import protocol
+
+
+class GenerationServer:
+    """Serve a backend over HTTP. ``port=0`` picks an ephemeral port (tests).
+
+    Usage::
+
+        server = GenerationServer(backend, port=11434)
+        server.start()          # returns once the socket is listening
+        ...
+        server.stop()
+
+    or blocking: ``server.serve_forever()``.
+    """
+
+    def __init__(
+        self,
+        backend: GenerationBackend,
+        host: str = "0.0.0.0",
+        port: int = protocol.DEFAULT_PORT,
+        models: Optional[List[str]] = None,
+        quiet: bool = False,
+    ) -> None:
+        self.backend = backend
+        self.models = list(models) if models else []
+        self.quiet = quiet
+        self._generate_lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: A003
+                if not server.quiet:
+                    term.log(f"serve: {fmt % args}")
+
+            def _send_json(self, status: int, payload) -> None:
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_json(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b"{}"
+                return json.loads(raw.decode("utf-8"))
+
+            def do_GET(self):  # noqa: N802
+                if self.path == protocol.HEALTH_PATH:
+                    self._send_json(200, {"status": "ok"})
+                elif self.path == protocol.TAGS_PATH:
+                    self._send_json(
+                        200,
+                        {"models": [{"name": m} for m in server.models]},
+                    )
+                else:
+                    self._send_json(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):  # noqa: N802
+                try:
+                    body = self._read_json()
+                except (ValueError, json.JSONDecodeError) as exc:
+                    self._send_json(400, {"error": f"bad JSON: {exc}"})
+                    return
+                if self.path == protocol.GENERATE_PATH:
+                    self._handle_generate(body)
+                elif self.path == protocol.LOAD_PATH:
+                    self._handle_load(body)
+                else:
+                    self._send_json(404, {"error": f"unknown path {self.path}"})
+
+            def _handle_generate(self, body) -> None:
+                try:
+                    request = protocol.request_from_wire(body)
+                except ValueError as exc:
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                if server.models and request.model not in server.models:
+                    self._send_json(
+                        404, {"error": f"model {request.model!r} not found"}
+                    )
+                    return
+                try:
+                    with server._generate_lock:
+                        result = server.backend.generate(request)
+                except KeyError as exc:
+                    self._send_json(404, {"error": f"model not found: {exc}"})
+                except Exception as exc:  # noqa: BLE001 — server must not die
+                    self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
+                else:
+                    self._send_json(200, protocol.result_to_wire(result))
+
+            def _handle_load(self, body) -> None:
+                model = body.get("model")
+                if not model:
+                    self._send_json(400, {"error": "load requires 'model'"})
+                    return
+                try:
+                    with server._generate_lock:
+                        server.backend.load_model(str(model))
+                        warm = body.get("x_warmup")
+                        if warm:
+                            server.backend.warmup(
+                                protocol.request_from_wire(warm)
+                            )
+                except KeyError as exc:
+                    self._send_json(404, {"error": f"model not found: {exc}"})
+                except Exception as exc:  # noqa: BLE001
+                    self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
+                else:
+                    self._send_json(200, {"status": "loaded", "model": model})
+
+        return Handler
+
+    def start(self) -> None:
+        """Serve on a daemon thread; returns once the socket is listening."""
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="generation-server", daemon=True
+        )
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        if not self.quiet:
+            term.log_ok(f"generation server listening on :{self.port}")
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self._httpd.server_close()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,242 @@
+"""Serving layer: HTTP server + client backend round trips.
+
+The reference has no loopback harness at all (SURVEY.md §4 — its "remote"
+treatment needs a real second machine); these tests run the full
+client→HTTP→server→backend path hermetically on localhost.
+"""
+
+import threading
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import FakeBackend
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import protocol
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
+    RemoteHTTPBackend,
+    RemoteServerError,
+    backend_from_env,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server import (
+    GenerationServer,
+)
+
+
+@pytest.fixture()
+def server():
+    srv = GenerationServer(
+        FakeBackend(),
+        host="127.0.0.1",
+        port=0,
+        models=["qwen2:1.5b", "gemma:2b"],
+        quiet=True,
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+
+
+def test_protocol_round_trip():
+    req = GenerationRequest(
+        "m", "hello", max_new_tokens=7, temperature=0.5, top_k=3, seed=9
+    )
+    assert protocol.request_from_wire(protocol.request_to_wire(req)) == req
+    result = FakeBackend().generate(req)
+    back = protocol.result_from_wire(protocol.result_to_wire(result), req)
+    assert back.tokens == result.tokens
+    assert back.text == result.text
+    assert back.generated_tokens == result.generated_tokens
+    assert back.prefill_s == pytest.approx(result.prefill_s, abs=1e-6)
+    assert back.decode_s == pytest.approx(result.decode_s, abs=1e-6)
+
+
+def test_request_from_wire_defaults():
+    req = protocol.request_from_wire({"model": "m", "prompt": "p"})
+    assert req.max_new_tokens == 128
+    assert req.temperature == 0.0
+    with pytest.raises(ValueError):
+        protocol.request_from_wire({"prompt": "no model"})
+
+
+def test_health_and_tags(server, client):
+    assert client.health()
+    assert client.list_models() == ["qwen2:1.5b", "gemma:2b"]
+
+
+def test_generate_round_trip(client):
+    req = GenerationRequest("qwen2:1.5b", "In 100 words, tell me", 32)
+    result = client.generate(req)
+    # Same deterministic tokens the fake produces locally
+    assert result.tokens == FakeBackend().generate(req).tokens
+    assert result.generated_tokens == 32
+    assert result.total_s > 0  # client wall time, not server-reported
+    assert result.decode_s > 0
+
+
+def test_unknown_model_is_404(client):
+    with pytest.raises(RemoteServerError) as exc_info:
+        client.generate(GenerationRequest("nope:13b", "hi", 4))
+    assert exc_info.value.status == 404
+
+
+def test_load_and_warmup(server, client):
+    client.load_model("gemma:2b")
+    assert server.backend.loaded.get("gemma:2b")
+    client.warmup(GenerationRequest("gemma:2b", "warm", 4))  # no error
+
+
+def test_bad_json_is_400():
+    import urllib.error
+    import urllib.request
+
+    srv = GenerationServer(FakeBackend(), host="127.0.0.1", port=0, quiet=True)
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/api/generate",
+            data=b"not json",
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_concurrent_requests_serialised(server):
+    """Generation is locked — concurrent posts all succeed (no interleaved
+    backend state), matching the one-accelerator serving model."""
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+    results = {}
+
+    def go(seed):
+        req = GenerationRequest("qwen2:1.5b", "topic", 16, seed=seed)
+        results[seed] = client.generate(req)
+
+    threads = [threading.Thread(target=go, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 4
+    for seed, result in results.items():
+        expected = FakeBackend().generate(
+            GenerationRequest("qwen2:1.5b", "topic", 16, seed=seed)
+        )
+        assert result.tokens == expected.tokens
+
+
+def test_load_falls_back_to_generate_on_plain_ollama(server):
+    """Against a server with no /api/load (real Ollama), load/warmup degrade
+    to a 1-token generate instead of failing the run."""
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+    orig = client._post
+
+    def post_no_load(path, payload, timeout_s):
+        if path == protocol.LOAD_PATH:
+            raise RemoteServerError(404, "page not found")
+        return orig(path, payload, timeout_s)
+
+    client._post = post_no_load
+    client.load_model("qwen2:1.5b")  # no raise
+    client.warmup(GenerationRequest("qwen2:1.5b", "warm", 4))  # no raise
+
+
+def test_remote_http_flops_use_local_registry(server, tmp_path):
+    """Energy modelling for HTTP-remote runs uses the local model registry
+    (a remote backend has no registry; flops must not degrade to 0)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        LlmEnergyConfig,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.context import (
+        RunContext,
+    )
+
+    url = f"http://127.0.0.1:{server.port}"
+    config = LlmEnergyConfig(
+        models=["qwen2:1.5b"],
+        locations=["remote"],
+        lengths=[100],
+        repetitions=1,
+        results_output_path=tmp_path,
+        backends={"remote": RemoteHTTPBackend(url)},
+    )
+    context = RunContext(
+        run_id="run_0_repetition_0",
+        run_nr=1,
+        total_runs=1,
+        variation={"model": "qwen2:1.5b", "location": "remote", "length": 100},
+        run_dir=tmp_path / "run_0_repetition_0",
+        experiment_dir=tmp_path,
+    )
+    config.start_run(context)
+    config.interact(context)
+    assert context.scratch["generation_stats"]["flops"] > 0
+
+
+def test_backend_from_env(monkeypatch, tmp_path):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv("SERVER_IP", raising=False)
+    assert backend_from_env() is None
+    (tmp_path / ".env").write_text("SERVER_IP=10.0.0.5\n")
+    backend = backend_from_env()
+    assert backend is not None
+    assert backend.base_url == "http://10.0.0.5:11434"
+    monkeypatch.setenv("SERVER_IP", "http://host.example:9999")
+    assert backend_from_env().base_url == "http://host.example:9999"
+
+
+def test_experiment_remote_over_http(server, tmp_path):
+    """End-to-end: the study config's remote treatment fetches over a real
+    (loopback) HTTP boundary — the reference's architecture, hermetically."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        LlmEnergyConfig,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.controller import (
+        ExperimentController,
+    )
+
+    url = f"http://127.0.0.1:{server.port}"
+    config = LlmEnergyConfig(
+        models=["qwen2:1.5b"],
+        locations=["remote"],
+        lengths=[100],
+        repetitions=1,
+        results_output_path=tmp_path,
+        cooldown_ms=0,
+        backends={"remote": RemoteHTTPBackend(url)},
+        shuffle=False,
+    )
+    ExperimentController(config).do_experiment()
+    table = (config.experiment_path / "run_table.csv").read_text()
+    assert "DONE" in table
+    assert "remote" in table
+
+
+def test_remote_url_constructor_builds_http_backend(tmp_path):
+    """remote_url wires the HTTP client in before_experiment (no real fetch)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        LlmEnergyConfig,
+    )
+
+    config = LlmEnergyConfig(
+        models=["qwen2:1.5b"],
+        locations=["remote"],
+        lengths=[100],
+        repetitions=1,
+        results_output_path=tmp_path,
+        remote_url="http://192.0.2.1:11434",
+    )
+    config.before_experiment()
+    backend = config._backends["remote"]
+    assert isinstance(backend, RemoteHTTPBackend)
+    assert backend.base_url == "http://192.0.2.1:11434"
