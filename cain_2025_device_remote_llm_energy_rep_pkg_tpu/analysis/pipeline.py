@@ -33,8 +33,8 @@ DEFAULT_METRICS = (
     "memory_usage",
     "tokens_per_s",
 )
-# Every metric column the framework's profilers/workloads can emit; used by
-# ``detect_metrics`` to analyse whatever table it is handed.
+# Every *study-metric* column the framework's profilers/workloads can emit;
+# used by ``detect_metrics`` to analyse whatever table it is handed.
 KNOWN_METRIC_COLUMNS = (
     "energy_J",
     "energy_model_J",
@@ -52,6 +52,9 @@ KNOWN_METRIC_COLUMNS = (
     "host_avg_power_W",
     "wall_energy_J",
     "wall_avg_power_W",
+    # Diagnostic columns the profilers emit (e.g. host_sample_rate_hz) are
+    # deliberately NOT listed: they would drag valid rows through the IQR
+    # outlier filter and get their own hypothesis tests.
 )
 LENGTH_LABELS = {100: "short", 500: "medium", 1000: "long"}
 

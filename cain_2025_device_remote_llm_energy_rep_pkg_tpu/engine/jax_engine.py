@@ -63,10 +63,15 @@ class JaxEngine(GenerationBackend):
         seed: int = 0,
         weight_cache_dir: "Optional[str]" = None,
         quantize: Optional[str] = None,  # None | "int8" (weight-only)
+        hf_checkpoints: Optional[Dict[str, str]] = None,
     ) -> None:
         if quantize not in (None, "int8"):
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
         self.quantize = quantize
+        # model name → local HF checkpoint dir; load_model converts the
+        # trained weights (models/convert.py) instead of random-initialising
+        # (the analogue of Ollama's pulled model store, README.md:29-31).
+        self.hf_checkpoints = dict(hf_checkpoints or {})
         self.registry = dict(registry) if registry is not None else dict(MODEL_REGISTRY)
         self.dtype = dtype
         self.seed = seed
@@ -104,26 +109,43 @@ class JaxEngine(GenerationBackend):
             else get_model_config(model)
         )
         t0 = time.monotonic()
+        if model in self.hf_checkpoints:
+
+            def make_params():
+                from ..models.convert import load_hf_pretrained
+
+                return load_hf_pretrained(
+                    self.hf_checkpoints[model], cfg, dtype=self.dtype
+                )
+
+            # Key the cached pytree to the checkpoint source, so the slow
+            # torch load + conversion happens once per checkpoint, not once
+            # per process start/resume.
+            source = f"hf:{self.hf_checkpoints[model]}"
+        else:
+
+            def make_params():
+                from ..models.transformer import init_params
+
+                return init_params(cfg, jax.random.PRNGKey(self.seed), self.dtype)
+
+            source = "init"
         if self._weight_cache is not None:
             import hashlib
 
-            from ..models.transformer import init_params
-
             # The fingerprint keys the checkpoint to this exact architecture
-            # + dtype; a tiny() test config or a dtype change must not
-            # restore a mismatched checkpoint.
+            # + dtype + weight source; a tiny() test config, a dtype change,
+            # or a different HF checkpoint dir must not restore a mismatched
+            # pytree.
             fingerprint = hashlib.sha256(
-                f"{cfg!r}|{jnp.dtype(self.dtype).name}".encode()
+                f"{cfg!r}|{jnp.dtype(self.dtype).name}|{source}".encode()
             ).hexdigest()[:12]
             params = self._weight_cache.get_or_init(
-                model,
-                self.seed,
-                lambda: init_params(cfg, jax.random.PRNGKey(self.seed), self.dtype),
-                fingerprint=fingerprint,
+                model, self.seed, make_params, fingerprint=fingerprint
             )
             tf = Transformer(cfg=cfg, params=params)
         else:
-            tf = Transformer.initialise(cfg, seed=self.seed, dtype=self.dtype)
+            tf = Transformer(cfg=cfg, params=make_params())
         if self.quantize == "int8":
             from ..models.quantize import quantize_params
 

@@ -150,7 +150,17 @@ class LlmEnergyConfig(ExperimentConfig):
                     # True machine boundary, as in the reference: the remote
                     # treatment fetches over HTTP from a serving host named
                     # by remote_url / the .env SERVER_IP convention
-                    # (experiment/RunnerConfig.py:122-131).
+                    # (experiment/RunnerConfig.py:122-131). Fail fast on an
+                    # unreachable server rather than hours into the sweep.
+                    if not http_backend.health():
+                        from ..runner.errors import ExperimentError
+
+                        raise ExperimentError(
+                            f"remote generation server unreachable at "
+                            f"{http_backend.base_url} (from remote_url / "
+                            f"SERVER_IP); start one with the 'serve' command "
+                            f"or unset the variable to use the local TP mesh"
+                        )
                     self._backends["remote"] = http_backend
                 elif len(jax.devices()) > 1:
                     mesh = build_mesh(MeshSpec.tp_only(self._remote_tp))

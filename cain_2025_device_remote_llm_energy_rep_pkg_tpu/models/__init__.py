@@ -9,6 +9,7 @@ architecture, not the trained values).
 """
 
 from .config import MODEL_REGISTRY, ModelConfig, get_model_config
+from .convert import convert_hf_state_dict, hf_config_for, load_hf_pretrained
 from .tokenizer import ByteTokenizer
 from .transformer import Transformer, init_params
 
@@ -19,4 +20,7 @@ __all__ = [
     "ByteTokenizer",
     "Transformer",
     "init_params",
+    "convert_hf_state_dict",
+    "hf_config_for",
+    "load_hf_pretrained",
 ]

@@ -1,0 +1,180 @@
+"""HuggingFace checkpoint → framework parameter conversion.
+
+The reference gets real model weights by `ollama pull` on an external server
+(README.md:29-31); here weight ingestion is part of the framework: a
+``transformers`` state dict (any of the 7 reference families — llama3.1,
+mistral, qwen2, gemma, phi3) converts into the stacked-[L, ...] pytree the
+TPU transformer runs (models/transformer.py). Conventions that make this a
+pure transpose-and-stack with no numeric fixups:
+
+- RoPE: both sides use the half-split rotation (ops/rope.py ↔ HF
+  ``rotate_half``), so q/k projections copy verbatim.
+- Norms: our ``gemma_norm`` stores the zero-centred gain exactly as HF's
+  GemmaRMSNorm does (effective gain ``1 + w``), so weights copy verbatim.
+- HF ``nn.Linear`` stores [out, in]; our einsum weights are [in, out] →
+  transpose. Phi-3's fused ``qkv_proj``/``gate_up_proj`` are split here.
+
+``torch`` is only needed while converting (CPU torch is in the image); the
+resulting pytree is pure JAX and can be checkpointed via engine/checkpoint.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+def family_of(cfg: ModelConfig) -> str:
+    """Model family key: the part of the registry name before ``:``
+    (``llama3.1:8b`` → ``llama3.1``)."""
+    return cfg.name.split(":", 1)[0].split("-tiny")[0]
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    """torch tensor (any dtype, any device) or array-like → float32 numpy."""
+    if hasattr(tensor, "detach"):  # torch.Tensor without importing torch
+        tensor = tensor.detach().cpu()
+        if str(tensor.dtype) == "torch.bfloat16":
+            tensor = tensor.float()
+        return tensor.numpy()
+    return np.asarray(tensor)
+
+
+def convert_hf_state_dict(
+    state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=None
+) -> Params:
+    """Map a HF causal-LM state dict onto the framework's parameter pytree.
+
+    Accepts the standard llama-style naming (also used by mistral/qwen2/gemma)
+    and phi3's fused projections. ``dtype`` defaults to bfloat16.
+    """
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    sd = {k: v for k, v in state_dict.items()}
+
+    def get(key: str) -> np.ndarray:
+        if key not in sd:
+            raise KeyError(
+                f"{cfg.name}: missing {key!r} in state dict "
+                f"(have {len(sd)} keys, e.g. {sorted(sd)[:3]})"
+            )
+        return _to_numpy(sd[key])
+
+    l = cfg.n_layers
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+    q_dim, kv_dim = hq * dh, hkv * dh
+
+    params: Params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype=dtype),
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype=dtype),
+    }
+
+    per_layer: Dict[str, list] = {
+        k: []
+        for k in (
+            "attn_norm",
+            "wq",
+            "wk",
+            "wv",
+            "wo",
+            "mlp_norm",
+            "w_gate",
+            "w_up",
+            "w_down",
+            "bq",
+            "bk",
+            "bv",
+        )
+    }
+    for i in range(l):
+        p = f"model.layers.{i}"
+        per_layer["attn_norm"].append(get(f"{p}.input_layernorm.weight"))
+        per_layer["mlp_norm"].append(get(f"{p}.post_attention_layernorm.weight"))
+        if f"{p}.self_attn.qkv_proj.weight" in sd:  # phi3 fused
+            qkv = get(f"{p}.self_attn.qkv_proj.weight")  # [q+2kv, D]
+            per_layer["wq"].append(qkv[:q_dim].T)
+            per_layer["wk"].append(qkv[q_dim : q_dim + kv_dim].T)
+            per_layer["wv"].append(qkv[q_dim + kv_dim :].T)
+        else:
+            per_layer["wq"].append(get(f"{p}.self_attn.q_proj.weight").T)
+            per_layer["wk"].append(get(f"{p}.self_attn.k_proj.weight").T)
+            per_layer["wv"].append(get(f"{p}.self_attn.v_proj.weight").T)
+        per_layer["wo"].append(get(f"{p}.self_attn.o_proj.weight").T)
+        if cfg.qkv_bias:
+            per_layer["bq"].append(get(f"{p}.self_attn.q_proj.bias"))
+            per_layer["bk"].append(get(f"{p}.self_attn.k_proj.bias"))
+            per_layer["bv"].append(get(f"{p}.self_attn.v_proj.bias"))
+        if f"{p}.mlp.gate_up_proj.weight" in sd:  # phi3 fused
+            gate_up = get(f"{p}.mlp.gate_up_proj.weight")  # [2F, D]
+            per_layer["w_gate"].append(gate_up[: cfg.d_ff].T)
+            per_layer["w_up"].append(gate_up[cfg.d_ff :].T)
+        else:
+            per_layer["w_gate"].append(get(f"{p}.mlp.gate_proj.weight").T)
+            per_layer["w_up"].append(get(f"{p}.mlp.up_proj.weight").T)
+        per_layer["w_down"].append(get(f"{p}.mlp.down_proj.weight").T)
+
+    for key, mats in per_layer.items():
+        if mats:
+            params[key] = jnp.asarray(np.stack(mats), dtype=dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype=dtype)
+    return params
+
+
+def hf_config_for(cfg: ModelConfig):
+    """The matching ``transformers`` config object for a registry entry —
+    used to instantiate parity-test models and to validate checkpoints."""
+    family = family_of(cfg)
+    common = dict(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        intermediate_size=cfg.d_ff,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.norm_eps,
+        tie_word_embeddings=cfg.tie_embeddings,
+        max_position_embeddings=cfg.max_seq_len,
+    )
+    if family.startswith("llama"):
+        from transformers import LlamaConfig
+
+        return LlamaConfig(head_dim=cfg.d_head, attention_bias=cfg.qkv_bias, **common)
+    if family == "mistral":
+        from transformers import MistralConfig
+
+        return MistralConfig(head_dim=cfg.d_head, **common)
+    if family == "qwen2":
+        from transformers import Qwen2Config
+
+        return Qwen2Config(**common)
+    if family == "gemma":
+        from transformers import GemmaConfig
+
+        return GemmaConfig(
+            head_dim=cfg.d_head, hidden_activation="gelu_pytorch_tanh", **common
+        )
+    if family == "phi3":
+        from transformers import Phi3Config
+
+        # Phi3Config's default pad_token_id (32000) exceeds small test
+        # vocabularies; 0 is safe for weight conversion (padding only
+        # affects embedding-gradient masking, not forward values).
+        return Phi3Config(pad_token_id=0, **common)
+    raise KeyError(f"no HF config mapping for family {family!r} ({cfg.name})")
+
+
+def load_hf_pretrained(path: str, cfg: ModelConfig, dtype=None) -> Params:
+    """Load a local HF checkpoint directory and convert it. (No network in
+    the build image: ``path`` must be an on-disk checkpoint.)"""
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(path)
+    return convert_hf_state_dict(model.state_dict(), cfg, dtype=dtype)

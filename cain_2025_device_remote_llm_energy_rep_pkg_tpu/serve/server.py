@@ -128,6 +128,9 @@ class GenerationServer:
                 if not model:
                     self._send_json(400, {"error": "load requires 'model'"})
                     return
+                if server.models and model not in server.models:
+                    self._send_json(404, {"error": f"model {model!r} not found"})
+                    return
                 try:
                     with server._generate_lock:
                         server.backend.load_model(str(model))
@@ -163,7 +166,10 @@ class GenerationServer:
             self._httpd.server_close()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        # shutdown() blocks on an event only serve_forever() sets; skip it
+        # when the serve loop never started (e.g. setup failed before start).
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -1,0 +1,154 @@
+"""HF-checkpoint conversion: logit-level parity with ``transformers``.
+
+The strongest correctness evidence for the model implementations: for each
+reference family, a randomly-initialised HuggingFace model's logits must
+match our transformer's logits on the converted weights (both float32).
+The reference itself never validates model outputs (generation is Ollama's
+problem, experiment/RunnerConfig.py:128-131); here it is a test invariant.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.convert import (
+    convert_hf_state_dict,
+    family_of,
+    hf_config_for,
+)
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def tiny_cfg(registry_name: str, **overrides):
+    """Structure-preserving miniature with d_model == n_heads · d_head so
+    every HF family accepts it (phi3 derives head_dim from the quotient)."""
+    base = get_model_config(registry_name)
+    defaults = dict(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_head=16,
+        d_ff=96,
+        max_seq_len=128,
+    )
+    defaults.update(overrides)
+    return dataclasses.replace(base, **defaults)
+
+
+FAMILIES = [
+    tiny_cfg("llama3.1:8b"),
+    tiny_cfg("mistral:7b"),
+    tiny_cfg("qwen2:1.5b"),  # qkv_bias + tied embeddings
+    tiny_cfg("gemma:2b", n_kv_heads=1),  # gelu + (1+w) norm + embed scaling
+    tiny_cfg("phi3:3.8b", n_kv_heads=4),  # fused qkv_proj / gate_up_proj
+]
+
+
+def hf_model_for(cfg):
+    hf_cfg = hf_config_for(cfg)
+    model = transformers.AutoModelForCausalLM.from_config(
+        hf_cfg, attn_implementation="eager"
+    )
+    model.eval()
+    return model
+
+
+@pytest.mark.parametrize("cfg", FAMILIES, ids=[family_of(c) for c in FAMILIES])
+def test_logits_match_hf(cfg):
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+        forward,
+        logits_for,
+    )
+
+    torch.manual_seed(0)
+    model = hf_model_for(cfg)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(1, cfg.vocab_size, size=(2, 9))
+
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(tokens)).logits.numpy()
+
+    params = convert_hf_state_dict(model.state_dict(), cfg, dtype=jnp.float32)
+    shape = (cfg.n_layers, 2, cfg.n_kv_heads, 16, cfg.d_head)
+    k_cache = jnp.zeros(shape, dtype=jnp.float32)
+    v_cache = jnp.zeros(shape, dtype=jnp.float32)
+    hidden, _, _ = forward(
+        params, cfg, jnp.asarray(tokens, dtype=jnp.int32), jnp.int32(0),
+        k_cache, v_cache,
+    )
+    ours = np.asarray(logits_for(params, cfg, hidden))
+
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-4)
+
+
+def test_phi3_fused_split_matches_unfused_shapes():
+    cfg = tiny_cfg("phi3:3.8b", n_kv_heads=4)
+    model = hf_model_for(cfg)
+    sd = model.state_dict()
+    assert "model.layers.0.self_attn.qkv_proj.weight" in sd
+    params = convert_hf_state_dict(sd, cfg)
+    assert params["wq"].shape == (2, 64, 64)
+    assert params["wk"].shape == (2, 64, 64)
+    assert params["w_gate"].shape == (2, 64, 96)
+    assert params["w_up"].shape == (2, 64, 96)
+
+
+def test_missing_key_reports_model_and_key():
+    cfg = tiny_cfg("llama3.1:8b")
+    with pytest.raises(KeyError, match="embed_tokens"):
+        convert_hf_state_dict({}, cfg)
+
+
+def test_engine_serves_converted_checkpoint(tmp_path):
+    """JaxEngine loads a local HF checkpoint dir instead of random weights."""
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+
+    cfg = tiny_cfg("mistral:7b")
+    model = hf_model_for(cfg)
+    ckpt_dir = tmp_path / "ckpt"
+    model.save_pretrained(ckpt_dir)
+
+    engine = JaxEngine(
+        registry={cfg.name: cfg},
+        dtype=jnp.float32,
+        hf_checkpoints={cfg.name: str(ckpt_dir)},
+    )
+    result = engine.generate(GenerationRequest(cfg.name, "hello", max_new_tokens=4))
+    assert result.generated_tokens >= 1
+    # The loaded params are the converted checkpoint, not a random init
+    expected = convert_hf_state_dict(model.state_dict(), cfg, dtype=jnp.float32)
+    loaded = engine._models[cfg.name].params
+    np.testing.assert_array_equal(
+        np.asarray(loaded["embed"]), np.asarray(expected["embed"])
+    )
+
+
+def test_registry_configs_all_map_to_hf():
+    """Every entry in the 7-model sweep has a valid HF config mapping with
+    consistent dimensions (guards registry hyperparameter typos)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        MODEL_REGISTRY,
+    )
+
+    for cfg in MODEL_REGISTRY.values():
+        hf_cfg = hf_config_for(cfg)
+        assert hf_cfg.hidden_size == cfg.d_model
+        assert hf_cfg.num_attention_heads == cfg.n_heads
+        assert getattr(hf_cfg, "head_dim", cfg.d_head) == cfg.d_head

@@ -185,7 +185,10 @@ def test_remote_http_flops_use_local_registry(server, tmp_path):
 
 def test_backend_from_env(monkeypatch, tmp_path):
     monkeypatch.chdir(tmp_path)
-    monkeypatch.delenv("SERVER_IP", raising=False)
+    # Register SERVER_IP with monkeypatch FIRST so teardown restores the
+    # pre-test state even though load_dotenv mutates os.environ directly.
+    monkeypatch.setenv("SERVER_IP", "placeholder")
+    monkeypatch.delenv("SERVER_IP")
     assert backend_from_env() is None
     (tmp_path / ".env").write_text("SERVER_IP=10.0.0.5\n")
     backend = backend_from_env()
@@ -222,10 +225,36 @@ def test_experiment_remote_over_http(server, tmp_path):
     assert "remote" in table
 
 
-def test_remote_url_constructor_builds_http_backend(tmp_path):
-    """remote_url wires the HTTP client in before_experiment (no real fetch)."""
+def test_remote_url_constructor_builds_http_backend(server, tmp_path):
+    """remote_url wires the HTTP client in before_experiment (health-checked,
+    no generation)."""
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
         LlmEnergyConfig,
+    )
+
+    url = f"http://127.0.0.1:{server.port}"
+    config = LlmEnergyConfig(
+        models=["qwen2:1.5b"],
+        locations=["remote"],
+        lengths=[100],
+        repetitions=1,
+        results_output_path=tmp_path,
+        remote_url=url,
+    )
+    config.before_experiment()
+    backend = config._backends["remote"]
+    assert isinstance(backend, RemoteHTTPBackend)
+    assert backend.base_url == url
+
+
+def test_unreachable_remote_url_fails_fast(tmp_path):
+    """An unreachable serving host aborts in before_experiment, not hours
+    into the sweep (127.0.0.1:9 is a closed port — connection refused)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        LlmEnergyConfig,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.errors import (
+        ExperimentError,
     )
 
     config = LlmEnergyConfig(
@@ -234,9 +263,20 @@ def test_remote_url_constructor_builds_http_backend(tmp_path):
         lengths=[100],
         repetitions=1,
         results_output_path=tmp_path,
-        remote_url="http://192.0.2.1:11434",
+        remote_url="http://127.0.0.1:9",
     )
-    config.before_experiment()
-    backend = config._backends["remote"]
-    assert isinstance(backend, RemoteHTTPBackend)
-    assert backend.base_url == "http://192.0.2.1:11434"
+    with pytest.raises(ExperimentError, match="unreachable"):
+        config.before_experiment()
+
+
+def test_load_respects_model_allowlist(server, client):
+    """/api/load enforces --models like /api/generate (no loading excluded
+    models into HBM via the load path)."""
+    with pytest.raises(RemoteServerError) as exc_info:
+        client.load_model("llama3.1:8b")  # not in server.models
+    assert exc_info.value.status == 404
+
+
+def test_stop_without_start_does_not_deadlock():
+    srv = GenerationServer(FakeBackend(), host="127.0.0.1", port=0, quiet=True)
+    srv.stop()  # must return, not block on the serve loop's shutdown event
