@@ -47,6 +47,22 @@ def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
     raise ValueError(f"{n} exceeds the largest bucket {buckets[-1]}")
 
 
+def _dir_signature(path: str) -> str:
+    """Cheap content signature of a checkpoint dir: latest mtime_ns + bytes."""
+    import os
+
+    latest, total = 0, 0
+    for root, _, files in os.walk(path):
+        for f in files:
+            try:
+                st = os.stat(os.path.join(root, f))
+            except OSError:
+                continue
+            latest = max(latest, st.st_mtime_ns)
+            total += st.st_size
+    return f"{latest}:{total}"
+
+
 class JaxEngine(GenerationBackend):
     """In-process generation over the model registry.
 
@@ -118,10 +134,15 @@ class JaxEngine(GenerationBackend):
                     self.hf_checkpoints[model], cfg, dtype=self.dtype
                 )
 
-            # Key the cached pytree to the checkpoint source, so the slow
-            # torch load + conversion happens once per checkpoint, not once
-            # per process start/resume.
-            source = f"hf:{self.hf_checkpoints[model]}"
+            # Key the cached pytree to the checkpoint source AND its content
+            # signature (latest mtime + total size), so the slow torch load
+            # happens once per checkpoint — but an in-place re-download or
+            # fine-tune at the same path misses the cache instead of
+            # silently serving stale weights.
+            source = (
+                f"hf:{self.hf_checkpoints[model]}"
+                f"|{_dir_signature(self.hf_checkpoints[model])}"
+            )
         else:
 
             def make_params():

@@ -33,6 +33,12 @@ from .quantize import maybe_dequant
 
 Params = Dict[str, Any]
 
+# Param leaves WITHOUT the leading stacked-layer [L, …] axis. Everything not
+# named here is scanned as a per-layer block (forward) and stage-sharded by
+# pipeline parallelism (parallel/pp.py) — keep the two views in sync by
+# defining the set exactly once, here.
+NON_LAYER_LEAVES = ("embed", "final_norm", "lm_head")
+
 # Signature: (q[B,Hq,D], k_cache[B,Hkv,T,D], v_cache[B,Hkv,T,D], lengths[B]) -> [B,Hq,D]
 DecodeAttentionFn = Callable[
     [jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray
@@ -172,8 +178,34 @@ def forward(
     positions = jnp.broadcast_to(positions, (b, s))
     cos, sin = rope_angles(positions, cfg.d_head, cfg.rope_theta)
 
-    layer_keys = [k for k in params if k not in ("embed", "final_norm", "lm_head")]
-    stacked = {k: params[k] for k in layer_keys}
+    stacked = {k: v for k, v in params.items() if k not in NON_LAYER_LEAVES}
+
+    x, new_k, new_v = run_blocks(
+        stacked, cfg, x, offset, k_cache, v_cache, cos, sin, decode_attention
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
+    return x, new_k, new_v
+
+
+def run_blocks(
+    stacked: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,  # [B,S,D] embedded inputs
+    offset: jnp.ndarray,
+    k_cache: jnp.ndarray,  # [L',B,Hkv,T,Dh] — L' may be a slice of the stack
+    v_cache: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    decode_attention: Optional[DecodeAttentionFn] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Scan the transformer blocks in ``stacked`` over ``x``.
+
+    Factored out of :func:`forward` so every execution mode — single-device
+    prefill/decode, the TP path, and the pipeline-parallel stage slice
+    (parallel/pp.py, where each stage holds L/S layers of the stack) — runs
+    the *same* layer math; there is exactly one implementation to keep
+    correct per architecture quirk (gemma norms, qwen2 biases, …).
+    """
 
     def block(x, scanned):
         layer, kc, vc = scanned
@@ -193,7 +225,6 @@ def forward(
         return x + mlp_out, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(block, x, (stacked, k_cache, v_cache))
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
     return x, new_k, new_v
 
 

@@ -13,6 +13,7 @@ Everything here is mesh-shape-agnostic: tests and the driver's dry run use
 """
 
 from .mesh import MeshSpec, build_mesh
+from .pp import make_pp_grad, make_pp_loss, make_pp_train_step, pp_param_specs
 from .sharding import param_shardings, cache_shardings, shard_model
 
 __all__ = [
@@ -21,4 +22,8 @@ __all__ = [
     "param_shardings",
     "cache_shardings",
     "shard_model",
+    "make_pp_grad",
+    "make_pp_loss",
+    "make_pp_train_step",
+    "pp_param_specs",
 ]

@@ -21,8 +21,8 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 
 def _block_attend(
@@ -102,6 +102,6 @@ def make_ring_attention(
         mesh=mesh,
         in_specs=(seq_sharded, seq_sharded, seq_sharded),
         out_specs=seq_sharded,
-        check_rep=False,
+        check_vma=False,
     )
     return fn

@@ -50,6 +50,9 @@ class GenerationServer:
         self._generate_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
+        # Set whenever a serve loop is live (threaded start() OR blocking
+        # serve_forever()) — stop() keys shutdown() on it, not on _thread.
+        self._serving = threading.Event()
 
     @property
     def port(self) -> int:
@@ -129,7 +132,13 @@ class GenerationServer:
                     self._send_json(400, {"error": "load requires 'model'"})
                     return
                 if server.models and model not in server.models:
-                    self._send_json(404, {"error": f"model {model!r} not found"})
+                    # 403, not 404: the client reads a 404 from /api/load as
+                    # "plain Ollama without this endpoint" and falls back to
+                    # a warm-up generate (serve/client.py) — an allowlist
+                    # rejection must be distinguishable from that.
+                    self._send_json(
+                        403, {"error": f"model {model!r} not in served set"}
+                    )
                     return
                 try:
                     with server._generate_lock:
@@ -154,22 +163,29 @@ class GenerationServer:
             target=self._httpd.serve_forever, name="generation-server", daemon=True
         )
         self._thread.start()
+        # Only after start() returns: if the thread failed to launch, a
+        # cleanup stop() must not block in shutdown() waiting on a serve
+        # loop that never began.
+        self._serving.set()
 
     def serve_forever(self) -> None:
         if not self.quiet:
             term.log_ok(f"generation server listening on :{self.port}")
+        self._serving.set()
         try:
             self._httpd.serve_forever()
         except KeyboardInterrupt:
             pass
         finally:
+            self._serving.clear()
             self._httpd.server_close()
 
     def stop(self) -> None:
         # shutdown() blocks on an event only serve_forever() sets; skip it
-        # when the serve loop never started (e.g. setup failed before start).
-        if self._thread is not None:
+        # when no serve loop ever started (e.g. setup failed before start).
+        if self._serving.is_set():
             self._httpd.shutdown()
+            self._serving.clear()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)

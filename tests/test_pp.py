@@ -1,0 +1,130 @@
+"""Pipeline parallelism: the GPipe schedule must match single-device math.
+
+SURVEY.md §4 prescribes virtual-device testing for every multi-chip path;
+the strongest check for a pipeline schedule is exact numerical parity of
+loss AND gradients against the unpipelined step (same params, same tokens).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+    Transformer,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.pp import (
+    make_pp_grad,
+    make_pp_loss,
+    make_pp_train_step,
+    pp_param_specs,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.train import (
+    next_token_loss,
+)
+
+
+def _setup(model="mistral:7b", n_layers=4, seed=0, batch=4, seq=12):
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        get_model_config(model).tiny(), n_layers=n_layers
+    )
+    tf = Transformer.initialise(cfg, seed=seed, dtype=jnp.float32)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(seed + 1), (batch, seq), 0, cfg.vocab_size
+    )
+    return cfg, tf.params, tokens
+
+
+def _reference_loss_and_grads(cfg, params, tokens):
+    def loss_fn(p):
+        b, s = tokens.shape
+        shape = (cfg.n_layers, b, cfg.n_kv_heads, s - 1, cfg.d_head)
+        k0 = jnp.zeros(shape, dtype=jnp.float32)
+        v0 = jnp.zeros(shape, dtype=jnp.float32)
+        return next_token_loss(p, cfg, tokens, k0, v0)
+
+    return jax.value_and_grad(loss_fn)(params)
+
+
+@pytest.mark.parametrize("pp,m", [(2, 2), (4, 4), (4, 2)])
+def test_pp_loss_matches_single_device(pp, m):
+    cfg, params, tokens = _setup(n_layers=4, batch=4)
+    mesh = build_mesh(MeshSpec(axes=(("pp", pp),)), jax.devices()[:pp])
+    pp_loss = jax.jit(make_pp_loss(cfg, mesh, n_microbatches=m))
+    ref_loss, _ = _reference_loss_and_grads(cfg, params, tokens)
+    np.testing.assert_allclose(
+        float(pp_loss(params, tokens)), float(ref_loss), rtol=2e-5
+    )
+
+
+# gemma:2b exercises every architecture quirk the pipelined path must share
+# with the single-device path: tied embeddings, sqrt(d) embed scaling,
+# (1+w) gemma norms, and the gelu MLP.
+def test_pp_loss_matches_single_device_gemma():
+    pp, m = 2, 2
+    cfg, params, tokens = _setup(model="gemma:2b", n_layers=4, batch=4)
+    mesh = build_mesh(MeshSpec(axes=(("pp", pp),)), jax.devices()[:pp])
+    pp_loss = jax.jit(make_pp_loss(cfg, mesh, n_microbatches=m))
+    ref_loss, _ = _reference_loss_and_grads(cfg, params, tokens)
+    np.testing.assert_allclose(
+        float(pp_loss(params, tokens)), float(ref_loss), rtol=2e-5
+    )
+
+
+def test_pp_grads_match_single_device():
+    cfg, params, tokens = _setup(n_layers=4, batch=4)
+    mesh = build_mesh(MeshSpec(axes=(("pp", 4),)), jax.devices()[:4])
+    loss, grads = jax.jit(make_pp_grad(cfg, mesh, n_microbatches=2))(
+        params, tokens
+    )
+    ref_loss, ref_grads = _reference_loss_and_grads(cfg, params, tokens)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    for name in params:
+        np.testing.assert_allclose(
+            np.asarray(grads[name]),
+            np.asarray(ref_grads[name]),
+            atol=1e-5,
+            rtol=1e-3,
+            err_msg=f"grad mismatch for {name}",
+        )
+
+
+def test_pp_train_step_decreases_loss_and_keeps_sharding():
+    cfg, params, tokens = _setup(model="qwen2:1.5b", n_layers=4, batch=4)
+    mesh = build_mesh(MeshSpec(axes=(("pp", 4),)), jax.devices()[:4])
+    init_fn, step = make_pp_train_step(
+        cfg, mesh, n_microbatches=2, learning_rate=1e-2
+    )
+    params, opt_state = init_fn(params)
+    from jax.sharding import NamedSharding
+
+    def _is_stage_sharded(arr):
+        return arr.sharding.is_equivalent_to(
+            NamedSharding(mesh, pp_param_specs(cfg)["wq"]), arr.ndim
+        )
+
+    assert _is_stage_sharded(params["wq"])
+
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert _is_stage_sharded(params["wq"])
+
+
+def test_pp_rejects_indivisible_layers():
+    cfg, params, tokens = _setup(n_layers=4)
+    mesh = build_mesh(MeshSpec(axes=(("pp", 3),)), jax.devices()[:3])
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pp_loss(cfg, mesh, n_microbatches=2)

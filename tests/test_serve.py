@@ -271,10 +271,12 @@ def test_unreachable_remote_url_fails_fast(tmp_path):
 
 def test_load_respects_model_allowlist(server, client):
     """/api/load enforces --models like /api/generate (no loading excluded
-    models into HBM via the load path)."""
+    models into HBM via the load path). The rejection is 403, not 404 —
+    the client reads a 404 from /api/load as "plain Ollama without this
+    endpoint" and would fall back to a warm-up generate."""
     with pytest.raises(RemoteServerError) as exc_info:
         client.load_model("llama3.1:8b")  # not in server.models
-    assert exc_info.value.status == 404
+    assert exc_info.value.status == 403
 
 
 def test_stop_without_start_does_not_deadlock():
