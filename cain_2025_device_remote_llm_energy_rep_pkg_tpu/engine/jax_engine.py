@@ -125,24 +125,14 @@ class JaxEngine(GenerationBackend):
             else get_model_config(model)
         )
         t0 = time.monotonic()
-        if model in self.hf_checkpoints:
+        ckpt_dir = self.hf_checkpoints.get(model)
+        if ckpt_dir is not None:
 
             def make_params():
                 from ..models.convert import load_hf_pretrained
 
-                return load_hf_pretrained(
-                    self.hf_checkpoints[model], cfg, dtype=self.dtype
-                )
+                return load_hf_pretrained(ckpt_dir, cfg, dtype=self.dtype)
 
-            # Key the cached pytree to the checkpoint source AND its content
-            # signature (latest mtime + total size), so the slow torch load
-            # happens once per checkpoint — but an in-place re-download or
-            # fine-tune at the same path misses the cache instead of
-            # silently serving stale weights.
-            source = (
-                f"hf:{self.hf_checkpoints[model]}"
-                f"|{_dir_signature(self.hf_checkpoints[model])}"
-            )
         else:
 
             def make_params():
@@ -150,14 +140,21 @@ class JaxEngine(GenerationBackend):
 
                 return init_params(cfg, jax.random.PRNGKey(self.seed), self.dtype)
 
-            source = "init"
         if self._weight_cache is not None:
             import hashlib
 
             # The fingerprint keys the checkpoint to this exact architecture
             # + dtype + weight source; a tiny() test config, a dtype change,
             # or a different HF checkpoint dir must not restore a mismatched
-            # pytree.
+            # pytree. HF sources also include a content signature (latest
+            # mtime + total size — computed only here, when a cache could
+            # serve stale weights) so an in-place re-download or fine-tune
+            # at the same path misses the cache.
+            source = (
+                f"hf:{ckpt_dir}|{_dir_signature(ckpt_dir)}"
+                if ckpt_dir is not None
+                else "init"
+            )
             fingerprint = hashlib.sha256(
                 f"{cfg!r}|{jnp.dtype(self.dtype).name}|{source}".encode()
             ).hexdigest()[:12]

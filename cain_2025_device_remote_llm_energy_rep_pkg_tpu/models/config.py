@@ -29,6 +29,11 @@ class ModelConfig:
     tie_embeddings: bool = False
     qkv_bias: bool = False  # qwen2 uses attention biases
     max_seq_len: int = 8192
+    # Mixture-of-experts MLP (0 = dense). With n_experts > 0 the MLP weights
+    # gain a leading expert axis and a per-layer router picks top_k_experts
+    # per token (Mixtral-style, renormalised top-k softmax weights).
+    n_experts: int = 0
+    top_k_experts: int = 2
 
     def __post_init__(self) -> None:
         if self.n_heads % self.n_kv_heads != 0:
@@ -38,6 +43,11 @@ class ModelConfig:
             )
         if self.d_head % 2 != 0:
             raise ValueError(f"{self.name}: d_head must be even for RoPE")
+        if self.n_experts and self.top_k_experts > self.n_experts:
+            raise ValueError(
+                f"{self.name}: top_k_experts {self.top_k_experts} exceeds "
+                f"n_experts {self.n_experts}"
+            )
 
     @property
     def params_count(self) -> int:
@@ -46,9 +56,10 @@ class ModelConfig:
         q = self.d_model * self.n_heads * self.d_head
         kv = 2 * self.d_model * self.n_kv_heads * self.d_head
         o = self.n_heads * self.d_head * self.d_model
-        mlp = 3 * self.d_model * self.d_ff
+        mlp = 3 * self.d_model * self.d_ff * max(1, self.n_experts)
+        router = self.d_model * self.n_experts
         norms = 2 * self.d_model
-        return embed + self.n_layers * (q + kv + o + mlp + norms) + self.d_model
+        return embed + self.n_layers * (q + kv + o + mlp + router + norms) + self.d_model
 
     def flops_per_token(self, context_len: int) -> float:
         """Approx. forward FLOPs for one decoded token at the given context:
@@ -57,7 +68,9 @@ class ModelConfig:
         q = self.d_model * self.n_heads * self.d_head
         kv = 2 * self.d_model * self.n_kv_heads * self.d_head
         o = self.n_heads * self.d_head * self.d_model
-        mlp = 3 * self.d_model * self.d_ff
+        # MoE: only top_k experts' FLOPs count per token, plus the router.
+        active = self.top_k_experts if self.n_experts else 1
+        mlp = 3 * self.d_model * self.d_ff * active + self.d_model * self.n_experts
         logits = self.d_model * self.vocab_size
         dense = 2 * (self.n_layers * (q + kv + o + mlp) + logits)
         attn = 4 * self.n_layers * context_len * self.n_heads * self.d_head
@@ -168,6 +181,21 @@ MODEL_REGISTRY: Dict[str, ModelConfig] = {
             d_head=128,
             d_ff=14_336,
             rope_theta=5e5,
+        ),
+        # Beyond the reference's 7-model sweep: the MoE family Ollama also
+        # serves, exercising the expert-parallel (ep) sharding path.
+        ModelConfig(
+            name="mixtral:8x7b",  # Mixtral-8x7B-Instruct-v0.1
+            vocab_size=32_000,
+            d_model=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=8,
+            d_head=128,
+            d_ff=14_336,
+            rope_theta=1e6,
+            n_experts=8,
+            top_k_experts=2,
         ),
     ]
 }

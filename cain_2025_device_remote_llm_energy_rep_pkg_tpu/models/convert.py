@@ -87,6 +87,7 @@ def convert_hf_state_dict(
             "w_gate",
             "w_up",
             "w_down",
+            "router",
             "bq",
             "bk",
             "bv",
@@ -110,14 +111,29 @@ def convert_hf_state_dict(
             per_layer["bq"].append(get(f"{p}.self_attn.q_proj.bias"))
             per_layer["bk"].append(get(f"{p}.self_attn.k_proj.bias"))
             per_layer["bv"].append(get(f"{p}.self_attn.v_proj.bias"))
-        if f"{p}.mlp.gate_up_proj.weight" in sd:  # phi3 fused
+        if cfg.n_experts:  # mixtral block-sparse MoE
+            moe = f"{p}.block_sparse_moe"
+            per_layer["router"].append(get(f"{moe}.gate.weight").T)  # [D,E]
+            # Experts stack to [E, D, F] / [E, F, D]; HF w1=gate, w3=up,
+            # w2=down (each nn.Linear [out, in] → transpose).
+            per_layer["w_gate"].append(
+                np.stack([get(f"{moe}.experts.{e}.w1.weight").T for e in range(cfg.n_experts)])
+            )
+            per_layer["w_up"].append(
+                np.stack([get(f"{moe}.experts.{e}.w3.weight").T for e in range(cfg.n_experts)])
+            )
+            per_layer["w_down"].append(
+                np.stack([get(f"{moe}.experts.{e}.w2.weight").T for e in range(cfg.n_experts)])
+            )
+        elif f"{p}.mlp.gate_up_proj.weight" in sd:  # phi3 fused
             gate_up = get(f"{p}.mlp.gate_up_proj.weight")  # [2F, D]
             per_layer["w_gate"].append(gate_up[: cfg.d_ff].T)
             per_layer["w_up"].append(gate_up[cfg.d_ff :].T)
+            per_layer["w_down"].append(get(f"{p}.mlp.down_proj.weight").T)
         else:
             per_layer["w_gate"].append(get(f"{p}.mlp.gate_proj.weight").T)
             per_layer["w_up"].append(get(f"{p}.mlp.up_proj.weight").T)
-        per_layer["w_down"].append(get(f"{p}.mlp.down_proj.weight").T)
+            per_layer["w_down"].append(get(f"{p}.mlp.down_proj.weight").T)
 
     for key, mats in per_layer.items():
         if mats:
@@ -151,6 +167,15 @@ def hf_config_for(cfg: ModelConfig):
         from transformers import MistralConfig
 
         return MistralConfig(head_dim=cfg.d_head, **common)
+    if family == "mixtral":
+        from transformers import MixtralConfig
+
+        return MixtralConfig(
+            head_dim=cfg.d_head,
+            num_local_experts=cfg.n_experts,
+            num_experts_per_tok=cfg.top_k_experts,
+            **common,
+        )
     if family == "qwen2":
         from transformers import Qwen2Config
 

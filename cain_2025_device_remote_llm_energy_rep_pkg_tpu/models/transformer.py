@@ -58,6 +58,9 @@ def init_params(
             jax.random.normal(k, shape, dtype=jnp.float32) / math.sqrt(fan_in)
         ).astype(dtype)
 
+    # MoE MLPs carry a leading expert axis [L, E, D, F]; dense is [L, D, F].
+    e = (cfg.n_experts,) if cfg.n_experts else ()
+
     params: Params = {
         "embed": (
             jax.random.normal(keys[0], (cfg.vocab_size, d), dtype=jnp.float32) * 0.02
@@ -72,9 +75,9 @@ def init_params(
         "mlp_norm": jnp.ones((l, d), dtype=dtype)
         if not cfg.gemma_norm
         else jnp.zeros((l, d), dtype=dtype),
-        "w_gate": mat(keys[5], (l, d, f), d),
-        "w_up": mat(keys[6], (l, d, f), d),
-        "w_down": mat(keys[7], (l, f, d), f),
+        "w_gate": mat(keys[5], (l, *e, d, f), d),
+        "w_up": mat(keys[6], (l, *e, d, f), d),
+        "w_down": mat(keys[7], (l, *e, f, d), f),
         "final_norm": jnp.ones((d,), dtype=dtype)
         if not cfg.gemma_norm
         else jnp.zeros((d,), dtype=dtype),
@@ -83,6 +86,8 @@ def init_params(
         params["bq"] = jnp.zeros((l, hq * dh), dtype=dtype)
         params["bk"] = jnp.zeros((l, hkv * dh), dtype=dtype)
         params["bv"] = jnp.zeros((l, hkv * dh), dtype=dtype)
+    if cfg.n_experts:
+        params["router"] = mat(keys[9], (l, d, cfg.n_experts), d)
     if not cfg.tie_embeddings:
         params["lm_head"] = mat(keys[8], (d, cfg.vocab_size), d)
     return params
@@ -92,6 +97,42 @@ def _activation(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.activation == "gelu":
         return jax.nn.gelu(x, approximate=True)
     return jax.nn.silu(x)
+
+
+def _moe_mlp(cfg: ModelConfig, h: jnp.ndarray, layer: Params) -> jnp.ndarray:
+    """Mixtral-style top-k MoE MLP with dense (einsum) dispatch.
+
+    Router softmax in f32, top-k weights renormalised (matches HF Mixtral).
+    Dispatch is *dense*: every expert computes every token and the combine
+    einsum contracts the expert axis — static shapes, no gather/scatter, and
+    under GSPMD the expert axis shards over the ``ep`` mesh axis so each
+    device runs only its local E/ep experts followed by one psum
+    (parallel/sharding.py). Overcompute vs top-k routing is E/k per device
+    divided by ep; an all_to_all token-dispatch kernel is the follow-up for
+    very large E.
+    """
+    router_logits = jnp.einsum(
+        "bsd,de->bse",
+        h.astype(jnp.float32),
+        maybe_dequant(layer["router"], jnp.float32),
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, cfg.top_k_experts)
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    # [B,S,k] weights scattered to a dense [B,S,E] combine tensor.
+    combine = jnp.sum(
+        jax.nn.one_hot(top_i, cfg.n_experts, dtype=jnp.float32)
+        * top_w[..., None],
+        axis=-2,
+    ).astype(h.dtype)
+    gate = _activation(
+        cfg, jnp.einsum("bsd,edf->bsef", h, maybe_dequant(layer["w_gate"], h.dtype))
+    )
+    up = jnp.einsum("bsd,edf->bsef", h, maybe_dequant(layer["w_up"], h.dtype))
+    y = jnp.einsum(
+        "bsef,efd->bsed", gate * up, maybe_dequant(layer["w_down"], h.dtype)
+    )
+    return jnp.einsum("bse,bsed->bsd", combine, y)
 
 
 def _attention_block(
@@ -215,13 +256,17 @@ def run_blocks(
         )
         x = x + attn_out
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
-        gate = _activation(
-            cfg, jnp.einsum("bsd,df->bsf", h, maybe_dequant(layer["w_gate"], h.dtype))
-        )
-        up = jnp.einsum("bsd,df->bsf", h, maybe_dequant(layer["w_up"], h.dtype))
-        mlp_out = jnp.einsum(
-            "bsf,fd->bsd", gate * up, maybe_dequant(layer["w_down"], h.dtype)
-        )
+        if cfg.n_experts:
+            mlp_out = _moe_mlp(cfg, h, layer)
+        else:
+            gate = _activation(
+                cfg,
+                jnp.einsum("bsd,df->bsf", h, maybe_dequant(layer["w_gate"], h.dtype)),
+            )
+            up = jnp.einsum("bsd,df->bsf", h, maybe_dequant(layer["w_up"], h.dtype))
+            mlp_out = jnp.einsum(
+                "bsf,fd->bsd", gate * up, maybe_dequant(layer["w_down"], h.dtype)
+            )
         return x + mlp_out, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(block, x, (stacked, k_cache, v_cache))

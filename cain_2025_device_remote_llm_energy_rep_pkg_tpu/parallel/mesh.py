@@ -38,6 +38,11 @@ class MeshSpec:
     def dp_tp_sp(cls, dp: int, tp: int, sp: int) -> "MeshSpec":
         return cls(axes=(("dp", dp), ("tp", tp), ("sp", sp)))
 
+    @classmethod
+    def tp_ep(cls, tp: int, ep: int) -> "MeshSpec":
+        """Tensor × expert parallelism (MoE serving)."""
+        return cls(axes=(("tp", tp), ("ep", ep)))
+
     def resolve(self, n_devices: int) -> Dict[str, int]:
         sizes = dict(self.axes)
         wild = [name for name, size in sizes.items() if size == -1]

@@ -62,10 +62,14 @@ def pp_param_specs(cfg: ModelConfig, axis: str = "pp") -> Dict[str, P]:
         "wk": P(axis, None, None),
         "wv": P(axis, None, None),
         "wo": P(axis, None, None),
-        "w_gate": P(axis, None, None),
-        "w_up": P(axis, None, None),
-        "w_down": P(axis, None, None),
     }
+    # MoE MLP leaves carry an extra expert axis; the stage (layer) axis is
+    # still the leading one either way.
+    mlp_nd = 4 if cfg.n_experts else 3
+    for k in ("w_gate", "w_up", "w_down"):
+        specs[k] = P(axis, *([None] * (mlp_nd - 1)))
+    if cfg.n_experts:
+        specs["router"] = P(axis, None, None)
     if cfg.qkv_bias:
         specs.update(bq=P(axis, None), bk=P(axis, None), bv=P(axis, None))
     if not cfg.tie_embeddings:

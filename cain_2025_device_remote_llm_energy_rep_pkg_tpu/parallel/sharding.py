@@ -33,9 +33,16 @@ def _tp_size(mesh: Mesh) -> int:
 def param_specs(cfg: ModelConfig, mesh: Mesh) -> Dict[str, P]:
     """PartitionSpec per parameter leaf (leading axis L is never sharded)."""
     tp = _tp_size(mesh)
+    ep = mesh.shape.get("ep", 1)
 
     def div(n: int) -> bool:
         return tp > 1 and n % tp == 0
+
+    # Expert axis over ep (each device holds E/ep whole experts; the combine
+    # einsum's expert contraction becomes a psum over ep — expert
+    # parallelism as pure GSPMD placement, like tp).
+    e_ax = "ep" if cfg.n_experts and ep > 1 and cfg.n_experts % ep == 0 else None
+    f_ax = "tp" if div(cfg.d_ff) else None
 
     specs: Dict[str, P] = {
         "embed": P("tp", None) if div(cfg.vocab_size) else P(),
@@ -46,10 +53,20 @@ def param_specs(cfg: ModelConfig, mesh: Mesh) -> Dict[str, P]:
         "wk": P(None, None, "tp") if div(cfg.n_kv_heads * cfg.d_head) else P(),
         "wv": P(None, None, "tp") if div(cfg.n_kv_heads * cfg.d_head) else P(),
         "wo": P(None, "tp", None) if div(cfg.n_heads * cfg.d_head) else P(),
-        "w_gate": P(None, None, "tp") if div(cfg.d_ff) else P(),
-        "w_up": P(None, None, "tp") if div(cfg.d_ff) else P(),
-        "w_down": P(None, "tp", None) if div(cfg.d_ff) else P(),
     }
+    if cfg.n_experts:
+        specs.update(
+            router=P(),
+            w_gate=P(None, e_ax, None, f_ax),
+            w_up=P(None, e_ax, None, f_ax),
+            w_down=P(None, e_ax, f_ax, None),
+        )
+    else:
+        specs.update(
+            w_gate=P(None, None, f_ax),
+            w_up=P(None, None, f_ax),
+            w_down=P(None, f_ax, None),
+        )
     if cfg.qkv_bias:
         specs["bq"] = P(None, "tp") if div(cfg.n_heads * cfg.d_head) else P()
         specs["bk"] = P(None, "tp") if div(cfg.n_kv_heads * cfg.d_head) else P()

@@ -49,6 +49,7 @@ FAMILIES = [
     tiny_cfg("qwen2:1.5b"),  # qkv_bias + tied embeddings
     tiny_cfg("gemma:2b", n_kv_heads=1),  # gelu + (1+w) norm + embed scaling
     tiny_cfg("phi3:3.8b", n_kv_heads=4),  # fused qkv_proj / gate_up_proj
+    tiny_cfg("mixtral:8x7b", n_experts=4),  # block-sparse MoE + top-2 router
 ]
 
 

@@ -28,8 +28,9 @@ REFERENCE_MODELS = [
 
 
 def test_registry_covers_the_reference_sweep():
-    # experiment/RunnerConfig.py:80 — the 7 Ollama models
-    assert set(MODEL_REGISTRY) == set(REFERENCE_MODELS)
+    # experiment/RunnerConfig.py:80 — the 7 Ollama models (the registry may
+    # carry extra families beyond the reference sweep, e.g. the MoE one)
+    assert set(REFERENCE_MODELS) <= set(MODEL_REGISTRY)
 
 
 def test_param_counts_near_nameplate():
