@@ -29,6 +29,7 @@ from ..models.config import MODEL_REGISTRY, ModelConfig, get_model_config
 from ..models.tokenizer import ByteTokenizer
 from ..models.transformer import (
     DecodeAttentionFn,
+    PrefillAttentionFn,
     Transformer,
     forward,
     logits_for,
@@ -80,6 +81,7 @@ class JaxEngine(GenerationBackend):
         weight_cache_dir: "Optional[str]" = None,
         quantize: Optional[str] = None,  # None | "int8" (weight-only)
         hf_checkpoints: Optional[Dict[str, str]] = None,
+        prefill_attention: "str | PrefillAttentionFn | None" = "auto",
     ) -> None:
         if quantize not in (None, "int8"):
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
@@ -106,6 +108,11 @@ class JaxEngine(GenerationBackend):
         if decode_attention == "auto":
             decode_attention = self._auto_decode_attention()
         self.decode_attention: Optional[DecodeAttentionFn] = decode_attention  # type: ignore[assignment]
+        # Independent of the decode kernel choice: "auto" (default) uses the
+        # Pallas flash prefill on TPU backends, None forces the jnp path.
+        if prefill_attention == "auto":
+            prefill_attention = self._auto_prefill_attention()
+        self.prefill_attention: Optional[PrefillAttentionFn] = prefill_attention  # type: ignore[assignment]
 
     @staticmethod
     def _auto_decode_attention() -> Optional[DecodeAttentionFn]:
@@ -113,6 +120,14 @@ class JaxEngine(GenerationBackend):
             from ..ops.pallas_attention import pallas_decode_attention
 
             return pallas_decode_attention
+        return None
+
+    @staticmethod
+    def _auto_prefill_attention():
+        if jax.default_backend() in ("tpu", "axon"):
+            from ..ops.pallas_attention import pallas_prefill_attention
+
+            return pallas_prefill_attention
         return None
 
     # -- model management -----------------------------------------------------
@@ -205,11 +220,13 @@ class JaxEngine(GenerationBackend):
             return self._prefill_cache[key]
         tf = self._models[model]
         cfg = tf.cfg
+        prefill_attention = self.prefill_attention
 
         @jax.jit
         def prefill(params, tokens, last_index, k_cache, v_cache):
             hidden, k_cache, v_cache = forward(
-                params, cfg, tokens, jnp.int32(0), k_cache, v_cache, None
+                params, cfg, tokens, jnp.int32(0), k_cache, v_cache,
+                None, prefill_attention,
             )
             last_hidden = jnp.take_along_axis(
                 hidden, last_index[:, None, None].astype(jnp.int32), axis=1

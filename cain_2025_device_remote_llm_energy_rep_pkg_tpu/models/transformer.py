@@ -44,6 +44,11 @@ DecodeAttentionFn = Callable[
     [jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray
 ]
 
+# Signature: (q[B,S,Hq,D], k_cache[B,Hkv,T,D], v_cache[B,Hkv,T,D], offset) -> [B,S,Hq,D]
+PrefillAttentionFn = Callable[
+    [jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray
+]
+
 
 def init_params(
     cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
@@ -145,6 +150,7 @@ def _attention_block(
     cos: jnp.ndarray,  # [B,S,half]
     sin: jnp.ndarray,
     decode_attention: Optional[DecodeAttentionFn],
+    prefill_attention: Optional[PrefillAttentionFn] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     b, s, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
@@ -175,6 +181,8 @@ def _attention_block(
         lengths = jnp.full((b,), offset + 1, dtype=jnp.int32)
         out = decode_attention(q[:, 0], k_cache, v_cache, lengths)  # [B,Hq,Dh]
         out = out[:, None]  # [B,1,Hq,Dh]
+    elif s > 1 and prefill_attention is not None:
+        out = prefill_attention(q, k_cache, v_cache, offset)  # [B,S,Hq,Dh]
     else:
         group = hq // hkv
         qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)
@@ -204,6 +212,7 @@ def forward(
     k_cache: jnp.ndarray,  # [L,B,Hkv,T,Dh]
     v_cache: jnp.ndarray,
     decode_attention: Optional[DecodeAttentionFn] = None,
+    prefill_attention: Optional[PrefillAttentionFn] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run the stack over S tokens starting at ``offset``.
 
@@ -222,7 +231,8 @@ def forward(
     stacked = {k: v for k, v in params.items() if k not in NON_LAYER_LEAVES}
 
     x, new_k, new_v = run_blocks(
-        stacked, cfg, x, offset, k_cache, v_cache, cos, sin, decode_attention
+        stacked, cfg, x, offset, k_cache, v_cache, cos, sin,
+        decode_attention, prefill_attention,
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
     return x, new_k, new_v
@@ -238,6 +248,7 @@ def run_blocks(
     cos: jnp.ndarray,
     sin: jnp.ndarray,
     decode_attention: Optional[DecodeAttentionFn] = None,
+    prefill_attention: Optional[PrefillAttentionFn] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Scan the transformer blocks in ``stacked`` over ``x``.
 
@@ -252,7 +263,8 @@ def run_blocks(
         layer, kc, vc = scanned
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
         attn_out, kc, vc = _attention_block(
-            cfg, h, layer, kc, vc, offset, cos, sin, decode_attention
+            cfg, h, layer, kc, vc, offset, cos, sin,
+            decode_attention, prefill_attention,
         )
         x = x + attn_out
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)

@@ -87,3 +87,108 @@ def test_pallas_inside_jit_and_grad_free_scan():
     out = f(q, k, v, jnp.array([30], dtype=jnp.int32))
     ref = decode_attention_reference(q, k, v, jnp.array([30], dtype=jnp.int32))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# ---- prefill kernel ---------------------------------------------------------
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_attention import (  # noqa: E402
+    pallas_prefill_attention,
+)
+
+
+def _prefill_reference(q, k_cache, v_cache, offset):
+    """Masked-softmax attention of S queries at ``offset`` vs the cache —
+    the same math as the transformer's jnp prefill path."""
+    b, s, hq, d = q.shape
+    hkv, t = k_cache.shape[1], k_cache.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, s, hkv, group, d).astype(jnp.float32)
+    scores = jnp.einsum(
+        "bskgd,bktd->bkgst", qg, k_cache.astype(jnp.float32)
+    ) / jnp.sqrt(jnp.float32(d))
+    qpos = offset + jnp.arange(s)[:, None]
+    kpos = jnp.arange(t)[None, :]
+    scores = jnp.where((kpos <= qpos)[None, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgst,bktd->bskgd", probs, v_cache.astype(jnp.float32))
+    return out.reshape(b, s, hq, d).astype(q.dtype)
+
+
+def _mk_prefill(b, s, hq, hkv, t, d, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, hq, d), dtype=jnp.float32)
+    k = jax.random.normal(ks[1], (b, hkv, t, d), dtype=jnp.float32)
+    v = jax.random.normal(ks[2], (b, hkv, t, d), dtype=jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize(
+    "b,s,hq,hkv,t,d,offset",
+    [
+        (1, 32, 8, 2, 64, 16, 0),  # GQA, lane padding, fresh prefill
+        (2, 16, 4, 4, 48, 64, 0),  # MHA, batch 2, ragged k blocks
+        (1, 64, 8, 1, 64, 128, 0),  # MQA, aligned d, S == T
+        (1, 16, 4, 2, 64, 32, 24),  # chunked prefill at offset > 0
+    ],
+)
+def test_prefill_matches_reference(b, s, hq, hkv, t, d, offset):
+    q, k, v = _mk_prefill(b, s, hq, hkv, t, d)
+    ref = _prefill_reference(q, k, v, jnp.int32(offset))
+    out = pallas_prefill_attention(q, k, v, jnp.int32(offset), interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_prefill_small_blocks_exercise_multiblock_grid():
+    """Force several q and k blocks so the online accumulation and the
+    causal block-skip logic actually run."""
+    q, k, v = _mk_prefill(1, 32, 4, 2, 64, 32)
+    ref = _prefill_reference(q, k, v, jnp.int32(0))
+    out = pallas_prefill_attention(
+        q, k, v, jnp.int32(0), block_q=8, block_k=16, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_prefill_ignores_unwritten_cache_suffix():
+    """Garbage beyond the causal frontier must not leak into the output."""
+    q, k, v = _mk_prefill(1, 16, 4, 2, 64, 32)
+    out1 = pallas_prefill_attention(q, k, v, jnp.int32(0), interpret=True)
+    k2 = k.at[:, :, 16:].set(1e9)
+    v2 = v.at[:, :, 16:].set(-1e9)
+    out2 = pallas_prefill_attention(q, k2, v2, jnp.int32(0), interpret=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=2e-5)
+
+
+def test_forward_with_pallas_prefill_matches_jnp_path():
+    """End-to-end: the transformer's prefill with the Pallas kernel injected
+    must match the default jnp path."""
+    import dataclasses
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+        Transformer,
+        forward,
+        logits_for,
+    )
+
+    cfg = dataclasses.replace(get_model_config("qwen2:1.5b").tiny(), n_layers=2)
+    tf = Transformer.initialise(cfg, seed=0, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, cfg.vocab_size)
+    k0, v0 = tf.init_cache(batch=1, max_len=32, dtype=jnp.float32)
+
+    hidden_jnp, _, _ = forward(
+        tf.params, cfg, tokens, jnp.int32(0), k0, v0, None
+    )
+    hidden_pl, _, _ = forward(
+        tf.params, cfg, tokens, jnp.int32(0), k0, v0, None,
+        lambda q, kc, vc, off: pallas_prefill_attention(
+            q, kc, vc, off, interpret=True
+        ),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_for(tf.params, cfg, hidden_pl)),
+        np.asarray(logits_for(tf.params, cfg, hidden_jnp)),
+        atol=5e-4,
+    )
