@@ -11,7 +11,7 @@ around curl; we split prefill vs decode and report tokens/s).
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import Iterator, List, Optional
 
 
 @dataclasses.dataclass(frozen=True)
@@ -21,8 +21,28 @@ class GenerationRequest:
     max_new_tokens: int
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 1.0  # 1.0 disables nucleus filtering
+    repeat_penalty: float = 1.0  # 1.0 disables
     seed: int = 0
     stop_at_eos: bool = True
+
+    def __post_init__(self) -> None:
+        # Degenerate knobs would silently corrupt sampling (top_p<=0 masks
+        # the whole vocab to -inf; repeat_penalty<=0 divides logits by
+        # zero), so reject them where every entry path — wire or direct
+        # construction — passes through.
+        if self.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.repeat_penalty <= 0:
+            raise ValueError(
+                f"repeat_penalty must be > 0, got {self.repeat_penalty}"
+            )
 
 
 @dataclasses.dataclass
@@ -41,6 +61,22 @@ class GenerationResult:
         return self.generated_tokens / self.decode_s if self.decode_s > 0 else 0.0
 
 
+@dataclasses.dataclass
+class GenerationChunk:
+    """One streamed increment of a generation.
+
+    ``text`` is the new text since the previous chunk; ``tokens`` the new
+    token ids. The final chunk has ``done=True`` and carries the full
+    :class:`GenerationResult` (Ollama's streaming wire likewise ends with a
+    ``done: true`` record holding the aggregate statistics).
+    """
+
+    text: str
+    tokens: List[int]
+    done: bool = False
+    result: Optional[GenerationResult] = None
+
+
 class GenerationBackend:
     """Abstract backend: load models, serve generation requests."""
 
@@ -50,6 +86,19 @@ class GenerationBackend:
 
     def generate(self, request: GenerationRequest) -> GenerationResult:
         raise NotImplementedError
+
+    def generate_stream(
+        self, request: GenerationRequest
+    ) -> Iterator[GenerationChunk]:
+        """Stream a generation as incremental chunks ending with a
+        ``done=True`` chunk carrying the full result. Default: degenerate
+        single-chunk stream over blocking :meth:`generate` (backends with a
+        real incremental path override this)."""
+        result = self.generate(request)
+        yield GenerationChunk(
+            text=result.text, tokens=list(result.tokens), done=False
+        )
+        yield GenerationChunk(text="", tokens=[], done=True, result=result)
 
     def warmup(self, request: GenerationRequest) -> None:
         """Bring the backend to steady state for this request shape (weights

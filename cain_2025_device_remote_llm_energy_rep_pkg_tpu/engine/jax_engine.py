@@ -35,10 +35,16 @@ from ..models.transformer import (
     logits_for,
 )
 from ..ops.sampling import sample_token
-from .backend import GenerationBackend, GenerationRequest, GenerationResult
+from .backend import (
+    GenerationBackend,
+    GenerationChunk,
+    GenerationRequest,
+    GenerationResult,
+)
 
 PROMPT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
 GEN_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
+DEFAULT_STREAM_CHUNK = 32  # decode steps per streamed chunk
 
 
 def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
@@ -207,10 +213,17 @@ class JaxEngine(GenerationBackend):
             _bucket(len(self.tokenizer.encode(request.prompt)), PROMPT_BUCKETS),
             _bucket(request.max_new_tokens, GEN_BUCKETS),
             request.top_k,
+            request.top_p < 1.0,
+            request.repeat_penalty != 1.0,
         )
         if key in self._warmed:
             return
         self.generate(request)
+        # Also compile the chunk-bucket decode the streaming path uses, so a
+        # first stream:true request doesn't pay XLA compilation inside the
+        # measured window either.
+        for _ in self.generate_stream(request):
+            pass
         self._warmed.add(key)
 
     # -- compiled stages ------------------------------------------------------
@@ -237,8 +250,19 @@ class JaxEngine(GenerationBackend):
         self._prefill_cache[key] = prefill
         return prefill
 
-    def _decode_fn(self, model: str, n_steps: int, top_k: int) -> Callable:
-        key = (model, n_steps, top_k)
+    def _decode_fn(
+        self,
+        model: str,
+        n_steps: int,
+        top_k: int,
+        use_top_p: bool = False,
+        use_rp: bool = False,
+    ) -> Callable:
+        """``use_top_p``/``use_rp`` are static: they gate whether the vocab
+        sort (nucleus) and the presence-mask scatter (repeat penalty) exist
+        in the compiled loop at all, so requests that don't use them pay
+        nothing."""
+        key = (model, n_steps, top_k, use_top_p, use_rp)
         if key in self._decode_cache:
             return self._decode_cache[key]
         tf = self._models[model]
@@ -248,7 +272,17 @@ class JaxEngine(GenerationBackend):
 
         @jax.jit
         def decode(
-            params, first_token, start_offset, k_cache, v_cache, temperature, rng, n_real
+            params,
+            first_token,
+            start_offset,
+            k_cache,
+            v_cache,
+            temperature,
+            rng,
+            n_real,
+            top_p,
+            repeat_penalty,
+            presence,
         ):
             """Runs exactly ``n_real`` steps (≤ the compiled bucket ``n_steps``)
             and stops early when every sequence hit EOS — so the measured
@@ -257,21 +291,31 @@ class JaxEngine(GenerationBackend):
             b = first_token.shape[0]
 
             def cond(carry):
-                _, _, _, _, _, done, i, _ = carry
+                _, _, _, _, _, done, i, _, _ = carry
                 return (i < n_real) & ~jnp.all(done)
 
             def body(carry):
-                token, offset, kc, vc, rng, done, i, out = carry
+                token, offset, kc, vc, rng, done, i, out, pres = carry
                 hidden, kc, vc = forward(
                     params, cfg, token[:, None], offset, kc, vc, decode_attention
                 )
                 logits = logits_for(params, cfg, hidden[:, 0])
                 rng, sub = jax.random.split(rng)
-                nxt = sample_token(logits, sub, temperature, top_k)
+                nxt = sample_token(
+                    logits,
+                    sub,
+                    temperature,
+                    top_k,
+                    top_p if use_top_p else None,
+                    pres if use_rp else None,
+                    repeat_penalty if use_rp else None,
+                )
                 nxt = jnp.where(done, jnp.int32(eos), nxt)
                 done = done | (nxt == eos)
+                if use_rp:
+                    pres = pres.at[jnp.arange(b), nxt].set(True)
                 out = out.at[:, i].set(nxt)
-                return (nxt, offset + 1, kc, vc, rng, done, i + 1, out)
+                return (nxt, offset + 1, kc, vc, rng, done, i + 1, out, pres)
 
             out0 = jnp.full((b, n_steps), eos, dtype=jnp.int32)
             init = (
@@ -283,15 +327,22 @@ class JaxEngine(GenerationBackend):
                 jnp.zeros((b,), dtype=bool),
                 jnp.int32(0),
                 out0,
+                presence,
             )
-            *_, n_done, out_tokens = jax.lax.while_loop(cond, body, init)
-            return out_tokens, n_done
+            (_, _, kc, vc, rng_out, _, n_done, out_tokens, presence_out) = (
+                jax.lax.while_loop(cond, body, init)
+            )
+            return out_tokens, n_done, kc, vc, presence_out, rng_out
 
         self._decode_cache[key] = decode
         return decode
 
     # -- generation -----------------------------------------------------------
-    def generate(self, request: GenerationRequest) -> GenerationResult:
+    def _start(self, request: GenerationRequest) -> Dict[str, Any]:
+        """The shared prefill path: tokenize, bucket, run prefill and sample
+        the first token. Returns the decode state that both :meth:`generate`
+        (one monolithic decode call) and :meth:`generate_stream` (chunked
+        decode calls) continue from."""
         self.load_model(request.model)
         tf = self._models[request.model]
         cfg = tf.cfg
@@ -308,12 +359,21 @@ class JaxEngine(GenerationBackend):
                 "shorten the prompt or max_new_tokens"
             )
 
+        use_top_p = request.top_p < 1.0
+        use_rp = request.repeat_penalty != 1.0
+
         tokens = jnp.asarray(
             [prompt_ids + [ByteTokenizer.PAD_ID] * (s_bucket - s_real)],
             dtype=jnp.int32,
         )
         k_cache, v_cache = tf.init_cache(1, cache_len, dtype=self.dtype)
         k_cache, v_cache = self._place_cache(k_cache, v_cache, cfg)
+        # The presence mask (repeat penalty) covers prompt + generated
+        # tokens, like Ollama's default repeat_last_n window over the full
+        # context. Kept all-False (and statically unused) when disabled.
+        presence = jnp.zeros((1, cfg.vocab_size), dtype=bool)
+        if use_rp:
+            presence = presence.at[0, jnp.asarray(prompt_ids)].set(True)
 
         t0 = time.monotonic()
         prefill = self._prefill_fn(request.model, s_bucket, cache_len)
@@ -323,36 +383,164 @@ class JaxEngine(GenerationBackend):
         rng = jax.random.PRNGKey(request.seed)
         rng, sub = jax.random.split(rng)
         first = sample_token(
-            logits, sub, jnp.float32(request.temperature), request.top_k
+            logits,
+            sub,
+            jnp.float32(request.temperature),
+            request.top_k,
+            jnp.float32(request.top_p) if use_top_p else None,
+            presence if use_rp else None,
+            jnp.float32(request.repeat_penalty) if use_rp else None,
         )
+        if use_rp:
+            presence = presence.at[jnp.arange(1), first].set(True)
         jax.block_until_ready(first)
         t1 = time.monotonic()
+        return {
+            "tf": tf,
+            "s_real": s_real,
+            "g_bucket": g_bucket,
+            "first": first,
+            "rng": rng,
+            "k_cache": k_cache,
+            "v_cache": v_cache,
+            "presence": presence,
+            "use_top_p": use_top_p,
+            "use_rp": use_rp,
+            "t0": t0,
+            "t1": t1,
+        }
 
-        decode = self._decode_fn(request.model, g_bucket, request.top_k)
-        out, n_done = decode(
-            tf.params,
-            first,
-            jnp.int32(s_real),
-            k_cache,
-            v_cache,
-            jnp.float32(request.temperature),
-            rng,
-            jnp.int32(request.max_new_tokens - 1),  # first token already sampled
-        )
-        out = jax.block_until_ready(out)
-        t2 = time.monotonic()
-
-        generated = [int(first[0])] + [int(t) for t in out[0][: int(n_done)]]
+    def _finish(
+        self,
+        request: GenerationRequest,
+        generated: "list[int]",
+        st: Dict[str, Any],
+        t2: float,
+    ) -> GenerationResult:
         if request.stop_at_eos and ByteTokenizer.EOS_ID in generated:
             generated = generated[: generated.index(ByteTokenizer.EOS_ID)]
-
         return GenerationResult(
             request=request,
             tokens=generated,
             text=self.tokenizer.decode(generated),
-            prompt_tokens=s_real,
+            prompt_tokens=st["s_real"],
             generated_tokens=len(generated),
-            prefill_s=t1 - t0,
-            decode_s=t2 - t1,
-            total_s=t2 - t0,
+            prefill_s=st["t1"] - st["t0"],
+            decode_s=t2 - st["t1"],
+            total_s=t2 - st["t0"],
+        )
+
+    def generate(self, request: GenerationRequest) -> GenerationResult:
+        st = self._start(request)
+        decode = self._decode_fn(
+            request.model,
+            st["g_bucket"],
+            request.top_k,
+            st["use_top_p"],
+            st["use_rp"],
+        )
+        out, n_done, _, _, _, _ = decode(
+            st["tf"].params,
+            st["first"],
+            jnp.int32(st["s_real"]),
+            st["k_cache"],
+            st["v_cache"],
+            jnp.float32(request.temperature),
+            st["rng"],
+            jnp.int32(request.max_new_tokens - 1),  # first token already sampled
+            jnp.float32(request.top_p),
+            jnp.float32(request.repeat_penalty),
+            st["presence"],
+        )
+        out = jax.block_until_ready(out)
+        t2 = time.monotonic()
+
+        generated = [int(st["first"][0])] + [int(t) for t in out[0][: int(n_done)]]
+        return self._finish(request, generated, st, t2)
+
+    def generate_stream(
+        self, request: GenerationRequest, chunk_tokens: int = DEFAULT_STREAM_CHUNK
+    ):
+        """Incremental generation: decode in compiled chunks of
+        ``chunk_tokens`` steps, yielding a :class:`GenerationChunk` after
+        each. The decode state (KV cache, rng, presence mask) threads
+        through the chunk calls, so the token stream is *identical* to the
+        monolithic :meth:`generate` for the same request — streaming only
+        bounds latency-to-first-text, it does not change the sample path.
+
+        Note on text deltas: each chunk's ``text`` decodes only that chunk's
+        tokens; a multi-byte UTF-8 character split across chunks may render
+        as a replacement char at the boundary. The final ``done`` chunk's
+        ``result.text`` decodes the full stream and is authoritative.
+        """
+        st = self._start(request)
+        eos = ByteTokenizer.EOS_ID
+        chunk_bucket = _bucket(min(chunk_tokens, request.max_new_tokens), GEN_BUCKETS)
+        decode = self._decode_fn(
+            request.model,
+            chunk_bucket,
+            request.top_k,
+            st["use_top_p"],
+            st["use_rp"],
+        )
+
+        generated = [int(st["first"][0])]
+        # The monolithic decode loop only stops on an EOS *sampled inside
+        # the loop* (the first token enters the loop as input, EOS or not);
+        # mirror that exactly so the chunked token stream is identical.
+        # When stop_at_eos, an EOS first token means nothing will ever be
+        # visible — end the stream instead of burning decode chunks.
+        stop = request.stop_at_eos and generated[0] == eos
+        if not stop:
+            visible = list(generated)
+            yield GenerationChunk(
+                text=self.tokenizer.decode(visible), tokens=visible
+            )
+
+        token = st["first"]
+        offset = jnp.int32(st["s_real"])
+        k_cache, v_cache = st["k_cache"], st["v_cache"]
+        presence, rng = st["presence"], st["rng"]
+        remaining = request.max_new_tokens - 1
+        while remaining > 0 and not stop:
+            n = min(chunk_bucket, remaining)
+            out, n_done, k_cache, v_cache, presence, rng = decode(
+                st["tf"].params,
+                token,
+                offset,
+                k_cache,
+                v_cache,
+                jnp.float32(request.temperature),
+                rng,
+                jnp.int32(n),
+                jnp.float32(request.top_p),
+                jnp.float32(request.repeat_penalty),
+                presence,
+            )
+            n_done = int(n_done)
+            chunk_ids = [int(t) for t in out[0][:n_done]]
+            if not chunk_ids:
+                break
+            generated.extend(chunk_ids)
+            remaining -= n_done
+            offset = offset + jnp.int32(n_done)
+            token = out[:, n_done - 1]
+            emit = list(chunk_ids)
+            if eos in chunk_ids:
+                # decode's done-mask stopped the loop; the monolithic path
+                # stops at the same step.
+                stop = True
+                if request.stop_at_eos:
+                    emit = emit[: emit.index(eos)]
+            if emit:
+                yield GenerationChunk(
+                    text=self.tokenizer.decode(emit), tokens=emit
+                )
+
+        t2 = time.monotonic()
+        yield GenerationChunk(
+            text="",
+            tokens=[],
+            done=True,
+            result=self._finish(request, generated, st, t2),
         )

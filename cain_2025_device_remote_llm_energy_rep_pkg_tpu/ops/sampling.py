@@ -1,29 +1,87 @@
-"""Token sampling: greedy, temperature, top-k — all jit/scan-safe.
+"""Token sampling: greedy, temperature, top-k, top-p, repeat penalty.
 
-Static-shape friendly: every path returns an int32 token id and the branch is
-selected by traced values only (temperature == 0 → greedy via lax.select), so
-one compiled decode loop serves all sampling settings.
+All jit/scan-safe and static-shape friendly: every path returns an int32
+token id and runtime knobs (temperature, top_p, repeat_penalty) are traced
+scalars selected with ``lax.select``/``where``, so one compiled decode loop
+serves all sampling settings. The knobs mirror the Ollama ``options`` the
+reference's experiment could set on its requests
+(experiment/RunnerConfig.py:128-131 builds ``{model, prompt, stream}``;
+Ollama's API additionally accepts ``temperature``, ``top_k``, ``top_p``,
+``repeat_penalty`` — this is the server-side implementation of those).
+
+``top_k`` is a *static* int (it changes the computation's lattice);
+``top_p``/``repeat_penalty`` are ``None`` to statically disable (keeping the
+vocab sort / penalty scatter out of the compiled loop entirely) or traced
+scalars to apply.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 
+def apply_repeat_penalty(
+    logits: jnp.ndarray,
+    presence: jnp.ndarray,
+    penalty: "jnp.ndarray | float",
+) -> jnp.ndarray:
+    """Discount tokens already emitted (llama.cpp/Ollama semantics).
+
+    ``presence`` is a bool mask [..., vocab] of token ids seen so far
+    (prompt + generated). Positive logits divide by ``penalty``, negative
+    multiply — so penalty > 1 always moves penalised logits down.
+    """
+    penalty = jnp.asarray(penalty, dtype=jnp.float32)
+    penalised = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(presence, penalised, logits)
+
+
+def top_p_filter(
+    scaled_logits: jnp.ndarray, top_p: "jnp.ndarray | float"
+) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of probability-sorted
+    tokens whose cumulative mass reaches ``top_p``; mask the rest to -inf.
+
+    Works on temperature-scaled logits. Always keeps at least the argmax
+    (the exclusive-cumsum of the top token is 0 < top_p for any top_p > 0).
+    """
+    top_p = jnp.asarray(top_p, dtype=jnp.float32)
+    probs = jax.nn.softmax(scaled_logits, axis=-1)
+    sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]
+    cum_excl = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+    kept = cum_excl < top_p
+    # Smallest kept probability = the inclusion threshold, mapped back to
+    # the unsorted lattice by value comparison (ties keep extra tokens —
+    # harmless: they had identical probability).
+    threshold = jnp.min(
+        jnp.where(kept, sorted_probs, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(probs >= threshold, scaled_logits, -jnp.inf)
+
+
 def sample_token(
     logits: jnp.ndarray,
     key: jax.Array,
-    temperature: jnp.ndarray | float,
+    temperature: "jnp.ndarray | float",
     top_k: int = 0,
+    top_p: "Optional[jnp.ndarray | float]" = None,
+    presence: Optional[jnp.ndarray] = None,
+    repeat_penalty: "Optional[jnp.ndarray | float]" = None,
 ) -> jnp.ndarray:
     """Sample the next token id from ``logits`` [..., vocab].
 
     ``temperature`` may be a traced scalar; 0 (or <1e-6) means greedy.
-    ``top_k`` is a *static* int (0 disables) because it changes the lattice of
-    the computation.
+    ``top_k`` is a *static* int (0 disables). ``top_p`` statically disables
+    when ``None``, else is a traced scalar in (0, 1]. ``repeat_penalty``
+    (with its ``presence`` mask) statically disables when ``None``.
+    Order matches llama.cpp: penalty → temperature → top-k → top-p.
     """
     logits = logits.astype(jnp.float32)
+    if repeat_penalty is not None and presence is not None:
+        logits = apply_repeat_penalty(logits, presence, repeat_penalty)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temperature = jnp.asarray(temperature, dtype=jnp.float32)
     safe_t = jnp.maximum(temperature, 1e-6)
@@ -31,5 +89,7 @@ def sample_token(
     if top_k > 0 and top_k < logits.shape[-1]:
         kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p is not None:
+        scaled = top_p_filter(scaled, top_p)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jax.lax.select(temperature < 1e-6, greedy, sampled)

@@ -15,9 +15,14 @@ import json
 import time
 import urllib.error
 import urllib.request
-from typing import Optional
+from typing import Iterator, Optional
 
-from ..engine.backend import GenerationBackend, GenerationRequest, GenerationResult
+from ..engine.backend import (
+    GenerationBackend,
+    GenerationChunk,
+    GenerationRequest,
+    GenerationResult,
+)
 from . import protocol
 
 
@@ -128,6 +133,63 @@ class RemoteHTTPBackend(GenerationBackend):
         # reference's curl wall-clock captured.
         result.total_s = wall_s
         return result
+
+    def generate_stream(
+        self, request: GenerationRequest
+    ) -> Iterator[GenerationChunk]:
+        """Stream over the wire: POST with ``stream: true`` and re-yield the
+        server's NDJSON records as :class:`GenerationChunk`s. The final
+        record rebuilds the full :class:`GenerationResult` (its text is the
+        concatenation of the streamed deltas; the server sends the final
+        ``response`` empty, Ollama-style)."""
+        t0 = time.monotonic()
+        body = json.dumps(
+            protocol.request_to_wire(request, stream=True)
+        ).encode("utf-8")
+        req = urllib.request.Request(
+            f"{self.base_url}{protocol.GENERATE_PATH}",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        text_parts = []
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                for raw in resp:  # urllib un-chunks; records are lines
+                    line = raw.decode("utf-8").strip()
+                    if not line:
+                        continue
+                    record = json.loads(line)
+                    if "error" in record:
+                        # Mid-stream backend failure, surfaced by the server
+                        # as a terminal NDJSON error record.
+                        raise RemoteServerError(500, str(record["error"]))
+                    if record.get("done"):
+                        result = protocol.result_from_wire(record, request)
+                        # x_text is the server's authoritative full decode
+                        # (per-chunk deltas can split multi-byte UTF-8);
+                        # fall back to the concatenated deltas for plain
+                        # Ollama servers that don't send it.
+                        result.text = str(
+                            record.get("x_text", "".join(text_parts))
+                        )
+                        result.total_s = time.monotonic() - t0
+                        yield GenerationChunk(
+                            text="", tokens=[], done=True, result=result
+                        )
+                    else:
+                        delta = str(record.get("response", ""))
+                        text_parts.append(delta)
+                        yield GenerationChunk(
+                            text=delta,
+                            tokens=[int(t) for t in record.get("x_tokens", [])],
+                        )
+        except urllib.error.HTTPError as exc:
+            try:
+                message = json.loads(exc.read().decode("utf-8")).get("error", "")
+            except Exception:  # noqa: BLE001
+                message = exc.reason
+            raise RemoteServerError(exc.code, str(message)) from exc
 
     def unload_all(self) -> None:  # nothing held client-side
         return None

@@ -23,15 +23,19 @@ LOAD_PATH = "/api/load"  # extension: explicit weight-load outside the window
 HEALTH_PATH = "/healthz"
 
 
-def request_to_wire(request: GenerationRequest) -> Dict[str, Any]:
+def request_to_wire(
+    request: GenerationRequest, stream: bool = False
+) -> Dict[str, Any]:
     return {
         "model": request.model,
         "prompt": request.prompt,
-        "stream": False,
+        "stream": stream,
         "options": {
             "num_predict": request.max_new_tokens,
             "temperature": request.temperature,
             "top_k": request.top_k,
+            "top_p": request.top_p,
+            "repeat_penalty": request.repeat_penalty,
             "seed": request.seed,
         },
         "x_stop_at_eos": request.stop_at_eos,
@@ -48,9 +52,23 @@ def request_from_wire(body: Dict[str, Any]) -> GenerationRequest:
         max_new_tokens=int(options.get("num_predict", 128)),
         temperature=float(options.get("temperature", 0.0)),
         top_k=int(options.get("top_k", 0)),
+        top_p=float(options.get("top_p", 1.0)),
+        repeat_penalty=float(options.get("repeat_penalty", 1.0)),
         seed=int(options.get("seed", 0)),
         stop_at_eos=bool(body.get("x_stop_at_eos", True)),
     )
+
+
+def stream_chunk_to_wire(
+    model: str, text: str, tokens: "list[int] | None" = None
+) -> Dict[str, Any]:
+    """One non-final NDJSON record of a streamed generation (Ollama's
+    ``stream: true`` wire shape: incremental ``response``, ``done: false``;
+    the chunk's new token ids ride in ``x_tokens``)."""
+    record: Dict[str, Any] = {"model": model, "response": text, "done": False}
+    if tokens:
+        record["x_tokens"] = list(tokens)
+    return record
 
 
 def result_to_wire(result: GenerationResult) -> Dict[str, Any]:

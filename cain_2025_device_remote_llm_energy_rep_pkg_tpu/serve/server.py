@@ -13,6 +13,7 @@ needed: the reference's entire protocol is one JSON POST.
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -21,6 +22,11 @@ from typing import List, Optional
 from ..engine.backend import GenerationBackend
 from ..runner import term
 from . import protocol
+
+# Bound on any single streamed-chunk socket write; a consumer slower than
+# this (or one that stopped reading) gets disconnected rather than holding
+# the generation lock indefinitely.
+STREAM_WRITE_TIMEOUT_S = 60.0
 
 
 class GenerationServer:
@@ -116,6 +122,9 @@ class GenerationServer:
                         404, {"error": f"model {request.model!r} not found"}
                     )
                     return
+                if body.get("stream"):
+                    self._handle_generate_stream(request)
+                    return
                 try:
                     with server._generate_lock:
                         result = server.backend.generate(request)
@@ -125,6 +134,88 @@ class GenerationServer:
                     self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
                 else:
                     self._send_json(200, protocol.result_to_wire(result))
+
+            def _write_ndjson_chunk(self, payload) -> None:
+                data = (json.dumps(payload) + "\n").encode("utf-8")
+                self.wfile.write(f"{len(data):X}\r\n".encode("ascii"))
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            def _handle_generate_stream(self, request) -> None:
+                """Ollama's ``stream: true`` shape: chunked NDJSON records of
+                incremental ``response`` text ending with a ``done: true``
+                record carrying the aggregate stats. The first record is only
+                sent once generation has begun, so backend errors surface as
+                a clean HTTP error status rather than a broken stream."""
+                with server._generate_lock:
+                    stream = server.backend.generate_stream(request)
+                    try:
+                        first = next(stream)
+                    except StopIteration:
+                        self._send_json(
+                            500, {"error": "backend produced an empty stream"}
+                        )
+                        return
+                    except KeyError as exc:
+                        self._send_json(
+                            404, {"error": f"model not found: {exc}"}
+                        )
+                        return
+                    except Exception as exc:  # noqa: BLE001
+                        self._send_json(
+                            500, {"error": f"{type(exc).__name__}: {exc}"}
+                        )
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    # A consumer that stops reading would otherwise block
+                    # flush() forever *while holding the generate lock* —
+                    # bound every socket write so one stalled client can't
+                    # wedge the whole server.
+                    self.connection.settimeout(STREAM_WRITE_TIMEOUT_S)
+                    try:
+                        for chunk in itertools.chain([first], stream):
+                            if chunk.done:
+                                final = protocol.result_to_wire(chunk.result)
+                                # Ollama-style: the final record's response
+                                # is empty (text was streamed); the
+                                # authoritative full text (per-chunk deltas
+                                # can split multi-byte chars) rides in x_text.
+                                final["response"] = ""
+                                final["x_text"] = chunk.result.text
+                                self._write_ndjson_chunk(final)
+                            else:
+                                self._write_ndjson_chunk(
+                                    protocol.stream_chunk_to_wire(
+                                        request.model, chunk.text, chunk.tokens
+                                    )
+                                )
+                    except OSError:
+                        # Socket gone (client hung up / write timed out):
+                        # nothing more to send; drop the connection.
+                        self.close_connection = True
+                        return
+                    except Exception as exc:  # noqa: BLE001 — backend died
+                        # Headers are out; surface the failure as a final
+                        # NDJSON error record so the client sees a clean,
+                        # terminated stream instead of an IncompleteRead.
+                        try:
+                            self._write_ndjson_chunk(
+                                {
+                                    "error": f"{type(exc).__name__}: {exc}",
+                                    "done": True,
+                                }
+                            )
+                        except OSError:
+                            self.close_connection = True
+                            return
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                    except OSError:
+                        self.close_connection = True
 
             def _handle_load(self, body) -> None:
                 model = body.get("model")

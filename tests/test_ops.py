@@ -123,3 +123,60 @@ def test_sample_token_jit_with_traced_temperature():
     f = jax.jit(lambda lg, k, t: sample_token(lg, k, t))
     logits = jnp.array([[0.0, 3.0]])
     assert int(f(logits, jax.random.PRNGKey(0), jnp.float32(0.0))[0]) == 1
+
+
+def test_top_p_filter_keeps_nucleus_only():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.sampling import (
+        top_p_filter,
+    )
+
+    # probs ≈ [0.64, 0.23, 0.086, 0.03, 0.01]: top_p=0.5 keeps only argmax,
+    # top_p=0.7 keeps the top two.
+    logits = jnp.log(jnp.array([[0.64, 0.23, 0.086, 0.032, 0.012]]))
+    kept_50 = np.isfinite(np.asarray(top_p_filter(logits, 0.5)))[0]
+    assert kept_50.tolist() == [True, False, False, False, False]
+    kept_70 = np.isfinite(np.asarray(top_p_filter(logits, 0.7)))[0]
+    assert kept_70.tolist() == [True, True, False, False, False]
+    # top_p=1.0 keeps everything
+    kept_all = np.isfinite(np.asarray(top_p_filter(logits, 1.0)))[0]
+    assert kept_all.all()
+
+
+def test_sample_token_top_p_restricts_support():
+    logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]]))
+    sampler = jax.jit(
+        lambda k, p: sample_token(logits, k, 1.0, top_p=p)
+    )
+    picks = {
+        int(sampler(jax.random.PRNGKey(i), jnp.float32(0.6))[0])
+        for i in range(50)
+    }
+    assert picks <= {0, 1}
+    # wide nucleus reaches the tail eventually
+    picks_all = {
+        int(sampler(jax.random.PRNGKey(i), jnp.float32(1.0))[0])
+        for i in range(50)
+    }
+    assert len(picks_all) > 2
+
+
+def test_repeat_penalty_discounts_seen_tokens():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.sampling import (
+        apply_repeat_penalty,
+    )
+
+    logits = jnp.array([[2.0, 1.9, -0.5]])
+    presence = jnp.array([[True, False, True]])
+    out = np.asarray(apply_repeat_penalty(logits, presence, 2.0))
+    np.testing.assert_allclose(out, [[1.0, 1.9, -1.0]], atol=1e-6)
+    # greedy flips from token 0 to token 1 once 0 is penalised
+    key = jax.random.PRNGKey(0)
+    assert int(sample_token(logits, key, 0.0)[0]) == 0
+    assert (
+        int(
+            sample_token(
+                logits, key, 0.0, presence=presence, repeat_penalty=2.0
+            )[0]
+        )
+        == 1
+    )

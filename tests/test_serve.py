@@ -282,3 +282,80 @@ def test_load_respects_model_allowlist(server, client):
 def test_stop_without_start_does_not_deadlock():
     srv = GenerationServer(FakeBackend(), host="127.0.0.1", port=0, quiet=True)
     srv.stop()  # must return, not block on the serve loop's shutdown event
+
+
+def test_streaming_generate_round_trip(server, client):
+    req = GenerationRequest("qwen2:1.5b", "stream please", max_new_tokens=12)
+    mono = client.generate(req)
+    chunks = list(client.generate_stream(req))
+    assert chunks[-1].done and chunks[-1].result is not None
+    final = chunks[-1].result
+    assert "".join(c.text for c in chunks[:-1]) == mono.text
+    assert final.text == mono.text
+    assert final.generated_tokens == mono.generated_tokens
+    assert final.tokens == mono.tokens
+    assert final.total_s > 0
+
+
+def test_streaming_unknown_model_is_clean_http_error(server, client):
+    req = GenerationRequest("nope", "x", max_new_tokens=4)
+    with pytest.raises(RemoteServerError) as exc_info:
+        list(client.generate_stream(req))
+    assert exc_info.value.status == 404
+
+
+def test_protocol_round_trip_new_options():
+    req = GenerationRequest(
+        "m", "hello", max_new_tokens=7, temperature=0.5,
+        top_k=3, top_p=0.85, repeat_penalty=1.2, seed=9,
+    )
+    assert protocol.request_from_wire(protocol.request_to_wire(req)) == req
+
+
+def test_degenerate_sampling_options_rejected():
+    with pytest.raises(ValueError, match="top_p"):
+        GenerationRequest("m", "x", max_new_tokens=4, top_p=0.0)
+    with pytest.raises(ValueError, match="repeat_penalty"):
+        GenerationRequest("m", "x", max_new_tokens=4, repeat_penalty=0.0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        GenerationRequest("m", "x", max_new_tokens=0)
+    # and over the wire they surface as a clean 400
+    with pytest.raises(ValueError, match="top_p"):
+        protocol.request_from_wire(
+            {"model": "m", "prompt": "x", "options": {"top_p": 0}}
+        )
+
+
+def test_mid_stream_backend_failure_is_clean_error():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationChunk,
+    )
+
+    class ExplodingBackend(FakeBackend):
+        def generate_stream(self, request):
+            yield GenerationChunk(text="partial", tokens=[1])
+            raise RuntimeError("decode blew up")
+
+    srv = GenerationServer(
+        ExplodingBackend(), host="127.0.0.1", port=0, quiet=True
+    )
+    srv.start()
+    try:
+        cl = RemoteHTTPBackend(f"http://127.0.0.1:{srv.port}")
+        req = GenerationRequest("m", "x", max_new_tokens=4)
+        chunks = []
+        with pytest.raises(RemoteServerError, match="decode blew up"):
+            for c in cl.generate_stream(req):
+                chunks.append(c)
+        # the partial chunk arrived before the terminal error record
+        assert chunks and chunks[0].text == "partial"
+    finally:
+        srv.stop()
+
+
+def test_streaming_chunks_carry_token_ids(server, client):
+    req = GenerationRequest("qwen2:1.5b", "tok ids", max_new_tokens=8)
+    mono = client.generate(req)
+    chunks = list(client.generate_stream(req))
+    streamed = [t for c in chunks[:-1] for t in c.tokens]
+    assert streamed == mono.tokens
