@@ -87,6 +87,14 @@ class GenerationBackend:
     def generate(self, request: GenerationRequest) -> GenerationResult:
         raise NotImplementedError
 
+    def generate_batch(
+        self, requests: List[GenerationRequest]
+    ) -> List[GenerationResult]:
+        """Serve several requests together. Default: sequentially — backends
+        with a real batched path (the JAX engine's shared decode loop)
+        override this for near-linear decode throughput scaling."""
+        return [self.generate(r) for r in requests]
+
     def generate_stream(
         self, request: GenerationRequest
     ) -> Iterator[GenerationChunk]:

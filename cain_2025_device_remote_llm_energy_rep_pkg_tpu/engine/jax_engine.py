@@ -44,6 +44,7 @@ from .backend import (
 
 PROMPT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
 GEN_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)  # generate_batch rows pad up to these
 DEFAULT_STREAM_CHUNK = 32  # decode steps per streamed chunk
 
 
@@ -338,11 +339,15 @@ class JaxEngine(GenerationBackend):
         return decode
 
     # -- generation -----------------------------------------------------------
-    def _start(self, request: GenerationRequest) -> Dict[str, Any]:
+    def _start(
+        self, request: GenerationRequest, cache_len: Optional[int] = None
+    ) -> Dict[str, Any]:
         """The shared prefill path: tokenize, bucket, run prefill and sample
-        the first token. Returns the decode state that both :meth:`generate`
-        (one monolithic decode call) and :meth:`generate_stream` (chunked
-        decode calls) continue from."""
+        the first token. Returns the decode state that :meth:`generate` (one
+        monolithic decode call), :meth:`generate_stream` (chunked decode
+        calls) and :meth:`generate_batch` (rows concatenated into one
+        batched decode) continue from. ``cache_len`` overrides the KV cache
+        size so a batch's rows can share one common cache shape."""
         self.load_model(request.model)
         tf = self._models[request.model]
         cfg = tf.cfg
@@ -351,7 +356,8 @@ class JaxEngine(GenerationBackend):
         s_real = len(prompt_ids)
         s_bucket = _bucket(s_real, PROMPT_BUCKETS)
         g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
-        cache_len = s_bucket + g_bucket
+        if cache_len is None:
+            cache_len = s_bucket + g_bucket
         if cache_len > cfg.max_seq_len:
             raise ValueError(
                 f"{request.model}: prompt bucket {s_bucket} + generation "
@@ -457,6 +463,231 @@ class JaxEngine(GenerationBackend):
 
         generated = [int(st["first"][0])] + [int(t) for t in out[0][: int(n_done)]]
         return self._finish(request, generated, st, t2)
+
+    # -- batched generation ---------------------------------------------------
+    def _batch_decode_fn(
+        self,
+        model: str,
+        n_steps: int,
+        top_k: int,
+        use_top_p: bool,
+        use_rp: bool,
+    ) -> Callable:
+        """Batched decode loop: per-row offsets, rng streams, sampling knobs
+        and done-masks, so every row's token stream is bit-identical to a
+        single-request :meth:`generate` with that row's request. One shared
+        ``lax.while_loop`` amortises the HBM weight stream over all rows —
+        the throughput win batching exists for."""
+        key = ("batch", model, n_steps, top_k, use_top_p, use_rp)
+        if key in self._decode_cache:
+            return self._decode_cache[key]
+        tf = self._models[model]
+        cfg = tf.cfg
+        decode_attention = self.decode_attention
+        eos = ByteTokenizer.EOS_ID
+
+        from ..ops.sampling import sample_token_per_row
+
+        @jax.jit
+        def decode(
+            params,
+            first_tokens,  # [B]
+            offsets,  # [B] — each row's next cache write position
+            k_cache,
+            v_cache,
+            temperature,  # [B]
+            rngs,  # [B] keys
+            n_real,  # scalar: max steps this call
+            top_p,  # [B]
+            repeat_penalty,  # [B]
+            presence,  # [B, vocab]
+            done0,  # [B] — padding rows enter pre-done
+        ):
+            b = first_tokens.shape[0]
+
+            def cond(carry):
+                _, _, _, _, _, done, i, _, _, _ = carry
+                return (i < n_real) & ~jnp.all(done)
+
+            def body(carry):
+                token, offs, kc, vc, rngs, done, i, out, pres, n_row = carry
+                prev_done = done
+                hidden, kc, vc = forward(
+                    params, cfg, token[:, None], offs, kc, vc, decode_attention
+                )
+                logits = logits_for(params, cfg, hidden[:, 0])
+                split = jax.vmap(jax.random.split)(rngs)
+                rngs, subs = split[:, 0], split[:, 1]
+                nxt = sample_token_per_row(
+                    logits,
+                    subs,
+                    temperature,
+                    top_k,
+                    top_p if use_top_p else None,
+                    pres if use_rp else None,
+                    repeat_penalty if use_rp else None,
+                )
+                nxt = jnp.where(done, jnp.int32(eos), nxt)
+                done = done | (nxt == eos)
+                if use_rp:
+                    pres = pres.at[jnp.arange(b), nxt].set(True)
+                out = out.at[:, i].set(nxt)
+                # Rows still live at entry record this step; matches the
+                # single-request loop's exit value of its step counter.
+                n_row = jnp.where(prev_done, n_row, i + 1)
+                return (
+                    nxt, offs + 1, kc, vc, rngs, done, i + 1, out, pres, n_row
+                )
+
+            out0 = jnp.full((b, n_steps), eos, dtype=jnp.int32)
+            init = (
+                first_tokens,
+                offsets,
+                k_cache,
+                v_cache,
+                rngs,
+                done0,
+                jnp.int32(0),
+                out0,
+                presence,
+                jnp.zeros((b,), dtype=jnp.int32),
+            )
+            *_, out_tokens, _, n_row = jax.lax.while_loop(cond, body, init)
+            return out_tokens, n_row
+
+        self._decode_cache[key] = decode
+        return decode
+
+    def generate_batch(
+        self, requests: "list[GenerationRequest]"
+    ) -> "list[GenerationResult]":
+        """Generate for several requests in one batched decode.
+
+        Prefill runs per request (reusing the single-request compiled
+        prefills); decode runs all rows together, reading the weights from
+        HBM once per step for the whole batch — decode is bandwidth-bound,
+        so batch throughput scales near-linearly until the MXU saturates.
+
+        Per-row rng streams, offsets and sampling knobs make each row's
+        output token-identical to ``generate(request)`` alone. Constraints:
+        all requests must name the same model and share ``top_k`` (it is
+        baked into the compiled loop's shape).
+
+        Each result's ``decode_s`` is the *batch* decode wall-time (the rows
+        ran together and are not separable); ``prefill_s`` is per-request.
+        """
+        if not requests:
+            return []
+        max_rows = BATCH_BUCKETS[-1]
+        if len(requests) > max_rows:
+            # Larger fleets run as sequential full-width batches rather than
+            # blowing past the widest compiled shape.
+            results = []
+            for i in range(0, len(requests), max_rows):
+                results.extend(self.generate_batch(requests[i : i + max_rows]))
+            return results
+        models = {r.model for r in requests}
+        if len(models) > 1:
+            raise ValueError(f"one model per batch, got {sorted(models)}")
+        top_ks = {r.top_k for r in requests}
+        if len(top_ks) > 1:
+            raise ValueError(f"one top_k per batch, got {sorted(top_ks)}")
+        model, top_k = requests[0].model, requests[0].top_k
+        self.load_model(model)
+        cfg = self._models[model].cfg
+
+        # One cache shape for every row: widest prompt bucket + widest
+        # generation bucket.
+        s_buckets = [
+            _bucket(len(self.tokenizer.encode(r.prompt)), PROMPT_BUCKETS)
+            for r in requests
+        ]
+        g_bucket = _bucket(max(r.max_new_tokens for r in requests), GEN_BUCKETS)
+        cache_len = max(s_buckets) + g_bucket
+        if cache_len > cfg.max_seq_len:
+            raise ValueError(
+                f"{model}: batch cache {cache_len} exceeds max_seq_len "
+                f"{cfg.max_seq_len}"
+            )
+
+        states = [self._start(r, cache_len=cache_len) for r in requests]
+        n = len(states)
+        b_bucket = _bucket(n, BATCH_BUCKETS)
+        use_top_p = any(st["use_top_p"] for st in states)
+        use_rp = any(st["use_rp"] for st in states)
+        # Pad to the batch bucket with copies of row 0 that enter pre-done.
+        rows = states + [states[0]] * (b_bucket - n)
+
+        first_tokens = jnp.concatenate([st["first"] for st in rows])
+        offsets = jnp.asarray([st["s_real"] for st in rows], dtype=jnp.int32)
+        k_cache = jnp.concatenate([st["k_cache"] for st in rows], axis=1)
+        v_cache = jnp.concatenate([st["v_cache"] for st in rows], axis=1)
+        presence = jnp.concatenate([st["presence"] for st in rows], axis=0)
+        rngs = jnp.stack([st["rng"] for st in rows])
+        temps = jnp.asarray(
+            [r.temperature for r in requests]
+            + [requests[0].temperature] * (b_bucket - n),
+            dtype=jnp.float32,
+        )
+        top_ps = jnp.asarray(
+            [r.top_p for r in requests] + [requests[0].top_p] * (b_bucket - n),
+            dtype=jnp.float32,
+        )
+        rps = jnp.asarray(
+            [r.repeat_penalty for r in requests]
+            + [requests[0].repeat_penalty] * (b_bucket - n),
+            dtype=jnp.float32,
+        )
+        done0 = jnp.asarray([False] * n + [True] * (b_bucket - n))
+        n_real = max(r.max_new_tokens for r in requests) - 1
+
+        t1 = time.monotonic()
+        if n_real > 0:
+            decode = self._batch_decode_fn(
+                model, g_bucket, top_k, use_top_p, use_rp
+            )
+            out, n_row = decode(
+                self._models[model].params,
+                first_tokens,
+                offsets,
+                k_cache,
+                v_cache,
+                temps,
+                rngs,
+                jnp.int32(n_real),
+                top_ps,
+                rps,
+                presence,
+                done0,
+            )
+            out = jax.block_until_ready(out)
+            n_row = [int(x) for x in n_row]
+        else:
+            out = jnp.zeros((b_bucket, 0), dtype=jnp.int32)
+            n_row = [0] * b_bucket
+        t2 = time.monotonic()
+
+        results = []
+        for r, (request, st) in enumerate(zip(requests, states)):
+            budget = request.max_new_tokens - 1
+            take = min(n_row[r], budget)
+            generated = [int(first_tokens[r])] + [int(t) for t in out[r][:take]]
+            if request.stop_at_eos and ByteTokenizer.EOS_ID in generated:
+                generated = generated[: generated.index(ByteTokenizer.EOS_ID)]
+            prefill_s = st["t1"] - st["t0"]  # this row's own prefill
+            results.append(
+                GenerationResult(
+                    request=request,
+                    tokens=generated,
+                    text=self.tokenizer.decode(generated),
+                    prompt_tokens=st["s_real"],
+                    generated_tokens=len(generated),
+                    prefill_s=prefill_s,
+                    decode_s=t2 - t1,  # the shared batch decode window
+                    total_s=prefill_s + (t2 - t1),
+                )
+            )
+        return results
 
     def generate_stream(
         self, request: GenerationRequest, chunk_tokens: int = DEFAULT_STREAM_CHUNK

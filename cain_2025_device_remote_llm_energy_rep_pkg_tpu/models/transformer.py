@@ -146,7 +146,7 @@ def _attention_block(
     layer: Params,
     k_cache: jnp.ndarray,  # [B,Hkv,T,Dh] — T-contiguous per head for DMA-friendly decode
     v_cache: jnp.ndarray,
-    offset: jnp.ndarray,  # scalar int32: write position of token 0
+    offset: jnp.ndarray,  # int32: write position of token 0 — scalar, or [B] (decode only)
     cos: jnp.ndarray,  # [B,S,half]
     sin: jnp.ndarray,
     decode_attention: Optional[DecodeAttentionFn],
@@ -155,6 +155,11 @@ def _attention_block(
     b, s, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
     t = k_cache.shape[2]
+    per_seq = jnp.ndim(offset) == 1  # batched decode: one offset per sequence
+    if per_seq and s != 1:
+        raise ValueError(
+            "per-sequence offsets are only supported for single-token decode"
+        )
 
     q = jnp.einsum("bsd,dh->bsh", x, maybe_dequant(layer["wq"], x.dtype))
     k = jnp.einsum("bsd,dh->bsh", x, maybe_dequant(layer["wk"], x.dtype))
@@ -169,16 +174,25 @@ def _attention_block(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, offset, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, offset, 0)
-    )
+    if per_seq:
+        # Each sequence writes its token's K/V at its own cache position.
+        k_cache = k_cache.at[jnp.arange(b), :, offset].set(
+            k[:, 0].astype(k_cache.dtype)
+        )
+        v_cache = v_cache.at[jnp.arange(b), :, offset].set(
+            v[:, 0].astype(v_cache.dtype)
+        )
+    else:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, offset, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, offset, 0)
+        )
 
     scale = 1.0 / math.sqrt(dh)
     if s == 1 and decode_attention is not None:
-        lengths = jnp.full((b,), offset + 1, dtype=jnp.int32)
+        lengths = jnp.broadcast_to(offset + 1, (b,)).astype(jnp.int32)
         out = decode_attention(q[:, 0], k_cache, v_cache, lengths)  # [B,Hq,Dh]
         out = out[:, None]  # [B,1,Hq,Dh]
     elif s > 1 and prefill_attention is not None:
@@ -189,10 +203,14 @@ def _attention_block(
         kf = k_cache.astype(jnp.float32)
         vf = v_cache.astype(jnp.float32)
         scores = jnp.einsum("bskgd,bktd->bkgst", qg, kf) * scale
-        qpos = offset + jnp.arange(s)[:, None]
-        kpos = jnp.arange(t)[None, :]
-        mask = kpos <= qpos  # causal + only-written-prefix, in one predicate
-        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+        kpos = jnp.arange(t)
+        if per_seq:
+            mask = kpos[None, None, :] <= offset[:, None, None]  # [B,1,T]
+        else:
+            qpos = offset + jnp.arange(s)[:, None]
+            # causal + only-written-prefix, in one predicate: [1,S,T]
+            mask = (kpos[None, :] <= qpos)[None]
+        scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bkgst,bktd->bskgd", probs, vf).reshape(b, s, hq, dh)
 
@@ -208,7 +226,7 @@ def forward(
     params: Params,
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # [B,S] int32
-    offset: jnp.ndarray,  # scalar int32
+    offset: jnp.ndarray,  # scalar int32, or [B] int32 (single-token decode only)
     k_cache: jnp.ndarray,  # [L,B,Hkv,T,Dh]
     v_cache: jnp.ndarray,
     decode_attention: Optional[DecodeAttentionFn] = None,
@@ -224,7 +242,9 @@ def forward(
     if cfg.gemma_norm:
         x = x * jnp.asarray(math.sqrt(cfg.d_model), dtype=x.dtype)
 
-    positions = offset + jnp.arange(s, dtype=jnp.int32)[None, :]  # [1,S]
+    # offset is a scalar (shared) or [B] (per-sequence, batched decode).
+    off = jnp.reshape(jnp.asarray(offset, dtype=jnp.int32), (-1, 1))
+    positions = off + jnp.arange(s, dtype=jnp.int32)[None, :]  # [1|B, S]
     positions = jnp.broadcast_to(positions, (b, s))
     cos, sin = rope_angles(positions, cfg.d_head, cfg.rope_theta)
 

@@ -40,16 +40,17 @@ def apply_repeat_penalty(
 
 
 def top_p_filter(
-    scaled_logits: jnp.ndarray, top_p: "jnp.ndarray | float"
+    logits: jnp.ndarray, top_p: "jnp.ndarray | float"
 ) -> jnp.ndarray:
     """Nucleus filtering: keep the smallest prefix of probability-sorted
     tokens whose cumulative mass reaches ``top_p``; mask the rest to -inf.
 
-    Works on temperature-scaled logits. Always keeps at least the argmax
-    (the exclusive-cumsum of the top token is 0 < top_p for any top_p > 0).
+    Applied to *unscaled* (pre-temperature) logits, matching llama.cpp's
+    sampler order. Always keeps at least the argmax (the exclusive-cumsum
+    of the top token is 0 < top_p for any top_p > 0).
     """
     top_p = jnp.asarray(top_p, dtype=jnp.float32)
-    probs = jax.nn.softmax(scaled_logits, axis=-1)
+    probs = jax.nn.softmax(logits, axis=-1)
     sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]
     cum_excl = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
     kept = cum_excl < top_p
@@ -59,7 +60,7 @@ def top_p_filter(
     threshold = jnp.min(
         jnp.where(kept, sorted_probs, jnp.inf), axis=-1, keepdims=True
     )
-    return jnp.where(probs >= threshold, scaled_logits, -jnp.inf)
+    return jnp.where(probs >= threshold, logits, -jnp.inf)
 
 
 def sample_token(
@@ -77,19 +78,70 @@ def sample_token(
     ``top_k`` is a *static* int (0 disables). ``top_p`` statically disables
     when ``None``, else is a traced scalar in (0, 1]. ``repeat_penalty``
     (with its ``presence`` mask) statically disables when ``None``.
-    Order matches llama.cpp: penalty → temperature → top-k → top-p.
+    Order matches llama.cpp's sampler chain: penalties → top-k → top-p →
+    temperature — the nucleus is computed on the *unscaled* distribution,
+    then temperature reshapes what survived.
     """
     logits = logits.astype(jnp.float32)
     if repeat_penalty is not None and presence is not None:
         logits = apply_repeat_penalty(logits, presence, repeat_penalty)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        logits = top_p_filter(logits, top_p)
     temperature = jnp.asarray(temperature, dtype=jnp.float32)
     safe_t = jnp.maximum(temperature, 1e-6)
     scaled = logits / safe_t
-    if top_k > 0 and top_k < logits.shape[-1]:
-        kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
-        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-    if top_p is not None:
-        scaled = top_p_filter(scaled, top_p)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jax.lax.select(temperature < 1e-6, greedy, sampled)
+
+
+def sample_token_per_row(
+    logits: jnp.ndarray,  # [B, vocab]
+    keys: jax.Array,  # [B] rng keys — one independent stream per row
+    temperature: jnp.ndarray,  # [B]
+    top_k: int = 0,
+    top_p: Optional[jnp.ndarray] = None,  # [B]
+    presence: Optional[jnp.ndarray] = None,  # [B, vocab]
+    repeat_penalty: Optional[jnp.ndarray] = None,  # [B]
+) -> jnp.ndarray:
+    """Row-independent :func:`sample_token`: each batch row has its own rng
+    key and its own sampling knobs, so a row's draw is bit-identical to a
+    single-request ``sample_token`` call with that row's key — the property
+    that makes batched generation reproduce per-request results exactly.
+    ``top_k`` stays static and shared (it shapes the computation)."""
+    if presence is None:
+
+        def one(lg, key, t, p):
+            return sample_token(
+                lg, key, t, top_k, p if top_p is not None else None
+            )
+
+        return jax.vmap(one)(
+            logits,
+            keys,
+            temperature,
+            top_p if top_p is not None else temperature,
+        )
+
+    def one_rp(lg, key, t, p, pres, rp):
+        return sample_token(
+            lg,
+            key,
+            t,
+            top_k,
+            p if top_p is not None else None,
+            pres,
+            rp,
+        )
+
+    return jax.vmap(one_rp)(
+        logits,
+        keys,
+        temperature,
+        top_p if top_p is not None else temperature,
+        presence,
+        repeat_penalty,
+    )

@@ -126,7 +126,9 @@ commands:
   prepare              validate the environment (JAX devices, RAPL access)
   serve [opts]         start the HTTP generation server (the framework-native
                        Ollama-equivalent): --port N (default 11434),
-                       --backend jax|jax-tp|fake, --tp N, --models a,b,c
+                       --backend jax|jax-tp|fake, --tp N, --models a,b,c,
+                       --batch-window-ms W --max-batch B (continuous batching
+                       of concurrent requests; off by default)
   help                 show this message
 """
 
@@ -139,6 +141,8 @@ def serve_command(args: List[str]) -> None:
     backend_kind = "jax"
     tp = -1
     models: Optional[List[str]] = None
+    batch_window_ms = 0.0
+    max_batch = 8
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -149,6 +153,10 @@ def serve_command(args: List[str]) -> None:
             tp = int(next(it, "-1"))
         elif arg == "--models":
             models = [m for m in next(it, "").split(",") if m]
+        elif arg == "--batch-window-ms":
+            batch_window_ms = float(next(it, "0"))
+        elif arg == "--max-batch":
+            max_batch = int(next(it, "8"))
         else:
             raise CommandError(f"serve: unrecognised option {arg!r}")
 
@@ -178,7 +186,11 @@ def serve_command(args: List[str]) -> None:
 
         models = sorted(MODEL_REGISTRY)
     server = GenerationServer(
-        backend, port=DEFAULT_PORT if port is None else port, models=models
+        backend,
+        port=DEFAULT_PORT if port is None else port,
+        models=models,
+        batch_window_ms=batch_window_ms,
+        max_batch=max_batch,
     )
     server.serve_forever()
 

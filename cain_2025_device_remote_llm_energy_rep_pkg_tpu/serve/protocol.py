@@ -17,6 +17,11 @@ from ..engine.backend import GenerationRequest, GenerationResult
 
 DEFAULT_PORT = 11434  # the port the reference's curl targets (README.md:31)
 
+# Ollama's num_predict accepts -1 ("until done") and -2 ("fill context");
+# this server's decode loop stops at EOS anyway, so negatives map to a
+# bounded budget rather than being rejected.
+UNLIMITED_NUM_PREDICT_CAP = 512
+
 GENERATE_PATH = "/api/generate"
 TAGS_PATH = "/api/tags"
 LOAD_PATH = "/api/load"  # extension: explicit weight-load outside the window
@@ -46,10 +51,13 @@ def request_from_wire(body: Dict[str, Any]) -> GenerationRequest:
     if "model" not in body or "prompt" not in body:
         raise ValueError("generate request requires 'model' and 'prompt'")
     options = body.get("options") or {}
+    num_predict = int(options.get("num_predict", 128))
+    if num_predict < 0:
+        num_predict = UNLIMITED_NUM_PREDICT_CAP
     return GenerationRequest(
         model=str(body["model"]),
         prompt=str(body["prompt"]),
-        max_new_tokens=int(options.get("num_predict", 128)),
+        max_new_tokens=num_predict,
         temperature=float(options.get("temperature", 0.0)),
         top_k=int(options.get("top_k", 0)),
         top_p=float(options.get("top_p", 1.0)),

@@ -1,0 +1,173 @@
+"""Continuous-batching scheduler for the generation server.
+
+The reference's Ollama server handles one request at a time and the
+experiment sends one request per run (experiment/RunnerConfig.py:128-131).
+A TPU serving a fleet of clients would waste most of its HBM bandwidth that
+way: decode is bandwidth-bound, so co-scheduling concurrent requests into
+one batched decode (``JaxEngine.generate_batch``) multiplies tokens/s at
+nearly constant energy/step. This scheduler gives the HTTP server that
+ability without changing the wire protocol: concurrent ``/api/generate``
+POSTs that arrive within a small window are coalesced, compatible ones
+(same model + top_k) decode together, and each caller still gets exactly
+the response it would have gotten alone (the batched engine is
+token-identical per row).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+from ..engine.backend import (
+    GenerationBackend,
+    GenerationRequest,
+    GenerationResult,
+)
+
+
+class _Ticket:
+    """One submitted request: the caller blocks on ``event`` until the
+    scheduler fills ``result`` or ``error``."""
+
+    __slots__ = ("request", "event", "result", "error")
+
+    def __init__(self, request: GenerationRequest) -> None:
+        self.request = request
+        self.event = threading.Event()
+        self.result: Optional[GenerationResult] = None
+        self.error: Optional[BaseException] = None
+
+
+class BatchScheduler:
+    """Coalesce concurrent generate calls into batched backend calls.
+
+    ``window_s`` is how long the first request of a batch waits for
+    companions (the classic continuous-batching admission window);
+    ``max_batch`` bounds a single decode's row count. Requests that are
+    mutually incompatible (different model or top_k) run as separate
+    batches in arrival order.
+    """
+
+    def __init__(
+        self,
+        backend: GenerationBackend,
+        max_batch: int = 8,
+        window_s: float = 0.05,
+        lock: Optional[threading.Lock] = None,
+    ) -> None:
+        self.backend = backend
+        self.max_batch = max_batch
+        self.window_s = window_s
+        # Shared with the server's streaming path so batched and streamed
+        # generations never run concurrently on one accelerator.
+        self._backend_lock = lock if lock is not None else threading.Lock()
+        self._queue: "queue.Queue[Optional[_Ticket]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        # Serialises submit() against stop() so a ticket can never be
+        # enqueued after the shutdown drain (which would strand its caller
+        # on event.wait() forever).
+        self._state_lock = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="batch-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._state_lock:
+            if not self._running:
+                return
+            self._running = False
+            self._queue.put(None)  # wake the loop
+            if self._thread is not None:
+                self._thread.join(timeout=10)
+                self._thread = None
+            # Fail any tickets still queued so their callers unblock; new
+            # submits are excluded by the state lock.
+            while True:
+                try:
+                    ticket = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if ticket is not None:
+                    ticket.error = RuntimeError("server shutting down")
+                    ticket.event.set()
+
+    # -- client side ----------------------------------------------------------
+    def submit(self, request: GenerationRequest) -> GenerationResult:
+        """Enqueue and block until the scheduler served the request."""
+        ticket = _Ticket(request)
+        with self._state_lock:
+            if not self._running:
+                raise RuntimeError("scheduler is not running")
+            self._queue.put(ticket)
+        ticket.event.wait()
+        if ticket.error is not None:
+            raise ticket.error
+        assert ticket.result is not None
+        return ticket.result
+
+    # -- scheduler loop -------------------------------------------------------
+    @staticmethod
+    def _compatible(a: GenerationRequest, b: GenerationRequest) -> bool:
+        return a.model == b.model and a.top_k == b.top_k
+
+    def _collect(self, first: _Ticket) -> List[_Ticket]:
+        """Admission: wait up to ``window_s`` for companions compatible with
+        ``first``; incompatible arrivals are re-queued (order within each
+        compatibility class is preserved)."""
+        batch = [first]
+        leftovers: List[_Ticket] = []
+        deadline = time.monotonic() + self.window_s
+        while len(batch) < self.max_batch:
+            timeout = deadline - time.monotonic()
+            if timeout <= 0:
+                break
+            try:
+                ticket = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                break
+            if ticket is None:  # shutdown sentinel — put back and stop
+                self._queue.put(None)
+                break
+            if self._compatible(first.request, ticket.request):
+                batch.append(ticket)
+            else:
+                leftovers.append(ticket)
+        for ticket in leftovers:
+            self._queue.put(ticket)
+        return batch
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                first = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if first is None:
+                break
+            batch = self._collect(first)
+            try:
+                with self._backend_lock:
+                    if len(batch) == 1:
+                        results = [self.backend.generate(batch[0].request)]
+                    else:
+                        results = self.backend.generate_batch(
+                            [t.request for t in batch]
+                        )
+            except BaseException as exc:  # noqa: BLE001 — fan the error out
+                for ticket in batch:
+                    ticket.error = exc
+                    ticket.event.set()
+            else:
+                for ticket, result in zip(batch, results):
+                    ticket.result = result
+                    ticket.event.set()

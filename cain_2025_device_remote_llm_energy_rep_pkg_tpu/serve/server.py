@@ -49,11 +49,28 @@ class GenerationServer:
         port: int = protocol.DEFAULT_PORT,
         models: Optional[List[str]] = None,
         quiet: bool = False,
+        batch_window_ms: float = 0.0,
+        max_batch: int = 8,
     ) -> None:
+        """``batch_window_ms > 0`` enables continuous batching: concurrent
+        non-streaming generate requests arriving within the window coalesce
+        into one batched decode (:mod:`.scheduler`). 0 (default) preserves
+        strictly serial one-at-a-time semantics — what the reference's
+        measurement model assumes."""
         self.backend = backend
         self.models = list(models) if models else []
         self.quiet = quiet
         self._generate_lock = threading.Lock()
+        self._scheduler = None
+        if batch_window_ms > 0:
+            from .scheduler import BatchScheduler
+
+            self._scheduler = BatchScheduler(
+                backend,
+                max_batch=max_batch,
+                window_s=batch_window_ms / 1e3,
+                lock=self._generate_lock,
+            )
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
         # Set whenever a serve loop is live (threaded start() OR blocking
@@ -126,8 +143,11 @@ class GenerationServer:
                     self._handle_generate_stream(request)
                     return
                 try:
-                    with server._generate_lock:
-                        result = server.backend.generate(request)
+                    if server._scheduler is not None:
+                        result = server._scheduler.submit(request)
+                    else:
+                        with server._generate_lock:
+                            result = server.backend.generate(request)
                 except KeyError as exc:
                     self._send_json(404, {"error": f"model not found: {exc}"})
                 except Exception as exc:  # noqa: BLE001 — server must not die
@@ -250,6 +270,8 @@ class GenerationServer:
 
     def start(self) -> None:
         """Serve on a daemon thread; returns once the socket is listening."""
+        if self._scheduler is not None:
+            self._scheduler.start()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="generation-server", daemon=True
         )
@@ -262,6 +284,8 @@ class GenerationServer:
     def serve_forever(self) -> None:
         if not self.quiet:
             term.log_ok(f"generation server listening on :{self.port}")
+        if self._scheduler is not None:
+            self._scheduler.start()
         self._serving.set()
         try:
             self._httpd.serve_forever()
@@ -272,6 +296,8 @@ class GenerationServer:
             self._httpd.server_close()
 
     def stop(self) -> None:
+        if self._scheduler is not None:
+            self._scheduler.stop()
         # shutdown() blocks on an event only serve_forever() sets; skip it
         # when no serve loop ever started (e.g. setup failed before start).
         if self._serving.is_set():

@@ -193,3 +193,85 @@ def test_warmup_compiles_stream_decode_bucket(engine):
     keys = {k[:2] for k in engine._decode_cache if k[0] == "tiny-gemma"}
     assert ("tiny-gemma", 64) in keys  # monolithic g_bucket
     assert ("tiny-gemma", DEFAULT_STREAM_CHUNK) in keys  # stream chunk bucket
+
+
+def test_generate_batch_matches_single_greedy(engine):
+    reqs = [
+        GenerationRequest("tiny-a", "first prompt", max_new_tokens=10),
+        GenerationRequest("tiny-a", "a second, rather longer prompt here", max_new_tokens=14),
+        GenerationRequest("tiny-a", "3rd", max_new_tokens=6),
+    ]
+    singles = [engine.generate(r) for r in reqs]
+    batch = engine.generate_batch(reqs)
+    assert len(batch) == 3
+    for s, b in zip(singles, batch):
+        assert b.tokens == s.tokens
+        assert b.text == s.text
+        assert b.prompt_tokens == s.prompt_tokens
+
+
+def test_generate_batch_matches_single_sampled(engine):
+    reqs = [
+        GenerationRequest(
+            "tiny-a", "alpha", max_new_tokens=12, temperature=1.1, seed=5
+        ),
+        GenerationRequest(
+            "tiny-a", "beta beta", max_new_tokens=12, temperature=0.8, seed=9
+        ),
+    ]
+    singles = [engine.generate(r) for r in reqs]
+    batch = engine.generate_batch(reqs)
+    for s, b in zip(singles, batch):
+        assert b.tokens == s.tokens
+
+
+def test_generate_batch_mixed_knobs(engine):
+    reqs = [
+        GenerationRequest(
+            "tiny-a", "x", max_new_tokens=8, temperature=1.0,
+            top_p=0.9, seed=1,
+        ),
+        GenerationRequest(
+            "tiny-a", "yy", max_new_tokens=8, temperature=0.0,
+            repeat_penalty=1.5,
+        ),
+    ]
+    singles = [engine.generate(r) for r in reqs]
+    batch = engine.generate_batch(reqs)
+    for s, b in zip(singles, batch):
+        assert b.tokens == s.tokens
+
+
+def test_generate_batch_validates_inputs(engine):
+    with pytest.raises(ValueError, match="one model"):
+        engine.generate_batch(
+            [
+                GenerationRequest("tiny-a", "x", max_new_tokens=4),
+                GenerationRequest("tiny-gemma", "y", max_new_tokens=4),
+            ]
+        )
+    with pytest.raises(ValueError, match="one top_k"):
+        engine.generate_batch(
+            [
+                GenerationRequest("tiny-a", "x", max_new_tokens=4, top_k=3),
+                GenerationRequest("tiny-a", "y", max_new_tokens=4, top_k=5),
+            ]
+        )
+    assert engine.generate_batch([]) == []
+
+
+def test_generate_batch_chunks_oversized_fleets(engine):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        BATCH_BUCKETS,
+    )
+
+    n = BATCH_BUCKETS[-1] + 3
+    reqs = [
+        GenerationRequest("tiny-a", f"p{i}", max_new_tokens=4, seed=i)
+        for i in range(n)
+    ]
+    batch = engine.generate_batch(reqs)
+    assert len(batch) == n
+    # spot-check parity at the chunk seam
+    for i in (0, BATCH_BUCKETS[-1] - 1, BATCH_BUCKETS[-1], n - 1):
+        assert batch[i].tokens == engine.generate(reqs[i]).tokens

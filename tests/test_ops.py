@@ -180,3 +180,20 @@ def test_repeat_penalty_discounts_seen_tokens():
         )
         == 1
     )
+
+
+def test_sample_token_per_row_matches_single_calls():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.sampling import (
+        sample_token_per_row,
+    )
+
+    vocab = 13
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, vocab)) * 3
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(4))
+    temps = jnp.asarray([0.0, 0.7, 1.3, 2.0])
+    batched = sample_token_per_row(logits, keys, temps, top_k=5)
+    for r in range(4):
+        single = sample_token(
+            logits[r : r + 1], keys[r], temps[r], top_k=5
+        )
+        assert int(batched[r]) == int(single[0]), f"row {r}"

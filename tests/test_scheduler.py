@@ -1,0 +1,182 @@
+"""Continuous batching: the scheduler coalesces concurrent requests into
+batched backend calls while preserving per-request results and ordering
+guarantees. All hermetic (FakeBackend) — no accelerator, no network."""
+
+import threading
+import time
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import FakeBackend
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
+    RemoteHTTPBackend,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+    BatchScheduler,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server import (
+    GenerationServer,
+)
+
+
+class RecordingBackend(FakeBackend):
+    """FakeBackend that records every call's batch size."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.calls = []  # list of batch sizes (1 == single generate)
+
+    def generate(self, request):
+        self.calls.append(1)
+        return super().generate(request)
+
+    def generate_batch(self, requests):
+        self.calls.append(len(requests))
+        return [super(RecordingBackend, self).generate(r) for r in requests]
+
+
+@pytest.fixture()
+def backend():
+    return RecordingBackend()
+
+
+def _submit_concurrently(scheduler, requests):
+    results = [None] * len(requests)
+    errors = [None] * len(requests)
+
+    def worker(i, req):
+        try:
+            results[i] = scheduler.submit(req)
+        except BaseException as exc:  # noqa: BLE001
+            errors[i] = exc
+
+    threads = [
+        threading.Thread(target=worker, args=(i, r))
+        for i, r in enumerate(requests)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    return results, errors
+
+
+def test_concurrent_compatible_requests_coalesce(backend):
+    sched = BatchScheduler(backend, max_batch=8, window_s=0.2)
+    sched.start()
+    try:
+        reqs = [
+            GenerationRequest("m", f"prompt {i}", max_new_tokens=8, seed=i)
+            for i in range(4)
+        ]
+        results, errors = _submit_concurrently(sched, reqs)
+        assert errors == [None] * 4
+        # each caller got its own request's result
+        for req, res in zip(reqs, results):
+            assert res.request == req
+            assert res.tokens == backend.generate(req).tokens
+        # at least one multi-row batch happened (timing-dependent how many)
+        assert max(backend.calls) >= 2
+    finally:
+        sched.stop()
+
+
+def test_incompatible_requests_split_into_separate_batches(backend):
+    sched = BatchScheduler(backend, max_batch=8, window_s=0.15)
+    sched.start()
+    try:
+        reqs = [
+            GenerationRequest("model-a", "x", max_new_tokens=4),
+            GenerationRequest("model-b", "y", max_new_tokens=4),
+            GenerationRequest("model-a", "z", max_new_tokens=4, top_k=7),
+        ]
+        results, errors = _submit_concurrently(sched, reqs)
+        assert errors == [None] * 3
+        for req, res in zip(reqs, results):
+            assert res.request == req
+    finally:
+        sched.stop()
+
+
+def test_backend_error_fans_out_to_all_callers():
+    class ExplodingBackend(FakeBackend):
+        def generate(self, request):
+            raise RuntimeError("boom")
+
+        def generate_batch(self, requests):
+            raise RuntimeError("boom")
+
+    sched = BatchScheduler(ExplodingBackend(), window_s=0.1)
+    sched.start()
+    try:
+        reqs = [GenerationRequest("m", "x", max_new_tokens=4) for _ in range(3)]
+        results, errors = _submit_concurrently(sched, reqs)
+        assert results == [None] * 3
+        assert all(isinstance(e, RuntimeError) for e in errors)
+    finally:
+        sched.stop()
+
+
+def test_stop_unblocks_pending_submits(backend):
+    sched = BatchScheduler(backend, window_s=0.05)
+    # never started: submit must refuse rather than hang
+    with pytest.raises(RuntimeError, match="not running"):
+        sched.submit(GenerationRequest("m", "x", max_new_tokens=4))
+    sched.start()
+    sched.stop()
+    with pytest.raises(RuntimeError, match="not running"):
+        sched.submit(GenerationRequest("m", "x", max_new_tokens=4))
+
+
+def test_server_batches_concurrent_http_requests(backend):
+    srv = GenerationServer(
+        backend,
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        batch_window_ms=150.0,
+        max_batch=8,
+    )
+    srv.start()
+    try:
+        client = RemoteHTTPBackend(f"http://127.0.0.1:{srv.port}")
+        reqs = [
+            GenerationRequest("m", f"p{i}", max_new_tokens=6, seed=i)
+            for i in range(4)
+        ]
+        results = [None] * 4
+
+        def call(i):
+            results[i] = client.generate(reqs[i])
+
+        threads = [
+            threading.Thread(target=call, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        reference = FakeBackend()
+        for req, res in zip(reqs, results):
+            assert res is not None
+            assert res.tokens == reference.generate(req).tokens
+        assert max(backend.calls) >= 2  # coalescing really happened
+    finally:
+        srv.stop()
+
+
+def test_server_without_batching_stays_serial(backend):
+    srv = GenerationServer(
+        backend, host="127.0.0.1", port=0, quiet=True
+    )  # batch_window_ms=0
+    srv.start()
+    try:
+        client = RemoteHTTPBackend(f"http://127.0.0.1:{srv.port}")
+        req = GenerationRequest("m", "solo", max_new_tokens=4)
+        client.generate(req)
+        assert backend.calls == [1]
+    finally:
+        srv.stop()

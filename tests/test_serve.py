@@ -359,3 +359,10 @@ def test_streaming_chunks_carry_token_ids(server, client):
     chunks = list(client.generate_stream(req))
     streamed = [t for c in chunks[:-1] for t in c.tokens]
     assert streamed == mono.tokens
+
+
+def test_negative_num_predict_maps_to_bounded_budget():
+    req = protocol.request_from_wire(
+        {"model": "m", "prompt": "x", "options": {"num_predict": -1}}
+    )
+    assert req.max_new_tokens == protocol.UNLIMITED_NUM_PREDICT_CAP

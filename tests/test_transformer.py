@@ -132,3 +132,51 @@ def test_tokenizer_round_trip():
     assert ids[0] == ByteTokenizer.BOS_ID
     assert tok.decode(ids) == text
     assert max(ids) < tok.vocab_size
+
+
+def test_forward_per_sequence_offsets_match_single_rows():
+    """Batched decode with a [B] offset vector must equal running each row
+    alone with its scalar offset (the property generate_batch builds on)."""
+    import dataclasses as _dc
+
+    import numpy as np
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+        Transformer,
+        forward,
+    )
+
+    cfg = get_model_config("qwen2:1.5b").tiny()
+    tf = Transformer.initialise(cfg, seed=0, dtype=jnp.float32)
+    t_len = 24
+    rng = jax.random.PRNGKey(0)
+    offsets = [3, 7, 11]
+    b = len(offsets)
+
+    # per-row caches with distinct valid prefixes
+    kc, vc = tf.init_cache(b, t_len, dtype=jnp.float32)
+    kc = jax.random.normal(rng, kc.shape, dtype=jnp.float32) * 0.1
+    vc = jax.random.normal(jax.random.PRNGKey(1), vc.shape, dtype=jnp.float32) * 0.1
+    tokens = jnp.asarray([[5], [9], [13]], dtype=jnp.int32)
+
+    hidden_b, kb, vb = forward(
+        tf.params, cfg, tokens, jnp.asarray(offsets, dtype=jnp.int32), kc, vc
+    )
+
+    for r, off in enumerate(offsets):
+        hidden_1, k1, v1 = forward(
+            tf.params,
+            cfg,
+            tokens[r : r + 1],
+            jnp.int32(off),
+            kc[:, r : r + 1],
+            vc[:, r : r + 1],
+        )
+        np.testing.assert_allclose(
+            np.asarray(hidden_b[r]), np.asarray(hidden_1[0]), rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(kb[:, r]), np.asarray(k1[:, 0]), rtol=2e-5, atol=2e-5
+        )
