@@ -107,7 +107,8 @@ class JaxEngine(GenerationBackend):
             from .checkpoint import WeightCache
 
             self._weight_cache = WeightCache(weight_cache_dir)
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = ByteTokenizer()  # fallback (random-weight models)
+        self._tokenizers: Dict[str, Any] = {}
         self._models: Dict[str, Transformer] = {}
         self._prefill_cache: Dict[Tuple, Callable] = {}
         self._decode_cache: Dict[Tuple, Callable] = {}
@@ -198,7 +199,20 @@ class JaxEngine(GenerationBackend):
         self._models.clear()
         self._prefill_cache.clear()
         self._decode_cache.clear()
+        self._tokenizers.clear()
         self._warmed.clear()  # a fresh load must re-warm outside the window
+
+    def _tokenizer_for(self, model: str):
+        """The model's own tokenizer when served from an HF checkpoint
+        (ids line up with the trained embeddings, text is real text); the
+        byte fallback otherwise."""
+        if model not in self._tokenizers:
+            from ..models.tokenizer import load_tokenizer
+
+            self._tokenizers[model] = load_tokenizer(
+                self.hf_checkpoints.get(model)
+            )
+        return self._tokenizers[model]
 
     def _place_cache(self, k_cache, v_cache, cfg: ModelConfig):
         """Placement hook: the TP engine overrides this to shard the KV cache
@@ -211,7 +225,10 @@ class JaxEngine(GenerationBackend):
         measurement window (once per (model, buckets, top_k) shape)."""
         key = (
             request.model,
-            _bucket(len(self.tokenizer.encode(request.prompt)), PROMPT_BUCKETS),
+            _bucket(
+                len(self._tokenizer_for(request.model).encode(request.prompt)),
+                PROMPT_BUCKETS,
+            ),
             _bucket(request.max_new_tokens, GEN_BUCKETS),
             request.top_k,
             request.top_p < 1.0,
@@ -269,7 +286,7 @@ class JaxEngine(GenerationBackend):
         tf = self._models[model]
         cfg = tf.cfg
         decode_attention = self.decode_attention
-        eos = ByteTokenizer.EOS_ID
+        eos = self._tokenizer_for(model).eos_id
 
         @jax.jit
         def decode(
@@ -340,19 +357,26 @@ class JaxEngine(GenerationBackend):
 
     # -- generation -----------------------------------------------------------
     def _start(
-        self, request: GenerationRequest, cache_len: Optional[int] = None
+        self,
+        request: GenerationRequest,
+        cache_len: Optional[int] = None,
+        prompt_ids: "Optional[list[int]]" = None,
     ) -> Dict[str, Any]:
         """The shared prefill path: tokenize, bucket, run prefill and sample
         the first token. Returns the decode state that :meth:`generate` (one
         monolithic decode call), :meth:`generate_stream` (chunked decode
         calls) and :meth:`generate_batch` (rows concatenated into one
         batched decode) continue from. ``cache_len`` overrides the KV cache
-        size so a batch's rows can share one common cache shape."""
+        size so a batch's rows can share one common cache shape;
+        ``prompt_ids`` skips re-tokenizing when the caller already encoded
+        the prompt."""
         self.load_model(request.model)
         tf = self._models[request.model]
         cfg = tf.cfg
 
-        prompt_ids = self.tokenizer.encode(request.prompt)
+        tok = self._tokenizer_for(request.model)
+        if prompt_ids is None:
+            prompt_ids = tok.encode(request.prompt)
         s_real = len(prompt_ids)
         s_bucket = _bucket(s_real, PROMPT_BUCKETS)
         g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
@@ -369,7 +393,7 @@ class JaxEngine(GenerationBackend):
         use_rp = request.repeat_penalty != 1.0
 
         tokens = jnp.asarray(
-            [prompt_ids + [ByteTokenizer.PAD_ID] * (s_bucket - s_real)],
+            [prompt_ids + [tok.pad_id] * (s_bucket - s_real)],
             dtype=jnp.int32,
         )
         k_cache, v_cache = tf.init_cache(1, cache_len, dtype=self.dtype)
@@ -403,6 +427,7 @@ class JaxEngine(GenerationBackend):
         t1 = time.monotonic()
         return {
             "tf": tf,
+            "tok": tok,
             "s_real": s_real,
             "g_bucket": g_bucket,
             "first": first,
@@ -423,12 +448,13 @@ class JaxEngine(GenerationBackend):
         st: Dict[str, Any],
         t2: float,
     ) -> GenerationResult:
-        if request.stop_at_eos and ByteTokenizer.EOS_ID in generated:
-            generated = generated[: generated.index(ByteTokenizer.EOS_ID)]
+        eos = st["tok"].eos_id
+        if request.stop_at_eos and eos in generated:
+            generated = generated[: generated.index(eos)]
         return GenerationResult(
             request=request,
             tokens=generated,
-            text=self.tokenizer.decode(generated),
+            text=st["tok"].decode(generated),
             prompt_tokens=st["s_real"],
             generated_tokens=len(generated),
             prefill_s=st["t1"] - st["t0"],
@@ -484,7 +510,7 @@ class JaxEngine(GenerationBackend):
         tf = self._models[model]
         cfg = tf.cfg
         decode_attention = self.decode_attention
-        eos = ByteTokenizer.EOS_ID
+        eos = self._tokenizer_for(model).eos_id
 
         from ..ops.sampling import sample_token_per_row
 
@@ -598,9 +624,10 @@ class JaxEngine(GenerationBackend):
 
         # One cache shape for every row: widest prompt bucket + widest
         # generation bucket.
+        tok = self._tokenizer_for(model)
+        all_prompt_ids = [tok.encode(r.prompt) for r in requests]
         s_buckets = [
-            _bucket(len(self.tokenizer.encode(r.prompt)), PROMPT_BUCKETS)
-            for r in requests
+            _bucket(len(ids), PROMPT_BUCKETS) for ids in all_prompt_ids
         ]
         g_bucket = _bucket(max(r.max_new_tokens for r in requests), GEN_BUCKETS)
         cache_len = max(s_buckets) + g_bucket
@@ -610,7 +637,10 @@ class JaxEngine(GenerationBackend):
                 f"{cfg.max_seq_len}"
             )
 
-        states = [self._start(r, cache_len=cache_len) for r in requests]
+        states = [
+            self._start(r, cache_len=cache_len, prompt_ids=ids)
+            for r, ids in zip(requests, all_prompt_ids)
+        ]
         n = len(states)
         b_bucket = _bucket(n, BATCH_BUCKETS)
         use_top_p = any(st["use_top_p"] for st in states)
@@ -629,8 +659,18 @@ class JaxEngine(GenerationBackend):
             + [requests[0].temperature] * (b_bucket - n),
             dtype=jnp.float32,
         )
+        # Rows that disabled nucleus filtering (top_p == 1.0) get a sentinel
+        # of 2.0: with the filter statically enabled for the whole batch
+        # (use_top_p = any row), cum_excl < 2.0 is exactly all-True, so the
+        # filter is a provable identity for those rows — float32 cumsum
+        # error near 1.0 could otherwise mask tail tokens and change their
+        # draw vs a lone generate().
+        def _row_top_p(r: GenerationRequest) -> float:
+            return r.top_p if r.top_p < 1.0 else 2.0
+
         top_ps = jnp.asarray(
-            [r.top_p for r in requests] + [requests[0].top_p] * (b_bucket - n),
+            [_row_top_p(r) for r in requests]
+            + [_row_top_p(requests[0])] * (b_bucket - n),
             dtype=jnp.float32,
         )
         rps = jnp.asarray(
@@ -672,14 +712,14 @@ class JaxEngine(GenerationBackend):
             budget = request.max_new_tokens - 1
             take = min(n_row[r], budget)
             generated = [int(first_tokens[r])] + [int(t) for t in out[r][:take]]
-            if request.stop_at_eos and ByteTokenizer.EOS_ID in generated:
-                generated = generated[: generated.index(ByteTokenizer.EOS_ID)]
+            if request.stop_at_eos and tok.eos_id in generated:
+                generated = generated[: generated.index(tok.eos_id)]
             prefill_s = st["t1"] - st["t0"]  # this row's own prefill
             results.append(
                 GenerationResult(
                     request=request,
                     tokens=generated,
-                    text=self.tokenizer.decode(generated),
+                    text=tok.decode(generated),
                     prompt_tokens=st["s_real"],
                     generated_tokens=len(generated),
                     prefill_s=prefill_s,
@@ -705,7 +745,7 @@ class JaxEngine(GenerationBackend):
         ``result.text`` decodes the full stream and is authoritative.
         """
         st = self._start(request)
-        eos = ByteTokenizer.EOS_ID
+        eos = st["tok"].eos_id
         chunk_bucket = _bucket(min(chunk_tokens, request.max_new_tokens), GEN_BUCKETS)
         decode = self._decode_fn(
             request.model,
@@ -725,7 +765,7 @@ class JaxEngine(GenerationBackend):
         if not stop:
             visible = list(generated)
             yield GenerationChunk(
-                text=self.tokenizer.decode(visible), tokens=visible
+                text=st["tok"].decode(visible), tokens=visible
             )
 
         token = st["first"]
@@ -765,7 +805,7 @@ class JaxEngine(GenerationBackend):
                     emit = emit[: emit.index(eos)]
             if emit:
                 yield GenerationChunk(
-                    text=self.tokenizer.decode(emit), tokens=emit
+                    text=st["tok"].decode(emit), tokens=emit
                 )
 
         t2 = time.monotonic()

@@ -1,23 +1,38 @@
-"""Byte-level tokenizer.
+"""Tokenizers: dependency-free byte fallback + HF adapter.
 
 The reference delegates tokenisation to Ollama's server-side tokenizers. For
 an energy study with randomly-initialised weights, what matters is token
 *count* and shape discipline, so a dependency-free byte tokenizer (256 byte
-ids + specials) is used. Vocab ids: 0=PAD, 1=BOS, 2=EOS, bytes at 3..258.
+ids + specials) is the default. When a model is served from a real HF
+checkpoint (engine ``hf_checkpoints``), :class:`HFTokenizer` wraps that
+checkpoint's own tokenizer so token ids line up with the trained embedding
+table and generated text is real text — the same pairing Ollama's model
+store guarantees (README.md:29-31: models are pulled with their tokenizers).
+
+Both classes expose the same surface: ``encode``/``decode`` +
+``pad_id``/``bos_id``/``eos_id``/``vocab_size``.
 """
 
 from __future__ import annotations
 
-from typing import List
+import os
+from typing import List, Optional
 
 
 class ByteTokenizer:
+    """Vocab ids: 0=PAD, 1=BOS, 2=EOS, bytes at 3..258."""
+
     PAD_ID = 0
     BOS_ID = 1
     EOS_ID = 2
     _OFFSET = 3
 
     vocab_size = 256 + _OFFSET
+
+    # uniform instance-level surface shared with HFTokenizer
+    pad_id = PAD_ID
+    bos_id = BOS_ID
+    eos_id = EOS_ID
 
     def encode(self, text: str, add_bos: bool = True) -> List[int]:
         ids = [b + self._OFFSET for b in text.encode("utf-8")]
@@ -33,3 +48,64 @@ class ByteTokenizer:
             if self._OFFSET <= i < self._OFFSET + 256
         )
         return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """A HuggingFace checkpoint's own tokenizer behind the framework's
+    tokenizer surface. Loaded strictly from local files (this environment
+    has no egress; so does a measurement box mid-experiment)."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+
+    @property
+    def eos_id(self) -> int:
+        # -1 = "no EOS": never equals a sampled id (ids are >= 0), so
+        # generation runs to its token budget, and stop_at_eos never cuts.
+        eid = self._tok.eos_token_id
+        return -1 if eid is None else int(eid)
+
+    @property
+    def bos_id(self) -> Optional[int]:
+        bid = self._tok.bos_token_id
+        return None if bid is None else int(bid)
+
+    @property
+    def pad_id(self) -> int:
+        pid = self._tok.pad_token_id
+        if pid is not None:
+            return int(pid)
+        # Common for decoder-only checkpoints: no pad token. Any id works —
+        # padded positions are never attended (prefill masks by position) —
+        # EOS is the conventional stand-in.
+        return max(self.eos_id, 0)
+
+    @property
+    def vocab_size(self) -> int:
+        return int(len(self._tok))
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = [int(i) for i in self._tok.encode(text, add_special_tokens=False)]
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(checkpoint_dir: Optional[str]) -> "HFTokenizer | ByteTokenizer":
+    """The tokenizer for a model: its checkpoint's own if one is present
+    (tokenizer.json / tokenizer_config.json / vocab.json), else the byte
+    fallback."""
+    if checkpoint_dir is not None and any(
+        os.path.exists(os.path.join(checkpoint_dir, f))
+        for f in ("tokenizer.json", "tokenizer_config.json", "vocab.json")
+    ):
+        try:
+            return HFTokenizer(checkpoint_dir)
+        except Exception:  # noqa: BLE001 — malformed files → fallback
+            pass
+    return ByteTokenizer()

@@ -128,7 +128,9 @@ commands:
                        Ollama-equivalent): --port N (default 11434),
                        --backend jax|jax-tp|fake, --tp N, --models a,b,c,
                        --batch-window-ms W --max-batch B (continuous batching
-                       of concurrent requests; off by default)
+                       of concurrent requests; off by default),
+                       --hf model=/ckpt/dir (serve trained weights + that
+                       checkpoint's tokenizer; repeatable), --quantize int8
   help                 show this message
 """
 
@@ -143,6 +145,8 @@ def serve_command(args: List[str]) -> None:
     models: Optional[List[str]] = None
     batch_window_ms = 0.0
     max_batch = 8
+    hf_checkpoints = {}
+    quantize = None
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -157,6 +161,17 @@ def serve_command(args: List[str]) -> None:
             batch_window_ms = float(next(it, "0"))
         elif arg == "--max-batch":
             max_batch = int(next(it, "8"))
+        elif arg == "--hf":
+            # --hf model=/path/to/checkpoint (repeatable): serve the model
+            # from a local HF checkpoint (trained weights + its tokenizer)
+            # instead of random-init — the analogue of `ollama pull`.
+            spec = next(it, "")
+            if "=" not in spec:
+                raise CommandError("serve: --hf expects model=/path/to/dir")
+            name, _, path = spec.partition("=")
+            hf_checkpoints[name] = path
+        elif arg == "--quantize":
+            quantize = next(it, "int8")
         else:
             raise CommandError(f"serve: unrecognised option {arg!r}")
 
@@ -172,12 +187,19 @@ def serve_command(args: List[str]) -> None:
         from ..parallel.tp import TensorParallelEngine
 
         backend = TensorParallelEngine(
-            mesh=build_mesh(MeshSpec.tp_only(tp)), decode_attention="auto"
+            mesh=build_mesh(MeshSpec.tp_only(tp)),
+            decode_attention="auto",
+            hf_checkpoints=hf_checkpoints or None,
+            quantize=quantize,
         )
     elif backend_kind == "jax":
         from ..engine.jax_engine import JaxEngine
 
-        backend = JaxEngine(decode_attention="auto")
+        backend = JaxEngine(
+            decode_attention="auto",
+            hf_checkpoints=hf_checkpoints or None,
+            quantize=quantize,
+        )
     else:
         raise CommandError(f"serve: unknown backend {backend_kind!r}")
 

@@ -163,10 +163,24 @@ class BatchScheduler:
                         results = self.backend.generate_batch(
                             [t.request for t in batch]
                         )
-            except BaseException as exc:  # noqa: BLE001 — fan the error out
-                for ticket in batch:
-                    ticket.error = exc
-                    ticket.event.set()
+            except BaseException as exc:  # noqa: BLE001
+                if len(batch) == 1:
+                    batch[0].error = exc
+                    batch[0].event.set()
+                else:
+                    # A batch-level failure (e.g. the combined KV footprint
+                    # exceeding max_seq_len) must not 500 callers whose
+                    # requests are individually fine: retry each alone and
+                    # fan out only its own error.
+                    for ticket in batch:
+                        try:
+                            with self._backend_lock:
+                                ticket.result = self.backend.generate(
+                                    ticket.request
+                                )
+                        except BaseException as single_exc:  # noqa: BLE001
+                            ticket.error = single_exc
+                        ticket.event.set()
             else:
                 for ticket, result in zip(batch, results):
                     ticket.result = result

@@ -275,3 +275,21 @@ def test_generate_batch_chunks_oversized_fleets(engine):
     # spot-check parity at the chunk seam
     for i in (0, BATCH_BUCKETS[-1] - 1, BATCH_BUCKETS[-1], n - 1):
         assert batch[i].tokens == engine.generate(reqs[i]).tokens
+
+
+def test_generate_batch_mixed_top_p_rows_stay_bit_identical(engine):
+    # a sampled row with top_p disabled next to a top_p row: the disabled
+    # row's draw must not be perturbed by the batch-wide nucleus filter
+    reqs = [
+        GenerationRequest(
+            "tiny-a", "nucleus", max_new_tokens=10, temperature=1.0,
+            top_p=0.8, seed=2,
+        ),
+        GenerationRequest(
+            "tiny-a", "free", max_new_tokens=10, temperature=1.3, seed=7,
+        ),  # top_p = 1.0 (disabled)
+    ]
+    singles = [engine.generate(r) for r in reqs]
+    batch = engine.generate_batch(reqs)
+    for s, b in zip(singles, batch):
+        assert b.tokens == s.tokens

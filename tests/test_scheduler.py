@@ -180,3 +180,27 @@ def test_server_without_batching_stays_serial(backend):
         assert backend.calls == [1]
     finally:
         srv.stop()
+
+
+def test_batch_failure_retries_singles():
+    """A batch-level failure must not fail callers whose requests are
+    individually fine."""
+
+    class BatchAllergicBackend(FakeBackend):
+        def generate_batch(self, requests):
+            raise ValueError("combined batch exceeds max_seq_len")
+
+    sched = BatchScheduler(BatchAllergicBackend(), window_s=0.2)
+    sched.start()
+    try:
+        reqs = [
+            GenerationRequest("m", f"p{i}", max_new_tokens=4, seed=i)
+            for i in range(3)
+        ]
+        results, errors = _submit_concurrently(sched, reqs)
+        assert errors == [None] * 3  # every caller served via single retry
+        reference = FakeBackend()
+        for req, res in zip(reqs, results):
+            assert res.tokens == reference.generate(req).tokens
+    finally:
+        sched.stop()

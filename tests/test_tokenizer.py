@@ -1,0 +1,128 @@
+"""Tokenizers: byte fallback surface, HF adapter, and engine integration.
+
+The HF tokenizer is built locally from a handcrafted ``tokenizer.json``
+(this environment has no egress), exercising the same loading path a real
+checkpoint directory provides.
+"""
+
+import json
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.tokenizer import (
+    ByteTokenizer,
+    load_tokenizer,
+)
+
+tokenizers = pytest.importorskip("tokenizers")
+transformers = pytest.importorskip("transformers")
+
+
+VOCAB = {
+    "<pad>": 0,
+    "<s>": 1,
+    "</s>": 2,
+    "[UNK]": 3,
+    "hello": 4,
+    "world": 5,
+    "energy": 6,
+    "tpu": 7,
+}
+
+
+@pytest.fixture()
+def hf_dir(tmp_path):
+    tok = tokenizers.Tokenizer(
+        tokenizers.models.WordLevel(vocab=VOCAB, unk_token="[UNK]")
+    )
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.Whitespace()
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    tok.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(
+        json.dumps(
+            {
+                "tokenizer_class": "PreTrainedTokenizerFast",
+                "bos_token": "<s>",
+                "eos_token": "</s>",
+                "pad_token": "<pad>",
+            }
+        )
+    )
+    return d
+
+
+def test_byte_tokenizer_uniform_surface():
+    tok = ByteTokenizer()
+    assert (tok.pad_id, tok.bos_id, tok.eos_id) == (0, 1, 2)
+    ids = tok.encode("hi")
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "hi"
+
+
+def test_hf_tokenizer_roundtrip_and_special_ids(hf_dir):
+    tok = load_tokenizer(str(hf_dir))
+    assert type(tok).__name__ == "HFTokenizer"
+    assert tok.bos_id == 1 and tok.eos_id == 2 and tok.pad_id == 0
+    ids = tok.encode("hello world")
+    assert ids == [1, 4, 5]  # bos + words
+    assert tok.decode(ids) == "hello world"
+    assert tok.encode("hello", add_bos=False) == [4]
+    assert tok.vocab_size == len(VOCAB)
+
+
+def test_load_tokenizer_falls_back_to_bytes(tmp_path):
+    assert isinstance(load_tokenizer(None), ByteTokenizer)
+    assert isinstance(load_tokenizer(str(tmp_path)), ByteTokenizer)  # empty dir
+    # malformed tokenizer.json → fallback, not a crash
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "tokenizer.json").write_text("{not json")
+    assert isinstance(load_tokenizer(str(bad)), ByteTokenizer)
+
+
+def test_engine_uses_checkpoint_tokenizer(hf_dir):
+    """An engine serving an HF checkpoint tokenizes with that checkpoint's
+    tokenizer: prompt ids line up with the trained embedding rows and the
+    output text decodes through the same vocab."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import torch  # noqa: F401 — transformers model construction
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.convert import (
+        hf_config_for,
+    )
+
+    cfg = dataclasses.replace(
+        get_model_config("mistral:7b").tiny(), vocab_size=len(VOCAB)
+    )
+    model = transformers.AutoModelForCausalLM.from_config(
+        hf_config_for(cfg), attn_implementation="eager"
+    )
+    model.save_pretrained(hf_dir)  # weights join the tokenizer files
+
+    engine = JaxEngine(
+        registry={cfg.name: cfg},
+        dtype=jnp.float32,
+        hf_checkpoints={cfg.name: str(hf_dir)},
+    )
+    tok = engine._tokenizer_for(cfg.name)
+    assert type(tok).__name__ == "HFTokenizer"
+    result = engine.generate(
+        GenerationRequest(cfg.name, "hello world energy", max_new_tokens=4)
+    )
+    assert result.prompt_tokens == 4  # bos + 3 known words
+    # every generated id is in the checkpoint vocab, and the text is its
+    # decode (possibly empty if only specials were sampled)
+    assert all(0 <= t < len(VOCAB) for t in result.tokens)
+    assert result.text == tok.decode(result.tokens)
