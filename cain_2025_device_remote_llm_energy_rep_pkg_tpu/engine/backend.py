@@ -55,6 +55,9 @@ class GenerationResult:
     prefill_s: float
     decode_s: float
     total_s: float
+    # Backend-specific extras (e.g. speculative decoding's rounds/accepted
+    # counters); absent for plain decoding.
+    extras: Optional[dict] = None
 
     @property
     def tokens_per_s(self) -> float:

@@ -26,7 +26,6 @@ import jax
 import jax.numpy as jnp
 
 from ..models.config import MODEL_REGISTRY, ModelConfig, get_model_config
-from ..models.tokenizer import ByteTokenizer
 from ..models.transformer import (
     DecodeAttentionFn,
     PrefillAttentionFn,
@@ -89,10 +88,14 @@ class JaxEngine(GenerationBackend):
         quantize: Optional[str] = None,  # None | "int8" (weight-only)
         hf_checkpoints: Optional[Dict[str, str]] = None,
         prefill_attention: "str | PrefillAttentionFn | None" = "auto",
+        speculative: "Optional[Dict[str, Tuple[str, int]]]" = None,
     ) -> None:
         if quantize not in (None, "int8"):
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
         self.quantize = quantize
+        # target model → (draft model, k): greedy requests for the target
+        # route through speculative decoding (engine/speculative.py).
+        self.speculative = dict(speculative or {})
         # model name → local HF checkpoint dir; load_model converts the
         # trained weights (models/convert.py) instead of random-initialising
         # (the analogue of Ollama's pulled model store, README.md:29-31).
@@ -107,8 +110,7 @@ class JaxEngine(GenerationBackend):
             from .checkpoint import WeightCache
 
             self._weight_cache = WeightCache(weight_cache_dir)
-        self.tokenizer = ByteTokenizer()  # fallback (random-weight models)
-        self._tokenizers: Dict[str, Any] = {}
+        self._tokenizers: Dict[str, Any] = {}  # per-model, via _tokenizer_for
         self._models: Dict[str, Transformer] = {}
         self._prefill_cache: Dict[Tuple, Callable] = {}
         self._decode_cache: Dict[Tuple, Callable] = {}
@@ -356,6 +358,25 @@ class JaxEngine(GenerationBackend):
         return decode
 
     # -- generation -----------------------------------------------------------
+    def _run_prefill(
+        self, model: str, prompt_ids: "list[int]", s_bucket: int, cache_len: int
+    ):
+        """Pad the prompt to its bucket, build + place the KV cache, and run
+        the compiled prefill. Shared by _start (target) and the speculative
+        path's draft prefill so the mechanics live in one place."""
+        tf = self._models[model]
+        tok = self._tokenizer_for(model)
+        s_real = len(prompt_ids)
+        tokens = jnp.asarray(
+            [prompt_ids + [tok.pad_id] * (s_bucket - s_real)], dtype=jnp.int32
+        )
+        k_cache, v_cache = tf.init_cache(1, cache_len, dtype=self.dtype)
+        k_cache, v_cache = self._place_cache(k_cache, v_cache, tf.cfg)
+        prefill = self._prefill_fn(model, s_bucket, cache_len)
+        return prefill(
+            tf.params, tokens, jnp.asarray([s_real - 1]), k_cache, v_cache
+        )
+
     def _start(
         self,
         request: GenerationRequest,
@@ -392,12 +413,6 @@ class JaxEngine(GenerationBackend):
         use_top_p = request.top_p < 1.0
         use_rp = request.repeat_penalty != 1.0
 
-        tokens = jnp.asarray(
-            [prompt_ids + [tok.pad_id] * (s_bucket - s_real)],
-            dtype=jnp.int32,
-        )
-        k_cache, v_cache = tf.init_cache(1, cache_len, dtype=self.dtype)
-        k_cache, v_cache = self._place_cache(k_cache, v_cache, cfg)
         # The presence mask (repeat penalty) covers prompt + generated
         # tokens, like Ollama's default repeat_last_n window over the full
         # context. Kept all-False (and statically unused) when disabled.
@@ -406,9 +421,8 @@ class JaxEngine(GenerationBackend):
             presence = presence.at[0, jnp.asarray(prompt_ids)].set(True)
 
         t0 = time.monotonic()
-        prefill = self._prefill_fn(request.model, s_bucket, cache_len)
-        logits, k_cache, v_cache = prefill(
-            tf.params, tokens, jnp.asarray([s_real - 1]), k_cache, v_cache
+        logits, k_cache, v_cache = self._run_prefill(
+            request.model, prompt_ids, s_bucket, cache_len
         )
         rng = jax.random.PRNGKey(request.seed)
         rng, sub = jax.random.split(rng)
@@ -463,7 +477,30 @@ class JaxEngine(GenerationBackend):
         )
 
     def generate(self, request: GenerationRequest) -> GenerationResult:
-        st = self._start(request)
+        spec = self.speculative.get(request.model)
+        if (
+            spec is not None
+            and request.temperature == 0.0
+            and request.repeat_penalty == 1.0
+        ):
+            # Same tokens as plain greedy decode, just faster (the accepted
+            # tokens ARE the greedy tokens); sampled requests fall through
+            # to the plain loop, as do requests whose speculative cache
+            # margin wouldn't fit max_seq_len (plain decode still serves
+            # them — configuring a draft must never reject a request).
+            self.load_model(request.model)
+            cfg = self._models[request.model].cfg
+            ids = self._tokenizer_for(request.model).encode(request.prompt)
+            s_b = _bucket(len(ids), PROMPT_BUCKETS)
+            g_b = _bucket(request.max_new_tokens, GEN_BUCKETS)
+            margin = -(-(2 * spec[1] + 2) // 128) * 128
+            if s_b + g_b + margin <= cfg.max_seq_len:
+                return self.generate_speculative(
+                    request, spec[0], spec[1], prompt_ids=ids
+                )
+            st = self._start(request, prompt_ids=ids)
+        else:
+            st = self._start(request)
         decode = self._decode_fn(
             request.model,
             st["g_bucket"],
@@ -489,6 +526,103 @@ class JaxEngine(GenerationBackend):
 
         generated = [int(st["first"][0])] + [int(t) for t in out[0][: int(n_done)]]
         return self._finish(request, generated, st, t2)
+
+    # -- speculative generation -----------------------------------------------
+    def generate_speculative(
+        self,
+        request: GenerationRequest,
+        draft_model: str,
+        k: int = 4,
+        prompt_ids: "Optional[list[int]]" = None,
+    ) -> GenerationResult:
+        """Greedy decode via draft-and-verify (engine/speculative.py): the
+        draft proposes ``k`` tokens per round, the target verifies them in
+        one forward. Output tokens are bit-identical to plain greedy
+        :meth:`generate`; ``result.extras`` reports rounds/accepted.
+
+        The draft must share the target's vocabulary (same tokenizer); the
+        KV caches carry a ``2k+2``-slot margin beyond the usual buckets, so
+        requests near ``max_seq_len`` may need a smaller budget.
+        """
+        if request.temperature != 0.0 or request.repeat_penalty != 1.0:
+            raise ValueError(
+                "speculative decoding is greedy-only (temperature=0, "
+                "repeat_penalty=1)"
+            )
+        model = request.model
+        self.load_model(model)
+        self.load_model(draft_model)
+        tcfg = self._models[model].cfg
+        dcfg = self._models[draft_model].cfg
+        if tcfg.vocab_size != dcfg.vocab_size:
+            raise ValueError(
+                f"draft {draft_model} vocab {dcfg.vocab_size} != target "
+                f"{model} vocab {tcfg.vocab_size}"
+            )
+
+        tok = self._tokenizer_for(model)
+        if prompt_ids is None:
+            prompt_ids = tok.encode(request.prompt)
+        s_real = len(prompt_ids)
+        s_bucket = _bucket(s_real, PROMPT_BUCKETS)
+        g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
+        # The rounds can overshoot the budget by up to k and the draft seats
+        # one extra K/V entry; round the margin up to 128 so the cache's T
+        # dimension keeps the tiling the Pallas kernels require.
+        margin = -(-(2 * k + 2) // 128) * 128
+        cache_len = s_bucket + g_bucket + margin
+
+        # target prefill + first greedy token (shared path, margin cache)
+        st = self._start(request, cache_len=cache_len, prompt_ids=prompt_ids)
+
+        # draft prefill over the same token ids
+        dft = self._models[draft_model]
+        _, dkc, dvc = self._run_prefill(
+            draft_model, prompt_ids, s_bucket, cache_len
+        )
+
+        key = ("spec", model, draft_model, k, g_bucket)
+        if key not in self._decode_cache:
+            from .speculative import build_spec_fn
+
+            # The verify step runs attention for only k+1 query rows — far
+            # below the flash-prefill kernel's tile size; the XLA-fused jnp
+            # path is the right tool there (prefill_attention=None). The
+            # prompt prefill in _start still uses the flash kernel.
+            self._decode_cache[key] = build_spec_fn(
+                tcfg,
+                dcfg,
+                k,
+                g_bucket,
+                tok.eos_id,
+                self.decode_attention,
+                None,
+            )
+        spec = self._decode_cache[key]
+        out, n_em, rounds, acc = spec(
+            self._models[model].params,
+            dft.params,
+            st["first"],
+            jnp.int32(s_real),
+            st["k_cache"],
+            st["v_cache"],
+            dkc,
+            dvc,
+            jnp.int32(request.max_new_tokens - 1),
+        )
+        out = jax.block_until_ready(out)
+        t2 = time.monotonic()
+
+        take = min(int(n_em), request.max_new_tokens - 1)
+        generated = [int(st["first"][0])] + [int(t) for t in out[:take]]
+        result = self._finish(request, generated, st, t2)
+        result.extras = {
+            "spec_rounds": int(rounds),
+            "spec_accepted": int(acc),
+            "draft_model": draft_model,
+            "k": k,
+        }
+        return result
 
     # -- batched generation ---------------------------------------------------
     def _batch_decode_fn(

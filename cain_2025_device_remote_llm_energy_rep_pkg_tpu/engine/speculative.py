@@ -1,0 +1,163 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Plain greedy decode is HBM-bandwidth-bound: every generated token streams
+the target's full weights once. Speculative decoding lets a cheap draft
+model run ``k`` sequential steps, then the target scores all ``k`` drafts
+*in one forward* (k+1 positions — reading its weights once for up to k+1
+tokens). Accepted drafts are exactly the tokens target-greedy would have
+produced, so the output is **bit-identical to plain greedy decode under
+matching kernel numerics** — only latency changes. With a well-matched
+draft, tokens per target-weight-read approaches k+1.
+
+Numerics caveat: the verify forward scores k+1 positions in one pass while
+the plain loop scores one position per pass; when the two run different
+attention kernels (Pallas decode vs XLA-fused verify) at bf16, a near-tied
+argmax can resolve differently. With trained weights argmax is decisive
+and this is negligible (the standard situation for every speculative
+implementation); with random flat-logit test weights it shows up, so the
+parity tests pin float32.
+
+The reference's Ollama backend (experiment/RunnerConfig.py:128-131) has no
+speculative path; this is a capability the TPU rebuild adds on top of
+parity. Greedy-only by design: sampled speculative decoding needs the
+rejection-resampling scheme and is not needed for the energy study's
+deterministic workloads.
+
+The whole multi-round loop is one compiled ``lax.while_loop``: draft scan,
+verify forward, accept/emit arithmetic — no host round-trips between
+rounds.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import forward, logits_for
+
+
+def build_spec_fn(
+    tcfg,
+    dcfg,
+    k: int,
+    n_steps: int,
+    eos: int,
+    decode_attention=None,
+    prefill_attention=None,
+) -> Callable:
+    """Compile the speculative decode loop for (target cfg, draft cfg, k).
+
+    Returned fn signature::
+
+        spec(tparams, dparams, first_token[1], start_offset, tkc, tvc,
+             dkc, dvc, n_real) -> (out[n_steps+k+1], n_emitted, rounds,
+                                   accepted_total)
+
+    ``out[:n_emitted]`` are the tokens after ``first_token``; every entry
+    equals what target-greedy alone would produce. The caches must have at
+    least ``start_offset + n_real + 2k + 2`` slots (rounds can overshoot
+    ``n_real`` by up to k and the draft seats one extra K/V entry).
+    """
+
+    @jax.jit
+    def spec(
+        tparams, dparams, first_token, start_offset, tkc, tvc, dkc, dvc, n_real
+    ):
+        idx = jnp.arange(k + 1)
+
+        def cond(carry):
+            (_, _, _, _, _, _, _, n_em, done, _, _) = carry
+            return (n_em < n_real) & ~done
+
+        def body(carry):
+            (last, off, tkc, tvc, dkc, dvc, out, n_em, done, rounds, acc) = carry
+
+            # Draft k proposals sequentially (the draft is cheap); one extra
+            # forward seats d_k's K/V so a fully-accepted round leaves no
+            # hole in the draft cache.
+            def dstep(c, _):
+                tok, doff, kc, vc = c
+                hidden, kc, vc = forward(
+                    dparams, dcfg, tok[:, None], doff, kc, vc, decode_attention
+                )
+                nxt = jnp.argmax(
+                    logits_for(dparams, dcfg, hidden[:, 0]), axis=-1
+                ).astype(jnp.int32)
+                return (nxt, doff + 1, kc, vc), nxt
+
+            (dlast, doff, dkc, dvc), drafts = jax.lax.scan(
+                dstep, (last, off, dkc, dvc), None, length=k
+            )
+            drafts = drafts[:, 0]  # [k]
+            _, dkc, dvc = forward(
+                dparams, dcfg, dlast[:, None], doff, dkc, dvc, decode_attention
+            )
+
+            # Verify: one target forward over [last, d_1..d_k] scores every
+            # draft position at once.
+            ver = jnp.concatenate([last, drafts])[None, :]  # [1, k+1]
+            hidden, tkc, tvc = forward(
+                tparams, tcfg, ver, off, tkc, tvc, None, prefill_attention
+            )
+            tnext = jnp.argmax(
+                logits_for(tparams, tcfg, hidden[0]), axis=-1
+            ).astype(jnp.int32)  # [k+1] = t_1..t_{k+1}
+
+            # longest accepted prefix, then the target's own next token
+            match = drafts == tnext[:k]
+            n_acc = jnp.argmin(
+                jnp.concatenate([match, jnp.zeros((1,), dtype=bool)])
+            ).astype(jnp.int32)
+            emit = jnp.where(
+                idx < n_acc,
+                jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)]),
+                jnp.where(idx == n_acc, tnext[n_acc], jnp.int32(eos)),
+            )
+            m = n_acc + 1
+            # clip the round at its first EOS so post-EOS tokens are never
+            # emitted (matches the plain loop, which stops right there)
+            is_eos = (emit == eos) & (idx < m)
+            has_eos = jnp.any(is_eos)
+            m = jnp.where(has_eos, jnp.minimum(m, jnp.argmax(is_eos) + 1), m)
+            # accepted-AND-emitted drafts only (an EOS clip discards the
+            # tail; counting it would inflate the speedup statistics)
+            n_acc_emitted = jnp.minimum(n_acc, m)
+
+            out = jax.lax.dynamic_update_slice(out, emit, (n_em,))
+            last = emit[m - 1][None]
+            return (
+                last,
+                off + m,
+                tkc,
+                tvc,
+                dkc,
+                dvc,
+                out,
+                n_em + m,
+                done | has_eos,
+                rounds + 1,
+                acc + n_acc_emitted,
+            )
+
+        out0 = jnp.full((n_steps + k + 1,), eos, dtype=jnp.int32)
+        init = (
+            first_token,
+            start_offset,
+            tkc,
+            tvc,
+            dkc,
+            dvc,
+            out0,
+            jnp.int32(0),
+            jnp.asarray(False),
+            jnp.int32(0),
+            jnp.int32(0),
+        )
+        (_, _, _, _, _, _, out, n_em, _, rounds, acc) = jax.lax.while_loop(
+            cond, body, init
+        )
+        return out, n_em, rounds, acc
+
+    return spec

@@ -130,7 +130,8 @@ commands:
                        --batch-window-ms W --max-batch B (continuous batching
                        of concurrent requests; off by default),
                        --hf model=/ckpt/dir (serve trained weights + that
-                       checkpoint's tokenizer; repeatable), --quantize int8
+                       checkpoint's tokenizer; repeatable), --quantize int8,
+                       --speculative target=draft:k (draft-verify decoding)
   help                 show this message
 """
 
@@ -147,6 +148,7 @@ def serve_command(args: List[str]) -> None:
     max_batch = 8
     hf_checkpoints = {}
     quantize = None
+    speculative = {}
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -172,6 +174,17 @@ def serve_command(args: List[str]) -> None:
             hf_checkpoints[name] = path
         elif arg == "--quantize":
             quantize = next(it, "int8")
+        elif arg == "--speculative":
+            # --speculative target=draft:k (repeatable): greedy requests
+            # for `target` decode via draft-and-verify with k proposals.
+            spec = next(it, "")
+            if "=" not in spec:
+                raise CommandError(
+                    "serve: --speculative expects target=draft:k"
+                )
+            name, _, rest = spec.partition("=")
+            draft, _, k_str = rest.partition(":")
+            speculative[name] = (draft, int(k_str) if k_str else 4)
         else:
             raise CommandError(f"serve: unrecognised option {arg!r}")
 
@@ -191,6 +204,7 @@ def serve_command(args: List[str]) -> None:
             decode_attention="auto",
             hf_checkpoints=hf_checkpoints or None,
             quantize=quantize,
+            speculative=speculative or None,
         )
     elif backend_kind == "jax":
         from ..engine.jax_engine import JaxEngine
@@ -199,6 +213,7 @@ def serve_command(args: List[str]) -> None:
             decode_attention="auto",
             hf_checkpoints=hf_checkpoints or None,
             quantize=quantize,
+            speculative=speculative or None,
         )
     else:
         raise CommandError(f"serve: unknown backend {backend_kind!r}")
