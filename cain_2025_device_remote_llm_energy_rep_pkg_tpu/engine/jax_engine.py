@@ -54,6 +54,14 @@ def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
     raise ValueError(f"{n} exceeds the largest bucket {buckets[-1]}")
 
 
+def _spec_margin(k: int) -> int:
+    """Extra KV-cache slots the speculative path needs beyond the usual
+    buckets (rounds overshoot by up to k; the draft seats one extra entry),
+    rounded up to the 128-lane tile the Pallas kernels require. Single
+    source of truth for the routing fit-check and the allocation."""
+    return -(-(2 * k + 2) // 128) * 128
+
+
 def _dir_signature(path: str) -> str:
     """Cheap content signature of a checkpoint dir: latest mtime_ns + bytes."""
     import os
@@ -90,7 +98,7 @@ class JaxEngine(GenerationBackend):
         prefill_attention: "str | PrefillAttentionFn | None" = "auto",
         speculative: "Optional[Dict[str, Tuple[str, int]]]" = None,
     ) -> None:
-        if quantize not in (None, "int8"):
+        if quantize not in (None, "int8", "int4"):
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
         self.quantize = quantize
         # target model → (draft model, k): greedy requests for the target
@@ -189,10 +197,12 @@ class JaxEngine(GenerationBackend):
             tf = Transformer(cfg=cfg, params=params)
         else:
             tf = Transformer(cfg=cfg, params=make_params())
-        if self.quantize == "int8":
+        if self.quantize is not None:
             from ..models.quantize import quantize_params
 
-            tf = Transformer(cfg=cfg, params=quantize_params(tf.params))
+            tf = Transformer(
+                cfg=cfg, params=quantize_params(tf.params, mode=self.quantize)
+            )
         jax.block_until_ready(tf.params)
         self._load_s = time.monotonic() - t0
         self._models[model] = tf
@@ -493,8 +503,7 @@ class JaxEngine(GenerationBackend):
             ids = self._tokenizer_for(request.model).encode(request.prompt)
             s_b = _bucket(len(ids), PROMPT_BUCKETS)
             g_b = _bucket(request.max_new_tokens, GEN_BUCKETS)
-            margin = -(-(2 * spec[1] + 2) // 128) * 128
-            if s_b + g_b + margin <= cfg.max_seq_len:
+            if s_b + g_b + _spec_margin(spec[1]) <= cfg.max_seq_len:
                 return self.generate_speculative(
                     request, spec[0], spec[1], prompt_ids=ids
                 )
@@ -566,11 +575,7 @@ class JaxEngine(GenerationBackend):
         s_real = len(prompt_ids)
         s_bucket = _bucket(s_real, PROMPT_BUCKETS)
         g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
-        # The rounds can overshoot the budget by up to k and the draft seats
-        # one extra K/V entry; round the margin up to 128 so the cache's T
-        # dimension keeps the tiling the Pallas kernels require.
-        margin = -(-(2 * k + 2) // 128) * 128
-        cache_len = s_bucket + g_bucket + margin
+        cache_len = s_bucket + g_bucket + _spec_margin(k)
 
         # target prefill + first greedy token (shared path, margin cache)
         st = self._start(request, cache_len=cache_len, prompt_ids=prompt_ids)

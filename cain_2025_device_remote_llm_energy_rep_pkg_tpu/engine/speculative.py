@@ -121,9 +121,11 @@ def build_spec_fn(
             is_eos = (emit == eos) & (idx < m)
             has_eos = jnp.any(is_eos)
             m = jnp.where(has_eos, jnp.minimum(m, jnp.argmax(is_eos) + 1), m)
-            # accepted-AND-emitted drafts only (an EOS clip discards the
-            # tail; counting it would inflate the speedup statistics)
-            n_acc_emitted = jnp.minimum(n_acc, m)
+            # accepted-AND-extracted drafts only: an EOS clip discards the
+            # tail, and a final round can overshoot the caller's budget
+            # (n_real) — counting either would inflate the speedup stats
+            within_budget = jnp.maximum(jnp.minimum(m, n_real - n_em), 0)
+            n_acc_emitted = jnp.minimum(n_acc, within_budget)
 
             out = jax.lax.dynamic_update_slice(out, emit, (n_em,))
             last = emit[m - 1][None]

@@ -1,15 +1,39 @@
-"""Int8 weight-only quantization (per-output-channel scales).
+"""Weight-only quantization: int8 and packed-int4, per-output-channel scales.
 
-Two TPU reasons: (1) decode is HBM-bandwidth-bound — int8 weights halve the
-bytes every decode step streams, so the bandwidth ceiling on tokens/s nearly
-doubles; (2) llama3.1:8b at bf16 (~16 GB) does not fit a 16 GB v5e chip with
-cache + activations; at int8 (~8 GB) it does. Compute stays bf16/f32: XLA
-fuses the ``int8 → bf16 multiply-by-scale`` dequant into the consuming
-matmul, so only the HBM read shrinks.
+TPU reasons: (1) decode is HBM-bandwidth-bound — int8 weights halve and
+int4 quarter the bytes every decode step streams, so the bandwidth ceiling
+on tokens/s rises accordingly; (2) llama3.1:8b at bf16 (~16 GB) does not
+fit a 16 GB v5e chip with cache + activations; at int8 (~8 GB) or int4
+(~4 GB) it does. Compute stays bf16/f32: XLA fuses the dequant (int8 →
+scale-multiply, int4 → nibble shifts + scale) into the consuming matmul,
+so only the HBM read shrinks.
 
-Quantized leaves are ``{"q": int8[..., out], "s": f32[broadcastable]}`` —
-symmetric per-output-channel. ``maybe_dequant`` is the single accessor the
-model uses, so every weight site transparently takes either form.
+The reference's baseline models are Ollama defaults — 4-bit GGUF quants
+(Q4_0/Q4_K) — so 4-bit serving is the apples-to-apples configuration for
+the energy comparison, not an extra trick.
+
+Quantized leaves are dicts:
+  int8: ``{"q":  int8[..., in,   out], "s": f32[..., 1, out]}``
+  int4: ``{"q4": int8[..., in/2, out], "s": f32[..., 1, out]}`` — two
+        nibbles per byte packed along the input-feature axis (lo = even
+        rows, hi = odd rows), symmetric in [-7, 7].
+        (jnp.int4 storage exists but cannot cross the jit boundary on this
+        TPU stack, so the packing is explicit int8.)
+
+Performance note (measured on a v5e chip, qwen2:1.5b decode): bf16 200
+tok/s → int8 320 tok/s (XLA fuses the int8→bf16 scale-multiply into the
+matmul, so the HBM read genuinely halves). int4's shift/stack/reshape
+unpack does NOT fuse — XLA materialises the dequantized weights per step
+and decode drops to ~40 tok/s — so int4 currently buys *memory capacity*
+(fitting llama3.1:8b-class models on one chip), not speed; the fix is a
+Pallas matmul kernel that unpacks nibbles in VMEM. Serve int8 for speed.
+
+Embeddings (and an untied lm_head) quantize at int8 in BOTH modes — the
+gather and the logits matmul read them every step and they are a large
+fraction of small models' bytes — but never int4 (quality-sensitive, and
+a packed gather would straddle row pairs). ``maybe_dequant`` is the single
+accessor the model uses, so every weight site transparently takes any
+form.
 """
 
 from __future__ import annotations
@@ -20,9 +44,11 @@ import jax.numpy as jnp
 
 QuantLeaf = Dict[str, jnp.ndarray]
 
-# The matmul weights worth quantizing ([L, in, out]-shaped); norms, biases and
-# (by default) embeddings stay high-precision.
+# The matmul weights worth quantizing ([L, in, out]-shaped); norms and
+# biases stay high-precision.
 DEFAULT_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# Quantized at int8 in every mode (see module docstring).
+EMBED_KEYS = ("embed", "lm_head")
 
 
 def quantize_tensor(w: jnp.ndarray) -> QuantLeaf:
@@ -39,24 +65,73 @@ def quantize_tensor(w: jnp.ndarray) -> QuantLeaf:
     return {"q": q, "s": scale}
 
 
+def quantize_tensor_int4(w: jnp.ndarray) -> QuantLeaf:
+    """Symmetric 4-bit quantization in [-7, 7], nibble pairs packed along
+    the input-feature axis (which must be even)."""
+    if w.shape[-2] % 2 != 0:
+        raise ValueError(
+            f"int4 packing needs an even input-feature dim, got {w.shape}"
+        )
+    wf = w.astype(jnp.float32)
+    max_abs = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.maximum(max_abs, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int8)
+    lo = q[..., 0::2, :]
+    hi = q[..., 1::2, :]
+    packed = ((lo & 0xF) | (hi << 4)).astype(jnp.int8)
+    return {"q4": packed, "s": scale}
+
+
 def is_quantized(leaf: Any) -> bool:
-    return isinstance(leaf, dict) and set(leaf) == {"q", "s"}
+    return isinstance(leaf, dict) and set(leaf) in ({"q", "s"}, {"q4", "s"})
 
 
-def maybe_dequant(leaf: Union[jnp.ndarray, QuantLeaf], dtype=jnp.bfloat16) -> jnp.ndarray:
+def maybe_dequant(
+    leaf: Union[jnp.ndarray, QuantLeaf], dtype=jnp.bfloat16
+) -> jnp.ndarray:
     """Dequantize a quantized leaf (or pass a plain array through)."""
+    if not is_quantized(leaf):
+        return leaf
+    if "q4" in leaf:
+        packed = leaf["q4"]
+        # arithmetic shifts sign-extend int8, recovering the signed nibbles
+        lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+        hi = jnp.right_shift(packed, 4)
+        stacked = jnp.stack([lo, hi], axis=-2)  # [..., in/2, 2, out]
+        shape = packed.shape[:-2] + (2 * packed.shape[-2], packed.shape[-1])
+        q = stacked.reshape(shape)
+    else:
+        q = leaf["q"]
+    return (q.astype(jnp.float32) * leaf["s"]).astype(dtype)
+
+
+def embed_lookup(
+    leaf: Union[jnp.ndarray, QuantLeaf], tokens: jnp.ndarray, dtype
+) -> jnp.ndarray:
+    """Row-gather from a (possibly int8-quantized) embedding table without
+    materialising the dequantized table."""
     if is_quantized(leaf):
-        return (leaf["q"].astype(jnp.float32) * leaf["s"]).astype(dtype)
-    return leaf
+        rows = leaf["q"][tokens].astype(jnp.float32) * leaf["s"][0]
+        return rows.astype(dtype)
+    return leaf[tokens]
 
 
 def quantize_params(
-    params: Dict[str, Any], keys=DEFAULT_QUANT_KEYS
+    params: Dict[str, Any], keys=DEFAULT_QUANT_KEYS, mode: str = "int8"
 ) -> Dict[str, Any]:
-    """Quantize the named matmul weights; everything else passes through."""
+    """Quantize the named matmul weights (+ embeddings at int8); everything
+    else passes through. ``mode`` is "int8" or "int4" (matmul weights only
+    — embeddings stay int8 in both)."""
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    qt = quantize_tensor if mode == "int8" else quantize_tensor_int4
     out: Dict[str, Any] = {}
     for name, leaf in params.items():
-        if name in keys and not is_quantized(leaf):
+        if is_quantized(leaf):
+            out[name] = leaf
+        elif name in keys:
+            out[name] = qt(leaf)
+        elif name in EMBED_KEYS:
             out[name] = quantize_tensor(leaf)
         else:
             out[name] = leaf
@@ -67,7 +142,7 @@ def params_nbytes(params: Dict[str, Any]) -> int:
     total = 0
     for leaf in params.values():
         if is_quantized(leaf):
-            total += leaf["q"].nbytes + leaf["s"].nbytes
+            total += sum(v.nbytes for v in leaf.values())
         else:
             total += leaf.nbytes
     return total

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_angles
 from .config import ModelConfig
-from .quantize import maybe_dequant
+from .quantize import embed_lookup, maybe_dequant
 
 Params = Dict[str, Any]
 
@@ -238,7 +238,9 @@ def forward(
     separately (``logits_for``) so prefill never materialises [B,S,vocab].
     """
     b, s = tokens.shape
-    x = params["embed"][tokens]
+    x = embed_lookup(
+        params["embed"], tokens, params["final_norm"].dtype
+    )
     if cfg.gemma_norm:
         x = x * jnp.asarray(math.sqrt(cfg.d_model), dtype=x.dtype)
 
@@ -307,10 +309,14 @@ def run_blocks(
 
 def logits_for(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
     """Project hidden states [..., D] to vocab logits in float32."""
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return jnp.einsum(
-        "...d,dv->...v", hidden.astype(jnp.float32), head.astype(jnp.float32)
-    )
+    hidden = hidden.astype(jnp.float32)
+    if cfg.tie_embeddings:
+        # embed is [V, D]; contract over D (avoids transposing, which a
+        # quantized dict leaf couldn't express anyway)
+        head = maybe_dequant(params["embed"], jnp.float32)
+        return jnp.einsum("...d,vd->...v", hidden, head.astype(jnp.float32))
+    head = maybe_dequant(params["lm_head"], jnp.float32)
+    return jnp.einsum("...d,dv->...v", hidden, head.astype(jnp.float32))
 
 
 @dataclasses.dataclass

@@ -112,11 +112,18 @@ def shard_model(params: Dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Dict[st
     for name, leaf in params.items():
         spec = specs[name]
         if is_quantized(leaf):
-            parts = list(spec) + [None] * (leaf["q"].ndim - len(spec))
+            # int8 stores "q" [..., in, out]; int4 stores "q4" with the
+            # input axis packed to in/2 — the same spec applies (axis order
+            # is unchanged; halving the input dim preserves divisibility
+            # for the even tp sizes the sharder accepts).
+            qkey = "q4" if "q4" in leaf else "q"
+            parts = list(spec) + [None] * (leaf[qkey].ndim - len(spec))
             scale_parts = list(parts)
             scale_parts[-2] = None
             out[name] = {
-                "q": jax.device_put(leaf["q"], NamedSharding(mesh, P(*parts))),
+                qkey: jax.device_put(
+                    leaf[qkey], NamedSharding(mesh, P(*parts))
+                ),
                 "s": jax.device_put(
                     leaf["s"], NamedSharding(mesh, P(*scale_parts))
                 ),

@@ -130,8 +130,9 @@ commands:
                        --batch-window-ms W --max-batch B (continuous batching
                        of concurrent requests; off by default),
                        --hf model=/ckpt/dir (serve trained weights + that
-                       checkpoint's tokenizer; repeatable), --quantize int8,
-                       --speculative target=draft:k (draft-verify decoding)
+                       checkpoint's tokenizer; repeatable),
+                       --quantize int8|int4 (int8 for speed, int4 for HBM
+                       fit), --speculative target=draft[:k] (draft-verify)
   help                 show this message
 """
 
@@ -175,16 +176,26 @@ def serve_command(args: List[str]) -> None:
         elif arg == "--quantize":
             quantize = next(it, "int8")
         elif arg == "--speculative":
-            # --speculative target=draft:k (repeatable): greedy requests
+            # --speculative target=draft[:k] (repeatable): greedy requests
             # for `target` decode via draft-and-verify with k proposals.
+            # Model names may contain colons (qwen2:1.5b), so only a
+            # trailing :<int> is treated as k.
             spec = next(it, "")
             if "=" not in spec:
                 raise CommandError(
-                    "serve: --speculative expects target=draft:k"
+                    "serve: --speculative expects target=draft[:k]"
                 )
             name, _, rest = spec.partition("=")
-            draft, _, k_str = rest.partition(":")
-            speculative[name] = (draft, int(k_str) if k_str else 4)
+            head, _, tail = rest.rpartition(":")
+            if head and tail.isdigit():
+                draft, k = head, int(tail)
+            else:
+                draft, k = rest, 4
+            if not name or not draft or k < 1:
+                raise CommandError(
+                    "serve: --speculative expects target=draft[:k] with k >= 1"
+                )
+            speculative[name] = (draft, k)
         else:
             raise CommandError(f"serve: unrecognised option {arg!r}")
 

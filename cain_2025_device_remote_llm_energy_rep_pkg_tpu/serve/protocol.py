@@ -91,6 +91,7 @@ def result_to_wire(result: GenerationResult) -> Dict[str, Any]:
         "eval_duration": int(result.decode_s * ns),
         "total_duration": int(result.total_s * ns),
         "x_tokens": list(result.tokens),
+        **({"x_extras": result.extras} if result.extras else {}),
     }
 
 
@@ -110,4 +111,5 @@ def result_from_wire(
         prefill_s=prefill_s,
         decode_s=decode_s,
         total_s=total_s,
+        extras=body.get("x_extras"),
     )

@@ -83,3 +83,19 @@ def test_main_help_and_unknown_command(capsys):
     assert main(["help"]) == 0
     assert "usage" in capsys.readouterr().out
     assert main(["definitely-not-a-command"]) == 2
+
+
+def test_speculative_flag_parsing_handles_colon_names():
+    """Model names contain colons (qwen2:1.5b); only a trailing :<int> is
+    k. Malformed values raise CommandError, not a raw traceback."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    with pytest.raises(CommandError, match="speculative"):
+        serve_command(["--speculative", "no-equals-here"])
+    with pytest.raises(CommandError, match="k >= 1"):
+        serve_command(["--speculative", "t=d:0"])
+    with pytest.raises(CommandError, match="speculative"):
+        serve_command(["--speculative", "=d:2"])

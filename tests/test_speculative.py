@@ -143,3 +143,20 @@ def test_spec_accepted_counts_only_emitted_drafts(registry):
     )
     spec = engine.generate_speculative(req, "target", k=4)
     assert spec.extras["spec_accepted"] <= max(0, spec.generated_tokens - 1)
+
+
+def test_spec_accepted_clipped_at_budget(registry):
+    """Repro from review: self-draft with a budget smaller than a full
+    round must not count overshoot drafts."""
+    engine = JaxEngine(registry=registry, dtype=jnp.float32)
+    for budget in (7, 3, 2):
+        spec = engine.generate_speculative(
+            GenerationRequest(
+                "target", "clip", max_new_tokens=budget, stop_at_eos=False
+            ),
+            "target",
+            k=4,
+        )
+        assert (
+            spec.extras["spec_accepted"] <= max(0, spec.generated_tokens - 1)
+        ), budget
