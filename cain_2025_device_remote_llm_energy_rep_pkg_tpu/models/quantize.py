@@ -15,18 +15,23 @@ the energy comparison, not an extra trick.
 Quantized leaves are dicts:
   int8: ``{"q":  int8[..., in,   out], "s": f32[..., 1, out]}``
   int4: ``{"q4": int8[..., in/2, out], "s": f32[..., 1, out]}`` — two
-        nibbles per byte packed along the input-feature axis (lo = even
-        rows, hi = odd rows), symmetric in [-7, 7].
-        (jnp.int4 storage exists but cannot cross the jit boundary on this
-        TPU stack, so the packing is explicit int8.)
+        nibbles per byte packed along the input-feature axis as *halves*:
+        packed row i carries weight row i (low nibble) and row i + in/2
+        (high nibble), symmetric in [-7, 7]. Halves rather than even/odd
+        interleave so the Pallas kernel's unpack needs no cross-lane
+        shuffle. (jnp.int4 storage exists but cannot cross the jit
+        boundary on this TPU stack, so the packing is explicit int8.)
 
-Performance note (measured on a v5e chip, qwen2:1.5b decode): bf16 200
-tok/s → int8 320 tok/s (XLA fuses the int8→bf16 scale-multiply into the
-matmul, so the HBM read genuinely halves). int4's shift/stack/reshape
-unpack does NOT fuse — XLA materialises the dequantized weights per step
-and decode drops to ~40 tok/s — so int4 currently buys *memory capacity*
-(fitting llama3.1:8b-class models on one chip), not speed; the fix is a
-Pallas matmul kernel that unpacks nibbles in VMEM. Serve int8 for speed.
+Performance note (measured on a v5e chip, qwen2:1.5b decode): bf16 203
+tok/s → int8 325 tok/s (XLA fuses the int8→bf16 scale-multiply into the
+matmul, so the HBM read genuinely halves). int4 through plain XLA does
+NOT fuse the nibble unpack (weights materialise per step, ~40 tok/s);
+decode-shaped int4 matmuls therefore route through the Pallas kernel in
+``ops/pallas_quant.py`` (unpack in VMEM after the packed DMA) → 233
+tok/s. int4 stays VPU-bound on the per-step nibble expansion, so its role
+is *capacity* — llama3.1:8b-class models on one 16 GB chip — while int8
+is the speed mode; native S4 storage would lift this but cannot cross the
+jit boundary on this TPU stack.
 
 Embeddings (and an untied lm_head) quantize at int8 in BOTH modes — the
 gather and the logits matmul read them every step and they are a large
@@ -38,6 +43,8 @@ form.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from typing import Any, Dict, Union
 
 import jax.numpy as jnp
@@ -65,19 +72,34 @@ def quantize_tensor(w: jnp.ndarray) -> QuantLeaf:
     return {"q": q, "s": scale}
 
 
+def quantize_tensor_rowwise(w: jnp.ndarray) -> QuantLeaf:
+    """Symmetric int8 with one scale per *row* (reduce axis -1) — the right
+    scheme for embedding tables [V, D]: each vocab row keeps its own
+    resolution (a single outlier row cannot crush the rest), the gather
+    dequantizes row-local, and for tied embeddings the logits matmul
+    contracts over D so per-V scales are per-output-channel there too."""
+    wf = w.astype(jnp.float32)
+    max_abs = jnp.max(jnp.abs(wf), axis=-1, keepdims=True)
+    scale = jnp.maximum(max_abs, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
 def quantize_tensor_int4(w: jnp.ndarray) -> QuantLeaf:
-    """Symmetric 4-bit quantization in [-7, 7], nibble pairs packed along
-    the input-feature axis (which must be even)."""
+    """Symmetric 4-bit quantization in [-7, 7], the input-feature axis
+    (which must be even) packed as halves: low nibbles = first half's
+    rows, high nibbles = second half's."""
     if w.shape[-2] % 2 != 0:
         raise ValueError(
             f"int4 packing needs an even input-feature dim, got {w.shape}"
         )
+    half = w.shape[-2] // 2
     wf = w.astype(jnp.float32)
     max_abs = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
     scale = jnp.maximum(max_abs, 1e-8) / 7.0
     q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int8)
-    lo = q[..., 0::2, :]
-    hi = q[..., 1::2, :]
+    lo = q[..., :half, :]
+    hi = q[..., half:, :]
     packed = ((lo & 0xF) | (hi << 4)).astype(jnp.int8)
     return {"q4": packed, "s": scale}
 
@@ -97,12 +119,50 @@ def maybe_dequant(
         # arithmetic shifts sign-extend int8, recovering the signed nibbles
         lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
         hi = jnp.right_shift(packed, 4)
-        stacked = jnp.stack([lo, hi], axis=-2)  # [..., in/2, 2, out]
-        shape = packed.shape[:-2] + (2 * packed.shape[-2], packed.shape[-1])
-        q = stacked.reshape(shape)
+        q = jnp.concatenate([lo, hi], axis=-2)  # halves layout
     else:
         q = leaf["q"]
     return (q.astype(jnp.float32) * leaf["s"]).astype(dtype)
+
+
+# The int4 Pallas kernel has no GSPMD partitioning rule: under a
+# tensor-parallel mesh it would force the partitioner to replicate
+# (all-gather) the packed weights every step — the opposite of what
+# sharding them is for. Sharded engines disable the kernel path for their
+# traces via this flag (the XLA dequant path partitions fine).
+_INT4_KERNEL = contextvars.ContextVar("int4_kernel_enabled", default=True)
+
+
+@contextlib.contextmanager
+def int4_kernel_disabled():
+    token = _INT4_KERNEL.set(False)
+    try:
+        yield
+    finally:
+        _INT4_KERNEL.reset(token)
+
+
+def dense_dot(x: jnp.ndarray, leaf: Union[jnp.ndarray, QuantLeaf]) -> jnp.ndarray:
+    """``x [B,S,IN] @ weight [IN,OUT]`` for any leaf form.
+
+    Decode-shaped int4 matmuls (B·S ≤ 8 rows, tile-compatible dims) route
+    through the Pallas kernel so the packed bytes cross HBM packed;
+    everything else uses the einsum with XLA-fused dequant (a no-op for
+    plain tensors)."""
+    if (
+        is_quantized(leaf)
+        and "q4" in leaf
+        and leaf["q4"].ndim == 2
+        and _INT4_KERNEL.get()
+    ):
+        from ..ops.pallas_quant import int4_matmul, int4_matmul_supported
+
+        b, s, d = x.shape
+        in_half, out_dim = leaf["q4"].shape
+        if int4_matmul_supported(b * s, in_half, out_dim):
+            out = int4_matmul(x.reshape(b * s, d), leaf["q4"], leaf["s"])
+            return out.reshape(b, s, out_dim)
+    return jnp.einsum("bsd,dh->bsh", x, maybe_dequant(leaf, x.dtype))
 
 
 def embed_lookup(
@@ -111,7 +171,11 @@ def embed_lookup(
     """Row-gather from a (possibly int8-quantized) embedding table without
     materialising the dequantized table."""
     if is_quantized(leaf):
-        rows = leaf["q"][tokens].astype(jnp.float32) * leaf["s"][0]
+        rows = leaf["q"][tokens].astype(jnp.float32)
+        if leaf["s"].shape[-1] == 1:  # per-row scales [V, 1]
+            rows = rows * leaf["s"][tokens]
+        else:  # per-column scales [1, D]
+            rows = rows * leaf["s"][0]
         return rows.astype(dtype)
     return leaf[tokens]
 
@@ -131,7 +195,11 @@ def quantize_params(
             out[name] = leaf
         elif name in keys:
             out[name] = qt(leaf)
-        elif name in EMBED_KEYS:
+        elif name == "embed":
+            # [V, D] with per-row scales (see quantize_tensor_rowwise)
+            out[name] = quantize_tensor_rowwise(leaf)
+        elif name == "lm_head":
+            # [D, V]: axis -2 reduce is already per-output-channel
             out[name] = quantize_tensor(leaf)
         else:
             out[name] = leaf

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_angles
 from .config import ModelConfig
-from .quantize import embed_lookup, maybe_dequant
+from .quantize import dense_dot, embed_lookup, maybe_dequant
 
 Params = Dict[str, Any]
 
@@ -161,9 +161,9 @@ def _attention_block(
             "per-sequence offsets are only supported for single-token decode"
         )
 
-    q = jnp.einsum("bsd,dh->bsh", x, maybe_dequant(layer["wq"], x.dtype))
-    k = jnp.einsum("bsd,dh->bsh", x, maybe_dequant(layer["wk"], x.dtype))
-    v = jnp.einsum("bsd,dh->bsh", x, maybe_dequant(layer["wv"], x.dtype))
+    q = dense_dot(x, layer["wq"])
+    k = dense_dot(x, layer["wk"])
+    v = dense_dot(x, layer["wv"])
     if cfg.qkv_bias:
         q = q + layer["bq"]
         k = k + layer["bk"]
@@ -216,7 +216,7 @@ def _attention_block(
 
     out = out.astype(x.dtype).reshape(b, s, hq * dh)
     return (
-        jnp.einsum("bsh,hd->bsd", out, maybe_dequant(layer["wo"], x.dtype)),
+        dense_dot(out, layer["wo"]),
         k_cache,
         v_cache,
     )
@@ -293,14 +293,9 @@ def run_blocks(
         if cfg.n_experts:
             mlp_out = _moe_mlp(cfg, h, layer)
         else:
-            gate = _activation(
-                cfg,
-                jnp.einsum("bsd,df->bsf", h, maybe_dequant(layer["w_gate"], h.dtype)),
-            )
-            up = jnp.einsum("bsd,df->bsf", h, maybe_dequant(layer["w_up"], h.dtype))
-            mlp_out = jnp.einsum(
-                "bsf,fd->bsd", gate * up, maybe_dequant(layer["w_down"], h.dtype)
-            )
+            gate = _activation(cfg, dense_dot(h, layer["w_gate"]))
+            up = dense_dot(h, layer["w_up"])
+            mlp_out = dense_dot(gate * up, layer["w_down"])
         return x + mlp_out, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(block, x, (stacked, k_cache, v_cache))

@@ -1,0 +1,154 @@
+"""Pallas TPU int4 dequant-matmul: unpack nibbles in VMEM, not in HBM.
+
+Decode is HBM-bandwidth-bound: at bf16 every generated token streams the
+full weight bytes once. int8 halves that; int4 halves it again — but only
+if the packed bytes cross HBM→VMEM *packed*. XLA cannot fuse the
+shift/concat unpack into a matmul operand read (it materialises the
+dequantized weights per step, measured ~5× slower than bf16), so this
+kernel does the unpack after the DMA: each grid step reads one
+[block_k, block_n] int8 tile (two weights per byte), splits it into the
+low/high nibbles, and issues two MXU dots against the matching halves of
+``x``.
+
+Packing layout (quantize.py ``quantize_tensor_int4``): the input-feature
+axis is split in half — row i of the packed tile carries weight row i in
+its low nibbles and row i + IN/2 in its high nibbles. Halves (not
+even/odd interleave) so the unpack needs no cross-lane shuffle: the two
+nibble planes are themselves contiguous weight tiles, each dotted with a
+contiguous slice of ``x``.
+
+Activations stay bf16/f32 and accumulate in f32 on the MXU; the
+per-output-channel scale applies once at the final k-block (scales
+commute with the k-sum). ``x`` rows pad to 8 (f32 sublane tile) — the
+intended callers are decode-shaped matvecs (M ≤ 8: single-token decode,
+small decode batches, the speculative verify window).
+
+On non-TPU backends the kernel runs in interpret mode so CPU tests
+exercise the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Row-count ceiling for the kernel path: one f32 sublane tile. Larger M
+# (prefill) amortises the XLA dequant path fine.
+MAX_KERNEL_ROWS = 8
+
+
+def _pick_block(n: int, preferred: int) -> int:
+    block = 1
+    while n % (block * 2) == 0 and block * 2 <= preferred:
+        block *= 2
+    return block
+
+
+def _int4_matmul_kernel(
+    x_ref,  # VMEM [8, 2*in_half_pad] activations (halves at 0 and in_half_pad)
+    p_ref,  # VMEM [block_k, block_n] int8 — packed nibble pairs
+    s_ref,  # VMEM [1, block_n] f32 per-output-channel scales
+    o_ref,  # VMEM [8, block_n]
+    acc_ref,  # VMEM scratch [8, block_n] f32
+    *,
+    block_k: int,
+    in_half: int,
+    in_half_pad: int,
+    n_k_blocks: int,
+):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    p = p_ref[...].astype(jnp.int32)
+    # The tail block can extend past the packed array's rows (block_k need
+    # not divide in_half); its out-of-bounds content is unspecified, so
+    # mask rows beyond the valid count. x needs no mask: the wrapper pads
+    # it with zeros to in_half_pad per half, keeping rows aligned.
+    rows_valid = in_half - k * block_k
+    row = jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+    p = jnp.where(row < rows_valid, p, 0)
+    # sign-extend the two 4-bit planes (arithmetic shifts on int32)
+    lo = jnp.right_shift(jnp.left_shift(p, 28), 28).astype(jnp.float32)
+    hi = jnp.right_shift(p, 4).astype(jnp.float32)
+    xl = x_ref[:, pl.ds(k * block_k, block_k)].astype(jnp.float32)
+    xh = x_ref[:, pl.ds(in_half_pad + k * block_k, block_k)].astype(jnp.float32)
+    dims = (((1,), (0,)), ((), ()))
+    acc_ref[...] += jax.lax.dot_general(
+        xl, lo, dims, preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(xh, hi, dims, preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k_blocks - 1)
+    def _finish():
+        o_ref[...] = (acc_ref[...] * s_ref[...]).astype(o_ref.dtype)
+
+
+def int4_matmul_supported(m: int, in_half: int, out_dim: int) -> bool:
+    """Static shape gate: int8 tiles need a 32-sublane, 128-lane block."""
+    return (
+        m <= MAX_KERNEL_ROWS
+        and in_half % 32 == 0
+        and out_dim % 128 == 0
+    )
+
+
+def int4_matmul(
+    x: jnp.ndarray,  # [M, IN], M <= 8
+    packed: jnp.ndarray,  # [IN/2, OUT] int8 (halves-packed)
+    scale: jnp.ndarray,  # [1, OUT] f32
+) -> jnp.ndarray:
+    """``x @ dequant(packed, scale)`` with the nibbles unpacked in VMEM."""
+    m, in_dim = x.shape
+    in_half, out_dim = packed.shape
+    if in_dim != 2 * in_half:
+        raise ValueError(f"x in-dim {in_dim} != 2 * packed rows {in_half}")
+    if not int4_matmul_supported(m, in_half, out_dim):
+        raise ValueError(
+            f"shape (m={m}, in_half={in_half}, out={out_dim}) outside the "
+            "kernel envelope; use the XLA dequant path"
+        )
+    # Blocks need not divide the array dims: the k-tail is masked in-kernel
+    # and the n-tail's out-of-bounds output region is discarded by Pallas,
+    # so both block sizes stay large for awkward dims (d_ff 8960 = 2^8·35
+    # would otherwise force 256-wide blocks and ~630 grid steps).
+    block_k = min(256, _pick_block(in_half, 256) if in_half < 256 else 256)
+    block_n = 512 if out_dim >= 512 else _pick_block(out_dim, 512)
+    n_k_blocks = -(-in_half // block_k)
+    in_half_pad = n_k_blocks * block_k
+    grid = (-(-out_dim // block_n), n_k_blocks)
+
+    # Pack x's two halves at [0, in_half) and [in_half_pad, ·), zero-padded
+    # so the kernel's aligned slices never clamp; pad rows to the f32 tile.
+    x8 = jnp.zeros((MAX_KERNEL_ROWS, 2 * in_half_pad), x.dtype)
+    x8 = x8.at[:m, :in_half].set(x[:, :in_half])
+    x8 = x8.at[:m, in_half_pad : in_half_pad + in_half].set(x[:, in_half:])
+
+    kernel = functools.partial(
+        _int4_matmul_kernel,
+        block_k=block_k,
+        in_half=in_half,
+        in_half_pad=in_half_pad,
+        n_k_blocks=n_k_blocks,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (MAX_KERNEL_ROWS, 2 * in_half_pad), lambda o, k: (0, 0)
+            ),  # whole x resident
+            pl.BlockSpec((block_k, block_n), lambda o, k: (k, o)),
+            pl.BlockSpec((1, block_n), lambda o, k: (0, o)),
+        ],
+        out_specs=pl.BlockSpec((MAX_KERNEL_ROWS, block_n), lambda o, k: (0, o)),
+        out_shape=jax.ShapeDtypeStruct((MAX_KERNEL_ROWS, out_dim), x.dtype),
+        scratch_shapes=[pltpu.VMEM((MAX_KERNEL_ROWS, block_n), jnp.float32)],
+        interpret=jax.default_backend() not in ("tpu", "axon"),
+    )(x8, packed, scale.astype(jnp.float32))
+    return out[:m]

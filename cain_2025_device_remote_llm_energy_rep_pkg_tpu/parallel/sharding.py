@@ -118,8 +118,13 @@ def shard_model(params: Dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Dict[st
             # for the even tp sizes the sharder accepts).
             qkey = "q4" if "q4" in leaf else "q"
             parts = list(spec) + [None] * (leaf[qkey].ndim - len(spec))
-            scale_parts = list(parts)
-            scale_parts[-2] = None
+            # The scale has size 1 on whichever axis was reduced (the input
+            # axis for matmul weights, the feature axis for row-wise
+            # embedding scales) — drop that axis's sharding for it.
+            scale_parts = [
+                p if dim != 1 else None
+                for p, dim in zip(parts, leaf["s"].shape)
+            ]
             out[name] = {
                 qkey: jax.device_put(
                     leaf[qkey], NamedSharding(mesh, P(*parts))

@@ -211,3 +211,99 @@ def test_tp_engine_with_int4():
     )
     req = GenerationRequest("t4", "int4 tensor parallel", max_new_tokens=10)
     assert single.generate(req).tokens == tp.generate(req).tokens
+
+
+def test_int4_pallas_matmul_matches_dequant():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+        quantize_tensor_int4,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_quant import (
+        int4_matmul,
+        int4_matmul_supported,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (512, 256), jnp.float32) * 0.1
+    leaf = quantize_tensor_int4(w)
+    assert int4_matmul_supported(1, 256, 256)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 512), jnp.float32)
+    got = int4_matmul(x, leaf["q4"], leaf["s"])
+    want = x @ maybe_dequant(leaf, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    # multi-row (speculative verify window) and non-square blocks
+    x5 = jax.random.normal(jax.random.PRNGKey(2), (5, 512), jnp.float32)
+    got5 = int4_matmul(x5, leaf["q4"], leaf["s"])
+    want5 = x5 @ maybe_dequant(leaf, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(got5), np.asarray(want5), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_int4_dense_dot_routes_and_matches():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+        dense_dot,
+        quantize_tensor_int4,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(3), (512, 128), jnp.float32) * 0.1
+    leaf = quantize_tensor_int4(w)
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, 1, 512), jnp.float32)
+    kernel_out = dense_dot(x, leaf)  # decode shape → kernel path
+    xla_out = jnp.einsum("bsd,dh->bsh", x, maybe_dequant(leaf, x.dtype))
+    np.testing.assert_allclose(
+        np.asarray(kernel_out), np.asarray(xla_out), rtol=2e-5, atol=2e-5
+    )
+    # prefill shape falls back to the einsum path, same numbers
+    xp = jax.random.normal(jax.random.PRNGKey(5), (1, 32, 512), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(dense_dot(xp, leaf)),
+        np.asarray(jnp.einsum("bsd,dh->bsh", xp, maybe_dequant(leaf, xp.dtype))),
+        rtol=2e-5,
+        atol=2e-5,
+    )
+
+
+def test_embed_rowwise_scales_resist_outlier_rows():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+        embed_lookup,
+        quantize_tensor_rowwise,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.02
+    w = w.at[7].set(w[7] * 500.0)  # one outlier vocab row
+    leaf = quantize_tensor_rowwise(w)
+    assert leaf["s"].shape == (64, 1)  # one scale per vocab row
+    deq = maybe_dequant(leaf, jnp.float32)
+    # non-outlier rows keep their own resolution
+    err = jnp.abs(deq[:7] - w[:7])
+    assert float(jnp.max(err)) <= float(jnp.max(jnp.abs(w[:7]))) / 127 * 1.01
+    # gather path dequantizes row-local
+    rows = embed_lookup(leaf, jnp.asarray([[1, 7]]), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(rows[0, 0]), np.asarray(deq[1]), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(rows[0, 1]), np.asarray(deq[7]), atol=1e-6
+    )
+
+
+def test_int4_kernel_disabled_context_uses_einsum(monkeypatch):
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_quant as pq
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+        dense_dot,
+        int4_kernel_disabled,
+        quantize_tensor_int4,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(3), (512, 128)) * 0.1
+    leaf = quantize_tensor_int4(w)
+    x = jnp.ones((1, 1, 512), jnp.float32)
+
+    def boom(*a, **k):
+        raise AssertionError("kernel must not run under the disabled context")
+
+    monkeypatch.setattr(pq, "int4_matmul", boom)
+    with int4_kernel_disabled():
+        out = dense_dot(x, leaf)  # einsum path despite decode shape
+    assert out.shape == (1, 1, 128)
