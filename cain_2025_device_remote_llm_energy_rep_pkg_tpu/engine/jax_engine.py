@@ -54,6 +54,31 @@ def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
     raise ValueError(f"{n} exceeds the largest bucket {buckets[-1]}")
 
 
+# Prompts longer than the largest bucket prefill in chunks of this size
+# (the flash-prefill kernel supports offset > 0 against a partially-filled
+# cache), so max prompt length is bounded by max_seq_len, not the bucket.
+PREFILL_CHUNK = PROMPT_BUCKETS[-1]
+
+
+def _prompt_chunks(s_real: int) -> "list[tuple[int, int]]":
+    """Cover ``s_real`` prompt tokens as [(start, bucket), ...]: full
+    PREFILL_CHUNK chunks, then one bucket-rounded tail."""
+    chunks = []
+    start = 0
+    while s_real - start > PREFILL_CHUNK:
+        chunks.append((start, PREFILL_CHUNK))
+        start += PREFILL_CHUNK
+    chunks.append((start, _bucket(s_real - start, PROMPT_BUCKETS)))
+    return chunks
+
+
+def _prompt_alloc(s_real: int) -> int:
+    """Cache slots the prompt needs (last chunk's end, bucket-rounded) —
+    equals ``_bucket(s_real, PROMPT_BUCKETS)`` for single-chunk prompts."""
+    start, bucket = _prompt_chunks(s_real)[-1]
+    return start + bucket
+
+
 def _spec_margin(k: int) -> int:
     """Extra KV-cache slots the speculative path needs beyond the usual
     buckets (rounds overshoot by up to k; the draft seats one extra entry),
@@ -237,9 +262,8 @@ class JaxEngine(GenerationBackend):
         measurement window (once per (model, buckets, top_k) shape)."""
         key = (
             request.model,
-            _bucket(
-                len(self._tokenizer_for(request.model).encode(request.prompt)),
-                PROMPT_BUCKETS,
+            _prompt_alloc(
+                len(self._tokenizer_for(request.model).encode(request.prompt))
             ),
             _bucket(request.max_new_tokens, GEN_BUCKETS),
             request.top_k,
@@ -266,9 +290,11 @@ class JaxEngine(GenerationBackend):
         prefill_attention = self.prefill_attention
 
         @jax.jit
-        def prefill(params, tokens, last_index, k_cache, v_cache):
+        def prefill(params, tokens, offset, last_index, k_cache, v_cache):
+            """``offset`` > 0 = a later chunk of a long prompt (earlier
+            chunks' K/V already sit in the cache)."""
             hidden, k_cache, v_cache = forward(
-                params, cfg, tokens, jnp.int32(0), k_cache, v_cache,
+                params, cfg, tokens, offset, k_cache, v_cache,
                 None, prefill_attention,
             )
             last_hidden = jnp.take_along_axis(
@@ -369,23 +395,35 @@ class JaxEngine(GenerationBackend):
 
     # -- generation -----------------------------------------------------------
     def _run_prefill(
-        self, model: str, prompt_ids: "list[int]", s_bucket: int, cache_len: int
+        self, model: str, prompt_ids: "list[int]", cache_len: int
     ):
-        """Pad the prompt to its bucket, build + place the KV cache, and run
-        the compiled prefill. Shared by _start (target) and the speculative
-        path's draft prefill so the mechanics live in one place."""
+        """Build + place the KV cache and prefill the prompt — in one
+        compiled call for prompts within the largest bucket, else in
+        PREFILL_CHUNK-sized chunks at increasing offsets. Shared by _start
+        (target) and the speculative path's draft prefill so the mechanics
+        live in one place. Returns the final chunk's last-position logits."""
         tf = self._models[model]
         tok = self._tokenizer_for(model)
         s_real = len(prompt_ids)
-        tokens = jnp.asarray(
-            [prompt_ids + [tok.pad_id] * (s_bucket - s_real)], dtype=jnp.int32
-        )
         k_cache, v_cache = tf.init_cache(1, cache_len, dtype=self.dtype)
         k_cache, v_cache = self._place_cache(k_cache, v_cache, tf.cfg)
-        prefill = self._prefill_fn(model, s_bucket, cache_len)
-        return prefill(
-            tf.params, tokens, jnp.asarray([s_real - 1]), k_cache, v_cache
-        )
+        logits = None
+        for start, bucket in _prompt_chunks(s_real):
+            ids = prompt_ids[start : start + bucket]
+            real = len(ids)
+            tokens = jnp.asarray(
+                [ids + [tok.pad_id] * (bucket - real)], dtype=jnp.int32
+            )
+            prefill = self._prefill_fn(model, bucket, cache_len)
+            logits, k_cache, v_cache = prefill(
+                tf.params,
+                tokens,
+                jnp.int32(start),
+                jnp.asarray([real - 1]),
+                k_cache,
+                v_cache,
+            )
+        return logits, k_cache, v_cache
 
     def _start(
         self,
@@ -409,7 +447,7 @@ class JaxEngine(GenerationBackend):
         if prompt_ids is None:
             prompt_ids = tok.encode(request.prompt)
         s_real = len(prompt_ids)
-        s_bucket = _bucket(s_real, PROMPT_BUCKETS)
+        s_bucket = _prompt_alloc(s_real)
         g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
         if cache_len is None:
             cache_len = s_bucket + g_bucket
@@ -432,7 +470,7 @@ class JaxEngine(GenerationBackend):
 
         t0 = time.monotonic()
         logits, k_cache, v_cache = self._run_prefill(
-            request.model, prompt_ids, s_bucket, cache_len
+            request.model, prompt_ids, cache_len
         )
         rng = jax.random.PRNGKey(request.seed)
         rng, sub = jax.random.split(rng)
@@ -501,7 +539,7 @@ class JaxEngine(GenerationBackend):
             self.load_model(request.model)
             cfg = self._models[request.model].cfg
             ids = self._tokenizer_for(request.model).encode(request.prompt)
-            s_b = _bucket(len(ids), PROMPT_BUCKETS)
+            s_b = _prompt_alloc(len(ids))
             g_b = _bucket(request.max_new_tokens, GEN_BUCKETS)
             if s_b + g_b + _spec_margin(spec[1]) <= cfg.max_seq_len:
                 return self.generate_speculative(
@@ -573,7 +611,7 @@ class JaxEngine(GenerationBackend):
         if prompt_ids is None:
             prompt_ids = tok.encode(request.prompt)
         s_real = len(prompt_ids)
-        s_bucket = _bucket(s_real, PROMPT_BUCKETS)
+        s_bucket = _prompt_alloc(s_real)
         g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
         cache_len = s_bucket + g_bucket + _spec_margin(k)
 
@@ -582,9 +620,7 @@ class JaxEngine(GenerationBackend):
 
         # draft prefill over the same token ids
         dft = self._models[draft_model]
-        _, dkc, dvc = self._run_prefill(
-            draft_model, prompt_ids, s_bucket, cache_len
-        )
+        _, dkc, dvc = self._run_prefill(draft_model, prompt_ids, cache_len)
 
         key = ("spec", model, draft_model, k, g_bucket)
         if key not in self._decode_cache:
@@ -765,9 +801,7 @@ class JaxEngine(GenerationBackend):
         # generation bucket.
         tok = self._tokenizer_for(model)
         all_prompt_ids = [tok.encode(r.prompt) for r in requests]
-        s_buckets = [
-            _bucket(len(ids), PROMPT_BUCKETS) for ids in all_prompt_ids
-        ]
+        s_buckets = [_prompt_alloc(len(ids)) for ids in all_prompt_ids]
         g_bucket = _bucket(max(r.max_new_tokens for r in requests), GEN_BUCKETS)
         cache_len = max(s_buckets) + g_bucket
         if cache_len > cfg.max_seq_len:

@@ -1,0 +1,149 @@
+"""Reproduce the CAIN 2025 paper's statistical results from its shipped raw
+data — through THIS framework's analysis pipeline.
+
+The reference analyses its 1,260-run table with an R notebook
+(data-analysis/analysis-visualization.ipynb: IQR outlier removal, Wilcoxon
+two-sided, Cliff's delta with the .147/.33/.474 labels, Spearman). This
+script feeds the same CSV (treated purely as input data) to the Python
+pipeline in ``analysis/`` and prints the paper's headline numbers: energy
+per treatment × length, the H1 hypothesis tests, and the H2 correlates.
+
+Usage::
+
+    python examples/reproduce_paper_analysis.py [path/to/run_table.csv]
+
+Default path is the read-only reference checkout used during development.
+"""
+
+from __future__ import annotations
+
+import csv
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.analysis.stats import (
+    cliffs_delta,
+    descriptives,
+    significance_stars,
+    spearman,
+    wilcoxon_rank_sum,
+)
+
+DEFAULT_CSV = Path("/root/reference/data-analysis/run_table.csv")
+LENGTH_NAMES = {100: "short", 500: "medium", 1000: "long"}
+
+
+def load(csv_path: Path):
+    with csv_path.open() as fh:
+        rows = list(csv.DictReader(fh))
+    for row in rows:
+        for key in (
+            "execution_time",
+            "cpu_usage",
+            "gpu_usage",
+            "memory_usage",
+            "energy_usage_J",
+        ):
+            row[key] = float(row[key])
+        row["length"] = int(row["length"])
+    return rows
+
+
+def iqr_filter_per_group(rows):
+    """The notebook filters outliers per (method × length) subset over every
+    metric (cells 11+13): the framework's own ``apply_iqr_filter`` (ANY
+    outlying metric drops the row) applied per subset."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.analysis.pipeline import (
+        apply_iqr_filter,
+    )
+
+    metrics = (
+        "energy_usage_J",
+        "execution_time",
+        "cpu_usage",
+        "gpu_usage",
+        "memory_usage",
+    )
+    kept = []
+    for method in ("on_device", "remote"):
+        for length in (100, 500, 1000):
+            subset = [
+                r
+                for r in rows
+                if r["method"] == method and r["length"] == length
+            ]
+            kept.extend(apply_iqr_filter(subset, metrics))
+    return kept
+
+
+def main(csv_path: Path) -> int:
+    rows = load(csv_path)
+    clean = iqr_filter_per_group(rows)
+    print(f"rows: {len(rows)} raw, {len(clean)} after per-subset IQR filter\n")
+
+    print("Energy (J) by treatment × length  [mean / median / sd / n]")
+    ratios = {}
+    for length in (100, 500, 1000):
+        line = f"  {LENGTH_NAMES[length]:>6}:"
+        means = {}
+        for method in ("on_device", "remote"):
+            vals = [
+                r["energy_usage_J"]
+                for r in clean
+                if r["method"] == method and r["length"] == length
+            ]
+            d = descriptives(vals)
+            means[method] = d.mean
+            line += (
+                f"  {method} {d.mean:7.1f} / {d.median:7.1f} / "
+                f"{d.sd:6.1f} (n={d.n})"
+            )
+        ratios[length] = means["on_device"] / means["remote"]
+        line += f"  → on-device/remote = {ratios[length]:.1f}×"
+        print(line)
+
+    print("\nH1: energy(on-device) vs energy(remote), per length")
+    for length in (100, 500, 1000):
+        a = [
+            r["energy_usage_J"]
+            for r in clean
+            if r["method"] == "on_device" and r["length"] == length
+        ]
+        b = [
+            r["energy_usage_J"]
+            for r in clean
+            if r["method"] == "remote" and r["length"] == length
+        ]
+        stat, p = wilcoxon_rank_sum(a, b)
+        delta, label = cliffs_delta(a, b)
+        print(
+            f"  {LENGTH_NAMES[length]:>6}: Wilcoxon p={p:.3g} "
+            f"{significance_stars(p)}  Cliff's δ={delta:+.3f} ({label})"
+        )
+
+    print("\nH2: Spearman ρ of on-device energy vs correlates")
+    on_device = [r for r in clean if r["method"] == "on_device"]
+    energy = [r["energy_usage_J"] for r in on_device]
+    for metric in ("execution_time", "cpu_usage", "gpu_usage", "memory_usage"):
+        rho, p = spearman(energy, [r[metric] for r in on_device])
+        print(
+            f"  {metric:>16}: ρ={rho:+.3f} p={p:.3g} {significance_stars(p)}"
+        )
+
+    headline = (
+        f"\nHeadline: on-device costs {ratios[100]:.1f}× (short) to "
+        f"{max(ratios[500], ratios[1000]):.1f}× (medium/long) more "
+        "client-side energy than fetching remotely."
+    )
+    print(headline)
+    return 0
+
+
+if __name__ == "__main__":
+    path = Path(sys.argv[1]) if len(sys.argv) > 1 else DEFAULT_CSV
+    if not path.exists():
+        print(f"run table not found: {path}", file=sys.stderr)
+        sys.exit(2)
+    sys.exit(main(path))

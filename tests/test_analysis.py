@@ -158,3 +158,61 @@ def test_render_markdown_handles_empty_subsets():
     report = analyze(_synthetic_rows(n_per_cell=5))
     md = render_markdown(report)
     assert md.startswith("# Experiment analysis")
+
+
+def test_paper_reproduction_matches_survey_baseline():
+    """Feed the reference's shipped 1,260-run table (pure input data)
+    through our stats pipeline: the descriptives must match SURVEY.md §6's
+    recomputed baseline to the decimal, and the hypothesis tests must
+    reproduce the paper's findings."""
+    from pathlib import Path
+
+    ref_csv = Path("/root/reference/data-analysis/run_table.csv")
+    if not ref_csv.exists():
+        pytest.skip("reference data not mounted")
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "repro", Path(__file__).parent.parent / "examples" / "reproduce_paper_analysis.py"
+    )
+    repro = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(repro)
+
+    rows = repro.load(ref_csv)
+    clean = repro.iqr_filter_per_group(rows)
+    assert len(rows) == 1260  # data rows (header consumed by DictReader)
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.analysis.stats import (
+        cliffs_delta,
+        descriptives,
+        wilcoxon_rank_sum,
+    )
+
+    def vals(method, length):
+        return [
+            r["energy_usage_J"]
+            for r in clean
+            if r["method"] == method and r["length"] == length
+        ]
+
+    # SURVEY.md §6 baseline table values (mean/median/sd, n)
+    d = descriptives(vals("on_device", 100))
+    assert (round(d.mean, 1), round(d.median, 1), d.n) == (52.8, 55.0, 167)
+    d = descriptives(vals("on_device", 1000))
+    assert (round(d.mean, 1), round(d.median, 1), d.n) == (432.0, 462.5, 191)
+
+    # H1: strongly significant, large effect, on-device higher
+    for length in (100, 500, 1000):
+        _, p = wilcoxon_rank_sum(vals("on_device", length), vals("remote", length))
+        delta, label = cliffs_delta(vals("on_device", length), vals("remote", length))
+        assert p < 1e-40 and label == "large" and delta > 0.9
+
+    # headline ratio envelope: ~3.5x short, ~9x long
+    ratio_short = descriptives(vals("on_device", 100)).mean / descriptives(
+        vals("remote", 100)
+    ).mean
+    ratio_long = descriptives(vals("on_device", 1000)).mean / descriptives(
+        vals("remote", 1000)
+    ).mean
+    assert 3.0 < ratio_short < 4.0
+    assert 8.0 < ratio_long < 10.0

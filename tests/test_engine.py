@@ -293,3 +293,36 @@ def test_generate_batch_mixed_top_p_rows_stay_bit_identical(engine):
     batch = engine.generate_batch(reqs)
     for s, b in zip(singles, batch):
         assert b.tokens == s.tokens
+
+
+def test_chunked_prefill_matches_single_chunk(monkeypatch):
+    """Force tiny prefill chunks: output must be identical to the
+    single-chunk path (the flash/jnp prefill handles offset > 0)."""
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine as je
+
+    registry = {"tiny-c": get_model_config("qwen2:1.5b").tiny(max_seq_len=512)}
+    prompt = "a moderately long prompt " * 8  # ~200 byte-tokens
+    req = GenerationRequest("tiny-c", prompt, max_new_tokens=12)
+
+    plain = JaxEngine(registry=registry, dtype=jnp.float32).generate(req)
+    monkeypatch.setattr(je, "PREFILL_CHUNK", 64)
+    chunked_engine = JaxEngine(registry=registry, dtype=jnp.float32)
+    chunked = chunked_engine.generate(req)
+    assert chunked.tokens == plain.tokens
+    assert chunked.text == plain.text
+    # several prefill chunk compilations actually happened
+    assert len(chunked_engine._prefill_cache) >= 2
+
+
+def test_long_prompt_beyond_largest_bucket(monkeypatch):
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine as je
+
+    monkeypatch.setattr(je, "PREFILL_CHUNK", 64)
+    registry = {"tiny-c": get_model_config("qwen2:1.5b").tiny(max_seq_len=512)}
+    engine = JaxEngine(registry=registry, dtype=jnp.float32)
+    prompt = "x" * 300  # > PREFILL_CHUNK once chunking is forced
+    r = engine.generate(
+        GenerationRequest("tiny-c", prompt, max_new_tokens=8)
+    )
+    assert r.prompt_tokens == 301  # bos + 300 bytes
+    assert r.generated_tokens >= 1
