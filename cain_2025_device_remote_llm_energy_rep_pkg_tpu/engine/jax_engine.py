@@ -122,9 +122,14 @@ class JaxEngine(GenerationBackend):
         hf_checkpoints: Optional[Dict[str, str]] = None,
         prefill_attention: "str | PrefillAttentionFn | None" = "auto",
         speculative: "Optional[Dict[str, Tuple[str, int]]]" = None,
+        prefix_cache_size: int = 0,  # cached prompt-KV entries per model
     ) -> None:
         if quantize not in (None, "int8", "int4"):
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
+        if prefix_cache_size < 0:
+            raise ValueError(
+                f"prefix_cache_size must be >= 0, got {prefix_cache_size}"
+            )
         self.quantize = quantize
         # target model → (draft model, k): greedy requests for the target
         # route through speculative decoding (engine/speculative.py).
@@ -144,6 +149,11 @@ class JaxEngine(GenerationBackend):
 
             self._weight_cache = WeightCache(weight_cache_dir)
         self._tokenizers: Dict[str, Any] = {}  # per-model, via _tokenizer_for
+        # prompt-prefix KV reuse (off by default: the energy study wants
+        # every run to pay its own prefill); model → OrderedDict LRU of
+        # ids-tuple → (k_cache, v_cache, last-position logits)
+        self.prefix_cache_size = prefix_cache_size
+        self._prefix_cache: Dict[str, Any] = {}
         self._models: Dict[str, Transformer] = {}
         self._prefill_cache: Dict[Tuple, Callable] = {}
         self._decode_cache: Dict[Tuple, Callable] = {}
@@ -237,6 +247,7 @@ class JaxEngine(GenerationBackend):
         self._prefill_cache.clear()
         self._decode_cache.clear()
         self._tokenizers.clear()
+        self._prefix_cache.clear()
         self._warmed.clear()  # a fresh load must re-warm outside the window
 
     def _tokenizer_for(self, model: str):
@@ -401,29 +412,107 @@ class JaxEngine(GenerationBackend):
         compiled call for prompts within the largest bucket, else in
         PREFILL_CHUNK-sized chunks at increasing offsets. Shared by _start
         (target) and the speculative path's draft prefill so the mechanics
-        live in one place. Returns the final chunk's last-position logits."""
+        live in one place. Returns the final chunk's last-position logits.
+
+        With ``prefix_cache_size`` > 0, the KV of previously prefilled
+        prompts is kept (LRU per model) and the longest cached entry that
+        is an exact prefix of this prompt seeds the cache — a device-side
+        copy instead of recompute, the standard system-prompt win."""
         tf = self._models[model]
         tok = self._tokenizer_for(model)
         s_real = len(prompt_ids)
         k_cache, v_cache = tf.init_cache(1, cache_len, dtype=self.dtype)
         k_cache, v_cache = self._place_cache(k_cache, v_cache, tf.cfg)
         logits = None
-        for start, bucket in _prompt_chunks(s_real):
-            ids = prompt_ids[start : start + bucket]
-            real = len(ids)
-            tokens = jnp.asarray(
-                [ids + [tok.pad_id] * (bucket - real)], dtype=jnp.int32
-            )
-            prefill = self._prefill_fn(model, bucket, cache_len)
-            logits, k_cache, v_cache = prefill(
-                tf.params,
-                tokens,
-                jnp.int32(start),
-                jnp.asarray([real - 1]),
-                k_cache,
-                v_cache,
-            )
+
+        covered = 0
+        hit = self._find_prefix(model, prompt_ids)
+        if hit is not None:
+            hit_ids, hit_k, hit_v, hit_logits = hit
+            p = len(hit_ids)
+            # The remaining tokens re-chunk from `covered`, and the tail
+            # chunk's bucket rounding must not write past cache_len (the
+            # underlying dynamic_update_slice would CLAMP the start and
+            # silently overwrite valid prefix K/V). Use less of the hit if
+            # needed so the chunk end always fits.
+            while p > 0 and p < s_real and (
+                p + _prompt_alloc(s_real - p) > cache_len
+            ):
+                p -= 1
+            if p > 0:
+                # copy the cached prefix region into the fresh cache
+                # (cache_len may differ between requests; positions are
+                # what matter)
+                k_cache = jax.lax.dynamic_update_slice(
+                    k_cache, hit_k[:, :, :, :p, :], (0, 0, 0, 0, 0)
+                )
+                v_cache = jax.lax.dynamic_update_slice(
+                    v_cache, hit_v[:, :, :, :p, :], (0, 0, 0, 0, 0)
+                )
+                covered = p
+                logits = hit_logits  # only used when the hit covers everything
+
+        if covered < s_real:
+            remaining = prompt_ids[covered:]
+            for start, bucket in _prompt_chunks(len(remaining)):
+                ids = remaining[start : start + bucket]
+                real = len(ids)
+                tokens = jnp.asarray(
+                    [ids + [tok.pad_id] * (bucket - real)], dtype=jnp.int32
+                )
+                prefill = self._prefill_fn(model, bucket, cache_len)
+                logits, k_cache, v_cache = prefill(
+                    tf.params,
+                    tokens,
+                    jnp.int32(covered + start),
+                    jnp.asarray([real - 1]),
+                    k_cache,
+                    v_cache,
+                )
+
+        self._store_prefix(model, prompt_ids, k_cache, v_cache, logits, s_real)
         return logits, k_cache, v_cache
+
+    # -- prefix cache ---------------------------------------------------------
+    def _find_prefix(self, model: str, prompt_ids: "list[int]"):
+        """Longest cached (ids, k, v, logits) whose ids are a prefix of
+        ``prompt_ids``; refreshes its LRU position."""
+        if not self.prefix_cache_size:
+            return None
+        entries = self._prefix_cache.get(model)
+        if not entries:
+            return None
+        best_key = None
+        n = len(prompt_ids)
+        for key in entries:
+            if len(key) <= n and list(key) == prompt_ids[: len(key)]:
+                if best_key is None or len(key) > len(best_key):
+                    best_key = key
+        if best_key is None:
+            return None
+        entries.move_to_end(best_key)
+        k, v, logits = entries[best_key]
+        return list(best_key), k, v, logits
+
+    def _store_prefix(self, model, prompt_ids, k_cache, v_cache, logits, s_real):
+        if not self.prefix_cache_size:
+            return
+        from collections import OrderedDict
+
+        entries = self._prefix_cache.setdefault(model, OrderedDict())
+        key = tuple(prompt_ids)
+        # Store only the prompt's own positions — the generation region and
+        # bucket padding would pin HBM a hit never reads. JAX arrays are
+        # immutable, so keeping references is safe (decode produces new
+        # arrays and never mutates these).
+        entries[key] = (
+            k_cache[:, :, :, :s_real],
+            v_cache[:, :, :, :s_real],
+            logits,
+        )
+        entries.move_to_end(key)
+        while len(entries) > self.prefix_cache_size:
+            entries.popitem(last=False)
 
     def _start(
         self,

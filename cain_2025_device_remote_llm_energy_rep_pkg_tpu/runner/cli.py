@@ -132,7 +132,8 @@ commands:
                        --hf model=/ckpt/dir (serve trained weights + that
                        checkpoint's tokenizer; repeatable),
                        --quantize int8|int4 (int8 for speed, int4 for HBM
-                       fit), --speculative target=draft[:k] (draft-verify)
+                       fit), --speculative target=draft[:k] (draft-verify),
+                       --prefix-cache N (reuse prompt-prefix KV, LRU of N)
   help                 show this message
 """
 
@@ -150,6 +151,7 @@ def serve_command(args: List[str]) -> None:
     hf_checkpoints = {}
     quantize = None
     speculative = {}
+    prefix_cache = 0
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -196,6 +198,8 @@ def serve_command(args: List[str]) -> None:
                     "serve: --speculative expects target=draft[:k] with k >= 1"
                 )
             speculative[name] = (draft, k)
+        elif arg == "--prefix-cache":
+            prefix_cache = int(next(it, "4"))
         else:
             raise CommandError(f"serve: unrecognised option {arg!r}")
 
@@ -216,6 +220,7 @@ def serve_command(args: List[str]) -> None:
             hf_checkpoints=hf_checkpoints or None,
             quantize=quantize,
             speculative=speculative or None,
+            prefix_cache_size=prefix_cache,
         )
     elif backend_kind == "jax":
         from ..engine.jax_engine import JaxEngine
@@ -225,6 +230,7 @@ def serve_command(args: List[str]) -> None:
             hf_checkpoints=hf_checkpoints or None,
             quantize=quantize,
             speculative=speculative or None,
+            prefix_cache_size=prefix_cache,
         )
     else:
         raise CommandError(f"serve: unknown backend {backend_kind!r}")

@@ -326,3 +326,65 @@ def test_long_prompt_beyond_largest_bucket(monkeypatch):
     )
     assert r.prompt_tokens == 301  # bos + 300 bytes
     assert r.generated_tokens >= 1
+
+
+def test_prefix_cache_exact_and_partial_hits():
+    registry = {"tiny-p": get_model_config("qwen2:1.5b").tiny()}
+    cold = JaxEngine(registry=registry, dtype=jnp.float32)
+    warm = JaxEngine(registry=registry, dtype=jnp.float32, prefix_cache_size=4)
+
+    sys_prompt = "You are a helpful assistant. "
+    r_a = GenerationRequest("tiny-p", sys_prompt + "Question A?", max_new_tokens=10)
+    r_b = GenerationRequest("tiny-p", sys_prompt + "Question A? And B too?", max_new_tokens=10)
+
+    # identical outputs with and without the cache, for exact re-ask and
+    # prefix-extension
+    assert warm.generate(r_a).tokens == cold.generate(r_a).tokens
+    assert warm.generate(r_a).tokens == cold.generate(r_a).tokens  # exact hit
+    assert warm.generate(r_b).tokens == cold.generate(r_b).tokens  # partial hit
+    assert len(warm._prefix_cache["tiny-p"]) >= 2
+
+
+def test_prefix_cache_lru_eviction():
+    registry = {"tiny-p": get_model_config("qwen2:1.5b").tiny()}
+    engine = JaxEngine(registry=registry, dtype=jnp.float32, prefix_cache_size=2)
+    for i in range(4):
+        engine.generate(
+            GenerationRequest("tiny-p", f"prompt number {i}", max_new_tokens=4)
+        )
+    assert len(engine._prefix_cache["tiny-p"]) == 2
+
+
+def test_prefix_cache_disabled_by_default():
+    registry = {"tiny-p": get_model_config("qwen2:1.5b").tiny()}
+    engine = JaxEngine(registry=registry, dtype=jnp.float32)
+    engine.generate(GenerationRequest("tiny-p", "hello", max_new_tokens=4))
+    assert engine._prefix_cache == {}
+
+
+def test_prefix_cache_partial_hit_near_cache_boundary():
+    """Review repro: a cached 60-token prompt extended by 2 tokens would
+    re-chunk past cache_len (tail bucket rounding) and the clamped write
+    would corrupt the prefix — the hit must shrink instead."""
+    registry = {"tiny-p": get_model_config("qwen2:1.5b").tiny()}
+    cold = JaxEngine(registry=registry, dtype=jnp.float32)
+    warm = JaxEngine(registry=registry, dtype=jnp.float32, prefix_cache_size=4)
+    p60 = "x" * 59  # +BOS = 60 tokens
+    p62 = "x" * 61  # +BOS = 62 tokens, shares the 60-token prefix
+    r60 = GenerationRequest("tiny-p", p60, max_new_tokens=16)
+    r62 = GenerationRequest("tiny-p", p62, max_new_tokens=16)
+    warm.generate(r60)  # seeds the cache with the 60-token prefix
+    assert warm.generate(r62).tokens == cold.generate(r62).tokens
+
+
+def test_prefix_cache_rejects_negative_size():
+    with pytest.raises(ValueError, match="prefix_cache_size"):
+        JaxEngine(prefix_cache_size=-1)
+
+
+def test_prefix_cache_entries_store_only_prompt_region():
+    registry = {"tiny-p": get_model_config("qwen2:1.5b").tiny()}
+    engine = JaxEngine(registry=registry, dtype=jnp.float32, prefix_cache_size=2)
+    engine.generate(GenerationRequest("tiny-p", "abcde", max_new_tokens=64))
+    (k, v, _), = engine._prefix_cache["tiny-p"].values()
+    assert k.shape[3] == 6  # bos + 5 bytes, not prompt_bucket + gen_bucket
