@@ -196,17 +196,54 @@ class JaxEngine(GenerationBackend):
         ckpt_dir = self.hf_checkpoints.get(model)
         if ckpt_dir is not None:
 
-            def make_params():
+            def make_full():
                 from ..models.convert import load_hf_pretrained
 
                 return load_hf_pretrained(ckpt_dir, cfg, dtype=self.dtype)
 
         else:
 
-            def make_params():
+            def make_full():
                 from ..models.transformer import init_params
 
                 return init_params(cfg, jax.random.PRNGKey(self.seed), self.dtype)
+
+        if self.quantize is None:
+            make_params = make_full
+        elif ckpt_dir is None:
+
+            def make_params():
+                # Stream init+quantize per tensor on-device: the chip never
+                # holds the full-precision model (llama3.1:8b bf16 alone
+                # fills a 16 GB chip — the whole point of quantizing is
+                # that it doesn't fit otherwise). block_until_ready keeps
+                # async dispatch from stacking several bf16 temporaries.
+                from ..models.quantize import quantize_leaf
+                from ..models.transformer import init_params
+
+                def post(name, leaf):
+                    q = quantize_leaf(name, leaf, self.quantize)
+                    jax.block_until_ready(q)
+                    return q
+
+                return init_params(
+                    cfg, jax.random.PRNGKey(self.seed), self.dtype, post=post
+                )
+
+        else:
+
+            def make_params():
+                # HF checkpoints materialise fully during conversion; route
+                # through the CPU backend and ship only the quantized
+                # tensors to the accelerator.
+                from ..models.quantize import quantize_params
+
+                cpu = jax.devices("cpu")[0]
+                with jax.default_device(cpu):
+                    p = quantize_params(make_full(), mode=self.quantize)
+                # device_put with no target is an identity for arrays
+                # already committed to a device — name the accelerator.
+                return jax.device_put(p, jax.devices()[0])
 
         if self._weight_cache is not None:
             import hashlib
@@ -224,7 +261,8 @@ class JaxEngine(GenerationBackend):
                 else "init"
             )
             fingerprint = hashlib.sha256(
-                f"{cfg!r}|{jnp.dtype(self.dtype).name}|{source}".encode()
+                f"{cfg!r}|{jnp.dtype(self.dtype).name}|{source}"
+                f"|quant:{self.quantize}".encode()
             ).hexdigest()[:12]
             params = self._weight_cache.get_or_init(
                 model, self.seed, make_params, fingerprint=fingerprint
@@ -232,12 +270,6 @@ class JaxEngine(GenerationBackend):
             tf = Transformer(cfg=cfg, params=params)
         else:
             tf = Transformer(cfg=cfg, params=make_params())
-        if self.quantize is not None:
-            from ..models.quantize import quantize_params
-
-            tf = Transformer(
-                cfg=cfg, params=quantize_params(tf.params, mode=self.quantize)
-            )
         jax.block_until_ready(tf.params)
         self._load_s = time.monotonic() - t0
         self._models[model] = tf

@@ -73,6 +73,7 @@ class LlmEnergyConfig(ExperimentConfig):
         shuffle: bool = True,
         seed: int = 0,
         n_chips_by_location: Optional[Dict[str, int]] = None,
+        quantize: Optional[str] = "int8",
     ) -> None:
         self.models = models or MODELS
         self.locations = locations or LOCATIONS
@@ -80,6 +81,11 @@ class LlmEnergyConfig(ExperimentConfig):
         self.repetitions = repetitions
         self.shuffle = shuffle
         self.seed = seed
+        # int8 by default: the reference's baseline models are Ollama 4-bit
+        # GGUF quants, so quantized serving is the matching configuration —
+        # and llama3.1:8b at bf16 (~16 GB) cannot share a 16 GB chip with
+        # its KV cache at all. None = full bf16 (smaller models only).
+        self.quantize = quantize
         if results_output_path is not None:
             self.results_output_path = Path(results_output_path)
         if cooldown_ms is not None:
@@ -137,7 +143,11 @@ class LlmEnergyConfig(ExperimentConfig):
 
             import jax
 
-            self._backends = {"on_device": JaxEngine(decode_attention="auto")}
+            self._backends = {
+                "on_device": JaxEngine(
+                    decode_attention="auto", quantize=self.quantize
+                )
+            }
             if "remote" in self.locations:
                 from ..serve.client import backend_from_env
 
@@ -165,7 +175,9 @@ class LlmEnergyConfig(ExperimentConfig):
                 elif len(jax.devices()) > 1:
                     mesh = build_mesh(MeshSpec.tp_only(self._remote_tp))
                     self._backends["remote"] = TensorParallelEngine(
-                        mesh=mesh, decode_attention="auto"
+                        mesh=mesh,
+                        decode_attention="auto",
+                        quantize=self.quantize,
                     )
                 else:
                     # single-chip dev box: the remote treatment still runs,

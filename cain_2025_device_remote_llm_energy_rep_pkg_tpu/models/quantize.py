@@ -29,9 +29,15 @@ NOT fuse the nibble unpack (weights materialise per step, ~40 tok/s);
 decode-shaped int4 matmuls therefore route through the Pallas kernel in
 ``ops/pallas_quant.py`` (unpack in VMEM after the packed DMA) → 233
 tok/s. int4 stays VPU-bound on the per-step nibble expansion, so its role
-is *capacity* — llama3.1:8b-class models on one 16 GB chip — while int8
-is the speed mode; native S4 storage would lift this but cannot cross the
-jit boundary on this TPU stack.
+is *capacity* — llama3.1:8b-class models on one 16 GB chip (int8 ~8.6 GB,
+int4 ~4.8 GB incl. int8 embeddings) — while int8 is the speed mode;
+native S4 storage would lift this but cannot cross the jit boundary on
+this TPU stack. Note the development relay only executes programs with a
+~4.5 GB live set (measured by layer-count bisection; raw allocations
+overcommit), so 7B/8B single-chip serving is validated there up to
+16-layer slices — full-size fits real 16 GB chips by the same
+arithmetic, and tensor parallelism (parallel/tp.py) is the designed path
+regardless.
 
 Embeddings (and an untied lm_head) quantize at int8 in BOTH modes — the
 gather and the logits matmul read them every step and they are a large
@@ -47,6 +53,7 @@ import contextlib
 import contextvars
 from typing import Any, Dict, Union
 
+import jax
 import jax.numpy as jnp
 
 QuantLeaf = Dict[str, jnp.ndarray]
@@ -58,13 +65,16 @@ DEFAULT_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 EMBED_KEYS = ("embed", "lm_head")
 
 
+@jax.jit
 def quantize_tensor(w: jnp.ndarray) -> QuantLeaf:
     """Symmetric int8 quantization, scales per output channel.
 
     The input-feature axis is ``-2`` for both stacked-layer ``[L, in, out]``
     and flat ``[in, out]`` weights, so reducing over exactly that axis keeps
     per-(layer, out-channel) scales — the leading L axis survives, which the
-    layer ``lax.scan`` requires of every stacked leaf."""
+    layer ``lax.scan`` requires of every stacked leaf. Jitted so the f32
+    upcast fuses instead of materialising a full-precision copy — the
+    streaming big-model load path depends on that."""
     wf = w.astype(jnp.float32)
     max_abs = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
     scale = jnp.maximum(max_abs, 1e-8) / 127.0
@@ -72,6 +82,7 @@ def quantize_tensor(w: jnp.ndarray) -> QuantLeaf:
     return {"q": q, "s": scale}
 
 
+@jax.jit
 def quantize_tensor_rowwise(w: jnp.ndarray) -> QuantLeaf:
     """Symmetric int8 with one scale per *row* (reduce axis -1) — the right
     scheme for embedding tables [V, D]: each vocab row keeps its own
@@ -85,6 +96,7 @@ def quantize_tensor_rowwise(w: jnp.ndarray) -> QuantLeaf:
     return {"q": q, "s": scale}
 
 
+@jax.jit
 def quantize_tensor_int4(w: jnp.ndarray) -> QuantLeaf:
     """Symmetric 4-bit quantization in [-7, 7], the input-feature axis
     (which must be even) packed as halves: low nibbles = first half's
@@ -180,30 +192,36 @@ def embed_lookup(
     return leaf[tokens]
 
 
+def quantize_leaf(
+    name: str, leaf: Any, mode: str = "int8", keys=DEFAULT_QUANT_KEYS
+) -> Any:
+    """The per-leaf quantization rule: named matmul weights at ``mode``,
+    embeddings at int8 (per-row scales), untied lm_head at int8
+    (per-output-channel), everything else passes through."""
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    if is_quantized(leaf):
+        return leaf
+    if name in keys:
+        qt = quantize_tensor if mode == "int8" else quantize_tensor_int4
+        return qt(leaf)
+    if name == "embed":
+        # [V, D] with per-row scales (see quantize_tensor_rowwise)
+        return quantize_tensor_rowwise(leaf)
+    if name == "lm_head":
+        # [D, V]: axis -2 reduce is already per-output-channel
+        return quantize_tensor(leaf)
+    return leaf
+
+
 def quantize_params(
     params: Dict[str, Any], keys=DEFAULT_QUANT_KEYS, mode: str = "int8"
 ) -> Dict[str, Any]:
-    """Quantize the named matmul weights (+ embeddings at int8); everything
-    else passes through. ``mode`` is "int8" or "int4" (matmul weights only
-    — embeddings stay int8 in both)."""
-    if mode not in ("int8", "int4"):
-        raise ValueError(f"unknown quantization mode {mode!r}")
-    qt = quantize_tensor if mode == "int8" else quantize_tensor_int4
-    out: Dict[str, Any] = {}
-    for name, leaf in params.items():
-        if is_quantized(leaf):
-            out[name] = leaf
-        elif name in keys:
-            out[name] = qt(leaf)
-        elif name == "embed":
-            # [V, D] with per-row scales (see quantize_tensor_rowwise)
-            out[name] = quantize_tensor_rowwise(leaf)
-        elif name == "lm_head":
-            # [D, V]: axis -2 reduce is already per-output-channel
-            out[name] = quantize_tensor(leaf)
-        else:
-            out[name] = leaf
-    return out
+    """Quantize a whole parameter dict via :func:`quantize_leaf`."""
+    return {
+        name: quantize_leaf(name, leaf, mode, keys)
+        for name, leaf in params.items()
+    }
 
 
 def params_nbytes(params: Dict[str, Any]) -> int:

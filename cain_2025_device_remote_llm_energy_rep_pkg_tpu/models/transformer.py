@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_angles
 from .config import ModelConfig
-from .quantize import dense_dot, embed_lookup, maybe_dequant
+from .quantize import dense_dot, embed_lookup, is_quantized, maybe_dequant
 
 Params = Dict[str, Any]
 
@@ -51,12 +51,22 @@ PrefillAttentionFn = Callable[
 
 
 def init_params(
-    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+    cfg: ModelConfig,
+    key: jax.Array,
+    dtype: jnp.dtype = jnp.bfloat16,
+    post: Optional[Callable[[str, jnp.ndarray], Any]] = None,
 ) -> Params:
-    """Random-init weights directly on the default device (HBM)."""
+    """Random-init weights directly on the default device (HBM).
+
+    ``post(name, leaf)`` (default identity) is applied to each leaf as it
+    is created — the quantized engine streams init+quantize per tensor so
+    the device never holds the full-precision model (llama3.1:8b bf16
+    alone fills a 16 GB chip)."""
     keys = jax.random.split(key, 12)
     d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+    if post is None:
+        post = lambda _name, leaf: leaf  # noqa: E731
 
     def mat(k, shape, fan_in):
         return (
@@ -66,35 +76,39 @@ def init_params(
     # MoE MLPs carry a leading expert axis [L, E, D, F]; dense is [L, D, F].
     e = (cfg.n_experts,) if cfg.n_experts else ()
 
-    params: Params = {
-        "embed": (
-            jax.random.normal(keys[0], (cfg.vocab_size, d), dtype=jnp.float32) * 0.02
+    def ones_or_zeros(shape):
+        return (
+            jnp.ones(shape, dtype=dtype)
+            if not cfg.gemma_norm
+            else jnp.zeros(shape, dtype=dtype)
+        )
+
+    params: Params = {}
+    params["embed"] = post(
+        "embed",
+        (
+            jax.random.normal(keys[0], (cfg.vocab_size, d), dtype=jnp.float32)
+            * 0.02
         ).astype(dtype),
-        "attn_norm": jnp.ones((l, d), dtype=dtype)
-        if not cfg.gemma_norm
-        else jnp.zeros((l, d), dtype=dtype),
-        "wq": mat(keys[1], (l, d, hq * dh), d),
-        "wk": mat(keys[2], (l, d, hkv * dh), d),
-        "wv": mat(keys[3], (l, d, hkv * dh), d),
-        "wo": mat(keys[4], (l, hq * dh, d), hq * dh),
-        "mlp_norm": jnp.ones((l, d), dtype=dtype)
-        if not cfg.gemma_norm
-        else jnp.zeros((l, d), dtype=dtype),
-        "w_gate": mat(keys[5], (l, *e, d, f), d),
-        "w_up": mat(keys[6], (l, *e, d, f), d),
-        "w_down": mat(keys[7], (l, *e, f, d), f),
-        "final_norm": jnp.ones((d,), dtype=dtype)
-        if not cfg.gemma_norm
-        else jnp.zeros((d,), dtype=dtype),
-    }
+    )
+    params["attn_norm"] = post("attn_norm", ones_or_zeros((l, d)))
+    params["wq"] = post("wq", mat(keys[1], (l, d, hq * dh), d))
+    params["wk"] = post("wk", mat(keys[2], (l, d, hkv * dh), d))
+    params["wv"] = post("wv", mat(keys[3], (l, d, hkv * dh), d))
+    params["wo"] = post("wo", mat(keys[4], (l, hq * dh, d), hq * dh))
+    params["mlp_norm"] = post("mlp_norm", ones_or_zeros((l, d)))
+    params["w_gate"] = post("w_gate", mat(keys[5], (l, *e, d, f), d))
+    params["w_up"] = post("w_up", mat(keys[6], (l, *e, d, f), d))
+    params["w_down"] = post("w_down", mat(keys[7], (l, *e, f, d), f))
+    params["final_norm"] = post("final_norm", ones_or_zeros((d,)))
     if cfg.qkv_bias:
-        params["bq"] = jnp.zeros((l, hq * dh), dtype=dtype)
-        params["bk"] = jnp.zeros((l, hkv * dh), dtype=dtype)
-        params["bv"] = jnp.zeros((l, hkv * dh), dtype=dtype)
+        params["bq"] = post("bq", jnp.zeros((l, hq * dh), dtype=dtype))
+        params["bk"] = post("bk", jnp.zeros((l, hkv * dh), dtype=dtype))
+        params["bv"] = post("bv", jnp.zeros((l, hkv * dh), dtype=dtype))
     if cfg.n_experts:
-        params["router"] = mat(keys[9], (l, d, cfg.n_experts), d)
+        params["router"] = post("router", mat(keys[9], (l, d, cfg.n_experts), d))
     if not cfg.tie_embeddings:
-        params["lm_head"] = mat(keys[8], (d, cfg.vocab_size), d)
+        params["lm_head"] = post("lm_head", mat(keys[8], (d, cfg.vocab_size), d))
     return params
 
 
@@ -303,15 +317,25 @@ def run_blocks(
 
 
 def logits_for(params: Params, cfg: ModelConfig, hidden: jnp.ndarray) -> jnp.ndarray:
-    """Project hidden states [..., D] to vocab logits in float32."""
-    hidden = hidden.astype(jnp.float32)
-    if cfg.tie_embeddings:
-        # embed is [V, D]; contract over D (avoids transposing, which a
-        # quantized dict leaf couldn't express anyway)
-        head = maybe_dequant(params["embed"], jnp.float32)
-        return jnp.einsum("...d,vd->...v", hidden, head.astype(jnp.float32))
-    head = maybe_dequant(params["lm_head"], jnp.float32)
-    return jnp.einsum("...d,dv->...v", hidden, head.astype(jnp.float32))
+    """Project hidden states [..., D] to vocab logits in float32.
+
+    Quantized heads dequantize to bf16 operands with f32 MXU accumulation:
+    an f32 dequant of a 150k-vocab table is a multi-GB temporary that can
+    decide whether an 8B model fits the chip at all; full-precision heads
+    keep the all-f32 path (the HF parity tests pin its numerics)."""
+    leaf = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+    pattern = "...d,vd->...v" if cfg.tie_embeddings else "...d,dv->...v"
+    if is_quantized(leaf):
+        head = maybe_dequant(leaf, jnp.bfloat16)
+        return jnp.einsum(
+            pattern,
+            hidden.astype(jnp.bfloat16),
+            head,
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.einsum(
+        pattern, hidden.astype(jnp.float32), leaf.astype(jnp.float32)
+    )
 
 
 @dataclasses.dataclass
