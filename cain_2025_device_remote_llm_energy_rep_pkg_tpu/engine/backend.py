@@ -87,6 +87,11 @@ class GenerationBackend:
         """Make ``model`` servable (weights into HBM for the JAX engine)."""
         raise NotImplementedError
 
+    def loaded_models(self) -> List[str]:
+        """Models currently resident in memory (the ``/api/ps`` surface).
+        Default: unknown/empty."""
+        return []
+
     def generate(self, request: GenerationRequest) -> GenerationResult:
         raise NotImplementedError
 

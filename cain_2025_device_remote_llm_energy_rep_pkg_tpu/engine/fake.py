@@ -24,6 +24,9 @@ class FakeBackend(GenerationBackend):
     def load_model(self, model: str) -> None:
         self.loaded[model] = True
 
+    def loaded_models(self):
+        return sorted(self.loaded)
+
     def generate(self, request: GenerationRequest) -> GenerationResult:
         if request.model not in self.loaded:
             self.load_model(request.model)

@@ -213,21 +213,29 @@ class JaxEngine(GenerationBackend):
         elif ckpt_dir is None:
 
             def make_params():
-                # Stream init+quantize per tensor on-device: the chip never
-                # holds the full-precision model (llama3.1:8b bf16 alone
-                # fills a 16 GB chip — the whole point of quantizing is
-                # that it doesn't fit otherwise). block_until_ready keeps
-                # async dispatch from stacking several bf16 temporaries.
+                # One jitted program that inits AND quantizes per leaf: XLA
+                # buffer liveness frees each full-precision leaf (and the
+                # rng's f32 intermediates, which fuse away) before the next
+                # allocates, so the chip never holds the full-precision
+                # model — llama3.1:8b bf16 alone fills a 16 GB chip; the
+                # whole point of quantizing is that it doesn't fit
+                # otherwise.
                 from ..models.quantize import quantize_leaf
                 from ..models.transformer import init_params
 
-                def post(name, leaf):
-                    q = quantize_leaf(name, leaf, self.quantize)
-                    jax.block_until_ready(q)
-                    return q
+                @jax.jit
+                def build(key):
+                    return init_params(
+                        cfg,
+                        key,
+                        self.dtype,
+                        post=lambda name, leaf: quantize_leaf(
+                            name, leaf, self.quantize
+                        ),
+                    )
 
-                return init_params(
-                    cfg, jax.random.PRNGKey(self.seed), self.dtype, post=post
+                return jax.block_until_ready(
+                    build(jax.random.PRNGKey(self.seed))
                 )
 
         else:
@@ -281,6 +289,11 @@ class JaxEngine(GenerationBackend):
         self._tokenizers.clear()
         self._prefix_cache.clear()
         self._warmed.clear()  # a fresh load must re-warm outside the window
+
+    def loaded_models(self) -> "list[str]":
+        # dict.copy() is C-atomic under the GIL: a safe snapshot even while
+        # another request thread is loading a model.
+        return sorted(self._models.copy())
 
     def _tokenizer_for(self, model: str):
         """The model's own tokenizer when served from an HF checkpoint

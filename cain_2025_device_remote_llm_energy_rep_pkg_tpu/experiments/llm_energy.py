@@ -93,17 +93,21 @@ class LlmEnergyConfig(ExperimentConfig):
         self._backends = backends  # None → built lazily in before_experiment
         self._remote_url = remote_url
         self._remote_tp = remote_tp
-        chips = n_chips_by_location or {"on_device": 1, "remote": 8}
-        self._energy_profilers = {
-            loc: TpuEnergyModelProfiler(n_chips=chips.get(loc, 1))
-            for loc in self.locations
-        }
+        # Plain data, deliberately NOT read back from the profiler object:
+        # the shared profiler's n_chips is mutated per run in before_run, and
+        # reading the target count from any aliased profiler instance would
+        # let one remote run permanently poison every later on_device run.
+        self._n_chips_by_location = dict(
+            n_chips_by_location or {"on_device": 1, "remote": 8}
+        )
         counter = TpuPowerCounterProfiler()
         from ..profilers.native_host import NativeHostProfiler
 
         self.profilers = [
             # one model-energy profiler; per-run chip count set in before_run
-            self._energy_profilers[self.locations[0]],
+            TpuEnergyModelProfiler(
+                n_chips=self._n_chips_by_location.get(self.locations[0], 1)
+            ),
             # C++ kHz sampler for host energy/cpu/memory; it transparently
             # falls back to the psutil+RAPL Python pair (same columns) when
             # the native library can't build or load at runtime
@@ -186,8 +190,9 @@ class LlmEnergyConfig(ExperimentConfig):
 
     def before_run(self, context: RunContext) -> None:
         location = context.factor("location")
-        model_profiler = self._energy_profilers[location]
-        self.profilers[self._model_profiler_index()].n_chips = model_profiler.n_chips
+        self.profilers[self._model_profiler_index()].n_chips = (
+            self._n_chips_by_location.get(location, 1)
+        )
 
     def _model_profiler_index(self) -> int:
         for i, p in enumerate(self.profilers):

@@ -24,8 +24,12 @@ UNLIMITED_NUM_PREDICT_CAP = 512
 
 GENERATE_PATH = "/api/generate"
 TAGS_PATH = "/api/tags"
+PS_PATH = "/api/ps"  # loaded models (Ollama parity)
+VERSION_PATH = "/api/version"
 LOAD_PATH = "/api/load"  # extension: explicit weight-load outside the window
 HEALTH_PATH = "/healthz"
+
+SERVER_VERSION = "0.1.0"
 
 
 def request_to_wire(

@@ -112,6 +112,22 @@ class GenerationServer:
                         200,
                         {"models": [{"name": m} for m in server.models]},
                     )
+                elif self.path == protocol.PS_PATH:
+                    # Ollama parity: the models currently resident in
+                    # accelerator memory (vs /api/tags: the servable set).
+                    self._send_json(
+                        200,
+                        {
+                            "models": [
+                                {"name": m}
+                                for m in server.backend.loaded_models()
+                            ]
+                        },
+                    )
+                elif self.path == protocol.VERSION_PATH:
+                    self._send_json(
+                        200, {"version": protocol.SERVER_VERSION}
+                    )
                 else:
                     self._send_json(404, {"error": f"unknown path {self.path}"})
 

@@ -1,0 +1,19 @@
+"""Capstone: a real (reduced) energy study on one TPU chip — full-size
+qwen2:1.5b and gemma:2b at int8, both treatments, two lengths, 3 reps."""
+from pathlib import Path
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+    LlmEnergyConfig,
+)
+
+
+class RunnerConfig(LlmEnergyConfig):
+    def __init__(self):
+        super().__init__(
+            models=["qwen2:1.5b", "gemma:2b"],
+            lengths=[100, 500],
+            repetitions=3,
+            cooldown_ms=2000,
+            results_output_path=Path("experiments_output"),
+            quantize="int8",
+        )

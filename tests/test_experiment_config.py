@@ -114,3 +114,37 @@ def test_study_resume_reuses_topic(tmp_path):
 
     topic2 = pick(seed=zlib.crc32(f"{config2.seed}|{first_id}".encode()))
     assert stored[first_id] == topic2
+
+
+def test_remote_runs_do_not_poison_on_device_chip_count(tmp_path):
+    """Regression (found in a real TPU capstone run): the shared energy
+    profiler's n_chips is mutated per run; when the target count was read
+    back from an aliased profiler instance, one remote run (8 chips)
+    permanently poisoned every later on_device run. before_run must set
+    the count from plain config data."""
+    cfg = LlmEnergyConfig(
+        models=["m"],
+        lengths=[100],
+        repetitions=1,
+        cooldown_ms=0,
+        results_output_path=tmp_path,
+        backends={"on_device": FakeBackend(), "remote": FakeBackend()},
+    )
+
+    def ctx(location):
+        return RunContext(
+            run_id="r",
+            run_nr=1,
+            total_runs=2,
+            variation={"model": "m", "location": location, "length": 100},
+            run_dir=tmp_path,
+            experiment_dir=tmp_path,
+        )
+
+    idx = cfg._model_profiler_index()
+    cfg.before_run(ctx("remote"))
+    assert cfg.profilers[idx].n_chips == 8
+    cfg.before_run(ctx("on_device"))
+    assert cfg.profilers[idx].n_chips == 1  # failed when read from the alias
+    cfg.before_run(ctx("remote"))
+    assert cfg.profilers[idx].n_chips == 8

@@ -5,6 +5,7 @@ treatment needs a real second machine); these tests run the full
 client→HTTP→server→backend path hermetically on localhost.
 """
 
+import json
 import threading
 
 import pytest
@@ -366,3 +367,16 @@ def test_negative_num_predict_maps_to_bounded_budget():
         {"model": "m", "prompt": "x", "options": {"num_predict": -1}}
     )
     assert req.max_new_tokens == protocol.UNLIMITED_NUM_PREDICT_CAP
+
+
+def test_ps_and_version_endpoints(server):
+    import urllib.request
+
+    base = f"http://127.0.0.1:{server.port}"
+    with urllib.request.urlopen(f"{base}/api/version", timeout=5) as resp:
+        assert json.loads(resp.read())["version"]
+    client = RemoteHTTPBackend(base)
+    client.generate(GenerationRequest("qwen2:1.5b", "warm", max_new_tokens=4))
+    with urllib.request.urlopen(f"{base}/api/ps", timeout=5) as resp:
+        body = json.loads(resp.read())
+    assert {"name": "qwen2:1.5b"} in body["models"]
