@@ -25,6 +25,9 @@ class GenerationRequest:
     repeat_penalty: float = 1.0  # 1.0 disables
     seed: int = 0
     stop_at_eos: bool = True
+    # Ollama's options.stop: generation output is cut before the first
+    # occurrence of any of these strings.
+    stop: "tuple[str, ...]" = ()
 
     def __post_init__(self) -> None:
         # Degenerate knobs would silently corrupt sampling (top_p<=0 masks
@@ -42,6 +45,11 @@ class GenerationRequest:
         if self.repeat_penalty <= 0:
             raise ValueError(
                 f"repeat_penalty must be > 0, got {self.repeat_penalty}"
+            )
+        if any(not s for s in self.stop):
+            raise ValueError(
+                "stop strings must be non-empty (an empty string matches at "
+                "position 0 and would blank every result)"
             )
 
 

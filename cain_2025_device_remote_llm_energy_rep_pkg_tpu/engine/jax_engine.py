@@ -79,6 +79,21 @@ def _prompt_alloc(s_real: int) -> int:
     return start + bucket
 
 
+def _apply_stop(tokens: "list[int]", text: str, tok, stop) -> "tuple[list[int], str]":
+    """Cut output before the first occurrence of any stop string (Ollama's
+    ``options.stop``): text cut exactly; tokens cut at the smallest prefix
+    whose decode covers the kept text."""
+    cuts = [text.find(s) for s in stop if s in text]
+    if not cuts:
+        return tokens, text
+    kept = text[: min(cuts)]
+    k, acc = 0, ""
+    while k < len(tokens) and len(acc) < len(kept):
+        k += 1
+        acc = tok.decode(tokens[:k])
+    return tokens[:k], kept
+
+
 def _spec_margin(k: int) -> int:
     """Extra KV-cache slots the speculative path needs beyond the usual
     buckets (rounds overshoot by up to k; the draft seats one extra entry),
@@ -647,10 +662,13 @@ class JaxEngine(GenerationBackend):
         eos = st["tok"].eos_id
         if request.stop_at_eos and eos in generated:
             generated = generated[: generated.index(eos)]
+        text = st["tok"].decode(generated)
+        if request.stop:
+            generated, text = _apply_stop(generated, text, st["tok"], request.stop)
         return GenerationResult(
             request=request,
             tokens=generated,
-            text=st["tok"].decode(generated),
+            text=text,
             prompt_tokens=st["s_real"],
             generated_tokens=len(generated),
             prefill_s=st["t1"] - st["t0"],
@@ -659,6 +677,16 @@ class JaxEngine(GenerationBackend):
         )
 
     def generate(self, request: GenerationRequest) -> GenerationResult:
+        if request.stop:
+            # Stop strings can only be matched on the host, so decode in
+            # chunks via the streaming machinery, which exits within one
+            # chunk of the hit — a monolithic decode would burn (and
+            # *measure*) the full token budget for output that gets cut,
+            # corrupting tokens/s and energy-per-token.
+            for chunk in self.generate_stream(request):
+                if chunk.done:
+                    return chunk.result
+            raise RuntimeError("stream ended without a final chunk")
         spec = self.speculative.get(request.model)
         if (
             spec is not None
@@ -1021,12 +1049,15 @@ class JaxEngine(GenerationBackend):
             generated = [int(first_tokens[r])] + [int(t) for t in out[r][:take]]
             if request.stop_at_eos and tok.eos_id in generated:
                 generated = generated[: generated.index(tok.eos_id)]
+            text = tok.decode(generated)
+            if request.stop:
+                generated, text = _apply_stop(generated, text, tok, request.stop)
             prefill_s = st["t1"] - st["t0"]  # this row's own prefill
             results.append(
                 GenerationResult(
                     request=request,
                     tokens=generated,
-                    text=tok.decode(generated),
+                    text=text,
                     prompt_tokens=st["s_real"],
                     generated_tokens=len(generated),
                     prefill_s=prefill_s,
@@ -1069,11 +1100,69 @@ class JaxEngine(GenerationBackend):
         # When stop_at_eos, an EOS first token means nothing will ever be
         # visible — end the stream instead of burning decode chunks.
         stop = request.stop_at_eos and generated[0] == eos
+
+        # Stop-string handling works on the CUMULATIVE decode of all
+        # streamed tokens (per-chunk decodes can split multi-byte chars and
+        # would corrupt the match): the stream ends as soon as the text
+        # contains any request.stop string, deltas are cut right before it,
+        # and a trailing replacement char (a possibly-incomplete multi-byte
+        # sequence) is held back until more tokens resolve it. The
+        # done-chunk's result applies the identical cut via _finish, so
+        # stream and result agree.
+        emitted_text = ""
+        pending_tokens: "list[int]" = []  # ids not yet attached to a chunk
+
+        def stop_delta(all_tokens: "list[int]") -> "tuple[str, bool]":
+            nonlocal emitted_text
+            cum = st["tok"].decode(all_tokens)
+            cuts = [cum.find(s) for s in request.stop if s in cum]
+            hit = bool(cuts)
+            if hit:
+                cum = cum[: min(cuts)]
+            display = cum
+            if not hit:
+                # hold back (a) a trailing replacement char — a possibly
+                # incomplete multi-byte sequence — and (b) any suffix that
+                # is a prefix of a stop string: emitting it now would leak
+                # text the next chunk may reveal to be part of the stop.
+                if display.endswith("�"):
+                    display = display[:-1]
+                hold = 0
+                for s in request.stop:
+                    for n in range(min(len(s) - 1, len(display)), 0, -1):
+                        if display.endswith(s[:n]):
+                            hold = max(hold, n)
+                            break
+                if hold:
+                    display = display[:-hold]
+            if display.startswith(emitted_text):
+                delta = display[len(emitted_text):]
+            elif len(display) > len(emitted_text):
+                # a tokenizer whose decode is not prefix-stable (HF
+                # cleanup/joining) rewrote earlier text; keep streaming from
+                # the same length rather than silently dropping the rest —
+                # the done-chunk's result stays authoritative
+                delta = display[len(emitted_text):]
+            else:
+                delta = ""
+            emitted_text += delta
+            return delta, hit
+
         if not stop:
             visible = list(generated)
-            yield GenerationChunk(
-                text=st["tok"].decode(visible), tokens=visible
-            )
+            if not request.stop:
+                # no stop strings: every token streams, even ones that
+                # decode to no text (extra-vocab ids)
+                yield GenerationChunk(
+                    text=st["tok"].decode(visible), tokens=visible
+                )
+            else:
+                delta, hit = stop_delta(list(generated))
+                pending_tokens.extend(visible)
+                if delta:
+                    yield GenerationChunk(text=delta, tokens=pending_tokens)
+                    pending_tokens = []
+                stop = stop or hit
 
         token = st["first"]
         offset = jnp.int32(st["s_real"])
@@ -1111,9 +1200,44 @@ class JaxEngine(GenerationBackend):
                 if request.stop_at_eos:
                     emit = emit[: emit.index(eos)]
             if emit:
+                if not request.stop:
+                    yield GenerationChunk(
+                        text=st["tok"].decode(emit), tokens=emit
+                    )
+                else:
+                    delta, hit = stop_delta(list(generated))
+                    pending_tokens.extend(emit)
+                    if delta:
+                        yield GenerationChunk(
+                            text=delta, tokens=pending_tokens
+                        )
+                        pending_tokens = []
+                    if hit:
+                        stop = True
+
+        if request.stop:
+            # flush any held-back trailing text so the streamed deltas sum
+            # to exactly the final result's text
+            final_tokens = list(generated)
+            eos_pos = (
+                final_tokens.index(eos)
+                if request.stop_at_eos and eos in final_tokens
+                else len(final_tokens)
+            )
+            cum = st["tok"].decode(final_tokens[:eos_pos])
+            cuts = [cum.find(s) for s in request.stop if s in cum]
+            if cuts:
+                cum = cum[: min(cuts)]
+            if len(cum) > len(emitted_text):
                 yield GenerationChunk(
-                    text=st["tok"].decode(emit), tokens=emit
+                    text=cum[len(emitted_text):], tokens=pending_tokens
                 )
+                pending_tokens = []
+            elif pending_tokens:
+                # text ended exactly at the cut but ids are still owed to
+                # the wire (chunk.tokens contract)
+                yield GenerationChunk(text="", tokens=pending_tokens)
+                pending_tokens = []
 
         t2 = time.monotonic()
         yield GenerationChunk(

@@ -46,6 +46,7 @@ def request_to_wire(
             "top_p": request.top_p,
             "repeat_penalty": request.repeat_penalty,
             "seed": request.seed,
+            **({"stop": list(request.stop)} if request.stop else {}),
         },
         "x_stop_at_eos": request.stop_at_eos,
     }
@@ -68,7 +69,18 @@ def request_from_wire(body: Dict[str, Any]) -> GenerationRequest:
         repeat_penalty=float(options.get("repeat_penalty", 1.0)),
         seed=int(options.get("seed", 0)),
         stop_at_eos=bool(body.get("x_stop_at_eos", True)),
+        stop=_stop_from_wire(options.get("stop")),
     )
+
+
+def _stop_from_wire(value) -> "tuple[str, ...]":
+    """Ollama takes a list; OpenAI-style clients send a bare string — wrap
+    it rather than iterating it character-by-character."""
+    if value is None:
+        return ()
+    if isinstance(value, str):
+        return (value,)
+    return tuple(str(s) for s in value)
 
 
 def stream_chunk_to_wire(

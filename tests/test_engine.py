@@ -388,3 +388,95 @@ def test_prefix_cache_entries_store_only_prompt_region():
     engine.generate(GenerationRequest("tiny-p", "abcde", max_new_tokens=64))
     (k, v, _), = engine._prefix_cache["tiny-p"].values()
     assert k.shape[3] == 6  # bos + 5 bytes, not prompt_bucket + gen_bucket
+
+
+def test_stop_strings_truncate_output(engine):
+    # find a sampled generation with enough text to cut (random weights can
+    # emit ids that decode to nothing)
+    base = full = None
+    for seed in range(8):
+        cand = GenerationRequest(
+            "tiny-a", "halt here", max_new_tokens=24, temperature=0.8,
+            seed=seed,
+        )
+        r = engine.generate(cand)
+        if len(r.text) >= 4:
+            base, full = cand, r
+            break
+    assert full is not None, "no seed produced 4+ chars of text"
+    stop_str = full.text[2:4]
+    import dataclasses as _dc
+
+    stopped = engine.generate(_dc.replace(base, stop=(stop_str,)))
+    assert stop_str not in stopped.text
+    assert stopped.text == full.text[: full.text.find(stop_str)]
+    assert stopped.generated_tokens == len(stopped.tokens)
+    # streamed output agrees with the non-streamed stop cut
+    chunks = list(
+        engine.generate_stream(_dc.replace(base, stop=(stop_str,)), chunk_tokens=4)
+    )
+    streamed = "".join(c.text for c in chunks[:-1])
+    assert streamed == stopped.text
+    assert chunks[-1].result.text == stopped.text
+
+
+def test_stop_strings_no_match_is_identity(engine):
+    req = GenerationRequest(
+        "tiny-a", "no stops", max_new_tokens=12, stop=(" NEVER ",)
+    )
+    plain = engine.generate(
+        GenerationRequest("tiny-a", "no stops", max_new_tokens=12)
+    )
+    assert engine.generate(req).tokens == plain.tokens
+
+
+def test_stop_string_spanning_chunks_does_not_leak_prefix(engine):
+    """A stop string split across chunk boundaries must not leak its first
+    characters into the stream (prefix holdback)."""
+    import dataclasses as _dc
+
+    base = None
+    for seed in range(10):
+        cand = GenerationRequest(
+            "tiny-a", "span", max_new_tokens=24, temperature=0.9, seed=seed
+        )
+        r = engine.generate(cand)
+        if len(r.text) >= 8:
+            base, full = cand, r
+            break
+    assert base is not None
+    stop_str = full.text[4:7]  # 3 chars, will straddle chunk_tokens=2 decode
+    stopped = engine.generate(_dc.replace(base, stop=(stop_str,)))
+    chunks = list(
+        engine.generate_stream(_dc.replace(base, stop=(stop_str,)), chunk_tokens=2)
+    )
+    streamed = "".join(c.text for c in chunks[:-1])
+    assert streamed == stopped.text == chunks[-1].result.text
+    assert stop_str not in streamed
+
+
+def test_stop_request_does_not_burn_full_budget(engine):
+    """generate() with a stop hit must not decode the whole token budget
+    (it would measure energy for discarded work)."""
+    import dataclasses as _dc
+
+    base = None
+    for seed in range(10):
+        cand = GenerationRequest(
+            "tiny-a", "budget", max_new_tokens=128, temperature=0.9, seed=seed
+        )
+        r = engine.generate(cand)
+        if len(r.text) >= 6:
+            base, full = cand, r
+            break
+    assert base is not None
+    stop_str = full.text[2:4]
+    stopped = engine.generate(_dc.replace(base, stop=(stop_str,)))
+    # streaming chunk granularity: the decode stops within ~2 chunks of
+    # the hit, nowhere near the 128-token budget
+    assert stopped.generated_tokens < 64
+
+
+def test_empty_stop_string_rejected():
+    with pytest.raises(ValueError, match="stop"):
+        GenerationRequest("m", "x", max_new_tokens=4, stop=("",))

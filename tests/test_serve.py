@@ -380,3 +380,17 @@ def test_ps_and_version_endpoints(server):
     with urllib.request.urlopen(f"{base}/api/ps", timeout=5) as resp:
         body = json.loads(resp.read())
     assert {"name": "qwen2:1.5b"} in body["models"]
+
+
+def test_stop_option_round_trips_on_wire():
+    req = GenerationRequest(
+        "m", "x", max_new_tokens=5, stop=("###", chr(10) + chr(10))
+    )
+    assert protocol.request_from_wire(protocol.request_to_wire(req)) == req
+
+
+def test_bare_string_stop_option_wraps():
+    req = protocol.request_from_wire(
+        {"model": "m", "prompt": "x", "options": {"stop": "###"}}
+    )
+    assert req.stop == ("###",)
