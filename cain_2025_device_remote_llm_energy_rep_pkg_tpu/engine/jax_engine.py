@@ -82,16 +82,23 @@ def _prompt_alloc(s_real: int) -> int:
 def _apply_stop(tokens: "list[int]", text: str, tok, stop) -> "tuple[list[int], str]":
     """Cut output before the first occurrence of any stop string (Ollama's
     ``options.stop``): text cut exactly; tokens cut at the smallest prefix
-    whose decode covers the kept text."""
+    whose decode covers the kept text. Decode length is approximately
+    monotone in the prefix length, so the cut binary-searches (O(log n)
+    decode calls, not O(n)); tokenizers whose decode is not prefix-stable
+    (HF cleanup/joining) make the token cut best-effort — the returned
+    *text* is always exact and authoritative."""
     cuts = [text.find(s) for s in stop if s in text]
     if not cuts:
         return tokens, text
     kept = text[: min(cuts)]
-    k, acc = 0, ""
-    while k < len(tokens) and len(acc) < len(kept):
-        k += 1
-        acc = tok.decode(tokens[:k])
-    return tokens[:k], kept
+    lo, hi = 0, len(tokens)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if len(tok.decode(tokens[:mid])) < len(kept):
+            lo = mid + 1
+        else:
+            hi = mid
+    return tokens[:lo], kept
 
 
 def _spec_margin(k: int) -> int:
@@ -595,6 +602,16 @@ class JaxEngine(GenerationBackend):
         tok = self._tokenizer_for(request.model)
         if prompt_ids is None:
             prompt_ids = tok.encode(request.prompt)
+        if not prompt_ids:
+            # An HF tokenizer with no BOS token + an empty prompt yields
+            # zero ids; prefill would then gather "last-position" logits
+            # from an all-pad chunk and sample garbage. Fail cleanly (the
+            # server maps ValueError to a 400).
+            raise ValueError(
+                f"{request.model}: prompt encodes to zero tokens (empty "
+                "prompt and the tokenizer adds no BOS); provide a non-empty "
+                "prompt"
+            )
         s_real = len(prompt_ids)
         s_bucket = _prompt_alloc(s_real)
         g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)

@@ -22,6 +22,12 @@ DEFAULT_PORT = 11434  # the port the reference's curl targets (README.md:31)
 # bounded budget rather than being rejected.
 UNLIMITED_NUM_PREDICT_CAP = 512
 
+# The engine's largest generation bucket (engine/jax_engine.GEN_BUCKETS[-1];
+# duplicated here so the wire layer stays importable without JAX — a test
+# pins the two equal). Values above it would only surface later as a 500
+# from the engine's bucket lookup; reject them at the wire as a 400.
+MAX_NUM_PREDICT = 2048
+
 GENERATE_PATH = "/api/generate"
 TAGS_PATH = "/api/tags"
 PS_PATH = "/api/ps"  # loaded models (Ollama parity)
@@ -59,6 +65,11 @@ def request_from_wire(body: Dict[str, Any]) -> GenerationRequest:
     num_predict = int(options.get("num_predict", 128))
     if num_predict < 0:
         num_predict = UNLIMITED_NUM_PREDICT_CAP
+    if num_predict > MAX_NUM_PREDICT:
+        raise ValueError(
+            f"num_predict {num_predict} exceeds the maximum generation "
+            f"budget {MAX_NUM_PREDICT}"
+        )
     return GenerationRequest(
         model=str(body["model"]),
         prompt=str(body["prompt"]),

@@ -81,25 +81,42 @@ class BatchScheduler:
         )
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, timeout_s: float = 30.0) -> None:
         with self._state_lock:
             if not self._running:
                 return
             self._running = False
             self._queue.put(None)  # wake the loop
-            if self._thread is not None:
-                self._thread.join(timeout=10)
-                self._thread = None
-            # Fail any tickets still queued so their callers unblock; new
-            # submits are excluded by the state lock.
-            while True:
-                try:
-                    ticket = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if ticket is not None:
-                    ticket.error = RuntimeError("server shutting down")
-                    ticket.event.set()
+            thread, self._thread = self._thread, None
+        # Join outside the state lock (new submits are already excluded by
+        # _running=False) and drain between join attempts: a batch still
+        # executing across the shutdown could otherwise re-queue
+        # incompatible leftovers *after* a single premature drain, stranding
+        # their submit() callers on event.wait() forever. The join is
+        # bounded (a wedged backend must not hang server shutdown — the
+        # worker is a daemon thread); the post-shutdown stranding case is
+        # closed independently by _collect, which fails leftovers instead of
+        # re-queuing them once _running is False.
+        deadline = time.monotonic() + timeout_s
+        while (
+            thread is not None
+            and thread.is_alive()
+            and time.monotonic() < deadline
+        ):
+            thread.join(timeout=1.0)
+            self._fail_queued()
+        self._fail_queued()
+
+    def _fail_queued(self) -> None:
+        """Fail every queued ticket so its caller unblocks (shutdown only)."""
+        while True:
+            try:
+                ticket = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if ticket is not None:
+                ticket.error = RuntimeError("server shutting down")
+                ticket.event.set()
 
     # -- client side ----------------------------------------------------------
     def submit(self, request: GenerationRequest) -> GenerationResult:
@@ -143,7 +160,17 @@ class BatchScheduler:
             else:
                 leftovers.append(ticket)
         for ticket in leftovers:
-            self._queue.put(ticket)
+            # Under the state lock so the re-queue cannot interleave with
+            # stop() flipping _running: either the ticket lands in the queue
+            # before the flip (stop()'s drains run after and fail it) or it
+            # is failed directly here — no window where it is re-queued
+            # after the final drain and stranded.
+            with self._state_lock:
+                if self._running:
+                    self._queue.put(ticket)
+                else:
+                    ticket.error = RuntimeError("server shutting down")
+                    ticket.event.set()
         return batch
 
     def _loop(self) -> None:

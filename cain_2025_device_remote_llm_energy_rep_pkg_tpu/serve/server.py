@@ -166,6 +166,10 @@ class GenerationServer:
                             result = server.backend.generate(request)
                 except KeyError as exc:
                     self._send_json(404, {"error": f"model not found: {exc}"})
+                except ValueError as exc:
+                    # Engine-side request validation (empty-encoding prompt,
+                    # budget over max_seq_len, …) is the client's fault.
+                    self._send_json(400, {"error": str(exc)})
                 except Exception as exc:  # noqa: BLE001 — server must not die
                     self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
                 else:
@@ -196,6 +200,9 @@ class GenerationServer:
                         self._send_json(
                             404, {"error": f"model not found: {exc}"}
                         )
+                        return
+                    except ValueError as exc:
+                        self._send_json(400, {"error": str(exc)})
                         return
                     except Exception as exc:  # noqa: BLE001
                         self._send_json(
@@ -277,6 +284,10 @@ class GenerationServer:
                             )
                 except KeyError as exc:
                     self._send_json(404, {"error": f"model not found: {exc}"})
+                except ValueError as exc:
+                    # Bad x_warmup payloads (e.g. num_predict over the cap)
+                    # are client errors, same as on /api/generate.
+                    self._send_json(400, {"error": str(exc)})
                 except Exception as exc:  # noqa: BLE001
                     self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
                 else:

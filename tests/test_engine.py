@@ -480,3 +480,60 @@ def test_stop_request_does_not_burn_full_budget(engine):
 def test_empty_stop_string_rejected():
     with pytest.raises(ValueError, match="stop"):
         GenerationRequest("m", "x", max_new_tokens=4, stop=("",))
+
+
+def test_empty_prompt_encoding_rejected(engine):
+    """A tokenizer that yields zero prompt ids (HF checkpoint with no BOS +
+    empty prompt) must fail cleanly, not sample from an all-pad prefill."""
+
+    class NoBosTokenizer:
+        pad_id = 0
+        eos_id = 2
+        vocab_size = 16
+
+        def encode(self, text, add_bos=True):
+            return []  # no BOS, empty prompt
+
+        def decode(self, ids):
+            return ""
+
+    engine.load_model("tiny-a")
+    engine._tokenizers["tiny-a"] = NoBosTokenizer()
+    try:
+        with pytest.raises(ValueError, match="zero tokens"):
+            engine.generate(GenerationRequest("tiny-a", "", max_new_tokens=4))
+    finally:
+        del engine._tokenizers["tiny-a"]
+
+
+def test_protocol_num_predict_cap_matches_engine_buckets():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import protocol
+
+    assert protocol.MAX_NUM_PREDICT == GEN_BUCKETS[-1]
+
+
+def test_apply_stop_binary_search_matches_linear_scan():
+    """The binary-searched token cut must equal the original linear scan's
+    (smallest prefix whose decode covers the kept text) for a prefix-stable
+    tokenizer, across cut positions."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        _apply_stop,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.tokenizer import (
+        ByteTokenizer,
+    )
+
+    tok = ByteTokenizer()
+    text = "the quick brown fox jumps over the lazy dog"
+    tokens = tok.encode(text, add_bos=False)
+    assert tok.decode(tokens) == text
+    for stop_str in ("quick", " fox", "dog", "t", "o"):
+        got_tokens, got_text = _apply_stop(list(tokens), text, tok, (stop_str,))
+        kept = text[: text.find(stop_str)]
+        assert got_text == kept
+        # linear-scan reference
+        k, acc = 0, ""
+        while k < len(tokens) and len(acc) < len(kept):
+            k += 1
+            acc = tok.decode(tokens[:k])
+        assert got_tokens == tokens[:k]

@@ -204,3 +204,49 @@ def test_batch_failure_retries_singles():
             assert res.tokens == reference.generate(req).tokens
     finally:
         sched.stop()
+
+
+def test_stop_during_inflight_batch_fails_leftovers_after_worker_exit():
+    """stop() must keep draining until the worker thread has really exited:
+    a batch executing across the shutdown can re-queue incompatible
+    leftovers after a premature drain, stranding their callers forever."""
+
+    class SlowBackend(FakeBackend):
+        def generate(self, request):
+            time.sleep(1.0)
+            return super().generate(request)
+
+    sched = BatchScheduler(SlowBackend(), window_s=0.3)
+    sched.start()
+    try:
+        # A opens a batch; B (different model) arrives inside A's admission
+        # window and becomes a leftover, re-queued when the window closes.
+        reqs = [
+            GenerationRequest("m1", "a", max_new_tokens=4),
+            GenerationRequest("m2", "b", max_new_tokens=4),
+        ]
+        results = [None, None]
+        errors = [None, None]
+
+        def worker(i):
+            try:
+                results[i] = sched.submit(reqs[i])
+            except BaseException as exc:  # noqa: BLE001
+                errors[i] = exc
+
+        t_a = threading.Thread(target=worker, args=(0,))
+        t_a.start()
+        time.sleep(0.1)
+        t_b = threading.Thread(target=worker, args=(1,))
+        t_b.start()
+        time.sleep(0.1)  # both enqueued; A's batch still collecting/executing
+        sched.stop()  # must block until the worker exited, then drain
+        t_a.join(timeout=10)
+        t_b.join(timeout=10)
+        assert not t_a.is_alive() and not t_b.is_alive()
+        # A was in flight → served; B was dropped at shutdown → failed, but
+        # NOT stranded.
+        assert results[0] is not None and errors[0] is None
+        assert results[1] is not None or isinstance(errors[1], RuntimeError)
+    finally:
+        sched.stop()

@@ -394,3 +394,27 @@ def test_bare_string_stop_option_wraps():
         {"model": "m", "prompt": "x", "options": {"stop": "###"}}
     )
     assert req.stop == ("###",)
+
+
+def test_num_predict_above_cap_rejected_at_wire():
+    with pytest.raises(ValueError, match="num_predict"):
+        protocol.request_from_wire(
+            {"model": "m", "prompt": "x", "options": {"num_predict": 4096}}
+        )
+
+
+def test_server_returns_400_for_oversized_num_predict(server):
+    import urllib.error
+    import urllib.request
+
+    body = json.dumps(
+        {"model": "qwen2:1.5b", "prompt": "x", "options": {"num_predict": 99999}}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/api/generate",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req, timeout=5)
+    assert exc_info.value.code == 400
