@@ -33,6 +33,12 @@ def main() -> int:
     backend = jax.default_backend()
     on_accelerator = backend in ("tpu", "axon")
 
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+
     import jax.numpy as jnp
 
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (

@@ -22,9 +22,13 @@ from .stats import (
     iqr_mask,
     shapiro_wilk,
     significance_stars,
+    skewness,
     spearman,
     wilcoxon_rank_sum,
 )
+
+# BASELINE.md target: ≤5% run-to-run energy variance per experiment cell.
+CV_TARGET = 0.05
 
 DEFAULT_METRICS = (
     "energy_J",
@@ -112,8 +116,10 @@ def analyze(
     metrics: Sequence[str] = DEFAULT_METRICS,
     location_factor: str = "location",
     length_factor: str = "length",
+    model_factor: str = "model",
     energy_metric: str = "energy_J",
     iqr_k: float = 1.5,
+    cv_target: float = CV_TARGET,
 ) -> Dict[str, Any]:
     metrics = [m for m in metrics if any(r.get(m) is not None for r in rows)]
     filtered = apply_iqr_filter(rows, metrics, k=iqr_k)
@@ -126,6 +132,8 @@ def analyze(
         "metrics": list(metrics),
         "descriptives": {},
         "normality": {},
+        "skewness": {},
+        "variance_check": {},
         "h1_energy_by_length": {},
         "h2_spearman": {},
     }
@@ -145,6 +153,61 @@ def analyze(
                         report["normality"][key] = {"W": w, "p": p}
                     except RuntimeError:
                         pass
+                    # nb cell 35: skewness decides whether a log transform
+                    # is needed; re-check normality on the transformed data
+                    # when it is (all energy values are > 0).
+                    entry = {"skew": skewness(vals)}
+                    if abs(entry["skew"]) > 1 and min(vals) > 0:
+                        logged = [math.log(v) for v in vals]
+                        entry["skew_log"] = skewness(logged)
+                        try:
+                            w, p = shapiro_wilk(logged)
+                            entry["normality_log"] = {"W": w, "p": p}
+                        except RuntimeError:
+                            pass
+                    report["skewness"][key] = entry
+
+    # Run-to-run variance per experiment cell (model × location × length):
+    # BASELINE.md's explicit ≤5% target, assessed as the CV of the energy
+    # metric over a cell's repetitions (VERDICT.md round-1 weakness 2).
+    if energy_metric in metrics and any(model_factor in r for r in filtered):
+        models = sorted({str(r.get(model_factor)) for r in filtered})
+        cells = {}
+        for model in models:
+            for loc in locations:
+                for length in lengths:
+                    sub = _subset(
+                        filtered,
+                        **{
+                            model_factor: model,
+                            location_factor: loc,
+                            length_factor: length,
+                        },
+                    )
+                    vals = _values(sub, energy_metric)
+                    if len(vals) < 2:
+                        continue
+                    d = descriptives(vals)
+                    cells[f"{model}|{loc}|{length}"] = {
+                        "n": d.n,
+                        "cv": d.cv,
+                        "pass": bool(d.cv <= cv_target),
+                    }
+        if cells:
+            worst_key = max(cells, key=lambda k: cells[k]["cv"])
+            report["variance_check"] = {
+                "target_cv": cv_target,
+                "metric": energy_metric,
+                "cells": cells,
+                "n_pass": sum(1 for c in cells.values() if c["pass"]),
+                "n_cells": len(cells),
+                "worst": {"cell": worst_key, **cells[worst_key]},
+                "verdict": (
+                    "pass"
+                    if all(c["pass"] for c in cells.values())
+                    else "fail"
+                ),
+            }
 
     # H1 (nb cell 37): on-device vs remote energy per content length.
     if len(locations) == 2 and energy_metric in metrics:
@@ -226,6 +289,37 @@ def render_markdown(report: Dict[str, Any]) -> str:
                 f"| {h['cliffs_delta']:.3f} | {h['magnitude']} "
                 f"| {h['mean_ratio']:.2f}× |"
             )
+    if report.get("variance_check"):
+        vc = report["variance_check"]
+        lines += ["", "## Run-to-run variance (≤{:.0%} CV target)".format(
+            vc["target_cv"]
+        ), ""]
+        lines.append(
+            f"**{vc['verdict'].upper()}** — {vc['n_pass']}/{vc['n_cells']} "
+            f"cells within target on `{vc['metric']}`; worst cell "
+            f"`{vc['worst']['cell']}` at CV {vc['worst']['cv']:.3f} "
+            f"(n={vc['worst']['n']})."
+        )
+        lines += ["", "| cell | n | CV | ≤ target |", "|---|---|---|---|"]
+        for cell, c in sorted(vc["cells"].items()):
+            lines.append(
+                f"| {cell} | {c['n']} | {c['cv']:.4f} "
+                f"| {'yes' if c['pass'] else 'NO'} |"
+            )
+    if report.get("skewness"):
+        lines += ["", "## Skewness (log-transform check)", ""]
+        lines.append("| subset | skew | skew(log) | Shapiro p (log) |")
+        lines.append("|---|---|---|---|")
+        for key, s in sorted(report["skewness"].items()):
+            skew_log = (
+                f"{s['skew_log']:.3f}" if "skew_log" in s else "—"
+            )
+            p_log = (
+                f"{s['normality_log']['p']:.2e}"
+                if "normality_log" in s
+                else "—"
+            )
+            lines.append(f"| {key} | {s['skew']:.3f} | {skew_log} | {p_log} |")
     if report["h2_spearman"]:
         lines += ["", "## H2: Spearman correlations with energy", ""]
         for loc, per_metric in sorted(report["h2_spearman"].items()):

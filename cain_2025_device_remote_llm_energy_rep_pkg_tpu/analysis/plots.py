@@ -62,6 +62,102 @@ def violin_by(
     return True
 
 
+def density_by(
+    rows: List[Dict[str, Any]],
+    metric: str,
+    by: str,
+    out_path: Path,
+    title: str = "",
+) -> bool:
+    """Overlaid KDE density curves of ``metric`` per level of ``by``
+    (nb cells 21-26 pair every violin with a density panel)."""
+    if plt is None:
+        term.log_warn("matplotlib unavailable; skipping density plot")
+        return False
+    import numpy as np
+
+    try:
+        from scipy.stats import gaussian_kde
+    except ImportError:  # pragma: no cover
+        return False
+    groups = {
+        k: v for k, v in _groups(rows, metric, by).items() if len(v) >= 3
+    }
+    groups = {k: v for k, v in groups.items() if len(set(v)) > 1}
+    if not groups:
+        return False
+    fig, ax = plt.subplots(figsize=(6, 4))
+    lo = min(min(v) for v in groups.values())
+    hi = max(max(v) for v in groups.values())
+    pad = 0.1 * (hi - lo or 1.0)
+    grid = np.linspace(lo - pad, hi + pad, 256)
+    for label, vals in groups.items():
+        try:
+            kde = gaussian_kde(vals)
+        except Exception:  # noqa: BLE001 - singular data
+            continue
+        ax.plot(grid, kde(grid), label=str(label))
+        ax.fill_between(grid, kde(grid), alpha=0.15)
+    ax.set_xlabel(metric)
+    ax.set_ylabel("density")
+    ax.set_title(title or f"{metric} density by {by}")
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return True
+
+
+def violin_panel_by_model(
+    rows: List[Dict[str, Any]],
+    metric: str,
+    out_path: Path,
+    model_factor: str = "model",
+    location_factor: str = "location",
+    title: str = "",
+) -> bool:
+    """Per-LLM violin panel: one subplot per model, violins of ``metric``
+    per location (nb cells 21-26's per-LLM figures)."""
+    if plt is None:
+        term.log_warn("matplotlib unavailable; skipping violin panel")
+        return False
+    models = sorted(
+        {str(r.get(model_factor)) for r in rows if r.get(model_factor)}
+    )
+    panels = []
+    for model in models:
+        sub = [r for r in rows if str(r.get(model_factor)) == model]
+        groups = {
+            k: v
+            for k, v in _groups(sub, metric, location_factor).items()
+            if len(v) >= 2
+        }
+        if groups:
+            panels.append((model, groups))
+    if not panels:
+        return False
+    ncols = min(4, len(panels))
+    nrows = -(-len(panels) // ncols)
+    fig, axes = plt.subplots(
+        nrows, ncols, figsize=(3.2 * ncols, 3.2 * nrows), squeeze=False
+    )
+    for i, (model, groups) in enumerate(panels):
+        ax = axes[i // ncols][i % ncols]
+        ax.violinplot(list(groups.values()), showmedians=True)
+        ax.set_xticks(range(1, len(groups) + 1))
+        ax.set_xticklabels([str(k) for k in groups], rotation=20, ha="right")
+        ax.set_title(model, fontsize=9)
+        if i % ncols == 0:
+            ax.set_ylabel(metric)
+    for j in range(len(panels), nrows * ncols):
+        axes[j // ncols][j % ncols].axis("off")
+    fig.suptitle(title or f"{metric} by {location_factor}, per model")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return True
+
+
 def qq_plot(values: Sequence[float], out_path: Path, title: str = "") -> bool:
     """Normal QQ plot (nb cell 28)."""
     if plt is None:
@@ -143,6 +239,18 @@ def plot_experiment(
             path = out_dir / f"violin_{metric}_by_{by}.png"
             if violin_by(rows, metric, by, path):
                 written.append(path)
+        path = out_dir / f"density_{metric}_by_{location_factor}.png"
+        if density_by(rows, metric, location_factor, path):
+            written.append(path)
+        path = out_dir / f"violin_{metric}_per_model.png"
+        if violin_panel_by_model(
+            rows,
+            metric,
+            path,
+            model_factor=model_factor,
+            location_factor=location_factor,
+        ):
+            written.append(path)
         vals = [r.get(metric) for r in rows if r.get(metric) is not None]
         path = out_dir / f"qq_{metric}.png"
         if qq_plot(vals, path, title=f"QQ: {metric}"):

@@ -45,6 +45,8 @@ class Descriptives:
     sd: float
     minimum: float
     maximum: float
+    cv: float = math.nan  # coefficient of variation sd/|mean| (BASELINE.md's
+    # "≤5% run-to-run variance" target is stated as a CV)
 
     def as_dict(self) -> Dict[str, float]:
         return dataclasses.asdict(self)
@@ -53,15 +55,35 @@ class Descriptives:
 def descriptives(values: Sequence[float]) -> Descriptives:
     arr = _as_clean_array(values)
     if arr.size == 0:
-        return Descriptives(0, math.nan, math.nan, math.nan, math.nan, math.nan)
+        return Descriptives(
+            0, math.nan, math.nan, math.nan, math.nan, math.nan, math.nan
+        )
+    mean = float(np.mean(arr))
+    sd = float(np.std(arr, ddof=1)) if arr.size > 1 else 0.0
     return Descriptives(
         n=int(arr.size),
-        mean=float(np.mean(arr)),
+        mean=mean,
         median=float(np.median(arr)),
-        sd=float(np.std(arr, ddof=1)) if arr.size > 1 else 0.0,
+        sd=sd,
         minimum=float(np.min(arr)),
         maximum=float(np.max(arr)),
+        cv=sd / abs(mean) if mean else math.nan,
     )
+
+
+def skewness(values: Sequence[float]) -> float:
+    """Sample skewness g1 (nb cell 35 uses e1071::skewness to decide which
+    subsets need a transform before parametric checks)."""
+    arr = _as_clean_array(values)
+    if arr.size < 3:
+        return math.nan
+    if _scipy_stats is not None:
+        return float(_scipy_stats.skew(arr))
+    m = arr.mean()
+    s = arr.std()
+    if s == 0:
+        return 0.0
+    return float(np.mean(((arr - m) / s) ** 3))
 
 
 def shapiro_wilk(values: Sequence[float]) -> Tuple[float, float]:

@@ -214,6 +214,7 @@ class JaxEngine(GenerationBackend):
             if model in self.registry
             else get_model_config(model)
         )
+        self._check_memory_budget(model, cfg)
         t0 = time.monotonic()
         ckpt_dir = self.hf_checkpoints.get(model)
         if ckpt_dir is not None:
@@ -303,6 +304,45 @@ class JaxEngine(GenerationBackend):
         jax.block_until_ready(tf.params)
         self._load_s = time.monotonic() - t0
         self._models[model] = tf
+
+    def _check_memory_budget(self, model: str, cfg: ModelConfig) -> None:
+        """Fail fast — with the estimated bytes, the probed budget, and the
+        remedy — instead of an opaque RESOURCE_EXHAUSTED from XLA minutes
+        into a load (or hours into a sweep). The budget source hierarchy
+        lives in utils/memory.py; unknown budget (CPU tests) skips the
+        check."""
+        from ..utils.memory import (
+            ModelMemoryError,
+            device_memory_budget,
+            estimate_weight_bytes,
+        )
+
+        budget = device_memory_budget()
+        if budget is None:
+            return
+        n_dev = max(1, getattr(self, "n_devices", 1))
+        dtype_b = jnp.dtype(self.dtype).itemsize
+        # A sharded engine (TP) splits the weights over its mesh; models
+        # already resident in HBM count against the budget too — a 7-model
+        # sweep accumulates unless the workload unloads between models.
+        est = estimate_weight_bytes(cfg, self.quantize, dtype_b) // n_dev
+        resident = sum(
+            estimate_weight_bytes(tf.cfg, self.quantize, dtype_b) // n_dev
+            for tf in self._models.values()
+        )
+        if est + resident > budget:
+            if self.quantize is None:
+                hint = "quantize (int8 halves, int4 quarters the bytes)"
+            elif self.quantize == "int8":
+                hint = "quantize to int4 or shard over a mesh (TensorParallelEngine)"
+            else:
+                hint = "shard over more devices (tensor/pipeline parallelism)"
+            if resident:
+                hint += (
+                    f"; or unload_all() first ({len(self._models)} models, "
+                    f"~{resident / 1024**3:.2f} GiB, already resident)"
+                )
+            raise ModelMemoryError(model, est + resident, budget, hint)
 
     def unload_all(self) -> None:
         self._models.clear()

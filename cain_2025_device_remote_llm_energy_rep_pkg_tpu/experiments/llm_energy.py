@@ -69,6 +69,7 @@ class LlmEnergyConfig(ExperimentConfig):
         cooldown_ms: Optional[int] = None,
         backends: Optional[Dict[str, GenerationBackend]] = None,
         remote_url: Optional[str] = None,
+        on_device_url: Optional[str] = None,
         remote_tp: int = -1,
         shuffle: bool = True,
         seed: int = 0,
@@ -92,6 +93,14 @@ class LlmEnergyConfig(ExperimentConfig):
             self.time_between_runs_in_ms = cooldown_ms
         self._backends = backends  # None → built lazily in before_experiment
         self._remote_url = remote_url
+        # The reference's on-device treatment ALSO crosses a process+HTTP
+        # boundary — curl to the local Ollama on localhost:11434
+        # (experiment/RunnerConfig.py:122-131). With on_device_url set, this
+        # study does the faithful equivalent: a separate serving process
+        # owns the chip and the experiment process is a pure HTTP client
+        # for both treatments (mandatory on single-chip relays, where two
+        # JAX runtimes cannot share the chip).
+        self._on_device_url = on_device_url
         self._remote_tp = remote_tp
         # Plain data, deliberately NOT read back from the profiler object:
         # the shared profiler's n_chips is mutated per run in before_run, and
@@ -101,6 +110,7 @@ class LlmEnergyConfig(ExperimentConfig):
             n_chips_by_location or {"on_device": 1, "remote": 8}
         )
         counter = TpuPowerCounterProfiler()
+        from ..profilers.energy_probe import TpuDutyCycleProfiler
         from ..profilers.native_host import NativeHostProfiler
 
         self.profilers = [
@@ -115,6 +125,9 @@ class LlmEnergyConfig(ExperimentConfig):
         ]
         if counter.available:  # real counters, when the platform has them
             self.profilers.insert(0, counter)
+        duty = TpuDutyCycleProfiler()
+        if duty.available:  # measured duty cycle (standard TPU VMs)
+            self.profilers.insert(0, duty)
 
     # -- run table ------------------------------------------------------------
     def create_run_table_model(self) -> RunTableModel:
@@ -127,6 +140,7 @@ class LlmEnergyConfig(ExperimentConfig):
             repetitions=self.repetitions,
             data_columns=[
                 "topic",
+                "backend",  # which backend/transport really served this row
                 "prompt_tokens",
                 "generated_tokens",
                 "execution_time_s",
@@ -140,53 +154,123 @@ class LlmEnergyConfig(ExperimentConfig):
 
     # -- lifecycle ------------------------------------------------------------
     def before_experiment(self) -> None:
-        if self._backends is None:
-            from ..engine.jax_engine import JaxEngine
-            from ..parallel.mesh import MeshSpec, build_mesh
-            from ..parallel.tp import TensorParallelEngine
+        # Persistent XLA compilation cache: a sweep's per-(model, bucket)
+        # warm-up compiles (~20-45 s each) hit disk after the first run, so
+        # resume/re-runs warm in seconds (VERDICT.md round-1 item 7).
+        from ..utils.compile_cache import enable_compilation_cache
 
-            import jax
+        enable_compilation_cache()
+        # Audit trail for the energy columns: which measured channels this
+        # host offers and why the unavailable ones are unavailable
+        # (VERDICT.md round-1 item 1 — a modelled-only table must say so).
+        if self.experiment_path is not None:
+            from ..profilers.energy_probe import write_probe_report
+            from ..runner import term
+
+            statuses = write_probe_report(
+                Path(self.experiment_path) / "energy_channels.json"
+            )
+            measured = [s.name for s in statuses if s.available]
+            term.log(
+                "energy channels: "
+                + (
+                    f"measured sources available: {', '.join(measured)}"
+                    if measured
+                    else "no measured source on this host - energy columns "
+                    "are modelled (see energy_channels.json)"
+                )
+            )
+        if self._backends is None:
+            if self._on_device_url:
+                on_device: GenerationBackend = RemoteHTTPBackend(
+                    self._on_device_url
+                )
+                if not on_device.health():
+                    from ..runner.errors import ExperimentError
+
+                    raise ExperimentError(
+                        f"on-device generation server unreachable at "
+                        f"{self._on_device_url}; start one with the 'serve' "
+                        f"command (it must own the chip before this client "
+                        f"process starts)"
+                    )
+                self._backends = {"on_device": on_device}
+                self._wire_remote_backend()
+                return
+            from ..engine.jax_engine import JaxEngine
 
             self._backends = {
                 "on_device": JaxEngine(
                     decode_attention="auto", quantize=self.quantize
                 )
             }
-            if "remote" in self.locations:
-                from ..serve.client import backend_from_env
+            self._wire_remote_backend(allow_local_mesh=True)
 
-                http_backend = (
-                    RemoteHTTPBackend(self._remote_url)
-                    if self._remote_url
-                    else backend_from_env()
+    def _wire_remote_backend(self, allow_local_mesh: bool = False) -> None:
+        """Choose the remote treatment's backend: an HTTP server named by
+        ``remote_url`` / ``.env SERVER_IP`` (the reference's machine
+        boundary, experiment/RunnerConfig.py:122-131), else a local TP mesh
+        (multi-chip hosts, in-process mode only — a second JAX runtime must
+        not start when a serving process already owns the chip), else the
+        on-device backend aliased and *recorded as aliased* in the run
+        table's backend column."""
+        if "remote" not in self.locations:
+            return
+        from ..serve.client import backend_from_env
+
+        http_backend = (
+            RemoteHTTPBackend(self._remote_url)
+            if self._remote_url
+            else backend_from_env()
+        )
+        if http_backend is not None:
+            # Fail fast on an unreachable server rather than hours into
+            # the sweep.
+            if not http_backend.health():
+                from ..runner.errors import ExperimentError
+
+                raise ExperimentError(
+                    f"remote generation server unreachable at "
+                    f"{http_backend.base_url} (from remote_url / "
+                    f"SERVER_IP); start one with the 'serve' command "
+                    f"or unset the variable to use the local TP mesh"
                 )
-                if http_backend is not None:
-                    # True machine boundary, as in the reference: the remote
-                    # treatment fetches over HTTP from a serving host named
-                    # by remote_url / the .env SERVER_IP convention
-                    # (experiment/RunnerConfig.py:122-131). Fail fast on an
-                    # unreachable server rather than hours into the sweep.
-                    if not http_backend.health():
-                        from ..runner.errors import ExperimentError
+            self._backends["remote"] = http_backend
+            return
+        if allow_local_mesh:
+            import jax
 
-                        raise ExperimentError(
-                            f"remote generation server unreachable at "
-                            f"{http_backend.base_url} (from remote_url / "
-                            f"SERVER_IP); start one with the 'serve' command "
-                            f"or unset the variable to use the local TP mesh"
-                        )
-                    self._backends["remote"] = http_backend
-                elif len(jax.devices()) > 1:
-                    mesh = build_mesh(MeshSpec.tp_only(self._remote_tp))
-                    self._backends["remote"] = TensorParallelEngine(
-                        mesh=mesh,
-                        decode_attention="auto",
-                        quantize=self.quantize,
-                    )
-                else:
-                    # single-chip dev box: the remote treatment still runs,
-                    # distinguished by its energy model's chip count
-                    self._backends["remote"] = self._backends["on_device"]
+            if len(jax.devices()) > 1:
+                from ..parallel.mesh import MeshSpec, build_mesh
+                from ..parallel.tp import TensorParallelEngine
+
+                mesh = build_mesh(MeshSpec.tp_only(self._remote_tp))
+                self._backends["remote"] = TensorParallelEngine(
+                    mesh=mesh,
+                    decode_attention="auto",
+                    quantize=self.quantize,
+                )
+                return
+        # single-chip dev box: the remote treatment still runs against the
+        # on-device backend, distinguished by the energy model's chip count
+        # — and the aliasing is recorded per row (describe_backend), so no
+        # reader can mistake these rows for a real machine boundary.
+        self._backends["remote"] = self._backends["on_device"]
+
+    def describe_backend(self, location: str) -> str:
+        """Human/machine-readable identity of the backend that serves
+        ``location``'s rows — recorded per run in the ``backend`` column
+        (VERDICT.md round-1 weakness 3: fallback rows must be
+        distinguishable)."""
+        be = self._backends[location]
+        if isinstance(be, RemoteHTTPBackend):
+            desc = f"http:{be.base_url}"
+        else:
+            n = getattr(be, "n_devices", 1)
+            desc = f"{type(be).__name__}[{n}chip]"
+        if location == "remote" and be is self._backends.get("on_device"):
+            desc += "[aliased-on_device]"
+        return desc
 
     def before_run(self, context: RunContext) -> None:
         location = context.factor("location")
@@ -260,6 +344,7 @@ class LlmEnergyConfig(ExperimentConfig):
             return None
         return {
             "topic": context.scratch["topic"],
+            "backend": self.describe_backend(context.factor("location")),
             "prompt_tokens": result.prompt_tokens,
             "generated_tokens": result.generated_tokens,
             "execution_time_s": round(result.total_s, 4),
@@ -286,6 +371,9 @@ class LlmEnergyConfig(ExperimentConfig):
                         "tokens_per_s",
                         "joules_per_token",
                     ),
+                    # the notebook's figure families are part of the study's
+                    # deliverable (nb cells 21-28, 39-40), not an opt-in
+                    make_plots=True,
                 )
             except Exception as exc:  # analysis must never lose run data
                 from ..runner import term

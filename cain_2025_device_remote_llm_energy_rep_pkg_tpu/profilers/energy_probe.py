@@ -1,0 +1,286 @@
+"""Exhaustive probe of every measured-energy channel this host could offer.
+
+The reference's meter is CodeCarbon (Plugins/Profilers/CodecarbonWrapper.py:
+43-99), which on Linux reads the same RAPL counters probed here — and, on
+hosts *without* RAPL, silently falls back to a TDP × load *model* (its
+documented "constant consumption" mode). So "measured vs modelled" is a
+property of the host, not the framework, for the reference too.
+
+This module makes that property explicit and auditable: it probes every
+channel the framework knows how to read, records exactly why each one is or
+isn't usable, and the study writes the result next to the run table
+(``energy_channels.json``) so a reader of a modelled-only table can see
+that measurement was attempted and what the host lacked — the honest
+equivalent of CodeCarbon's silent fallback.
+
+Channels probed (all the ones that exist on TPU-VM-class Linux hosts):
+  - host RAPL package counters (/sys/class/powercap/intel-rapl:*)
+  - hwmon power/energy sensors (/sys/class/hwmon/*/power*_input)
+  - battery discharge rate (/sys/class/power_supply/*/power_now)
+  - tpu-info / libtpu chip power (``tpu_info.metrics.get_chip_power``)
+  - libtpu monitoring SDK metrics (``libtpu.sdk.tpumonitoring`` —
+    duty_cycle_pct / tensorcore_util: measured *utilisation*, which feeds
+    the energy model with a measured duty factor where available)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ChannelStatus:
+    name: str
+    kind: str  # "energy" | "power" | "utilization"
+    scope: str  # "host" | "device"
+    available: bool
+    detail: str
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _probe_rapl() -> ChannelStatus:
+    domains = sorted(glob.glob("/sys/class/powercap/intel-rapl:*"))
+    if not domains:
+        detail = (
+            "no /sys/class/powercap/intel-rapl:* domains (powercap absent "
+            "in this kernel/container)"
+            if not os.path.isdir("/sys/class/powercap")
+            else "powercap present but no intel-rapl domains"
+        )
+        return ChannelStatus("rapl", "energy", "host", False, detail)
+    readable = [
+        d
+        for d in domains
+        if os.access(os.path.join(d, "energy_uj"), os.R_OK)
+    ]
+    if not readable:
+        return ChannelStatus(
+            "rapl", "energy", "host", False,
+            f"{len(domains)} domains but energy_uj unreadable (permissions)",
+        )
+    return ChannelStatus(
+        "rapl", "energy", "host", True, f"{len(readable)} readable domains"
+    )
+
+
+def _probe_hwmon() -> ChannelStatus:
+    sensors = sorted(
+        glob.glob("/sys/class/hwmon/hwmon*/power*_input")
+        + glob.glob("/sys/class/hwmon/hwmon*/energy*_input")
+    )
+    if not sensors:
+        detail = (
+            "no /sys/class/hwmon at all"
+            if not os.path.isdir("/sys/class/hwmon")
+            else "hwmon present but no power/energy sensors"
+        )
+        return ChannelStatus("hwmon", "power", "host", False, detail)
+    return ChannelStatus(
+        "hwmon", "power", "host", True, f"{len(sensors)} sensors"
+    )
+
+
+def _probe_battery() -> ChannelStatus:
+    paths = sorted(glob.glob("/sys/class/power_supply/*/power_now"))
+    if not paths:
+        return ChannelStatus(
+            "battery", "power", "host", False, "no power_supply devices"
+        )
+    return ChannelStatus(
+        "battery", "power", "host", True, f"{len(paths)} supplies"
+    )
+
+
+def _probe_tpu_info() -> ChannelStatus:
+    try:
+        from tpu_info import metrics  # type: ignore
+    except ImportError:
+        return ChannelStatus(
+            "tpu_info", "power", "device", False,
+            "tpu_info package not installed",
+        )
+    try:
+        readings = metrics.get_chip_power()
+    except Exception as exc:  # noqa: BLE001 - probe must never raise
+        return ChannelStatus(
+            "tpu_info", "power", "device", False,
+            f"get_chip_power failed: {type(exc).__name__}: {exc}",
+        )
+    if not readings:
+        return ChannelStatus(
+            "tpu_info", "power", "device", False, "no chips report power"
+        )
+    return ChannelStatus(
+        "tpu_info", "power", "device", True, f"{len(readings)} chips"
+    )
+
+
+def _probe_libtpu_monitoring() -> ChannelStatus:
+    try:
+        from libtpu.sdk import tpumonitoring  # type: ignore
+    except Exception as exc:  # noqa: BLE001 - import can fail many ways
+        return ChannelStatus(
+            "libtpu_monitoring", "utilization", "device", False,
+            f"libtpu.sdk unavailable: {type(exc).__name__}",
+        )
+    try:
+        supported = list(tpumonitoring.list_supported_metrics())
+        data = tpumonitoring.get_metric("duty_cycle_pct").data()
+    except Exception as exc:  # noqa: BLE001
+        return ChannelStatus(
+            "libtpu_monitoring", "utilization", "device", False,
+            f"metric query failed: {type(exc).__name__}: {exc}",
+        )
+    if not data:
+        return ChannelStatus(
+            "libtpu_monitoring", "utilization", "device", False,
+            f"SDK live ({len(supported)} metrics listed) but duty_cycle_pct "
+            "returns no data — the chip is not locally attached (e.g. "
+            "served through a tunnel)",
+        )
+    return ChannelStatus(
+        "libtpu_monitoring", "utilization", "device", True,
+        f"duty_cycle_pct reporting for {len(data)} accelerators",
+    )
+
+
+def probe_energy_channels() -> List[ChannelStatus]:
+    """Probe every channel; never raises."""
+    return [
+        _probe_rapl(),
+        _probe_hwmon(),
+        _probe_battery(),
+        _probe_tpu_info(),
+        _probe_libtpu_monitoring(),
+    ]
+
+
+def write_probe_report(path: Path) -> List[ChannelStatus]:
+    """Probe and persist ``energy_channels.json`` next to the run table, so
+    a modelled-only table is auditable (which channels were tried, why each
+    was unavailable)."""
+    statuses = probe_energy_channels()
+    payload = {
+        "channels": [s.as_dict() for s in statuses],
+        "any_measured_energy": any(
+            s.available and s.kind in ("energy", "power") for s in statuses
+        ),
+        "note": (
+            "When no energy/power channel is available the study's energy "
+            "columns are modelled (energy_model_J) from measured duration "
+            "and achieved FLOPs — the same fallback class CodeCarbon "
+            "applies on RAPL-less hosts (TDP x load)."
+        ),
+    }
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2))
+    return statuses
+
+
+class TpuDutyCycleProfiler:
+    """Measured duty-cycle sampler via the libtpu monitoring SDK.
+
+    On hosts where the SDK reports (standard Cloud TPU VMs — not tunneled
+    dev relays), this replaces the energy model's FLOPs-*estimated*
+    utilisation with the chip's *measured* duty cycle:
+    ``P = idle + duty · (peak − idle)``, scaled by the number of locally
+    reporting accelerators. Emits the measured duty cycle and the
+    duty-integrated energy as separate columns so modelled and
+    measured-utilisation Joules are never conflated.
+
+    Scope: the LOCAL host's accelerators — the client-side measurement, in
+    the reference's sense (CodeCarbon likewise meters the *measuring*
+    machine, experiment/RunnerConfig.py:28-31). For an on_device row the
+    local chip is the serving chip; for a true HTTP-remote row this column
+    records the near-idle local draw of waiting — exactly the quantity
+    whose contrast is the study's headline. The *serving* side of a remote
+    row is the energy-model column (n_chips-scaled), a deliberately
+    different quantity.
+    """
+
+    data_columns = ("tpu_duty_cycle_pct", "energy_duty_J")
+
+    def __init__(
+        self,
+        period_s: float = 0.25,
+        peak_w: float = 200.0,
+        idle_w: float = 55.0,
+    ) -> None:
+        from .base import SamplingProfiler
+
+        # Composition over inheritance so importing this module never pulls
+        # the sampling machinery when only probing is wanted.
+        outer = self
+
+        class _Sampler(SamplingProfiler):
+            artifact_name = "tpu_duty_cycle"
+            data_columns = outer.data_columns
+
+            def sample(self) -> Dict[str, Any]:
+                reading = outer._read_duty()
+                if reading is None:
+                    return {"duty_pct": None, "n_chips": None}
+                return {"duty_pct": reading[0], "n_chips": reading[1]}
+
+            def summarise(self, samples: List[Dict[str, Any]]) -> Dict[str, Any]:
+                pts = [
+                    (s["t_s"], float(s["duty_pct"]), int(s["n_chips"]))
+                    for s in samples
+                    if s.get("duty_pct") is not None
+                ]
+                if len(pts) < 2:
+                    return {"tpu_duty_cycle_pct": None, "energy_duty_J": None}
+                span = pts[-1][0] - pts[0][0]
+                mean_duty = sum(p for _, p, _ in pts) / len(pts) / 100.0
+                n_chips = max(n for _, _, n in pts)
+                energy = (
+                    (outer.idle_w + mean_duty * (outer.peak_w - outer.idle_w))
+                    * n_chips
+                    * span
+                )
+                return {
+                    "tpu_duty_cycle_pct": round(mean_duty * 100.0, 2),
+                    "energy_duty_J": round(energy, 4),
+                }
+
+        self._impl = _Sampler(period_s=period_s)
+        self.peak_w = peak_w
+        self.idle_w = idle_w
+
+    @staticmethod
+    def _read_duty() -> "Optional[tuple[float, int]]":
+        """(mean duty %, number of locally reporting accelerators), or None."""
+        try:  # pragma: no cover - environment-dependent
+            from libtpu.sdk import tpumonitoring  # type: ignore
+
+            data = tpumonitoring.get_metric("duty_cycle_pct").data()
+            if data:
+                return (
+                    float(sum(float(d) for d in data) / len(data)),
+                    len(data),
+                )
+        except Exception:  # noqa: BLE001
+            pass
+        return None
+
+    @property
+    def available(self) -> bool:
+        return self._read_duty() is not None
+
+    # Profiler contract delegates
+    def on_start(self, context) -> None:
+        self._impl.on_start(context)
+
+    def on_stop(self, context) -> None:
+        self._impl.on_stop(context)
+
+    def collect(self, context) -> Dict[str, Any]:
+        return self._impl.collect(context)

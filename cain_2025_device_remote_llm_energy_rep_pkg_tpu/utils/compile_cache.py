@@ -1,0 +1,42 @@
+"""Persistent XLA compilation cache for studies and benches.
+
+A 7-model × 3-length sweep pays a 20-45 s jit warm-up per (model, bucket)
+shape — ~20 minutes of compile on a cold start (BENCH_r01: 45.6 s for one
+shape). The compiles all happen *outside* measurement windows, so they
+don't corrupt energy numbers, but they dominate sweep wall-time and every
+resume pays them again. JAX's persistent compilation cache keeps the
+compiled executables on disk; a re-run or resume warms in seconds.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional, Union
+
+DEFAULT_CACHE_DIR = "~/.cache/cain_tpu_jax_compilation"
+
+
+def enable_compilation_cache(
+    cache_dir: Optional[Union[str, Path]] = None
+) -> Path:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (default:
+    ``JAX_COMPILATION_CACHE_DIR`` env, else ``~/.cache/...``). Safe to call
+    repeatedly; returns the directory in use. Every compile is cached
+    (min-compile-time threshold 0) — on this platform even small decode
+    loops take seconds to build."""
+    import jax
+
+    path = Path(
+        os.path.expanduser(
+            str(
+                cache_dir
+                or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                or DEFAULT_CACHE_DIR
+            )
+        )
+    )
+    path.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return path

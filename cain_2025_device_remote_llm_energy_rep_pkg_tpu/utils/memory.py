@@ -1,0 +1,115 @@
+"""Accelerator memory budget probing and weight-size estimation.
+
+The reference never has to reason about accelerator memory — Ollama
+rejects or swaps models on its own. This engine loads weights into HBM
+itself, and an oversized model surfaces as an opaque RESOURCE_EXHAUSTED
+deep inside XLA, possibly hours into a sweep. ``device_memory_budget``
+probes what this process can actually allocate; the engine's
+``load_model`` compares it against ``estimate_weight_bytes`` and fails
+fast with both numbers and the remedies (quantize harder, shard over a
+mesh) in the message.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# The development relay (JAX platform "axon") tunnels one real chip but
+# only executes programs whose live set stays under ~4.5 GB (measured by
+# layer-count bisection — models/quantize.py module docstring); raw
+# allocations overcommit, so memory_stats() cannot see the ceiling.
+AXON_RELAY_BUDGET_BYTES = int(4.5 * 1024**3)
+
+ENV_OVERRIDE = "TPU_MEMORY_BUDGET_BYTES"
+
+
+def device_memory_budget(device=None) -> Optional[int]:
+    """Bytes of accelerator memory this process can realistically use for
+    model state, or ``None`` when unknown (no check is then possible).
+
+    Sources, most authoritative first:
+    1. ``TPU_MEMORY_BUDGET_BYTES`` env var — operator override.
+    2. ``device.memory_stats()['bytes_limit']`` — real TPU/GPU runtimes.
+    3. The axon relay's measured executable live-set ceiling.
+    CPU devices return None (host RAM is not the scarce resource the
+    check exists for, and tests run there).
+    """
+    override = os.environ.get(ENV_OVERRIDE)
+    if override:
+        try:
+            return int(override)
+        except ValueError:
+            pass
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    if device.platform == "cpu":
+        return None
+    try:
+        stats = device.memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:  # pragma: no cover - backend-dependent
+        pass
+    if jax.default_backend() == "axon" or device.platform == "axon":
+        return AXON_RELAY_BUDGET_BYTES
+    return None
+
+
+def estimate_weight_bytes(
+    cfg, quantize: Optional[str], dtype_bytes: int = 2
+) -> int:
+    """Estimated HBM bytes of one model's parameters under the engine's
+    quantization rules (models/quantize.py): matmul weights at the mode's
+    width (int8 = 1 B, int4 = 0.5 B + f32 per-output-channel scales),
+    embeddings/lm_head at int8 in every quantized mode, norms and biases
+    at full precision.
+    """
+    d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+    experts = max(1, cfg.n_experts)
+
+    embed_params = cfg.vocab_size * d * (1 if cfg.tie_embeddings else 2)
+    matmul_per_layer = (
+        d * hq * dh  # wq
+        + 2 * d * hkv * dh  # wk, wv
+        + hq * dh * d  # wo
+        + 3 * d * f * experts  # gate, up, down
+        + (d * cfg.n_experts if cfg.n_experts else 0)  # router
+    )
+    matmul_out_channels = (
+        hq * dh + 2 * hkv * dh + d + (2 * f + d) * experts
+    )  # scale entries per layer (per output channel)
+    norms_biases = 2 * l * d + d  # attn/mlp norms + final norm
+    if cfg.qkv_bias:
+        norms_biases += l * (hq * dh + 2 * hkv * dh)
+
+    if quantize is None:
+        return dtype_bytes * (
+            embed_params + l * matmul_per_layer + norms_biases
+        )
+    weight_b = 1.0 if quantize == "int8" else 0.5
+    return int(
+        embed_params  # int8 in both modes
+        + 4 * cfg.vocab_size  # per-row embed scales (f32)
+        + l * matmul_per_layer * weight_b
+        + 4 * l * matmul_out_channels  # per-output-channel scales (f32)
+        + dtype_bytes * norms_biases
+    )
+
+
+class ModelMemoryError(RuntimeError):
+    """A model's estimated weight bytes exceed the probed device budget."""
+
+    def __init__(self, model: str, estimated: int, budget: int, hint: str) -> None:
+        super().__init__(
+            f"{model}: estimated weight footprint "
+            f"{estimated / 1024**3:.2f} GiB exceeds the device budget "
+            f"{budget / 1024**3:.2f} GiB — {hint} "
+            f"(override the probed budget with {ENV_OVERRIDE}=<bytes>)"
+        )
+        self.model = model
+        self.estimated = estimated
+        self.budget = budget

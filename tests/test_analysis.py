@@ -216,3 +216,72 @@ def test_paper_reproduction_matches_survey_baseline():
     ).mean
     assert 3.0 < ratio_short < 4.0
     assert 8.0 < ratio_long < 10.0
+
+
+def test_descriptives_cv():
+    d = descriptives([10.0, 10.5, 9.5, 10.0])
+    assert d.cv == pytest.approx(d.sd / d.mean)
+    assert math.isnan(descriptives([]).cv)
+
+
+def test_skewness_detects_asymmetry():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.analysis.stats import (
+        skewness,
+    )
+
+    sym = [float(x) for x in range(-50, 51)]
+    assert abs(skewness(sym)) < 1e-9
+    skewed = [math.exp(x / 10.0) for x in range(100)]
+    assert skewness(skewed) > 1.0
+
+
+def test_variance_check_reports_cells_and_verdict():
+    rows = _synthetic_rows(n_per_cell=12)
+    report = analyze(rows)
+    vc = report["variance_check"]
+    # uniform(0.9, 1.1) noise → CV ≈ 5.8% > 5% target on at least some cells
+    assert vc["target_cv"] == 0.05
+    assert vc["n_cells"] == 4  # 1 model × 2 locations × 2 lengths
+    assert vc["verdict"] in ("pass", "fail")
+    assert vc["worst"]["cell"] in vc["cells"]
+    md = render_markdown(report)
+    assert "Run-to-run variance" in md
+    # tight synthetic data: verdict should actually pass when noise is small
+    tight = _synthetic_rows(n_per_cell=12)
+    for r in tight:
+        r["energy_J"] = 100.0 if r["location"] == "on_device" else 50.0
+    vc2 = analyze(tight)["variance_check"]
+    assert vc2["verdict"] == "pass"
+
+
+def test_skewness_transform_step_in_report():
+    rows = _synthetic_rows(n_per_cell=15)
+    # make one subset strongly right-skewed so the log-transform step fires
+    for r in rows:
+        if r["location"] == "on_device" and r["length"] == 100:
+            r["energy_J"] = math.exp(r["cpu_usage"]) * 10
+    report = analyze(rows, iqr_k=100.0)  # keep the skewed tail in
+    skew = report["skewness"]["on_device|100"]
+    assert skew["skew"] > 1
+    assert "skew_log" in skew and abs(skew["skew_log"]) < abs(skew["skew"])
+    assert "Skewness" in render_markdown(report)
+
+
+def test_density_and_panel_plots_written(tmp_path):
+    pytest.importorskip("matplotlib")
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.analysis.plots import (
+        density_by,
+        plot_experiment,
+        violin_panel_by_model,
+    )
+
+    rows = _synthetic_rows(n_per_cell=10)
+    assert density_by(rows, "energy_J", "location", tmp_path / "d.png")
+    assert (tmp_path / "d.png").exists()
+    assert violin_panel_by_model(rows, "energy_J", tmp_path / "p.png")
+    assert (tmp_path / "p.png").exists()
+    written = plot_experiment(rows, tmp_path / "all")
+    names = {p.name for p in written}
+    assert "density_energy_J_by_location.png" in names
+    assert "violin_energy_J_per_model.png" in names
+    assert "qq_energy_J.png" in names

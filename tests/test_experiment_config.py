@@ -148,3 +148,93 @@ def test_remote_runs_do_not_poison_on_device_chip_count(tmp_path):
     assert cfg.profilers[idx].n_chips == 1  # failed when read from the alias
     cfg.before_run(ctx("remote"))
     assert cfg.profilers[idx].n_chips == 8
+
+
+def test_backend_column_recorded_per_run(tmp_path):
+    config = _hermetic_config(tmp_path)
+    ExperimentController(config, echo=False).do_experiment()
+    rows = RunTableStore(tmp_path / "llm_energy_tpu").read()
+    assert all(row["backend"] for row in rows)
+    # both treatments are served by the same FakeBackend object → remote
+    # rows must be flagged as aliased so nobody mistakes them for a real
+    # machine boundary
+    for row in rows:
+        if row["location"] == "remote":
+            assert "aliased-on_device" in row["backend"]
+        else:
+            assert "aliased" not in row["backend"]
+
+
+def test_describe_backend_for_http_and_engine():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
+        RemoteHTTPBackend,
+    )
+
+    cfg = LlmEnergyConfig(
+        models=["m"],
+        lengths=[100],
+        repetitions=1,
+        backends={
+            "on_device": FakeBackend(),
+            "remote": RemoteHTTPBackend("http://10.0.0.5:11434"),
+        },
+    )
+    assert cfg.describe_backend("on_device") == "FakeBackend[1chip]"
+    assert cfg.describe_backend("remote") == "http:http://10.0.0.5:11434"
+
+
+def test_energy_channels_report_written(tmp_path):
+    config = _hermetic_config(tmp_path)
+    ExperimentController(config, echo=False).do_experiment()
+    import json
+
+    path = tmp_path / "llm_energy_tpu" / "energy_channels.json"
+    assert path.exists()
+    payload = json.loads(path.read_text())
+    assert {c["name"] for c in payload["channels"]} >= {"rapl", "hwmon"}
+
+
+def test_on_device_url_builds_http_backend_and_checks_health(tmp_path):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+        FakeBackend as FB,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server import (
+        GenerationServer,
+    )
+
+    srv = GenerationServer(FB(), host="127.0.0.1", port=0, quiet=True)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        cfg = LlmEnergyConfig(
+            models=["m"],
+            lengths=[100],
+            repetitions=1,
+            results_output_path=tmp_path,
+            on_device_url=url,
+            remote_url=url,
+        )
+        cfg.experiment_path = tmp_path / "exp"
+        cfg.before_experiment()
+        assert cfg.describe_backend("on_device") == f"http:{url}"
+        # same URL for both → remote is a distinct client object, NOT aliased
+        assert cfg.describe_backend("remote") == f"http:{url}"
+    finally:
+        srv.stop()
+
+
+def test_on_device_url_unreachable_fails_fast(tmp_path):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.errors import (
+        ExperimentError,
+    )
+
+    cfg = LlmEnergyConfig(
+        models=["m"],
+        lengths=[100],
+        repetitions=1,
+        results_output_path=tmp_path,
+        on_device_url="http://127.0.0.1:9",  # discard port: nothing listens
+    )
+    cfg.experiment_path = tmp_path / "exp"
+    with pytest.raises(ExperimentError, match="unreachable"):
+        cfg.before_experiment()

@@ -118,3 +118,140 @@ def test_rapl_wraparound_corrected(tmp_path):
     (dom / "energy_uj").write_text("1000000")  # wrapped: +2 J given 10 J range
     prof.on_stop(ctx)
     assert prof.collect(ctx)["host_energy_J"] == 2.0
+
+
+# -- energy model validation against known power states ----------------------
+# VERDICT.md round-1 item 1: with no measured channel on this host, pin the
+# model's coefficients and its integration against the chip's known draw
+# states so modelled Joules are at least *calibrated*, not arbitrary.
+
+
+def test_energy_model_pinned_to_v5e_power_envelope(tmp_path):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.tpu import (
+        V5E_IDLE_W,
+        V5E_PEAK_BF16_TFLOPS,
+        V5E_PEAK_W,
+        TpuEnergyModelProfiler,
+    )
+
+    # public v5e figures the model is built on; changing them silently
+    # would re-scale every shipped energy number
+    assert V5E_PEAK_BF16_TFLOPS == 394.0
+    assert V5E_IDLE_W == 55.0
+    assert V5E_PEAK_W == 200.0
+
+    prof = TpuEnergyModelProfiler()
+    ctx = _ctx(tmp_path)
+
+    # idle state: zero achieved FLOPs → exactly idle power × duration
+    ctx.scratch["generation_stats"] = {
+        "flops": 0.0, "duration_s": 2.0, "generated_tokens": 10,
+    }
+    out = prof.collect(ctx)
+    assert out["energy_model_J"] == V5E_IDLE_W * 2.0
+    assert out["tpu_util_est"] == 0.0
+
+    # saturated state: achieved == peak FLOP/s → exactly peak power
+    ctx.scratch["generation_stats"] = {
+        "flops": V5E_PEAK_BF16_TFLOPS * 1e12 * 2.0,
+        "duration_s": 2.0,
+        "generated_tokens": 10,
+    }
+    out = prof.collect(ctx)
+    assert out["energy_model_J"] == V5E_PEAK_W * 2.0
+    assert out["tpu_util_est"] == 1.0
+
+    # any workload: average power must stay inside [idle, peak] — the model
+    # can never emit a physically impossible draw
+    for flops in (1e9, 1e12, 1e15, 1e18):
+        ctx.scratch["generation_stats"] = {
+            "flops": flops, "duration_s": 0.5, "generated_tokens": 64,
+        }
+        power = prof.collect(ctx)["energy_model_J"] / 0.5
+        assert V5E_IDLE_W <= power <= V5E_PEAK_W
+
+
+def test_energy_model_on_bench_workload_is_plausible(tmp_path):
+    """The shipped BENCH decode (qwen2:1.5b, 256 tokens, ~0.95 s) must land
+    at a plausible J/token: between pure-idle and pure-peak bounds."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.tpu import (
+        V5E_IDLE_W,
+        V5E_PEAK_W,
+        TpuEnergyModelProfiler,
+    )
+
+    cfg = get_model_config("qwen2:1.5b")
+    tokens, duration = 256, 0.95
+    flops = cfg.flops_per_token(64 + tokens) * tokens
+    ctx = _ctx(tmp_path)
+    ctx.scratch["generation_stats"] = {
+        "flops": flops, "duration_s": duration, "generated_tokens": tokens,
+    }
+    out = TpuEnergyModelProfiler().collect(ctx)
+    assert V5E_IDLE_W * duration <= out["energy_model_J"] <= V5E_PEAK_W * duration
+    jpt = out["joules_per_token"]
+    assert V5E_IDLE_W * duration / tokens <= jpt <= V5E_PEAK_W * duration / tokens
+    # decode is bandwidth-bound: estimated MXU utilisation must be low
+    assert out["tpu_util_est"] < 0.05
+
+
+# -- energy channel probe -----------------------------------------------------
+
+
+def test_probe_energy_channels_covers_all_sources():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.energy_probe import (
+        probe_energy_channels,
+    )
+
+    statuses = probe_energy_channels()
+    assert {s.name for s in statuses} == {
+        "rapl", "hwmon", "battery", "tpu_info", "libtpu_monitoring",
+    }
+    for s in statuses:
+        assert s.kind in ("energy", "power", "utilization")
+        assert s.scope in ("host", "device")
+        assert s.detail  # every unavailable channel says WHY
+
+
+def test_write_probe_report(tmp_path):
+    import json as _json
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.energy_probe import (
+        write_probe_report,
+    )
+
+    path = tmp_path / "energy_channels.json"
+    statuses = write_probe_report(path)
+    payload = _json.loads(path.read_text())
+    assert len(payload["channels"]) == len(statuses)
+    assert isinstance(payload["any_measured_energy"], bool)
+    assert "modelled" in payload["note"]
+
+
+def test_duty_cycle_profiler_summarises_trace(tmp_path, monkeypatch):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers import (
+        energy_probe,
+    )
+
+    prof = energy_probe.TpuDutyCycleProfiler(
+        period_s=0.01, peak_w=200.0, idle_w=50.0
+    )
+    monkeypatch.setattr(
+        energy_probe.TpuDutyCycleProfiler,
+        "_read_duty",
+        staticmethod(lambda: (50.0, 1)),
+    )
+    ctx = _ctx(tmp_path)
+    prof.on_start(ctx)
+    time.sleep(0.12)
+    prof.on_stop(ctx)
+    out = prof.collect(ctx)
+    assert out["tpu_duty_cycle_pct"] == 50.0
+    span = 0.12  # approximate window
+    # P = 50 + 0.5·150 = 125 W over ~span seconds
+    assert abs(out["energy_duty_J"] - 125.0 * span) < 125.0 * span  # loose
+    assert out["energy_duty_J"] > 0
+    assert (ctx.run_dir / "tpu_duty_cycle.csv").exists()

@@ -2,6 +2,8 @@
 
 import os
 
+import pytest
+
 from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.env import (
     load_dotenv,
     parse_dotenv,
@@ -38,3 +40,121 @@ def test_load_dotenv_respects_existing(tmp_path, monkeypatch):
 
 def test_load_dotenv_missing_file(tmp_path):
     assert load_dotenv(tmp_path / "nope.env") == {}
+
+
+# -- memory budget / weight estimation ---------------------------------------
+
+
+def test_estimate_weight_bytes_matches_actual_quantized_params():
+    """The fail-fast estimate must track what quantize_params actually
+    allocates (within a couple of %, scales included)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+        params_nbytes,
+        quantize_params,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+        init_params,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        estimate_weight_bytes,
+    )
+
+    for base in ("qwen2:1.5b", "gemma:2b"):
+        cfg = get_model_config(base).tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        for mode in (None, "int8", "int4"):
+            actual = params_nbytes(
+                quantize_params(params, mode=mode) if mode else params
+            )
+            est = estimate_weight_bytes(cfg, mode, dtype_bytes=4)
+            assert abs(est - actual) / actual < 0.03, (base, mode, est, actual)
+
+
+def test_load_model_fails_fast_when_over_budget(monkeypatch):
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        ModelMemoryError,
+    )
+
+    monkeypatch.setenv("TPU_MEMORY_BUDGET_BYTES", "1000")
+    engine = JaxEngine(
+        registry={"tiny": get_model_config("qwen2:1.5b").tiny()},
+        dtype=jnp.float32,
+    )
+    with pytest.raises(ModelMemoryError) as exc_info:
+        engine.load_model("tiny")
+    msg = str(exc_info.value)
+    # actionable: both numbers, a remedy, and the override knob
+    assert "GiB" in msg and "quantize" in msg and "TPU_MEMORY_BUDGET_BYTES" in msg
+    assert "tiny" not in engine._models
+
+
+def test_memory_budget_unknown_on_cpu(monkeypatch):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        device_memory_budget,
+    )
+
+    monkeypatch.delenv("TPU_MEMORY_BUDGET_BYTES", raising=False)
+    assert device_memory_budget() is None  # tests run on CPU devices
+
+
+# -- persistent compilation cache --------------------------------------------
+
+
+def test_enable_compilation_cache_configures_jax(tmp_path):
+    import jax
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        used = enable_compilation_cache(tmp_path / "cache")
+        assert used.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(used)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_load_model_budget_counts_resident_models(monkeypatch):
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        ModelMemoryError,
+        estimate_weight_bytes,
+    )
+
+    cfg_a = get_model_config("qwen2:1.5b").tiny()
+    cfg_b = get_model_config("gemma:2b").tiny()
+    one = estimate_weight_bytes(cfg_a, None, 4)
+    # budget fits one resident model plus half of the second — the second
+    # load must fail BECAUSE of the resident one
+    monkeypatch.setenv("TPU_MEMORY_BUDGET_BYTES", str(int(1.5 * one)))
+    engine = JaxEngine(
+        registry={"a": cfg_a, "b": cfg_b}, dtype=jnp.float32
+    )
+    engine.load_model("a")
+    with pytest.raises(ModelMemoryError, match="already resident"):
+        engine.load_model("b")
+    engine.unload_all()
+    engine.load_model("b")  # fits alone once the first is unloaded
