@@ -140,13 +140,24 @@ class JaxEngine(GenerationBackend):
         decode_attention: "str | DecodeAttentionFn | None" = None,
         seed: int = 0,
         weight_cache_dir: "Optional[str]" = None,
-        quantize: Optional[str] = None,  # None | "int8" (weight-only)
+        quantize: "str | Dict[str, Optional[str]] | None" = None,
         hf_checkpoints: Optional[Dict[str, str]] = None,
         prefill_attention: "str | PrefillAttentionFn | None" = "auto",
         speculative: "Optional[Dict[str, Tuple[str, int]]]" = None,
         prefix_cache_size: int = 0,  # cached prompt-KV entries per model
     ) -> None:
-        if quantize not in (None, "int8", "int4"):
+        # quantize: one mode for every model (None | "int8" | "int4"), or a
+        # per-model dict {model: mode} with an optional "default" key — a
+        # sweep can then serve small models at int8 (speed) and large ones
+        # at int4 (capacity) from ONE engine, like Ollama's per-model GGUF
+        # quant choices.
+        if isinstance(quantize, dict):
+            for name, mode in quantize.items():
+                if mode not in (None, "int8", "int4"):
+                    raise ValueError(
+                        f"unsupported quantize mode for {name!r}: {mode!r}"
+                    )
+        elif quantize not in (None, "int8", "int4"):
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
         if prefix_cache_size < 0:
             raise ValueError(
@@ -206,6 +217,12 @@ class JaxEngine(GenerationBackend):
         return None
 
     # -- model management -----------------------------------------------------
+    def _quant_mode(self, model: str) -> Optional[str]:
+        """The weight-quantization mode for ``model`` (see ctor)."""
+        if isinstance(self.quantize, dict):
+            return self.quantize.get(model, self.quantize.get("default"))
+        return self.quantize
+
     def load_model(self, model: str) -> None:
         if model in self._models:
             return
@@ -215,6 +232,7 @@ class JaxEngine(GenerationBackend):
             else get_model_config(model)
         )
         self._check_memory_budget(model, cfg)
+        quant_mode = self._quant_mode(model)
         t0 = time.monotonic()
         ckpt_dir = self.hf_checkpoints.get(model)
         if ckpt_dir is not None:
@@ -231,7 +249,7 @@ class JaxEngine(GenerationBackend):
 
                 return init_params(cfg, jax.random.PRNGKey(self.seed), self.dtype)
 
-        if self.quantize is None:
+        if quant_mode is None:
             make_params = make_full
         elif ckpt_dir is None:
 
@@ -253,7 +271,7 @@ class JaxEngine(GenerationBackend):
                         key,
                         self.dtype,
                         post=lambda name, leaf: quantize_leaf(
-                            name, leaf, self.quantize
+                            name, leaf, quant_mode
                         ),
                     )
 
@@ -271,7 +289,7 @@ class JaxEngine(GenerationBackend):
 
                 cpu = jax.devices("cpu")[0]
                 with jax.default_device(cpu):
-                    p = quantize_params(make_full(), mode=self.quantize)
+                    p = quantize_params(make_full(), mode=quant_mode)
                 # device_put with no target is an identity for arrays
                 # already committed to a device — name the accelerator.
                 return jax.device_put(p, jax.devices()[0])
@@ -293,7 +311,7 @@ class JaxEngine(GenerationBackend):
             )
             fingerprint = hashlib.sha256(
                 f"{cfg!r}|{jnp.dtype(self.dtype).name}|{source}"
-                f"|quant:{self.quantize}".encode()
+                f"|quant:{quant_mode}".encode()
             ).hexdigest()[:12]
             params = self._weight_cache.get_or_init(
                 model, self.seed, make_params, fingerprint=fingerprint
@@ -322,18 +340,29 @@ class JaxEngine(GenerationBackend):
             return
         n_dev = max(1, getattr(self, "n_devices", 1))
         dtype_b = jnp.dtype(self.dtype).itemsize
-        # A sharded engine (TP) splits the weights over its mesh; models
-        # already resident in HBM count against the budget too — a 7-model
-        # sweep accumulates unless the workload unloads between models.
-        est = estimate_weight_bytes(cfg, self.quantize, dtype_b) // n_dev
-        resident = sum(
-            estimate_weight_bytes(tf.cfg, self.quantize, dtype_b) // n_dev
-            for tf in self._models.values()
+        mode = self._quant_mode(model)
+        # A sharded engine (TP) splits the weights over its mesh. Against
+        # an allocation-scoped budget (real HBM), models already resident
+        # count too — a 7-model sweep accumulates unless the workload
+        # unloads between models. A program-scoped budget (the axon relay's
+        # executable live-set ceiling) sees one model per decode program,
+        # so residency is free there.
+        est = estimate_weight_bytes(cfg, mode, dtype_b) // n_dev
+        resident = (
+            0
+            if budget.per_program
+            else sum(
+                estimate_weight_bytes(
+                    tf.cfg, self._quant_mode(name), dtype_b
+                )
+                // n_dev
+                for name, tf in self._models.items()
+            )
         )
-        if est + resident > budget:
-            if self.quantize is None:
+        if est + resident > budget.bytes:
+            if mode is None:
                 hint = "quantize (int8 halves, int4 quarters the bytes)"
-            elif self.quantize == "int8":
+            elif mode == "int8":
                 hint = "quantize to int4 or shard over a mesh (TensorParallelEngine)"
             else:
                 hint = "shard over more devices (tensor/pipeline parallelism)"
@@ -342,7 +371,42 @@ class JaxEngine(GenerationBackend):
                     f"; or unload_all() first ({len(self._models)} models, "
                     f"~{resident / 1024**3:.2f} GiB, already resident)"
                 )
-            raise ModelMemoryError(model, est + resident, budget, hint)
+            raise ModelMemoryError(model, est + resident, budget.bytes, hint)
+
+    def install_model(
+        self, model: str, cfg: ModelConfig, params: Dict[str, Any]
+    ) -> None:
+        """Serve externally produced weights (a trained checkpoint from
+        ``parallel.train`` / ``models.tiny_lm``, or any converted pytree)
+        under ``model`` — the engine-side analogue of dropping a model into
+        Ollama's store. Applies the engine's quantization mode, registers
+        the config, and skips ``load_model``'s init path entirely.
+        Re-installing an existing name evicts every cache derived from the
+        old weights/config (prefix KV, compiled fns, warm markers)."""
+        self._check_memory_budget(model, cfg)
+        self._evict_model_state(model)
+        mode = self._quant_mode(model)
+        if mode is not None:
+            from ..models.quantize import quantize_params
+
+            params = quantize_params(params, mode=mode)
+        self.registry[model] = cfg
+        self._models[model] = Transformer(cfg=cfg, params=params)
+
+    def _evict_model_state(self, model: str) -> None:
+        """Drop every per-model derivative: compiled prefill/decode fns
+        (their closures capture the old cfg/eos), prefix-cache KV (computed
+        from the old weights), warm markers, the tokenizer, and the model
+        itself. Keys are tuples whose elements include the model name
+        (plain, 'batch'- and 'spec'-prefixed; spec entries also name the
+        draft)."""
+        self._models.pop(model, None)
+        self._tokenizers.pop(model, None)
+        self._prefix_cache.pop(model, None)
+        for cache in (self._prefill_cache, self._decode_cache):
+            for key in [k for k in cache if model in k]:
+                del cache[key]
+        self._warmed = {k for k in self._warmed if model not in k}
 
     def unload_all(self) -> None:
         self._models.clear()

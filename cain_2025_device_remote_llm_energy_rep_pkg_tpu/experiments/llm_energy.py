@@ -109,8 +109,6 @@ class LlmEnergyConfig(ExperimentConfig):
         self._n_chips_by_location = dict(
             n_chips_by_location or {"on_device": 1, "remote": 8}
         )
-        counter = TpuPowerCounterProfiler()
-        from ..profilers.energy_probe import TpuDutyCycleProfiler
         from ..profilers.native_host import NativeHostProfiler
 
         self.profilers = [
@@ -123,11 +121,18 @@ class LlmEnergyConfig(ExperimentConfig):
             # the native library can't build or load at runtime
             NativeHostProfiler(period_us=1000),
         ]
-        if counter.available:  # real counters, when the platform has them
-            self.profilers.insert(0, counter)
-        duty = TpuDutyCycleProfiler()
-        if duty.available:  # measured duty cycle (standard TPU VMs)
-            self.profilers.insert(0, duty)
+        # Device-touching profilers only when this process owns (or will
+        # own) the accelerator — in HTTP-client mode a libtpu query could
+        # block on the device grant held by the serving process.
+        if on_device_url is None:
+            from ..profilers.energy_probe import TpuDutyCycleProfiler
+
+            counter = TpuPowerCounterProfiler()
+            if counter.available:  # real counters, when the platform has them
+                self.profilers.insert(0, counter)
+            duty = TpuDutyCycleProfiler()
+            if duty.available:  # measured duty cycle (standard TPU VMs)
+                self.profilers.insert(0, duty)
 
     # -- run table ------------------------------------------------------------
     def create_run_table_model(self) -> RunTableModel:
@@ -156,10 +161,13 @@ class LlmEnergyConfig(ExperimentConfig):
     def before_experiment(self) -> None:
         # Persistent XLA compilation cache: a sweep's per-(model, bucket)
         # warm-up compiles (~20-45 s each) hit disk after the first run, so
-        # resume/re-runs warm in seconds (VERDICT.md round-1 item 7).
-        from ..utils.compile_cache import enable_compilation_cache
+        # resume/re-runs warm in seconds (VERDICT.md round-1 item 7). In
+        # HTTP-client mode the server compiles, not this process — keep the
+        # client JAX-free.
+        if self._on_device_url is None:
+            from ..utils.compile_cache import enable_compilation_cache
 
-        enable_compilation_cache()
+            enable_compilation_cache()
         # Audit trail for the energy columns: which measured channels this
         # host offers and why the unavailable ones are unavailable
         # (VERDICT.md round-1 item 1 — a modelled-only table must say so).
@@ -168,7 +176,8 @@ class LlmEnergyConfig(ExperimentConfig):
             from ..runner import term
 
             statuses = write_probe_report(
-                Path(self.experiment_path) / "energy_channels.json"
+                Path(self.experiment_path) / "energy_channels.json",
+                include_device=self._on_device_url is None,
             )
             measured = [s.name for s in statuses if s.available]
             term.log(
@@ -342,6 +351,15 @@ class LlmEnergyConfig(ExperimentConfig):
         result = context.scratch.get("result")
         if result is None:
             return None
+        # Per-run artifact: the generated text itself (the reference keeps
+        # raw measurement artifacts per run dir; the generation is this
+        # study's raw output, and with trained weights it is readable).
+        try:
+            (context.run_dir / "generation.txt").write_text(
+                f"prompt: {result.request.prompt}\n---\n{result.text}\n"
+            )
+        except OSError:
+            pass
         return {
             "topic": context.scratch["topic"],
             "backend": self.describe_backend(context.factor("location")),

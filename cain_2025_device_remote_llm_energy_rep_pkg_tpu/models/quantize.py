@@ -27,12 +27,15 @@ tok/s → int8 325 tok/s (XLA fuses the int8→bf16 scale-multiply into the
 matmul, so the HBM read genuinely halves). int4 through plain XLA does
 NOT fuse the nibble unpack (weights materialise per step, ~40 tok/s);
 decode-shaped int4 matmuls therefore route through the Pallas kernel in
-``ops/pallas_quant.py`` (unpack in VMEM after the packed DMA) → 233
-tok/s. int4 stays VPU-bound on the per-step nibble expansion, so its role
-is *capacity* — llama3.1:8b-class models on one 16 GB chip (int8 ~8.6 GB,
-int4 ~4.8 GB incl. int8 embeddings) — while int8 is the speed mode;
-native S4 storage would lift this but cannot cross the jit boundary on
-this TPU stack. Note the development relay only executes programs with a
+``ops/pallas_quant.py`` (unpack in VMEM after the packed DMA) → 279
+tok/s with bf16 MXU dots and divisor-aligned k-blocks (was 233 with f32
+dots + per-block tail masking). int4 remains VPU-bound on the nibble
+expansion (~5 VPU ops per packed byte ≈ 3.3 ms/step — arithmetic and
+measurement agree); a narrower unpack needs i8 elementwise ops Mosaic
+does not yet legalize (scripts/w4a8_probe.py records the attempt), so
+int4's role is *capacity* — llama3.1:8b-class models on one 16 GB chip
+(int8 ~8.6 GB, int4 ~4.8 GB incl. int8 embeddings) — while int8 is the
+speed mode. Note the development relay only executes programs with a
 ~4.5 GB live set (measured by layer-count bisection; raw allocations
 overcommit), so 7B/8B single-chip serving is validated there up to
 16-layer slices — full-size fits real 16 GB chips by the same

@@ -59,6 +59,7 @@ def _int4_matmul_kernel(
     in_half: int,
     in_half_pad: int,
     n_k_blocks: int,
+    masked_tail: bool,
 ):
     k = pl.program_id(1)
 
@@ -67,18 +68,24 @@ def _int4_matmul_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     p = p_ref[...].astype(jnp.int32)
-    # The tail block can extend past the packed array's rows (block_k need
-    # not divide in_half); its out-of-bounds content is unspecified, so
-    # mask rows beyond the valid count. x needs no mask: the wrapper pads
-    # it with zeros to in_half_pad per half, keeping rows aligned.
-    rows_valid = in_half - k * block_k
-    row = jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
-    p = jnp.where(row < rows_valid, p, 0)
-    # sign-extend the two 4-bit planes (arithmetic shifts on int32)
-    lo = jnp.right_shift(jnp.left_shift(p, 28), 28).astype(jnp.float32)
-    hi = jnp.right_shift(p, 4).astype(jnp.float32)
-    xl = x_ref[:, pl.ds(k * block_k, block_k)].astype(jnp.float32)
-    xh = x_ref[:, pl.ds(in_half_pad + k * block_k, block_k)].astype(jnp.float32)
+    if masked_tail:
+        # Only reachable when no block_k divides in_half (rare awkward
+        # dims): the tail block extends past the packed rows and its
+        # out-of-bounds content is unspecified. The divisible fast path
+        # skips these three VPU ops per element entirely.
+        rows_valid = in_half - k * block_k
+        row = jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+        p = jnp.where(row < rows_valid, p, 0)
+    # Sign-extend the two 4-bit planes (arithmetic shifts) and dot in
+    # bfloat16: the MXU runs bf16×bf16→f32 at full rate where an f32 dot
+    # takes multiple passes, and 4-bit weights are exact in bf16 (|w|≤7),
+    # so this loses no precision over the f32-operand version while
+    # cutting both the convert cost and the MXU time. This unpack is the
+    # kernel's VPU budget — keep it at 3 shifts + 2 converts per byte.
+    lo = jnp.right_shift(jnp.left_shift(p, 28), 28).astype(jnp.bfloat16)
+    hi = jnp.right_shift(p, 4).astype(jnp.bfloat16)
+    xl = x_ref[:, pl.ds(k * block_k, block_k)].astype(jnp.bfloat16)
+    xh = x_ref[:, pl.ds(in_half_pad + k * block_k, block_k)].astype(jnp.bfloat16)
     dims = (((1,), (0,)), ((), ()))
     acc_ref[...] += jax.lax.dot_general(
         xl, lo, dims, preferred_element_type=jnp.float32
@@ -113,11 +120,23 @@ def int4_matmul(
             f"shape (m={m}, in_half={in_half}, out={out_dim}) outside the "
             "kernel envelope; use the XLA dequant path"
         )
-    # Blocks need not divide the array dims: the k-tail is masked in-kernel
-    # and the n-tail's out-of-bounds output region is discarded by Pallas,
-    # so both block sizes stay large for awkward dims (d_ff 8960 = 2^8·35
-    # would otherwise force 256-wide blocks and ~630 grid steps).
-    block_k = min(256, _pick_block(in_half, 256) if in_half < 256 else 256)
+    # Prefer a block_k that DIVIDES in_half: the kernel then skips tail
+    # masking, three fewer VPU ops per packed element on every block. Fall
+    # back to a masked tail only for dims with no such divisor. The n-tail's
+    # out-of-bounds output region is discarded by Pallas either way, so
+    # block_n stays large for awkward dims (d_ff 8960 = 2^8·35 would
+    # otherwise force 256-wide blocks and ~630 grid steps).
+    # block_k must keep the x-slice offsets lane-aligned (Mosaic: dim-1
+    # vector loads start at multiples of 128), so candidates are multiples
+    # of 128; up to 1024 keeps the p tile ≤ 512 KB of VMEM.
+    block_k = 0
+    for cand in range(128 * (min(1024, in_half) // 128), 127, -128):
+        if in_half % cand == 0:
+            block_k = cand
+            break
+    masked_tail = block_k == 0
+    if masked_tail:
+        block_k = min(256, _pick_block(in_half, 256) if in_half < 256 else 256)
     block_n = 512 if out_dim >= 512 else _pick_block(out_dim, 512)
     n_k_blocks = -(-in_half // block_k)
     in_half_pad = n_k_blocks * block_k
@@ -135,6 +154,7 @@ def int4_matmul(
         in_half=in_half,
         in_half_pad=in_half_pad,
         n_k_blocks=n_k_blocks,
+        masked_tail=masked_tail,
     )
     out = pl.pallas_call(
         kernel,

@@ -151,22 +151,36 @@ def _probe_libtpu_monitoring() -> ChannelStatus:
     )
 
 
-def probe_energy_channels() -> List[ChannelStatus]:
-    """Probe every channel; never raises."""
-    return [
+def probe_energy_channels(include_device: bool = True) -> List[ChannelStatus]:
+    """Probe every channel; never raises. ``include_device=False`` skips
+    the accelerator-touching probes — required in an HTTP-client experiment
+    process whose serving process owns the chip (a libtpu query here could
+    block on the device grant)."""
+    statuses = [
         _probe_rapl(),
         _probe_hwmon(),
         _probe_battery(),
-        _probe_tpu_info(),
-        _probe_libtpu_monitoring(),
     ]
+    if include_device:
+        statuses += [_probe_tpu_info(), _probe_libtpu_monitoring()]
+    else:
+        skip = "skipped: a separate serving process owns the accelerator"
+        statuses += [
+            ChannelStatus("tpu_info", "power", "device", False, skip),
+            ChannelStatus(
+                "libtpu_monitoring", "utilization", "device", False, skip
+            ),
+        ]
+    return statuses
 
 
-def write_probe_report(path: Path) -> List[ChannelStatus]:
+def write_probe_report(
+    path: Path, include_device: bool = True
+) -> List[ChannelStatus]:
     """Probe and persist ``energy_channels.json`` next to the run table, so
     a modelled-only table is auditable (which channels were tried, why each
     was unavailable)."""
-    statuses = probe_energy_channels()
+    statuses = probe_energy_channels(include_device=include_device)
     payload = {
         "channels": [s.as_dict() for s in statuses],
         "any_measured_energy": any(
@@ -211,9 +225,16 @@ class TpuDutyCycleProfiler:
     def __init__(
         self,
         period_s: float = 0.25,
-        peak_w: float = 200.0,
-        idle_w: float = 55.0,
+        peak_w: Optional[float] = None,
+        idle_w: Optional[float] = None,
     ) -> None:
+        # Default to the SAME pinned envelope as the energy model
+        # (profilers/tpu.py) so energy_duty_J and energy_model_J are
+        # directly comparable; a recalibration there propagates here.
+        from .tpu import V5E_IDLE_W, V5E_PEAK_W
+
+        peak_w = V5E_PEAK_W if peak_w is None else peak_w
+        idle_w = V5E_IDLE_W if idle_w is None else idle_w
         from .base import SamplingProfiler
 
         # Composition over inheritance so importing this module never pulls

@@ -125,15 +125,16 @@ commands:
                        run_table.csv, writing analysis_report.{json,md} + plots
   prepare              validate the environment (JAX devices, RAPL access)
   serve [opts]         start the HTTP generation server (the framework-native
-                       Ollama-equivalent): --port N (default 11434),
+                       Ollama-equivalent): --host H --port N (default 11434),
                        --backend jax|jax-tp|fake, --tp N, --models a,b,c,
                        --batch-window-ms W --max-batch B (continuous batching
                        of concurrent requests; off by default),
                        --hf model=/ckpt/dir (serve trained weights + that
                        checkpoint's tokenizer; repeatable),
-                       --quantize int8|int4 (int8 for speed, int4 for HBM
-                       fit), --speculative target=draft[:k] (draft-verify),
-                       --prefix-cache N (reuse prompt-prefix KV, LRU of N)
+                       --quantize int8|int4|none or per-model
+                       "m1=int8,m2=int4,default=int8" (int8 for speed, int4
+                       for HBM fit), --speculative target=draft[:k]
+                       (draft-verify), --prefix-cache N (prompt-prefix KV LRU)
   help                 show this message
 """
 
@@ -143,6 +144,7 @@ def serve_command(args: List[str]) -> None:
     (reference: a separately-installed Ollama server on the remote host,
     README.md:29-31; here it is part of the framework)."""
     port = None
+    host = "0.0.0.0"
     backend_kind = "jax"
     tp = -1
     models: Optional[List[str]] = None
@@ -156,6 +158,8 @@ def serve_command(args: List[str]) -> None:
     for arg in it:
         if arg == "--port":
             port = int(next(it, "11434"))
+        elif arg == "--host":
+            host = next(it, "0.0.0.0")
         elif arg == "--backend":
             backend_kind = next(it, "jax")
         elif arg == "--tp":
@@ -176,7 +180,22 @@ def serve_command(args: List[str]) -> None:
             name, _, path = spec.partition("=")
             hf_checkpoints[name] = path
         elif arg == "--quantize":
-            quantize = next(it, "int8")
+            # "int8" | "int4" | "none" for every model, or a per-model
+            # spec "qwen2:1.5b=int8,phi3:3.8b=int4,default=int8" (model
+            # names may contain colons; '=' separates name from mode).
+            spec = next(it, "int8")
+            if "=" in spec:
+                quantize = {}
+                for entry in spec.split(","):
+                    name, _, mode = entry.partition("=")
+                    if not name or not mode:
+                        raise CommandError(
+                            "serve: --quantize per-model spec is "
+                            "model=mode[,model=mode...]"
+                        )
+                    quantize[name] = None if mode == "none" else mode
+            else:
+                quantize = None if spec == "none" else spec
         elif arg == "--speculative":
             # --speculative target=draft[:k] (repeatable): greedy requests
             # for `target` decode via draft-and-verify with k proposals.
@@ -206,6 +225,11 @@ def serve_command(args: List[str]) -> None:
     from ..serve.protocol import DEFAULT_PORT
     from ..serve.server import GenerationServer
 
+    if backend_kind != "fake":
+        # The serving process pays all jit compiles — persist them.
+        from ..utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache()
     if backend_kind == "fake":
         from ..engine.fake import FakeBackend
 
@@ -241,6 +265,7 @@ def serve_command(args: List[str]) -> None:
         models = sorted(MODEL_REGISTRY)
     server = GenerationServer(
         backend,
+        host=host,
         port=DEFAULT_PORT if port is None else port,
         models=models,
         batch_window_ms=batch_window_ms,

@@ -24,21 +24,39 @@ AXON_RELAY_BUDGET_BYTES = int(4.5 * 1024**3)
 ENV_OVERRIDE = "TPU_MEMORY_BUDGET_BYTES"
 
 
-def device_memory_budget(device=None) -> Optional[int]:
-    """Bytes of accelerator memory this process can realistically use for
-    model state, or ``None`` when unknown (no check is then possible).
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryBudget:
+    bytes: int
+    # True when the limit applies to one executed program's live set (the
+    # axon relay overcommits raw allocations but refuses programs whose
+    # live arrays exceed the ceiling): then only the model being loaded
+    # counts, because a decode program references a single model's
+    # weights. False for real HBM limits, where resident models
+    # accumulate against the budget.
+    per_program: bool = False
+
+
+def device_memory_budget(device=None) -> Optional[MemoryBudget]:
+    """The accelerator-memory budget for model state, or ``None`` when
+    unknown (no check is then possible).
 
     Sources, most authoritative first:
-    1. ``TPU_MEMORY_BUDGET_BYTES`` env var — operator override.
-    2. ``device.memory_stats()['bytes_limit']`` — real TPU/GPU runtimes.
-    3. The axon relay's measured executable live-set ceiling.
+    1. ``TPU_MEMORY_BUDGET_BYTES`` env var — operator override
+       (allocation-scoped).
+    2. ``device.memory_stats()['bytes_limit']`` — real TPU/GPU runtimes
+       (allocation-scoped: resident models accumulate).
+    3. The axon relay's measured executable live-set ceiling
+       (program-scoped: models swap per program, residency overcommits).
     CPU devices return None (host RAM is not the scarce resource the
     check exists for, and tests run there).
     """
     override = os.environ.get(ENV_OVERRIDE)
     if override:
         try:
-            return int(override)
+            return MemoryBudget(int(override), per_program=False)
         except ValueError:
             pass
     import jax
@@ -50,11 +68,11 @@ def device_memory_budget(device=None) -> Optional[int]:
     try:
         stats = device.memory_stats()
         if stats and stats.get("bytes_limit"):
-            return int(stats["bytes_limit"])
+            return MemoryBudget(int(stats["bytes_limit"]), per_program=False)
     except Exception:  # pragma: no cover - backend-dependent
         pass
     if jax.default_backend() == "axon" or device.platform == "axon":
-        return AXON_RELAY_BUDGET_BYTES
+        return MemoryBudget(AXON_RELAY_BUDGET_BYTES, per_program=True)
     return None
 
 

@@ -1,0 +1,45 @@
+"""Real-weights study cell: learned model, EOS-driven generation lengths.
+
+Run with:
+    python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu examples/llm_energy_real_weights.py
+
+The sweep's 7 reference families run from random-init weights (no egress,
+no checkpoints in this environment), which means generation always runs to
+its token budget. This cell closes that gap (VERDICT.md round-1 item 6)
+with the framework's own *trained* tiny LM (models/tiny_lm.py): the model
+learned an in-repo corpus and emits EOS on its own, so ``generated_tokens``
+varies per row and is below the budget, and the per-run artifacts contain
+readable text. Weights are trained once and checkpointed under the
+experiment output dir; re-runs restore them through Orbax.
+"""
+
+from pathlib import Path
+
+import jax.numpy as jnp
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import JaxEngine
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+    LlmEnergyConfig,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.tiny_lm import (
+    TINY_LM_NAME,
+    load_or_train_tiny_lm,
+)
+
+_CKPT_DIR = Path("experiments_output") / "tiny_lm_weights"
+
+_cfg, _params = load_or_train_tiny_lm(_CKPT_DIR, log_every=100)
+_ENGINE = JaxEngine(registry={}, dtype=jnp.float32)
+_ENGINE.install_model(TINY_LM_NAME, _cfg, _params)
+
+
+class RunnerConfig(LlmEnergyConfig):
+    def __init__(self):
+        super().__init__(
+            models=[TINY_LM_NAME],
+            lengths=[100],
+            repetitions=3,
+            cooldown_ms=500,
+            results_output_path=Path("experiments_output"),
+            backends={"on_device": _ENGINE, "remote": _ENGINE},
+        )

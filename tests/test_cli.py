@@ -99,3 +99,38 @@ def test_speculative_flag_parsing_handles_colon_names():
         serve_command(["--speculative", "t=d:0"])
     with pytest.raises(CommandError, match="speculative"):
         serve_command(["--speculative", "=d:2"])
+
+
+def test_serve_quantize_per_model_spec_parses(monkeypatch):
+    """--quantize per-model spec reaches the engine as a dict."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured["backend"] = backend
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    cli.serve_command(
+        [
+            "--backend", "jax",
+            "--host", "127.0.0.1",
+            "--port", "0",
+            "--quantize", "qwen2:1.5b=int8,phi3:3.8b=int4,default=none",
+        ]
+    )
+    be = captured["backend"]
+    assert be.quantize == {
+        "qwen2:1.5b": "int8", "phi3:3.8b": "int4", "default": None,
+    }
+    assert be._quant_mode("qwen2:1.5b") == "int8"
+    assert be._quant_mode("phi3:3.8b") == "int4"
+    assert be._quant_mode("gemma:2b") is None
+    assert captured["host"] == "127.0.0.1"

@@ -537,3 +537,60 @@ def test_apply_stop_binary_search_matches_linear_scan():
             k += 1
             acc = tok.decode(tokens[:k])
         assert got_tokens == tokens[:k]
+
+
+def test_per_model_quantize_dict():
+    """One engine can serve different models at different quant modes
+    (small = int8 for speed, large = int4 for capacity)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+        is_quantized,
+    )
+
+    registry = {
+        "tiny-a": get_model_config("qwen2:1.5b").tiny(),
+        "tiny-gemma": get_model_config("gemma:2b").tiny(),
+    }
+    eng = JaxEngine(
+        registry=registry,
+        dtype=jnp.float32,
+        quantize={"tiny-a": "int8", "default": None},
+    )
+    assert eng._quant_mode("tiny-a") == "int8"
+    assert eng._quant_mode("tiny-gemma") is None
+    eng.load_model("tiny-a")
+    eng.load_model("tiny-gemma")
+    assert is_quantized(eng._models["tiny-a"].params["wq"])
+    assert not is_quantized(eng._models["tiny-gemma"].params["wq"])
+    r = eng.generate(GenerationRequest("tiny-a", "hi", max_new_tokens=6))
+    assert r.generated_tokens <= 6
+    with pytest.raises(ValueError, match="unsupported quantize"):
+        JaxEngine(registry=registry, quantize={"tiny-a": "int3"})
+
+
+def test_install_model_reinstall_evicts_stale_state():
+    """Re-installing a model name must drop compiled fns, prefix KV and
+    warm markers derived from the old weights/config."""
+    import jax
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+        init_params,
+    )
+
+    cfg_old = get_model_config("qwen2:1.5b").tiny()
+    cfg_new = get_model_config("gemma:2b").tiny()  # different architecture
+    eng = JaxEngine(registry={}, dtype=jnp.float32, prefix_cache_size=2)
+    eng.install_model(
+        "m", cfg_old, init_params(cfg_old, jax.random.PRNGKey(0), jnp.float32)
+    )
+    r_old = eng.generate(GenerationRequest("m", "same prompt", 8))
+    assert eng._prefill_cache and eng._prefix_cache.get("m")
+    eng.install_model(
+        "m", cfg_new, init_params(cfg_new, jax.random.PRNGKey(1), jnp.float32)
+    )
+    assert not eng._prefix_cache.get("m")
+    assert not [k for k in eng._prefill_cache if "m" in k]
+    assert not [k for k in eng._decode_cache if "m" in k]
+    r_new = eng.generate(GenerationRequest("m", "same prompt", 8))
+    # different config + weights → decode runs the NEW architecture
+    assert eng._models["m"].cfg == cfg_new
+    assert r_new.tokens != r_old.tokens
