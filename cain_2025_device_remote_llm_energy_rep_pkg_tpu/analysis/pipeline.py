@@ -335,6 +335,39 @@ def render_markdown(report: Dict[str, Any]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_latex_descriptives(
+    report: Dict[str, Any], metric: str
+) -> str:
+    """The notebook's cell-15 deliverable: a LaTeX tabular of
+    mean/median/SD per location × length subset for one metric (the paper
+    pastes this into the manuscript)."""
+    lines = [
+        "\\begin{tabular}{lrrrr}",
+        "\\hline",
+        "subset & n & mean & median & SD \\\\",
+        "\\hline",
+    ]
+    for key, per_metric in sorted(report["descriptives"].items()):
+        d = per_metric.get(metric)
+        if not d or d["n"] == 0 or math.isnan(d["mean"]):
+            continue
+        # escape LaTeX specials in factor levels ('on_device' would abort
+        # compilation as a math-mode subscript outside math mode)
+        subset = (
+            key.replace("|", " / ")
+            .replace("_", "\\_")
+            .replace("%", "\\%")
+            .replace("&", "\\&")
+            .replace("#", "\\#")
+        )
+        lines.append(
+            f"{subset} & {d['n']} & {d['mean']:.2f} & {d['median']:.2f} "
+            f"& {d['sd']:.2f} \\\\"
+        )
+    lines += ["\\hline", "\\end{tabular}"]
+    return "\n".join(lines) + "\n"
+
+
 def analyze_experiment(
     experiment_dir: Path,
     out_dir: Optional[Path] = None,
@@ -360,6 +393,10 @@ def analyze_experiment(
     out_dir.mkdir(parents=True, exist_ok=True)
     (out_dir / "analysis_report.json").write_text(json.dumps(report, indent=2))
     (out_dir / "analysis_report.md").write_text(render_markdown(report))
+    # nb cell 15 parity: the paper's LaTeX descriptives table
+    (out_dir / "descriptives.tex").write_text(
+        render_latex_descriptives(report, energy_metric)
+    )
     if make_plots:
         from .plots import plot_experiment
 

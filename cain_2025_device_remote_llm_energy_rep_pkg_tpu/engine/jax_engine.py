@@ -47,6 +47,14 @@ BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)  # generate_batch rows pad up to these
 DEFAULT_STREAM_CHUNK = 32  # decode steps per streamed chunk
 
 
+def _to_host_list(arr) -> "list":
+    """One batched device→host transfer (never per-element int() reads —
+    each is a full RPC round trip on tunneled devices)."""
+    import numpy as np
+
+    return np.asarray(arr).tolist()
+
+
 def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
     for b in buckets:
         if n <= b:
@@ -854,7 +862,14 @@ class JaxEngine(GenerationBackend):
         out = jax.block_until_ready(out)
         t2 = time.monotonic()
 
-        generated = [int(st["first"][0])] + [int(t) for t in out[0][: int(n_done)]]
+        # ONE device→host transfer for the whole token block. A per-element
+        # int(t) loop issues one device read per token — microseconds on a
+        # local chip but a full RPC round trip (~100 ms) per token through
+        # a tunneled device, which turned a 5 s decode into a 2-minute
+        # request (found in the round-2 capstone).
+        generated = [int(st["first"][0])] + _to_host_list(
+            out[0][: int(n_done)]
+        )
         return self._finish(request, generated, st, t2)
 
     # -- speculative generation -----------------------------------------------
@@ -938,7 +953,7 @@ class JaxEngine(GenerationBackend):
         t2 = time.monotonic()
 
         take = min(int(n_em), request.max_new_tokens - 1)
-        generated = [int(st["first"][0])] + [int(t) for t in out[:take]]
+        generated = [int(st["first"][0])] + _to_host_list(out[:take])
         result = self._finish(request, generated, st, t2)
         result.extras = {
             "spec_rounds": int(rounds),
@@ -1157,17 +1172,21 @@ class JaxEngine(GenerationBackend):
                 done0,
             )
             out = jax.block_until_ready(out)
-            n_row = [int(x) for x in n_row]
+            n_row = _to_host_list(n_row)
         else:
             out = jnp.zeros((b_bucket, 0), dtype=jnp.int32)
             n_row = [0] * b_bucket
         t2 = time.monotonic()
 
+        # batched transfers: whole-array host copies, not per-int reads
+        # (one RPC per element on tunneled devices — see generate())
+        out_host = _to_host_list(out)
+        first_host = _to_host_list(first_tokens)
         results = []
         for r, (request, st) in enumerate(zip(requests, states)):
             budget = request.max_new_tokens - 1
             take = min(n_row[r], budget)
-            generated = [int(first_tokens[r])] + [int(t) for t in out[r][:take]]
+            generated = [int(first_host[r])] + out_host[r][:take]
             if request.stop_at_eos and tok.eos_id in generated:
                 generated = generated[: generated.index(tok.eos_id)]
             text = tok.decode(generated)
@@ -1306,7 +1325,7 @@ class JaxEngine(GenerationBackend):
                 presence,
             )
             n_done = int(n_done)
-            chunk_ids = [int(t) for t in out[0][:n_done]]
+            chunk_ids = _to_host_list(out[0][:n_done])
             if not chunk_ids:
                 break
             generated.extend(chunk_ids)

@@ -298,13 +298,22 @@ def prepare() -> None:
         term.log_ok(f"jax {jax.__version__}; devices: {jax.devices()}")
     except Exception as exc:  # noqa: BLE001
         term.log_warn(f"jax unavailable: {exc}")
-    from ..profilers.rapl import RaplEnergyProfiler
+    from ..profilers.energy_probe import probe_energy_channels
 
-    rapl = RaplEnergyProfiler()
-    if rapl.available:
-        term.log_ok("RAPL host energy counters readable")
-    else:
-        term.log_warn("RAPL host energy counters not readable (host_energy_J will be None)")
+    measured = False
+    for status in probe_energy_channels():
+        line = f"energy channel {status.name} ({status.kind}/{status.scope}): {status.detail}"
+        if status.available:
+            term.log_ok(line)
+            measured = measured or status.kind in ("energy", "power")
+        else:
+            term.log_warn(line)
+    if not measured:
+        term.log_warn(
+            "no measured energy source on this host - studies will record "
+            "modelled Joules (energy_model_J) and say so in "
+            "energy_channels.json"
+        )
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -285,3 +285,20 @@ def test_density_and_panel_plots_written(tmp_path):
     assert "density_energy_J_by_location.png" in names
     assert "violin_energy_J_per_model.png" in names
     assert "qq_energy_J.png" in names
+
+
+def test_latex_descriptives_table(tmp_path):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.analysis.pipeline import (
+        render_latex_descriptives,
+    )
+
+    rows = _synthetic_rows(n_per_cell=8)
+    store = RunTableStore(tmp_path)
+    store.write(rows)
+    report = analyze_experiment(tmp_path)
+    tex = (tmp_path / "descriptives.tex").read_text()
+    assert tex.startswith("\\begin{tabular}")
+    # underscores must be escaped or the pasted tabular won't compile
+    assert "on\\_device / 100" in tex and "remote / 200" in tex
+    assert "on_device" not in tex.replace("on\\_device", "")
+    assert tex == render_latex_descriptives(report, "energy_J")
