@@ -233,12 +233,19 @@ class JaxEngine(GenerationBackend):
 
     def load_model(self, model: str) -> None:
         if model in self._models:
+            # refresh LRU recency (dicts preserve insertion order; the
+            # eviction policy pops from the front)
+            self._models[model] = self._models.pop(model)
             return
         cfg = (
             self.registry[model]
             if model in self.registry
             else get_model_config(model)
         )
+        # Eviction first: on allocation-scoped budgets the resident-sum
+        # fail-fast would otherwise reject loads the LRU eviction exists
+        # to make possible.
+        self._ensure_allocation_capacity(model, cfg)
         self._check_memory_budget(model, cfg)
         quant_mode = self._quant_mode(model)
         t0 = time.monotonic()
@@ -391,8 +398,9 @@ class JaxEngine(GenerationBackend):
         the config, and skips ``load_model``'s init path entirely.
         Re-installing an existing name evicts every cache derived from the
         old weights/config (prefix KV, compiled fns, warm markers)."""
-        self._check_memory_budget(model, cfg)
         self._evict_model_state(model)
+        self._ensure_allocation_capacity(model, cfg)
+        self._check_memory_budget(model, cfg)
         mode = self._quant_mode(model)
         if mode is not None:
             from ..models.quantize import quantize_params
@@ -400,6 +408,51 @@ class JaxEngine(GenerationBackend):
             params = quantize_params(params, mode=mode)
         self.registry[model] = cfg
         self._models[model] = Transformer(cfg=cfg, params=params)
+
+    def _ensure_allocation_capacity(self, model: str, cfg: ModelConfig) -> None:
+        """Ollama-style LRU model eviction: total HBM holds only a few
+        models (the 7-model sweep's weights sum to ~22 GiB), so before a
+        load that would overflow the device's ALLOCATION budget, evict the
+        least-recently-used models' *weights*. Compiled executables, warm
+        markers and tokenizers are kept — they capture configs, not
+        params — so a later request for an evicted model reloads in
+        seconds (persistent-compile-cache-backed init) instead of paying
+        the full compile again."""
+        from ..runner import term
+        from ..utils.memory import (
+            LOAD_TRANSIENT_HEADROOM_BYTES,
+            device_allocation_budget,
+            estimate_weight_bytes,
+        )
+
+        budget = device_allocation_budget()
+        if budget is None or not self._models:
+            return
+        n_dev = max(1, getattr(self, "n_devices", 1))
+        dtype_b = jnp.dtype(self.dtype).itemsize
+
+        def weight_bytes(name: str, c: ModelConfig) -> int:
+            return estimate_weight_bytes(c, self._quant_mode(name), dtype_b) // n_dev
+
+        incoming = weight_bytes(model, cfg) + LOAD_TRANSIENT_HEADROOM_BYTES
+        resident = {
+            name: weight_bytes(name, tf.cfg) for name, tf in self._models.items()
+        }
+        while resident and sum(resident.values()) + incoming > budget:
+            victim = next(iter(self._models))  # least recently used
+            freed = resident.pop(victim)
+            self._evict_weights(victim)
+            term.log(
+                f"evicted {victim} weights (~{freed / 1024**3:.2f} GiB) to "
+                f"fit {model}; compiled state kept, reload is cheap"
+            )
+
+    def _evict_weights(self, model: str) -> None:
+        """Drop a model's weights (and its prefix-cache K/V — device
+        arrays) but KEEP compiled fns/warm markers/tokenizer: the config
+        is unchanged, so a reload serves them unmodified."""
+        self._models.pop(model, None)
+        self._prefix_cache.pop(model, None)
 
     def _evict_model_state(self, model: str) -> None:
         """Drop every per-model derivative: compiled prefill/decode fns
@@ -897,6 +950,16 @@ class JaxEngine(GenerationBackend):
         model = request.model
         self.load_model(model)
         self.load_model(draft_model)
+        if model not in self._models:
+            # the draft's load may have LRU-evicted the target; one retry
+            # (the target load refreshes recency, so the draft survives it)
+            self.load_model(model)
+        if model not in self._models or draft_model not in self._models:
+            raise RuntimeError(
+                f"speculative decoding needs {model} and {draft_model} "
+                "resident together, but they exceed the device allocation "
+                "budget; raise TPU_ALLOC_BUDGET_BYTES or drop the draft"
+            )
         tcfg = self._models[model].cfg
         dcfg = self._models[draft_model].cfg
         if tcfg.vocab_size != dcfg.vocab_size:

@@ -1,19 +1,55 @@
-"""Capstone: a real (reduced) energy study on one TPU chip — full-size
-qwen2:1.5b and gemma:2b at int8, both treatments, two lengths, 3 reps."""
+"""The round-2 real-hardware capstone study.
+
+3 model families × 2 locations × 3 content lengths × 10 repetitions, with
+the faithful client/server split of the reference (its on-device treatment
+curls a LOCAL Ollama server on 11434; remote curls another machine's —
+experiment/RunnerConfig.py:122-131):
+
+  terminal 1 (owns the chip):
+    python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu serve \
+        --host 127.0.0.1 --port 11434 --quantize per-model
+
+  terminal 2 (pure HTTP client; NEVER initialises a JAX backend):
+    python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu \
+        examples/llm_energy_capstone.py
+
+Every generation crosses a real process + socket boundary; the run
+table's ``backend`` column records the URL per row. With one host and one
+chip, the remote treatment's *network* hop is loopback — the serving-side
+energy for remote is still modelled as the 8-chip mesh via
+``n_chips_by_location`` (documented in docs/sample_run/README.md).
+
+Model/quantization plan (what fits the relay's ~4.5 GB program budget):
+qwen2:1.5b and gemma:2b at int8 (speed mode), phi3:3.8b at int4
+(capacity mode) — mirroring Ollama's default 4-bit GGUF for the big
+model. Cooldown is 2 s, not the reference's 90 s: the modelled energy is
+thermal-state-free, so long cooldowns only stretch wall-clock (recorded
+as a protocol deviation).
+"""
+
+import os
 from pathlib import Path
 
 from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
     LlmEnergyConfig,
 )
 
+SERVER_URL = os.environ.get("CAPSTONE_SERVER_URL", "http://127.0.0.1:11434")
+
+CAPSTONE_MODELS = ["qwen2:1.5b", "gemma:2b", "phi3:3.8b"]
+# Served by the `serve` process; recorded here for the study metadata.
+CAPSTONE_QUANT = {"qwen2:1.5b": "int8", "gemma:2b": "int8", "phi3:3.8b": "int4"}
+
 
 class RunnerConfig(LlmEnergyConfig):
     def __init__(self):
         super().__init__(
-            models=["qwen2:1.5b", "gemma:2b"],
-            lengths=[100, 500],
-            repetitions=3,
+            models=CAPSTONE_MODELS,
+            lengths=[100, 500, 1000],
+            repetitions=10,
             cooldown_ms=2000,
             results_output_path=Path("experiments_output"),
-            quantize="int8",
+            on_device_url=SERVER_URL,
+            remote_url=SERVER_URL,
+            quantize=CAPSTONE_QUANT,
         )

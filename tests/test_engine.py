@@ -594,3 +594,57 @@ def test_install_model_reinstall_evicts_stale_state():
     # different config + weights → decode runs the NEW architecture
     assert eng._models["m"].cfg == cfg_new
     assert r_new.tokens != r_old.tokens
+
+
+def test_lru_weight_eviction_under_allocation_budget(monkeypatch):
+    """When total resident weights would overflow the allocation budget,
+    the least-recently-used model's weights are evicted; compiled state
+    survives, so a reload serves the same compiled fns."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils import memory as mem
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        estimate_weight_bytes,
+    )
+
+    registry = {
+        "a": get_model_config("qwen2:1.5b").tiny(),
+        "b": get_model_config("gemma:2b").tiny(),
+    }
+    one = estimate_weight_bytes(registry["a"], None, 4)
+    # headroom dwarfs tiny models; shrink it so the budget math is exact
+    monkeypatch.setattr(mem, "LOAD_TRANSIENT_HEADROOM_BYTES", 0)
+    monkeypatch.setenv("TPU_ALLOC_BUDGET_BYTES", str(int(1.7 * one)))
+    eng = JaxEngine(registry=registry, dtype=jnp.float32)
+    eng.generate(GenerationRequest("a", "warm a", 6))
+    n_decode = len(eng._decode_cache)
+    eng.load_model("b")  # must evict a's weights to fit
+    assert "a" not in eng._models and "b" in eng._models
+    assert len(eng._decode_cache) == n_decode  # compiled state kept
+    # transparent reload: generating on the evicted model works and reuses
+    # the compiled decode fn (no new cache entries)
+    r = eng.generate(GenerationRequest("a", "warm a", 6))
+    assert r.generated_tokens == 6
+    assert len(eng._decode_cache) == n_decode
+    assert "b" not in eng._models  # b became the LRU victim in turn
+
+
+def test_lru_recency_updated_on_use(monkeypatch):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils import memory as mem
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        estimate_weight_bytes,
+    )
+
+    registry = {
+        "a": get_model_config("qwen2:1.5b").tiny(),
+        "b": get_model_config("gemma:2b").tiny(),
+        "c": get_model_config("phi3:3.8b").tiny(),
+    }
+    one = estimate_weight_bytes(registry["a"], None, 4)
+    monkeypatch.setattr(mem, "LOAD_TRANSIENT_HEADROOM_BYTES", 0)
+    monkeypatch.setenv("TPU_ALLOC_BUDGET_BYTES", str(int(2.9 * one)))
+    eng = JaxEngine(registry=registry, dtype=jnp.float32)
+    eng.load_model("a")
+    eng.load_model("b")
+    eng.load_model("a")  # touch a → b becomes LRU
+    eng.load_model("c")  # must evict b, not a
+    assert "a" in eng._models and "c" in eng._models
+    assert "b" not in eng._models
