@@ -153,6 +153,7 @@ class JaxEngine(GenerationBackend):
         prefill_attention: "str | PrefillAttentionFn | None" = "auto",
         speculative: "Optional[Dict[str, Tuple[str, int]]]" = None,
         prefix_cache_size: int = 0,  # cached prompt-KV entries per model
+        kv_quantize: Optional[str] = None,  # None | "int8" (decode path)
     ) -> None:
         # quantize: one mode for every model (None | "int8" | "int4"), or a
         # per-model dict {model: mode} with an optional "default" key — a
@@ -171,6 +172,20 @@ class JaxEngine(GenerationBackend):
             raise ValueError(
                 f"prefix_cache_size must be >= 0, got {prefix_cache_size}"
             )
+        # kv_quantize="int8": the DECODE loop runs over an int8 KV cache
+        # (per-position vector scales; prefill fills a bf16 cache which is
+        # quantized once before decoding). Halves the cache stream — the
+        # dominant per-step bytes for many-KV-head models at long context
+        # (phi3: ~0.8 GB/step at 2k). Single-request decode only for now:
+        # incompatible with speculative decoding and prefix caching.
+        if kv_quantize not in (None, "int8"):
+            raise ValueError(f"unsupported kv_quantize mode: {kv_quantize!r}")
+        if kv_quantize and (speculative or prefix_cache_size):
+            raise ValueError(
+                "kv_quantize is incompatible with speculative decoding and "
+                "prefix caching (both thread bf16 caches)"
+            )
+        self.kv_quantize = kv_quantize
         self.quantize = quantize
         # target model → (draft model, k): greedy requests for the target
         # route through speculative decoding (engine/speculative.py).
@@ -196,6 +211,10 @@ class JaxEngine(GenerationBackend):
         self.prefix_cache_size = prefix_cache_size
         self._prefix_cache: Dict[str, Any] = {}
         self._models: Dict[str, Transformer] = {}
+        # Models whose weights exist ONLY in memory (install_model — no
+        # registry-init or checkpoint source to reload from): never LRU
+        # victims, or a later load would silently re-randomise them.
+        self._pinned: set = set()
         self._prefill_cache: Dict[Tuple, Callable] = {}
         self._decode_cache: Dict[Tuple, Callable] = {}
         self._warmed: set = set()
@@ -408,6 +427,7 @@ class JaxEngine(GenerationBackend):
             params = quantize_params(params, mode=mode)
         self.registry[model] = cfg
         self._models[model] = Transformer(cfg=cfg, params=params)
+        self._pinned.add(model)
 
     def _ensure_allocation_capacity(self, model: str, cfg: ModelConfig) -> None:
         """Ollama-style LRU model eviction: total HBM holds only a few
@@ -438,8 +458,14 @@ class JaxEngine(GenerationBackend):
         resident = {
             name: weight_bytes(name, tf.cfg) for name, tf in self._models.items()
         }
-        while resident and sum(resident.values()) + incoming > budget:
-            victim = next(iter(self._models))  # least recently used
+        while sum(resident.values()) + incoming > budget:
+            # oldest (LRU) un-pinned model; installed-only weights have no
+            # source to reload from and are never victims
+            victim = next(
+                (n for n in self._models if n not in self._pinned), None
+            )
+            if victim is None:
+                break
             freed = resident.pop(victim)
             self._evict_weights(victim)
             term.log(
@@ -462,6 +488,7 @@ class JaxEngine(GenerationBackend):
         (plain, 'batch'- and 'spec'-prefixed; spec entries also name the
         draft)."""
         self._models.pop(model, None)
+        self._pinned.discard(model)
         self._tokenizers.pop(model, None)
         self._prefix_cache.pop(model, None)
         for cache in (self._prefill_cache, self._decode_cache):
@@ -471,6 +498,7 @@ class JaxEngine(GenerationBackend):
 
     def unload_all(self) -> None:
         self._models.clear()
+        self._pinned.clear()
         self._prefill_cache.clear()
         self._decode_cache.clear()
         self._tokenizers.clear()
@@ -566,7 +594,7 @@ class JaxEngine(GenerationBackend):
             return self._decode_cache[key]
         tf = self._models[model]
         cfg = tf.cfg
-        decode_attention = self.decode_attention
+        decode_attention = self._decode_attention_for_cache()
         eos = self._tokenizer_for(model).eos_id
 
         @jax.jit
@@ -635,6 +663,33 @@ class JaxEngine(GenerationBackend):
 
         self._decode_cache[key] = decode
         return decode
+
+    def _decode_attention_for_cache(self) -> Optional[DecodeAttentionFn]:
+        """The decode kernel matching the cache representation: the int8
+        variant unpacks the quantized cache's codes+scales; without a
+        kernel (CPU tests) the jnp fallback in the model handles both."""
+        if self.decode_attention is None or not self.kv_quantize:
+            return self.decode_attention
+
+        from ..ops.pallas_attention import pallas_decode_attention_int8
+
+        def int8_cache_attention(q, kc, vc, lengths):
+            return pallas_decode_attention_int8(
+                q, kc["q"], kc["s"], vc["q"], vc["s"], lengths
+            )
+
+        return int8_cache_attention
+
+    def _maybe_quantize_cache(self, st: Dict[str, Any]) -> Dict[str, Any]:
+        """Post-prefill cache conversion for the decode loop (prefill
+        always runs on the bf16 cache; see kv_quantize in the ctor)."""
+        if self.kv_quantize:
+            from ..models.quantize import quantize_kv_cache
+
+            st["k_cache"], st["v_cache"] = quantize_kv_cache(
+                st["k_cache"], st["v_cache"]
+            )
+        return st
 
     # -- generation -----------------------------------------------------------
     def _run_prefill(
@@ -892,6 +947,7 @@ class JaxEngine(GenerationBackend):
             st = self._start(request, prompt_ids=ids)
         else:
             st = self._start(request)
+        st = self._maybe_quantize_cache(st)
         decode = self._decode_fn(
             request.model,
             st["g_bucket"],
@@ -1140,6 +1196,12 @@ class JaxEngine(GenerationBackend):
         """
         if not requests:
             return []
+        if self.kv_quantize:
+            raise ValueError(
+                "generate_batch is not supported with kv_quantize (the "
+                "batched decode threads a shared bf16 cache); serve "
+                "batches from a non-quantized-KV engine"
+            )
         max_rows = BATCH_BUCKETS[-1]
         if len(requests) > max_rows:
             # Larger fleets run as sequential full-width batches rather than
@@ -1285,7 +1347,7 @@ class JaxEngine(GenerationBackend):
         as a replacement char at the boundary. The final ``done`` chunk's
         ``result.text`` decodes the full stream and is authoritative.
         """
-        st = self._start(request)
+        st = self._maybe_quantize_cache(self._start(request))
         eos = st["tok"].eos_id
         chunk_bucket = _bucket(min(chunk_tokens, request.max_new_tokens), GEN_BUCKETS)
         decode = self._decode_fn(

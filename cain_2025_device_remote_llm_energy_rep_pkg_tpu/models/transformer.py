@@ -29,7 +29,15 @@ import jax.numpy as jnp
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_angles
 from .config import ModelConfig
-from .quantize import dense_dot, embed_lookup, is_quantized, maybe_dequant
+from .quantize import (
+    dense_dot,
+    dequant_cache,
+    embed_lookup,
+    is_quantized,
+    is_quantized_cache,
+    maybe_dequant,
+    quantize_kv_vector,
+)
 
 Params = Dict[str, Any]
 
@@ -168,11 +176,17 @@ def _attention_block(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     b, s, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
-    t = k_cache.shape[2]
+    quant_cache = is_quantized_cache(k_cache)
+    t = (k_cache["q"] if quant_cache else k_cache).shape[2]
     per_seq = jnp.ndim(offset) == 1  # batched decode: one offset per sequence
     if per_seq and s != 1:
         raise ValueError(
             "per-sequence offsets are only supported for single-token decode"
+        )
+    if quant_cache and (per_seq or s != 1):
+        raise ValueError(
+            "quantized KV caches support single-sequence decode only "
+            "(prefill runs on the bf16 cache; it is quantized afterwards)"
         )
 
     q = dense_dot(x, layer["wq"])
@@ -188,7 +202,27 @@ def _attention_block(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if per_seq:
+    if quant_cache:
+        # Quantize the new entry and write codes + per-vector scale.
+        kq, ks = quantize_kv_vector(k[:, 0])  # [B,Hkv,dh]
+        vq, vs = quantize_kv_vector(v[:, 0])
+        k_cache = {
+            "q": jax.lax.dynamic_update_slice(
+                k_cache["q"], kq[:, :, None, :], (0, 0, offset, 0)
+            ),
+            "s": jax.lax.dynamic_update_slice(
+                k_cache["s"], ks[:, :, None], (0, 0, offset)
+            ),
+        }
+        v_cache = {
+            "q": jax.lax.dynamic_update_slice(
+                v_cache["q"], vq[:, :, None, :], (0, 0, offset, 0)
+            ),
+            "s": jax.lax.dynamic_update_slice(
+                v_cache["s"], vs[:, :, None], (0, 0, offset)
+            ),
+        }
+    elif per_seq:
         # Each sequence writes its token's K/V at its own cache position.
         k_cache = k_cache.at[jnp.arange(b), :, offset].set(
             k[:, 0].astype(k_cache.dtype)
@@ -214,8 +248,16 @@ def _attention_block(
     else:
         group = hq // hkv
         qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)
-        kf = k_cache.astype(jnp.float32)
-        vf = v_cache.astype(jnp.float32)
+        kf = (
+            dequant_cache(k_cache)
+            if quant_cache
+            else k_cache.astype(jnp.float32)
+        )
+        vf = (
+            dequant_cache(v_cache)
+            if quant_cache
+            else v_cache.astype(jnp.float32)
+        )
         scores = jnp.einsum("bskgd,bktd->bkgst", qg, kf) * scale
         kpos = jnp.arange(t)
         if per_seq:

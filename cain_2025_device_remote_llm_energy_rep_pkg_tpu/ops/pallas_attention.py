@@ -169,6 +169,153 @@ def pallas_decode_attention(
     return out.reshape(b, hq, d)
 
 
+def _decode_kernel_int8(
+    lengths_ref,  # SMEM [B] int32 (scalar-prefetched)
+    q_ref,  # VMEM [1,1,G,D]
+    k_ref,  # VMEM [1,1,block_t,D] int8
+    ks_ref,  # VMEM [1,1,block_t] f32 per-position K scales
+    v_ref,  # VMEM [1,1,block_t,D] int8
+    vs_ref,  # VMEM [1,1,block_t] f32 per-position V scales
+    o_ref,  # VMEM [1,1,G,D]
+    m_ref,  # VMEM scratch [G,128] f32
+    l_ref,  # VMEM scratch [G,128] f32
+    acc_ref,  # VMEM scratch [G,D] f32
+    *,
+    block_t: int,
+    n_blocks: int,
+    scale: float,
+):
+    """Flash decode over an int8 KV cache. Dequantization never
+    materialises: K's per-position scale multiplies the SCORE column it
+    produced (scales commute with the q·k dot over D), and V's scale
+    folds into the probability row before the p·v dot — two [G,Tb]
+    multiplies per block instead of a [Tb,D] dequant."""
+    b_i = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[b_i]
+    block_start = j * block_t
+
+    @pl.when(block_start < length)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G,D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [Tb,D] int8 codes
+        ks = ks_ref[0, 0].astype(jnp.float32)  # [Tb]
+        vs = vs_ref[0, 0].astype(jnp.float32)  # [Tb]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+            * ks[None, :]
+        )  # [G,Tb] — k dequant applied as a per-column score scale
+        idx = block_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(idx < length, s, -jnp.inf)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [G,Tb]
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)  # [Tb,D] int8 codes
+        pv = jax.lax.dot_general(
+            p * vs[None, :],  # v dequant folded into the probability row
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G,D]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_blocks - 1)
+    def _finalise():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def pallas_decode_attention_int8(
+    q: jnp.ndarray,  # [B,Hq,D]
+    k_q: jnp.ndarray,  # [B,Hkv,T,D] int8
+    k_s: jnp.ndarray,  # [B,Hkv,T] f32
+    v_q: jnp.ndarray,  # [B,Hkv,T,D] int8
+    v_s: jnp.ndarray,  # [B,Hkv,T] f32
+    lengths: jnp.ndarray,  # [B] int32
+    *,
+    block_t: int = 512,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash-decode attention over an int8-quantized KV cache — math-
+    identical to running :func:`pallas_decode_attention` on the
+    dequantized cache (scales commute with the dots)."""
+    b, hq, d = q.shape
+    _, hkv, t, _ = k_q.shape
+    group = hq // hkv
+    scale = 1.0 / math.sqrt(d)
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    d_pad = (-d) % 128
+    if d_pad:
+        pad4 = ((0, 0), (0, 0), (0, 0), (0, d_pad))
+        q = jnp.pad(q.reshape(b, hkv, group, d), pad4)
+        k_q = jnp.pad(k_q, pad4)
+        v_q = jnp.pad(v_q, pad4)
+        dp = d + d_pad
+    else:
+        q = q.reshape(b, hkv, group, d)
+        dp = d
+
+    bt = min(_pick_block_t(t, block_t), t)
+    n_blocks = t // bt
+
+    kernel = functools.partial(
+        _decode_kernel_int8, block_t=bt, n_blocks=n_blocks, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv, n_blocks),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, dp), lambda b_i, h, j, L: (b_i, h, 0, 0)),
+                pl.BlockSpec((1, 1, bt, dp), lambda b_i, h, j, L: (b_i, h, j, 0)),
+                pl.BlockSpec((1, 1, bt), lambda b_i, h, j, L: (b_i, h, j)),
+                pl.BlockSpec((1, 1, bt, dp), lambda b_i, h, j, L: (b_i, h, j, 0)),
+                pl.BlockSpec((1, 1, bt), lambda b_i, h, j, L: (b_i, h, j)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, group, dp), lambda b_i, h, j, L: (b_i, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, dp), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, dp), q.dtype),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        q,
+        k_q,
+        k_s.astype(jnp.float32),
+        v_q,
+        v_s.astype(jnp.float32),
+    )
+
+    if d_pad:
+        out = out[..., :d]
+    return out.reshape(b, hq, d)
+
+
 def _prefill_kernel(
     offset_ref,  # SMEM [1] int32 (scalar-prefetched)
     q_ref,  # VMEM [1,1,block_q*G,D]

@@ -133,7 +133,8 @@ commands:
                        checkpoint's tokenizer; repeatable),
                        --quantize int8|int4|none or per-model
                        "m1=int8,m2=int4,default=int8" (int8 for speed, int4
-                       for HBM fit), --speculative target=draft[:k]
+                       for HBM fit), --kv-quantize int8 (halve the decode
+                       KV stream), --speculative target=draft[:k]
                        (draft-verify), --prefix-cache N (prompt-prefix KV LRU)
   help                 show this message
 """
@@ -152,6 +153,7 @@ def serve_command(args: List[str]) -> None:
     max_batch = 8
     hf_checkpoints = {}
     quantize = None
+    kv_quantize = None
     speculative = {}
     prefix_cache = 0
     it = iter(args)
@@ -219,6 +221,10 @@ def serve_command(args: List[str]) -> None:
             speculative[name] = (draft, k)
         elif arg == "--prefix-cache":
             prefix_cache = int(next(it, "4"))
+        elif arg == "--kv-quantize":
+            kv_quantize = next(it, "int8")
+            if kv_quantize == "none":
+                kv_quantize = None
         else:
             raise CommandError(f"serve: unrecognised option {arg!r}")
 
@@ -253,6 +259,7 @@ def serve_command(args: List[str]) -> None:
             decode_attention="auto",
             hf_checkpoints=hf_checkpoints or None,
             quantize=quantize,
+            kv_quantize=kv_quantize,
             speculative=speculative or None,
             prefix_cache_size=prefix_cache,
         )

@@ -80,7 +80,7 @@ def device_allocation_budget(device=None) -> Optional[int]:
             return int(stats["bytes_limit"])
     except Exception:  # pragma: no cover - backend-dependent
         pass
-    if "axon" in _requested_platforms():
+    if "axon" in _requested_platforms() or jax.default_backend() == "axon":
         return AXON_RELAY_ALLOC_BYTES
     return None
 

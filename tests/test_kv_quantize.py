@@ -1,0 +1,181 @@
+"""int8 KV cache: quantization helpers, kernel parity, engine decode path.
+
+The cache is the dominant per-step stream for many-KV-head models at long
+context (phi3: ~0.8 GB/step at 2k); int8 halves it. The decode kernel
+never materialises the dequantized cache — K scales fold into scores, V
+scales into probabilities — so the Pallas output must match the bf16
+kernel run on the dequantized cache to float tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+    JaxEngine,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+    dequant_cache,
+    is_quantized,
+    is_quantized_cache,
+    quantize_kv_cache,
+    quantize_kv_vector,
+)
+
+
+def test_kv_cache_quantization_roundtrip():
+    key = jax.random.PRNGKey(0)
+    k = jax.random.normal(key, (2, 4, 64, 32), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 64, 32), jnp.float32)
+    kq, vq = quantize_kv_cache(k, v)
+    assert is_quantized_cache(kq) and is_quantized_cache(vq)
+    assert kq["q"].dtype == jnp.int8 and kq["s"].shape == (2, 4, 64)
+    # per-vector symmetric int8: relative error bounded by 1/127 of the max
+    err = np.abs(np.asarray(dequant_cache(kq)) - np.asarray(k))
+    bound = np.asarray(kq["s"])[..., None] * 0.5 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_quantized_cache_distinct_from_weight_leaf():
+    w = jax.random.normal(jax.random.PRNGKey(0), (8, 16), jnp.float32)
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+        quantize_tensor,
+    )
+
+    leaf = quantize_tensor(w)
+    assert is_quantized(leaf) and not is_quantized_cache(leaf)
+    kq, _ = quantize_kv_cache(
+        jnp.zeros((1, 1, 4, 8)), jnp.zeros((1, 1, 4, 8))
+    )
+    assert is_quantized_cache(kq)
+
+
+def test_int8_decode_kernel_matches_dequantized_reference():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_attention import (
+        pallas_decode_attention,
+        pallas_decode_attention_int8,
+    )
+
+    key = jax.random.PRNGKey(2)
+    b, hq, hkv, t, d = 2, 8, 2, 256, 128
+    q = jax.random.normal(key, (b, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(3), (b, hkv, t, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(4), (b, hkv, t, d), jnp.float32)
+    lengths = jnp.asarray([100, 256], jnp.int32)
+    kq, vq = quantize_kv_cache(k, v)
+    got = pallas_decode_attention_int8(
+        q, kq["q"], kq["s"], vq["q"], vq["s"], lengths, interpret=True
+    )
+    want = pallas_decode_attention(
+        q, dequant_cache(kq), dequant_cache(vq), lengths, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.fixture(scope="module")
+def engines():
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    base = JaxEngine(registry=dict(registry), dtype=jnp.float32)
+    kv8 = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, kv_quantize="int8"
+    )
+    return base, kv8
+
+
+def test_engine_kv_quantize_generates(engines):
+    base, kv8 = engines
+    req = GenerationRequest("tiny", "hello quantized cache", max_new_tokens=16)
+    r8 = kv8.generate(req)
+    rb = base.generate(req)
+    assert r8.generated_tokens == rb.generated_tokens == 16
+    # greedy decode over a tiny random model: int8 cache noise may flip a
+    # late token, but the stream must agree early (same prefill, first
+    # token sampled before any quantized read)
+    assert r8.tokens[0] == rb.tokens[0]
+
+
+def test_engine_kv_quantize_stream_matches_monolithic(engines):
+    _, kv8 = engines
+    req = GenerationRequest("tiny", "stream parity", max_new_tokens=12)
+    mono = kv8.generate(req)
+    chunks = list(kv8.generate_stream(req, chunk_tokens=4))
+    streamed = [t for c in chunks[:-1] for t in c.tokens]
+    assert streamed == mono.tokens
+    assert chunks[-1].result.tokens == mono.tokens
+
+
+def test_kv_quantize_guards():
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    with pytest.raises(ValueError, match="kv_quantize"):
+        JaxEngine(registry=registry, kv_quantize="int4")
+    with pytest.raises(ValueError, match="incompatible"):
+        JaxEngine(registry=registry, kv_quantize="int8", prefix_cache_size=2)
+    with pytest.raises(ValueError, match="incompatible"):
+        JaxEngine(
+            registry=registry,
+            kv_quantize="int8",
+            speculative={"a": ("b", 4)},
+        )
+    kv8 = JaxEngine(registry=registry, dtype=jnp.float32, kv_quantize="int8")
+    with pytest.raises(ValueError, match="generate_batch"):
+        kv8.generate_batch(
+            [GenerationRequest("tiny", "x", max_new_tokens=4)]
+        )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.mesh import (
+        MeshSpec,
+        build_mesh,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.tp import (
+        TensorParallelEngine,
+    )
+
+    mesh = build_mesh(MeshSpec.tp_only(2), devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="tensor-parallel"):
+        TensorParallelEngine(mesh=mesh, kv_quantize="int8")
+
+
+def test_quantize_kv_vector_shapes():
+    vec = jax.random.normal(jax.random.PRNGKey(5), (3, 4, 32), jnp.float32)
+    q, s = quantize_kv_vector(vec)
+    assert q.shape == vec.shape and q.dtype == jnp.int8
+    assert s.shape == (3, 4)
+
+
+def test_installed_models_never_evicted(monkeypatch):
+    """install_model'ed weights exist only in memory — eviction must never
+    pick them (a reload would silently re-randomise a trained model)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+        init_params,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils import (
+        memory as mem,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        estimate_weight_bytes,
+    )
+
+    cfg_a = get_model_config("qwen2:1.5b").tiny()
+    cfg_b = get_model_config("gemma:2b").tiny()
+    one = estimate_weight_bytes(cfg_a, None, 4)
+    monkeypatch.setattr(mem, "LOAD_TRANSIENT_HEADROOM_BYTES", 0)
+    monkeypatch.setenv("TPU_ALLOC_BUDGET_BYTES", str(int(1.7 * one)))
+    eng = JaxEngine(registry={"b": cfg_b}, dtype=jnp.float32)
+    trained = init_params(cfg_a, jax.random.PRNGKey(7), jnp.float32)
+    eng.install_model("trained", cfg_a, trained)
+    # loading b would need eviction; 'trained' is pinned, so the budget is
+    # simply exceeded rather than the trained weights destroyed
+    eng.load_model("b")
+    assert "trained" in eng._models
+    np.testing.assert_array_equal(
+        np.asarray(eng._models["trained"].params["embed"]),
+        np.asarray(trained["embed"]),
+    )
