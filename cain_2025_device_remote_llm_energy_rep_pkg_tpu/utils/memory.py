@@ -28,13 +28,13 @@ ENV_OVERRIDE = "TPU_MEMORY_BUDGET_BYTES"
 # Total-device-allocation ceiling on the relay, distinct from the
 # per-program live-set ceiling: resident models accumulate real HBM even
 # though each decode program only references one model. Calibration
-# (round 2): llama3.1:8b int4 (4.23 GiB) + qwen2:1.5b int8 (1.45 GiB)
-# resident, then a gemma:7b int4 load (4.64 GiB + ~3.5 GiB of f32 init
-# transients ≈ 13.8 GiB peak) hit RESOURCE_EXHAUSTED, while a lone
-# gemma:7b load (~8.1 GiB peak) succeeds → the cap lies in (8.1, 13.8);
-# 13 GiB is the working figure, with the per-load transient charged
-# explicitly by the eviction policy.
-AXON_RELAY_ALLOC_BYTES = int(13 * 1024**3)
+# (round 2, two observed RESOURCE_EXHAUSTED events in the 7-model sweep):
+# a lone gemma:7b int4 load peaks ~8.1 GiB and succeeds; phi3 (1.93 GiB)
+# resident + the same load (~10.1 GiB peak) fails → the cap lies in
+# (8.1, 10.1). 8.5 GiB is the safe figure: the heaviest single load still
+# fits, and anything resident beyond ~0.4 GiB is LRU-evicted before a
+# big-model load (cheap — compiled state survives eviction).
+AXON_RELAY_ALLOC_BYTES = int(8.5 * 1024**3)
 ALLOC_ENV_OVERRIDE = "TPU_ALLOC_BUDGET_BYTES"
 # Headroom for a load's transient buffers (the largest full-precision
 # leaf — e.g. a 256k-vocab f32 embedding ≈ 3 GiB — lives briefly during
