@@ -170,14 +170,31 @@ def analyze(
     # Run-to-run variance per experiment cell (model × location × length):
     # BASELINE.md's explicit ≤5% target, assessed as the CV of the energy
     # metric over a cell's repetitions (VERDICT.md round-1 weakness 2).
-    if energy_metric in metrics and any(model_factor in r for r in filtered):
-        models = sorted({str(r.get(model_factor)) for r in filtered})
+    # Judged on the RAW rows with a PER-CELL IQR filter, not the global
+    # filter above: that one pools models, so a slow model's entire cell
+    # can be dropped wholesale as "outliers" of the pooled subset and
+    # become unassessable (round 2 lost 6 of 42 cells this way) — a
+    # within-cell spread measure must be judged against the cell's own
+    # distribution. Zero-mean/NaN CVs are flagged, never silently failed.
+    if energy_metric in metrics and any(model_factor in r for r in rows):
+        models = sorted(
+            {str(r.get(model_factor)) for r in rows if model_factor in r}
+        )
+        # Factor levels enumerated from the RAW rows too: a treatment whose
+        # rows the pooled filter drops wholesale (e.g. every remote row of
+        # a lopsided sweep) must still get variance entries, not vanish.
+        raw_locations = sorted(
+            {r[location_factor] for r in rows if location_factor in r}
+        )
+        raw_lengths = sorted(
+            {r[length_factor] for r in rows if length_factor in r}
+        )
         cells = {}
         for model in models:
-            for loc in locations:
-                for length in lengths:
+            for loc in raw_locations:
+                for length in raw_lengths:
                     sub = _subset(
-                        filtered,
+                        rows,
                         **{
                             model_factor: model,
                             location_factor: loc,
@@ -187,27 +204,48 @@ def analyze(
                     vals = _values(sub, energy_metric)
                     if len(vals) < 2:
                         continue
-                    d = descriptives(vals)
-                    cells[f"{model}|{loc}|{length}"] = {
-                        "n": d.n,
-                        "cv": d.cv,
-                        "pass": bool(d.cv <= cv_target),
-                    }
+                    kept = [
+                        v
+                        for v, keep in zip(vals, iqr_mask(vals, k=iqr_k))
+                        if keep
+                    ]
+                    if len(kept) < 2:
+                        kept = vals  # degenerate cell; judge it unfiltered
+                    d = descriptives(kept)
+                    entry: Dict[str, Any] = {"n": d.n, "n_raw": len(vals)}
+                    if math.isnan(d.cv):
+                        entry.update(
+                            cv=None, **{"pass": None},
+                            note="zero-mean/NaN CV - unassessable",
+                        )
+                    else:
+                        entry.update(cv=d.cv, **{"pass": bool(d.cv <= cv_target)})
+                    cells[f"{model}|{loc}|{length}"] = entry
+        assessable = {k: c for k, c in cells.items() if c["cv"] is not None}
         if cells:
-            worst_key = max(cells, key=lambda k: cells[k]["cv"])
             report["variance_check"] = {
                 "target_cv": cv_target,
                 "metric": energy_metric,
                 "cells": cells,
-                "n_pass": sum(1 for c in cells.values() if c["pass"]),
-                "n_cells": len(cells),
-                "worst": {"cell": worst_key, **cells[worst_key]},
+                "n_pass": sum(1 for c in assessable.values() if c["pass"]),
+                "n_cells": len(assessable),
+                "n_unassessable": len(cells) - len(assessable),
+                # three-valued: a table with NO assessable cell has not
+                # failed the CV target — it could not be judged at all
                 "verdict": (
-                    "pass"
-                    if all(c["pass"] for c in cells.values())
+                    "unassessable"
+                    if not assessable
+                    else "pass"
+                    if all(c["pass"] for c in assessable.values())
                     else "fail"
                 ),
             }
+            if assessable:
+                worst_key = max(assessable, key=lambda k: assessable[k]["cv"])
+                report["variance_check"]["worst"] = {
+                    "cell": worst_key,
+                    **assessable[worst_key],
+                }
 
     # H1 (nb cell 37): on-device vs remote energy per content length.
     if len(locations) == 2 and energy_metric in metrics:
@@ -294,18 +332,27 @@ def render_markdown(report: Dict[str, Any]) -> str:
         lines += ["", "## Run-to-run variance (≤{:.0%} CV target)".format(
             vc["target_cv"]
         ), ""]
-        lines.append(
+        headline = (
             f"**{vc['verdict'].upper()}** — {vc['n_pass']}/{vc['n_cells']} "
-            f"cells within target on `{vc['metric']}`; worst cell "
-            f"`{vc['worst']['cell']}` at CV {vc['worst']['cv']:.3f} "
-            f"(n={vc['worst']['n']})."
+            f"cells within target on `{vc['metric']}`"
         )
+        if vc.get("worst"):
+            headline += (
+                f"; worst cell `{vc['worst']['cell']}` at CV "
+                f"{vc['worst']['cv']:.3f} (n={vc['worst']['n']})"
+            )
+        if vc.get("n_unassessable"):
+            headline += f"; {vc['n_unassessable']} cell(s) unassessable (NaN CV)"
+        lines.append(headline + ".")
         lines += ["", "| cell | n | CV | ≤ target |", "|---|---|---|---|"]
         for cell, c in sorted(vc["cells"].items()):
-            lines.append(
-                f"| {cell} | {c['n']} | {c['cv']:.4f} "
-                f"| {'yes' if c['pass'] else 'NO'} |"
-            )
+            if c["cv"] is None:
+                lines.append(f"| {cell} | {c['n']} | — | unassessable |")
+            else:
+                lines.append(
+                    f"| {cell} | {c['n']} | {c['cv']:.4f} "
+                    f"| {'yes' if c['pass'] else 'NO'} |"
+                )
     if report.get("skewness"):
         lines += ["", "## Skewness (log-transform check)", ""]
         lines.append("| subset | skew | skew(log) | Shapiro p (log) |")

@@ -106,6 +106,15 @@ def _apply_stop(tokens: "list[int]", text: str, tok, stop) -> "tuple[list[int], 
             lo = mid + 1
         else:
             hi = mid
+    # Bounded linear fix-up: cleanup/merging tokenizers are only
+    # *approximately* monotone, so the bisect can land a position or two
+    # off; scan the neighbourhood for the true smallest covering prefix at
+    # O(1) extra decodes so token counts (eval_count on the wire) stay
+    # exact wherever a covering prefix exists.
+    for j in range(max(0, lo - 2), min(len(tokens), lo + 2) + 1):
+        if len(tok.decode(tokens[:j])) >= len(kept):
+            lo = j
+            break
     return tokens[:lo], kept
 
 
@@ -944,9 +953,18 @@ class JaxEngine(GenerationBackend):
                 return self.generate_speculative(
                     request, spec[0], spec[1], prompt_ids=ids
                 )
-            st = self._start(request, prompt_ids=ids)
-        else:
-            st = self._start(request)
+            return self._generate_plain(request, prompt_ids=ids)
+        return self._generate_plain(request)
+
+    def _generate_plain(
+        self,
+        request: GenerationRequest,
+        prompt_ids: "Optional[list[int]]" = None,
+    ) -> GenerationResult:
+        """The non-speculative monolithic decode — also the fallback when a
+        configured draft can't be co-resident with its target (a draft must
+        never make a request fail that plain decoding would serve)."""
+        st = self._start(request, prompt_ids=prompt_ids)
         st = self._maybe_quantize_cache(st)
         decode = self._decode_fn(
             request.model,
@@ -1007,15 +1025,24 @@ class JaxEngine(GenerationBackend):
         self.load_model(model)
         self.load_model(draft_model)
         if model not in self._models:
-            # the draft's load may have LRU-evicted the target; one retry
-            # (the target load refreshes recency, so the draft survives it)
+            # The draft's load may have LRU-evicted the target; one retry.
+            # Note the retry can itself evict the draft (the draft becomes
+            # the oldest un-pinned resident) — that case falls through to
+            # the co-residency check below.
             self.load_model(model)
         if model not in self._models or draft_model not in self._models:
-            raise RuntimeError(
-                f"speculative decoding needs {model} and {draft_model} "
-                "resident together, but they exceed the device allocation "
-                "budget; raise TPU_ALLOC_BUDGET_BYTES or drop the draft"
+            # The pair genuinely can't be co-resident under the allocation
+            # budget: serve the request WITHOUT the draft rather than
+            # failing it — plain greedy decode produces the same tokens.
+            from ..runner import term
+
+            term.log_warn(
+                f"speculative decoding: {model} and {draft_model} cannot "
+                "be co-resident under the device allocation budget; "
+                "falling back to plain decode (raise "
+                "TPU_ALLOC_BUDGET_BYTES or drop the draft to avoid this)"
             )
+            return self._generate_plain(request, prompt_ids=prompt_ids)
         tcfg = self._models[model].cfg
         dcfg = self._models[draft_model].cfg
         if tcfg.vocab_size != dcfg.vocab_size:

@@ -35,6 +35,12 @@ class Profiler:
     """
 
     data_columns: Sequence[str] = ()
+    # True when this profiler reads real hardware energy/power/utilisation
+    # counters (vs deriving modelled values). Drives the experiment's
+    # cooldown policy: measured channels need the reference's 90 s thermal
+    # discipline (a hot chip throttles and skews real Joules); modelled
+    # energy is thermal-state-free.
+    measured_channel: bool = False
 
     def on_start(self, context: RunContext) -> None:  # pragma: no cover - trivial
         pass

@@ -221,6 +221,7 @@ class TpuDutyCycleProfiler:
     """
 
     data_columns = ("tpu_duty_cycle_pct", "energy_duty_J")
+    measured_channel = True
 
     def __init__(
         self,

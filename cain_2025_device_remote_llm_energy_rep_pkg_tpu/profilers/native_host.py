@@ -36,6 +36,15 @@ class NativeHostProfiler(Profiler):
     )
     artifact_name = "native_host_samples"
 
+    @property
+    def measured_channel(self) -> bool:
+        """Real host Joules only where RAPL is readable — cpu/mem sampling
+        alone is not an energy channel and must not trigger the 90 s
+        thermal cooldown."""
+        from .rapl import RaplEnergyProfiler
+
+        return RaplEnergyProfiler().available
+
     def __init__(
         self,
         period_us: int = 1000,  # 1 kHz; the reference's Python loop: ~0.9 Hz

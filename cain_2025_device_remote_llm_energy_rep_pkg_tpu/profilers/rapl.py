@@ -49,6 +49,10 @@ class RaplEnergyProfiler(Profiler):
             os.path.join(self._domains[0], "energy_uj")
         ) is not None
 
+    @property
+    def measured_channel(self) -> bool:  # real host Joules when readable
+        return self.available
+
     def on_start(self, context: RunContext) -> None:
         self._t0 = time.monotonic()
         self._start = []

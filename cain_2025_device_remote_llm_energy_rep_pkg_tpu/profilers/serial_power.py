@@ -45,6 +45,7 @@ def parse_wattsup_frame(line: str) -> Optional[Dict[str, float]]:
 class SerialPowerMeterProfiler(Profiler):
     data_columns = ("wall_energy_J", "wall_avg_power_W")
     artifact_name = "wall_power"
+    measured_channel = True
 
     def __init__(
         self,

@@ -51,6 +51,7 @@ class TpuPowerCounterProfiler(SamplingProfiler):
 
     data_columns = ("tpu_energy_J", "tpu_avg_power_W")
     artifact_name = "tpu_power"
+    measured_channel = True
 
     def __init__(self, period_s: float = 0.1) -> None:
         super().__init__(period_s=period_s)

@@ -170,9 +170,12 @@ def estimate_weight_bytes(
             embed_params + l * matmul_per_layer + norms_biases
         )
     weight_b = 1.0 if quantize == "int8" else 0.5
+    # per-row embed scales (f32): the int8 embedding table carries one, and
+    # an untied lm_head carries its own (quantize.py stores both)
+    embed_scale_rows = cfg.vocab_size * (1 if cfg.tie_embeddings else 2)
     return int(
         embed_params  # int8 in both modes
-        + 4 * cfg.vocab_size  # per-row embed scales (f32)
+        + 4 * embed_scale_rows
         + l * matmul_per_layer * weight_b
         + 4 * l * matmul_out_channels  # per-output-channel scales (f32)
         + dtype_bytes * norms_biases

@@ -7,7 +7,8 @@ experiment/RunnerConfig.py:122-131):
 
   terminal 1 (owns the chip):
     python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu serve \
-        --host 127.0.0.1 --port 11434 --quantize per-model
+        --host 127.0.0.1 --port 11434 \
+        --quantize "qwen2:1.5b=int8,gemma:2b=int8,default=int4"
 
   terminal 2 (pure HTTP client; NEVER initialises a JAX backend):
     python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu \

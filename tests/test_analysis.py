@@ -254,6 +254,102 @@ def test_variance_check_reports_cells_and_verdict():
     assert vc2["verdict"] == "pass"
 
 
+def test_variance_check_judges_cells_against_their_own_distribution():
+    """A slow model's rows can be outliers of the POOLED location×length
+    subset while being perfectly tight within their own cell. The global
+    IQR filter must not make such cells unassessable (round 2 lost 6 of
+    42 cells this way) — per-cell CV is judged on the cell's own
+    distribution."""
+    rows = []
+    i = 0
+    # slow is a small minority → the whole-table IQR fence sits tight
+    # around the fast rows and (globally) drops every slow row
+    for model, base, reps in (("fast", 1.0, 40), ("slow", 400.0, 6)):
+        for rep in range(reps):
+            rows.append(
+                {
+                    "__run_id": f"run_{i}_repetition_{rep}",
+                    "__done": RunProgress.DONE,
+                    "model": model,
+                    "location": "on_device",
+                    "length": 100,
+                    "energy_J": base * (1.0 + 0.002 * (rep % 3)),
+                    "execution_time_s": base,
+                }
+            )
+            i += 1
+    report = analyze(rows, metrics=("energy_J", "execution_time_s"))
+    # sanity: the global filter really does drop the slow rows
+    assert report["n_after_iqr"] < len(rows)
+    vc = report["variance_check"]
+    assert set(vc["cells"]) == {"fast|on_device|100", "slow|on_device|100"}
+    assert vc["cells"]["slow|on_device|100"]["n"] >= 4
+    assert vc["n_cells"] == 2
+    assert vc["verdict"] == "pass"  # both cells are tight within themselves
+
+
+def test_variance_check_flags_nan_cv_cells():
+    """A zero-mean cell has an undefined CV: it must be flagged
+    unassessable, excluded from the worst-cell pick, and never silently
+    counted as a failure (ADVICE round-2)."""
+    rows = []
+    i = 0
+    for model, energy in (("ok", 10.0), ("zero", 0.0)):
+        for rep in range(5):
+            rows.append(
+                {
+                    "__run_id": f"run_{i}_repetition_{rep}",
+                    "__done": RunProgress.DONE,
+                    "model": model,
+                    "location": "on_device",
+                    "length": 100,
+                    "energy_J": energy,
+                }
+            )
+            i += 1
+    report = analyze(rows, metrics=("energy_J",))
+    vc = report["variance_check"]
+    assert vc["cells"]["zero|on_device|100"]["cv"] is None
+    assert vc["n_unassessable"] == 1
+    assert vc["worst"]["cell"] == "ok|on_device|100"
+    assert vc["verdict"] == "pass"  # the assessable cell passes
+    md = render_markdown(report)
+    assert "unassessable" in md
+
+    # every cell NaN → the target was never failed, it was never judged
+    for r in rows:
+        r["energy_J"] = 0.0
+    vc_all_nan = analyze(rows, metrics=("energy_J",))["variance_check"]
+    assert vc_all_nan["verdict"] == "unassessable"
+    assert vc_all_nan["n_cells"] == 0
+    assert "worst" not in vc_all_nan
+
+
+def test_variance_check_keeps_globally_filtered_treatments():
+    """A treatment (location/length level) whose rows the pooled IQR
+    filter drops wholesale must still appear in the variance check."""
+    rows = []
+    i = 0
+    for loc, base, reps in (("on_device", 1.0, 40), ("remote", 400.0, 6)):
+        for rep in range(reps):
+            rows.append(
+                {
+                    "__run_id": f"run_{i}_repetition_{rep}",
+                    "__done": RunProgress.DONE,
+                    "model": "m",
+                    "location": loc,
+                    "length": 100,
+                    "energy_J": base * (1.0 + 0.002 * (rep % 3)),
+                }
+            )
+            i += 1
+    report = analyze(rows, metrics=("energy_J",))
+    vc = report["variance_check"]
+    assert "m|remote|100" in vc["cells"]
+    assert vc["cells"]["m|remote|100"]["n"] >= 4
+    assert vc["verdict"] == "pass"
+
+
 def test_skewness_transform_step_in_report():
     rows = _synthetic_rows(n_per_cell=15)
     # make one subset strongly right-skewed so the log-transform step fires

@@ -539,6 +539,30 @@ def test_apply_stop_binary_search_matches_linear_scan():
         assert got_tokens == tokens[:k]
 
 
+def test_apply_stop_fixup_repairs_non_monotone_decode():
+    """Cleanup/merging tokenizers make decode length only approximately
+    monotone in prefix length — the bisect can land positions off. The
+    bounded fix-up must restore the smallest covering prefix (ADVICE
+    round-2: wire-visible token counts were drifting)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        _apply_stop,
+    )
+
+    class WeirdTok:
+        # decode length by prefix length: dips at 2 and 4 steer the bisect
+        # to land at 5; the true smallest covering prefix (len >= 2) is 3.
+        lens = [0, 1, 1, 4, 1, 4]
+
+        def decode(self, ids):
+            return "abZd"[: self.lens[len(ids)]]
+
+    tokens = [10, 11, 12, 13, 14]
+    text = "abZd"  # full decode; stop at index 2 → kept = "ab"
+    got_tokens, got_text = _apply_stop(tokens, text, WeirdTok(), ("Z",))
+    assert got_text == "ab"
+    assert got_tokens == tokens[:3]
+
+
 def test_per_model_quantize_dict():
     """One engine can serve different models at different quant modes
     (small = int8 for speed, large = int4 for capacity)."""

@@ -110,6 +110,34 @@ def test_speculative_rejects_vocab_mismatch(engine):
         )
 
 
+def test_non_coresident_pair_falls_back_to_plain_decode(registry, monkeypatch):
+    """When target+draft can't share the allocation budget, the request is
+    served by plain greedy decode (same tokens) with a warning — a
+    configured draft must never hard-fail a request plain decoding would
+    serve (ADVICE round-2)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils import memory as mem
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        estimate_weight_bytes,
+    )
+
+    one = estimate_weight_bytes(registry["target"], None, 4)
+    monkeypatch.setattr(mem, "LOAD_TRANSIENT_HEADROOM_BYTES", 0)
+    # budget fits ONE model, never two
+    monkeypatch.setenv("TPU_ALLOC_BUDGET_BYTES", str(int(1.2 * one)))
+    engine = JaxEngine(
+        registry=registry,
+        dtype=jnp.float32,
+        speculative={"target": ("draft", 4)},
+    )
+    req = GenerationRequest("target", "cannot be co-resident", max_new_tokens=12)
+    result = engine.generate(req)  # must not raise
+    assert result.generated_tokens > 0
+    assert result.extras is None  # plain path, not speculative
+    # token-identical to an unconfigured engine's plain decode
+    plain = JaxEngine(registry=registry, dtype=jnp.float32).generate(req)
+    assert result.tokens == plain.tokens
+
+
 def test_speculative_rejects_sampling(engine):
     with pytest.raises(ValueError, match="greedy-only"):
         engine.generate_speculative(

@@ -65,7 +65,10 @@ def test_estimate_weight_bytes_matches_actual_quantized_params():
         estimate_weight_bytes,
     )
 
-    for base in ("qwen2:1.5b", "gemma:2b"):
+    # gemma ties embeddings; llama3.1/mistral don't — the untied case
+    # exercises the lm_head's own per-row scale vector in the estimate
+    # (ADVICE round-2: it was previously counted once, not twice).
+    for base in ("qwen2:1.5b", "gemma:2b", "llama3.1:8b", "mistral:7b"):
         cfg = get_model_config(base).tiny()
         params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
         for mode in (None, "int8", "int4"):
