@@ -162,6 +162,7 @@ class JaxEngine(GenerationBackend):
         prefill_attention: "str | PrefillAttentionFn | None" = "auto",
         speculative: "Optional[Dict[str, Tuple[str, int]]]" = None,
         prefix_cache_size: int = 0,  # cached prompt-KV entries per model
+        prefix_cache_bytes: Optional[int] = None,  # total KV bytes cap
         kv_quantize: Optional[str] = None,  # None | "int8" (decode path)
     ) -> None:
         # quantize: one mode for every model (None | "int8" | "int4"), or a
@@ -181,15 +182,22 @@ class JaxEngine(GenerationBackend):
             raise ValueError(
                 f"prefix_cache_size must be >= 0, got {prefix_cache_size}"
             )
+        if prefix_cache_bytes is not None and prefix_cache_bytes < 0:
+            raise ValueError(
+                f"prefix_cache_bytes must be >= 0, got {prefix_cache_bytes}"
+            )
         # kv_quantize="int8": the DECODE loop runs over an int8 KV cache
         # (per-position vector scales; prefill fills a bf16 cache which is
         # quantized once before decoding). Halves the cache stream — the
         # dominant per-step bytes for many-KV-head models at long context
-        # (phi3: ~0.8 GB/step at 2k). Single-request decode only for now:
-        # incompatible with speculative decoding and prefix caching.
+        # (phi3: ~0.8 GB/step at 2k). Composes with generate/stream/batch
+        # and the TP engine; still incompatible with speculative decoding
+        # and prefix caching (both thread bf16 caches across calls).
         if kv_quantize not in (None, "int8"):
             raise ValueError(f"unsupported kv_quantize mode: {kv_quantize!r}")
-        if kv_quantize and (speculative or prefix_cache_size):
+        if kv_quantize and (
+            speculative or prefix_cache_size or prefix_cache_bytes is not None
+        ):
             raise ValueError(
                 "kv_quantize is incompatible with speculative decoding and "
                 "prefix caching (both thread bf16 caches)"
@@ -216,9 +224,19 @@ class JaxEngine(GenerationBackend):
         self._tokenizers: Dict[str, Any] = {}  # per-model, via _tokenizer_for
         # prompt-prefix KV reuse (off by default: the energy study wants
         # every run to pay its own prefill); model → OrderedDict LRU of
-        # ids-tuple → (k_cache, v_cache, last-position logits)
+        # ids-tuple → (k_cache, v_cache, last-position logits, lru_stamp).
+        # Budgeted by BYTES, not just entries: cached KV is device memory
+        # (tens–hundreds of MB per entry on 7B models) and counts against
+        # the same allocation budget as resident weights.
         self.prefix_cache_size = prefix_cache_size
+        self.prefix_cache_bytes = prefix_cache_bytes
+        # Either cap enables the cache: entries (per model), bytes (global),
+        # or both. A byte cap alone must not be silently inert.
+        self._prefix_enabled = (
+            prefix_cache_size > 0 or prefix_cache_bytes is not None
+        )
         self._prefix_cache: Dict[str, Any] = {}
+        self._prefix_clock = 0  # global LRU stamp across models
         self._models: Dict[str, Transformer] = {}
         # Models whose weights exist ONLY in memory (install_model — no
         # registry-init or checkpoint source to reload from): never LRU
@@ -467,7 +485,24 @@ class JaxEngine(GenerationBackend):
         resident = {
             name: weight_bytes(name, tf.cfg) for name, tf in self._models.items()
         }
-        while sum(resident.values()) + incoming > budget:
+        # Cached prompt KV is device memory too (tens–hundreds of MB per
+        # entry on 7B models) and counts against the same budget. Prefix
+        # entries evict FIRST — they are pure recompute, far cheaper to
+        # rebuild than a model reload. Charged per device like the weights
+        # (nbytes of a mesh-sharded array is its GLOBAL size).
+        prefix_resident = self._prefix_bytes() // n_dev
+        while sum(resident.values()) + prefix_resident + incoming > budget:
+            if prefix_resident > 0:
+                freed_global = self._evict_prefix_lru()
+                if freed_global:
+                    prefix_resident -= freed_global // n_dev
+                    term.log(
+                        f"evicted a cached prompt prefix "
+                        f"(~{freed_global / n_dev / 1024**2:.1f} MiB/device) "
+                        f"to fit {model}"
+                    )
+                    continue
+                prefix_resident = 0
             # oldest (LRU) un-pinned model; installed-only weights have no
             # source to reload from and are never victims
             victim = next(
@@ -689,6 +724,16 @@ class JaxEngine(GenerationBackend):
 
         return int8_cache_attention
 
+    def _quantize_batch_cache(self, model: str, k_cache, v_cache):
+        """One bulk quantization of a batch's assembled cache: scales are
+        per (layer, row, head, position), so rows stay independent and each
+        row's stream is bit-identical to its single-request quantized
+        decode. Hook point — the TP engine overrides to also place the
+        {"q","s"} leaves on its mesh (same reason as _maybe_quantize_cache)."""
+        from ..models.quantize import quantize_kv_cache
+
+        return quantize_kv_cache(k_cache, v_cache)
+
     def _maybe_quantize_cache(self, st: Dict[str, Any]) -> Dict[str, Any]:
         """Post-prefill cache conversion for the decode loop (prefill
         always runs on the bf16 cache; see kv_quantize in the ctor)."""
@@ -773,7 +818,7 @@ class JaxEngine(GenerationBackend):
     def _find_prefix(self, model: str, prompt_ids: "list[int]"):
         """Longest cached (ids, k, v, logits) whose ids are a prefix of
         ``prompt_ids``; refreshes its LRU position."""
-        if not self.prefix_cache_size:
+        if not self._prefix_enabled:
             return None
         entries = self._prefix_cache.get(model)
         if not entries:
@@ -787,11 +832,42 @@ class JaxEngine(GenerationBackend):
         if best_key is None:
             return None
         entries.move_to_end(best_key)
-        k, v, logits = entries[best_key]
+        k, v, logits, _ = entries[best_key]
+        self._prefix_clock += 1
+        entries[best_key] = (k, v, logits, self._prefix_clock)
         return list(best_key), k, v, logits
 
+    @staticmethod
+    def _prefix_entry_bytes(entry) -> int:
+        k, v, logits, _stamp = entry
+        return k.nbytes + v.nbytes + (logits.nbytes if logits is not None else 0)
+
+    def _prefix_bytes(self) -> int:
+        """Total device bytes pinned by cached prompt KV, all models."""
+        return sum(
+            self._prefix_entry_bytes(e)
+            for entries in self._prefix_cache.values()
+            for e in entries.values()
+        )
+
+    def _evict_prefix_lru(self) -> int:
+        """Drop the globally least-recently-used prefix entry; returns the
+        bytes freed (0 when the cache is empty)."""
+        best = None
+        for model, entries in self._prefix_cache.items():
+            for key, entry in entries.items():
+                if best is None or entry[3] < best[0]:
+                    best = (entry[3], model, key)
+        if best is None:
+            return 0
+        _, model, key = best
+        freed = self._prefix_entry_bytes(self._prefix_cache[model].pop(key))
+        if not self._prefix_cache[model]:
+            del self._prefix_cache[model]
+        return freed
+
     def _store_prefix(self, model, prompt_ids, k_cache, v_cache, logits, s_real):
-        if not self.prefix_cache_size:
+        if not self._prefix_enabled:
             return
         from collections import OrderedDict
 
@@ -801,14 +877,25 @@ class JaxEngine(GenerationBackend):
         # bucket padding would pin HBM a hit never reads. JAX arrays are
         # immutable, so keeping references is safe (decode produces new
         # arrays and never mutates these).
+        self._prefix_clock += 1
         entries[key] = (
             k_cache[:, :, :, :s_real],
             v_cache[:, :, :, :s_real],
             logits,
+            self._prefix_clock,
         )
         entries.move_to_end(key)
-        while len(entries) > self.prefix_cache_size:
+        while self.prefix_cache_size and len(entries) > self.prefix_cache_size:
             entries.popitem(last=False)
+        # Byte cap across ALL models' entries: evict globally-LRU entries
+        # until under the cap. A lone entry larger than the cap is dropped
+        # outright — caching it would defeat the budget it enforces.
+        if self.prefix_cache_bytes is not None:
+            while (
+                self._prefix_bytes() > self.prefix_cache_bytes
+                and self._evict_prefix_lru()
+            ):
+                pass
 
     def _start(
         self,
@@ -1128,7 +1215,9 @@ class JaxEngine(GenerationBackend):
             return self._decode_cache[key]
         tf = self._models[model]
         cfg = tf.cfg
-        decode_attention = self.decode_attention
+        # the attention matching the cache representation (int8 codes +
+        # per-(row, head, position) scales under kv_quantize)
+        decode_attention = self._decode_attention_for_cache()
         eos = self._tokenizer_for(model).eos_id
 
         from ..ops.sampling import sample_token_per_row
@@ -1223,12 +1312,6 @@ class JaxEngine(GenerationBackend):
         """
         if not requests:
             return []
-        if self.kv_quantize:
-            raise ValueError(
-                "generate_batch is not supported with kv_quantize (the "
-                "batched decode threads a shared bf16 cache); serve "
-                "batches from a non-quantized-KV engine"
-            )
         max_rows = BATCH_BUCKETS[-1]
         if len(requests) > max_rows:
             # Larger fleets run as sequential full-width batches rather than
@@ -1275,6 +1358,10 @@ class JaxEngine(GenerationBackend):
         offsets = jnp.asarray([st["s_real"] for st in rows], dtype=jnp.int32)
         k_cache = jnp.concatenate([st["k_cache"] for st in rows], axis=1)
         v_cache = jnp.concatenate([st["v_cache"] for st in rows], axis=1)
+        if self.kv_quantize:
+            k_cache, v_cache = self._quantize_batch_cache(
+                model, k_cache, v_cache
+            )
         presence = jnp.concatenate([st["presence"] for st in rows], axis=0)
         rngs = jnp.stack([st["rng"] for st in rows])
         temps = jnp.asarray(

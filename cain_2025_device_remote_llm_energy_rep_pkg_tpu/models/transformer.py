@@ -183,10 +183,10 @@ def _attention_block(
         raise ValueError(
             "per-sequence offsets are only supported for single-token decode"
         )
-    if quant_cache and (per_seq or s != 1):
+    if quant_cache and s != 1:
         raise ValueError(
-            "quantized KV caches support single-sequence decode only "
-            "(prefill runs on the bf16 cache; it is quantized afterwards)"
+            "quantized KV caches support decode only (prefill runs on the "
+            "bf16 cache; it is quantized afterwards)"
         )
 
     q = dense_dot(x, layer["wq"])
@@ -206,22 +206,37 @@ def _attention_block(
         # Quantize the new entry and write codes + per-vector scale.
         kq, ks = quantize_kv_vector(k[:, 0])  # [B,Hkv,dh]
         vq, vs = quantize_kv_vector(v[:, 0])
-        k_cache = {
-            "q": jax.lax.dynamic_update_slice(
-                k_cache["q"], kq[:, :, None, :], (0, 0, offset, 0)
-            ),
-            "s": jax.lax.dynamic_update_slice(
-                k_cache["s"], ks[:, :, None], (0, 0, offset)
-            ),
-        }
-        v_cache = {
-            "q": jax.lax.dynamic_update_slice(
-                v_cache["q"], vq[:, :, None, :], (0, 0, offset, 0)
-            ),
-            "s": jax.lax.dynamic_update_slice(
-                v_cache["s"], vs[:, :, None], (0, 0, offset)
-            ),
-        }
+        if per_seq:
+            # Batched decode: each row writes at its own cache position
+            # (scales are per (row, head, position) — the batch axis is
+            # free, which is what lets kv_quantize compose with
+            # generate_batch).
+            rows = jnp.arange(b)
+            k_cache = {
+                "q": k_cache["q"].at[rows, :, offset].set(kq),
+                "s": k_cache["s"].at[rows, :, offset].set(ks),
+            }
+            v_cache = {
+                "q": v_cache["q"].at[rows, :, offset].set(vq),
+                "s": v_cache["s"].at[rows, :, offset].set(vs),
+            }
+        else:
+            k_cache = {
+                "q": jax.lax.dynamic_update_slice(
+                    k_cache["q"], kq[:, :, None, :], (0, 0, offset, 0)
+                ),
+                "s": jax.lax.dynamic_update_slice(
+                    k_cache["s"], ks[:, :, None], (0, 0, offset)
+                ),
+            }
+            v_cache = {
+                "q": jax.lax.dynamic_update_slice(
+                    v_cache["q"], vq[:, :, None, :], (0, 0, offset, 0)
+                ),
+                "s": jax.lax.dynamic_update_slice(
+                    v_cache["s"], vs[:, :, None], (0, 0, offset)
+                ),
+            }
     elif per_seq:
         # Each sequence writes its token's K/V at its own cache position.
         k_cache = k_cache.at[jnp.arange(b), :, offset].set(

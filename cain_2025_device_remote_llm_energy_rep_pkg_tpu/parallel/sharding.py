@@ -98,6 +98,20 @@ def cache_shardings(
     return NamedSharding(mesh, cache_spec(cfg, mesh, batch_axis))
 
 
+def quant_cache_shardings(
+    cfg: ModelConfig, mesh: Mesh, batch_axis: str | None = None
+) -> Dict[str, NamedSharding]:
+    """Shardings for an int8-quantized cache leaf ``{"q", "s"}``
+    (models/quantize.py): codes ``q`` [L,B,Hkv,T,Dh] take the bf16 cache's
+    spec; scales ``s`` [L,B,Hkv,T] take the same spec minus the head dim
+    it reduced away."""
+    spec = cache_spec(cfg, mesh, batch_axis)
+    return {
+        "q": NamedSharding(mesh, spec),
+        "s": NamedSharding(mesh, P(*tuple(spec)[:-1])),
+    }
+
+
 def shard_model(params: Dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Dict[str, Any]:
     """Place an existing params pytree onto the mesh per the TP rules.
 

@@ -22,7 +22,7 @@ from ..engine.jax_engine import JaxEngine
 from ..models.config import ModelConfig
 from ..models.quantize import int4_kernel_disabled
 from .mesh import MeshSpec, build_mesh
-from .sharding import cache_shardings, shard_model
+from .sharding import cache_shardings, quant_cache_shardings, shard_model
 
 
 class TensorParallelEngine(JaxEngine):
@@ -35,11 +35,6 @@ class TensorParallelEngine(JaxEngine):
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, **kwargs) -> None:
-        if kwargs.get("kv_quantize"):
-            raise ValueError(
-                "kv_quantize is not supported on the tensor-parallel "
-                "engine yet (the quantized cache has no sharding rules)"
-            )
         super().__init__(**kwargs)
         self.mesh = mesh if mesh is not None else build_mesh(MeshSpec.tp_only())
 
@@ -82,3 +77,38 @@ class TensorParallelEngine(JaxEngine):
             jax.device_put(k_cache, sharding),
             jax.device_put(v_cache, sharding),
         )
+
+    def _place_quant_cache(self, cfg: ModelConfig, cache):
+        """Explicit mesh placement of a ``{"q","s"}`` cache leaf (codes
+        keep the bf16 cache's head sharding; scales drop the reduced head
+        dim) so decode partitions the int8 stream instead of inheriting
+        whatever GSPMD inferred for the eager quantization ops."""
+        shardings = quant_cache_shardings(cfg, self.mesh)
+        return {
+            key: jax.device_put(cache[key], shardings[key])
+            for key in ("q", "s")
+        }
+
+    def _maybe_quantize_cache(self, st):
+        st = super()._maybe_quantize_cache(st)
+        if self.kv_quantize:
+            cfg = st["tf"].cfg
+            st["k_cache"] = self._place_quant_cache(cfg, st["k_cache"])
+            st["v_cache"] = self._place_quant_cache(cfg, st["v_cache"])
+        return st
+
+    def _quantize_batch_cache(self, model, k_cache, v_cache):
+        kq, vq = super()._quantize_batch_cache(model, k_cache, v_cache)
+        cfg = self._models[model].cfg
+        return (
+            self._place_quant_cache(cfg, kq),
+            self._place_quant_cache(cfg, vq),
+        )
+
+    def _decode_attention_for_cache(self):
+        """The int8 flash-decode Pallas kernel has no GSPMD partitioning
+        rule (like the int4 matmul kernel) — under a real multi-device
+        mesh the jnp fallback path partitions fine, so use it there."""
+        if self.kv_quantize and self.n_devices > 1:
+            return None
+        return super()._decode_attention_for_cache()

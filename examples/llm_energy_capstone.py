@@ -1,6 +1,7 @@
-"""The round-2 real-hardware capstone study — the FULL 7-model sweep.
+"""The real-hardware capstone study — the FULL reference protocol.
 
-7 model families × 2 locations × 3 content lengths × 10 repetitions, with
+7 model families × 2 locations × 3 content lengths × 30 repetitions
+(1,260 runs, experiment/RunnerConfig.py:80-88), with
 the faithful client/server split of the reference (its on-device treatment
 curls a LOCAL Ollama server on 11434; remote curls another machine's —
 experiment/RunnerConfig.py:122-131):
@@ -23,9 +24,9 @@ energy for remote is still modelled as the 8-chip mesh via
 Quantization: the two small models at int8 (speed mode), everything from
 phi3:3.8b up at int4 (capacity mode — all four 7B/8B-class models fit the
 chip's program budget at int4, validated by direct decode) — mirroring
-Ollama's default 4-bit GGUF quants for the large models. Cooldown is 2 s,
-not the reference's 90 s: the modelled energy is thermal-state-free, so
-long cooldowns only stretch wall-clock (recorded as a protocol deviation).
+Ollama's default 4-bit GGUF quants for the large models. Cooldown follows
+the channel-typed policy: 2 s on this modelled-energy host (thermal-state
+-free), the reference's 90 s wherever a measured channel is active.
 """
 
 import os
@@ -62,8 +63,12 @@ class RunnerConfig(LlmEnergyConfig):
         super().__init__(
             models=CAPSTONE_MODELS,
             lengths=[100, 500, 1000],
-            repetitions=10,
-            cooldown_ms=2000,
+            # The EXACT reference protocol: 30 repetitions per cell →
+            # 7 × 2 × 3 × 30 = 1,260 runs (experiment/RunnerConfig.py:87).
+            repetitions=30,
+            # cooldown deliberately unset: the channel-typed policy picks
+            # 2 s on modelled-only hosts and the reference's 90 s when a
+            # measured energy channel is active.
             results_output_path=Path("experiments_output"),
             on_device_url=SERVER_URL,
             remote_url=SERVER_URL,

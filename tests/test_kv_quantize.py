@@ -125,11 +125,30 @@ def test_kv_quantize_guards():
             kv_quantize="int8",
             speculative={"a": ("b", 4)},
         )
-    kv8 = JaxEngine(registry=registry, dtype=jnp.float32, kv_quantize="int8")
-    with pytest.raises(ValueError, match="generate_batch"):
-        kv8.generate_batch(
-            [GenerationRequest("tiny", "x", max_new_tokens=4)]
-        )
+
+
+def test_kv_quantize_batch_matches_single(engines):
+    """VERDICT round-2 item 3: generate_batch must run with
+    kv_quantize="int8", each row token-identical to its own
+    single-request quantized decode (per-row scales make rows
+    independent)."""
+    _, kv8 = engines
+    reqs = [
+        GenerationRequest("tiny", "batch row one", max_new_tokens=10),
+        GenerationRequest("tiny", "a different second row", max_new_tokens=14),
+        GenerationRequest("tiny", "and row three", max_new_tokens=7),
+    ]
+    batch = kv8.generate_batch(reqs)
+    singles = [kv8.generate(r) for r in reqs]
+    for b_r, s_r in zip(batch, singles):
+        assert b_r.tokens == s_r.tokens
+        assert b_r.text == s_r.text
+
+
+def test_kv_quantize_on_tensor_parallel_engine():
+    """VERDICT round-2 item 3: the TP engine serves kv_quantize="int8" —
+    the {"q","s"} cache pytree gets explicit mesh shardings — with
+    tokens matching the single-device quantized engine."""
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.mesh import (
         MeshSpec,
         build_mesh,
@@ -138,9 +157,24 @@ def test_kv_quantize_guards():
         TensorParallelEngine,
     )
 
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
     mesh = build_mesh(MeshSpec.tp_only(2), devices=jax.devices()[:2])
-    with pytest.raises(ValueError, match="tensor-parallel"):
-        TensorParallelEngine(mesh=mesh, kv_quantize="int8")
+    tp = TensorParallelEngine(
+        mesh=mesh,
+        registry=dict(registry),
+        dtype=jnp.float32,
+        kv_quantize="int8",
+    )
+    single = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, kv_quantize="int8"
+    )
+    req = GenerationRequest("tiny", "sharded quantized cache", max_new_tokens=12)
+    r_tp = tp.generate(req)
+    r_single = single.generate(req)
+    assert r_tp.tokens == r_single.tokens
+    # batched decode on the sharded quantized cache too
+    batch = tp.generate_batch([req, req])
+    assert batch[0].tokens == r_tp.tokens == batch[1].tokens
 
 
 def test_quantize_kv_vector_shapes():

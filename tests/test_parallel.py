@@ -108,6 +108,56 @@ def test_tp_engine_matches_single_device_greedy():
     assert r_single.tokens == r_tp.tokens
 
 
+def test_tp_generate_batch_matches_single_requests():
+    """The TP engine's batched decode (VERDICT round-2 item 5: previously
+    untested) — every row token-identical to its own TP generate()."""
+    cfg = _tiny8()
+    registry = {"tiny8": cfg}
+    tp = TensorParallelEngine(
+        mesh=build_mesh(MeshSpec.tp_only()), registry=registry, dtype=jnp.float32
+    )
+    reqs = [
+        GenerationRequest("tiny8", "first sharded row", max_new_tokens=10),
+        GenerationRequest("tiny8", "second row differs", max_new_tokens=12),
+        GenerationRequest("tiny8", "third", max_new_tokens=6),
+    ]
+    batch = tp.generate_batch(reqs)
+    for r, req in zip(batch, reqs):
+        assert r.tokens == tp.generate(req).tokens
+
+
+def test_tp_generate_stream_matches_monolithic():
+    cfg = _tiny8()
+    registry = {"tiny8": cfg}
+    tp = TensorParallelEngine(
+        mesh=build_mesh(MeshSpec.tp_only()), registry=registry, dtype=jnp.float32
+    )
+    req = GenerationRequest("tiny8", "streamed over the mesh", max_new_tokens=12)
+    mono = tp.generate(req)
+    chunks = list(tp.generate_stream(req, chunk_tokens=4))
+    streamed = [t for c in chunks[:-1] for t in c.tokens]
+    assert streamed == mono.tokens
+    assert chunks[-1].result.tokens == mono.tokens
+
+
+def test_tp_speculative_matches_plain_greedy():
+    """Speculative decoding on the sharded engine: draft+target both live
+    on the mesh; accepted tokens must equal TP plain greedy."""
+    import dataclasses
+
+    cfg = _tiny8()
+    draft_cfg = dataclasses.replace(cfg, n_layers=1)
+    registry = {"tiny8": cfg, "draft8": draft_cfg}
+    tp = TensorParallelEngine(
+        mesh=build_mesh(MeshSpec.tp_only()), registry=registry, dtype=jnp.float32
+    )
+    req = GenerationRequest("tiny8", "speculate on the mesh", max_new_tokens=16)
+    plain = tp.generate(req)
+    spec = tp.generate_speculative(req, "draft8", k=4)
+    assert spec.tokens == plain.tokens
+    assert spec.extras is not None and spec.extras["spec_rounds"] >= 1
+
+
 def test_ring_attention_matches_reference():
     mesh = build_mesh(MeshSpec(axes=(("sp", 8),)))
     b, s, hq, hkv, d = 1, 64, 4, 2, 16
