@@ -164,6 +164,8 @@ class JaxEngine(GenerationBackend):
         prefix_cache_size: int = 0,  # cached prompt-KV entries per model
         prefix_cache_bytes: Optional[int] = None,  # total KV bytes cap
         kv_quantize: Optional[str] = None,  # None | "int8" (decode path)
+        paged_kv: bool = False,  # batched decode over a paged pool
+        page_size: int = 128,
     ) -> None:
         # quantize: one mode for every model (None | "int8" | "int4"), or a
         # per-model dict {model: mode} with an optional "default" key — a
@@ -202,6 +204,24 @@ class JaxEngine(GenerationBackend):
                 "kv_quantize is incompatible with speculative decoding and "
                 "prefix caching (both thread bf16 caches)"
             )
+        # paged_kv=True: generate_batch decodes over a shared page pool
+        # (engine/paged_kv.py) instead of one max-shape contiguous cache —
+        # each row holds exactly ceil(tokens/page) pages, so mixed-length
+        # concurrent requests stop paying the widest row's padding. The
+        # pool is assembled per batch (stateless); prefill stays
+        # contiguous per request and is scattered in whole pages.
+        if paged_kv and kv_quantize:
+            raise ValueError(
+                "paged_kv and kv_quantize cannot combine yet (the pool "
+                "holds bf16 pages; an int8 pool is future work)"
+            )
+        if page_size < 1 or page_size % 128:
+            raise ValueError(
+                f"page_size must be a positive multiple of 128 (the lane "
+                f"width the decode kernel tiles on), got {page_size}"
+            )
+        self.paged_kv = paged_kv
+        self.page_size = page_size
         self.kv_quantize = kv_quantize
         self.quantize = quantize
         # target model → (draft model, k): greedy requests for the target
@@ -1292,6 +1312,301 @@ class JaxEngine(GenerationBackend):
         self._decode_cache[key] = decode
         return decode
 
+    def _paged_batch_decode_fn(
+        self,
+        model: str,
+        n_steps: int,
+        top_k: int,
+        use_top_p: bool,
+        use_rp: bool,
+        n_pages: int,
+        jmax: int,
+    ) -> Callable:
+        """Batched decode over a paged pool: rows write each step's K/V at
+        their own (page, slot) through the table and attend through it.
+        Emitted tokens are identical to the contiguous batch loop for every
+        row (per-row rng/knobs/done-masks are the same machinery); rows
+        additionally stop writing once their OWN budget is exhausted, so a
+        row's pool allocation is bounded by its own request, not the
+        batch's widest."""
+        key = (
+            "paged-batch", model, n_steps, top_k, use_top_p, use_rp,
+            n_pages, jmax,
+        )
+        if key in self._decode_cache:
+            return self._decode_cache[key]
+        tf = self._models[model]
+        cfg = tf.cfg
+        eos = self._tokenizer_for(model).eos_id
+
+        if self.decode_attention is not None:
+            from ..ops.pallas_paged_attention import (
+                pallas_paged_decode_attention,
+            )
+
+            def decode_attention(q, kc, vc, lengths):
+                return pallas_paged_decode_attention(
+                    q, kc["pool"], vc["pool"], kc["table"], lengths
+                )
+
+        else:  # jnp fallback gathers through the table (CPU tests)
+            decode_attention = None
+
+        from ..ops.sampling import sample_token_per_row
+
+        @jax.jit
+        def decode(
+            params,
+            first_tokens,  # [B]
+            offsets,  # [B]
+            pool_k,  # [L, P, Hkv, page, D]
+            pool_v,
+            table,  # [B, Jmax] int32
+            temperature,  # [B]
+            rngs,
+            n_real,  # scalar
+            budgets,  # [B] — per-row token budgets
+            top_p,
+            repeat_penalty,
+            presence,
+            done0,
+        ):
+            b = first_tokens.shape[0]
+            l = pool_k.shape[0]
+            table_l = jnp.broadcast_to(table, (l,) + table.shape)
+
+            def cond(carry):
+                _, _, _, _, _, done, i, _, _, _ = carry
+                return (i < n_real) & ~jnp.all(done)
+
+            def body(carry):
+                token, offs, pk, pv, rngs, done, i, out, pres, n_row = carry
+                prev_done = done
+                kc = {"pool": pk, "table": table_l}
+                vc = {"pool": pv, "table": table_l}
+                hidden, kc, vc = forward(
+                    params, cfg, token[:, None], offs, kc, vc, decode_attention
+                )
+                pk, pv = kc["pool"], vc["pool"]
+                logits = logits_for(params, cfg, hidden[:, 0])
+                split = jax.vmap(jax.random.split)(rngs)
+                rngs, subs = split[:, 0], split[:, 1]
+                nxt = sample_token_per_row(
+                    logits,
+                    subs,
+                    temperature,
+                    top_k,
+                    top_p if use_top_p else None,
+                    pres if use_rp else None,
+                    repeat_penalty if use_rp else None,
+                )
+                nxt = jnp.where(done, jnp.int32(eos), nxt)
+                # a row is done at EOS *or* when its own budget is spent —
+                # after that it re-writes one frozen slot instead of
+                # consuming fresh pages
+                done = done | (nxt == eos) | (i + 1 >= budgets)
+                if use_rp:
+                    pres = pres.at[jnp.arange(b), nxt].set(True)
+                out = out.at[:, i].set(nxt)
+                n_row = jnp.where(prev_done, n_row, i + 1)
+                offs = jnp.where(done, offs, offs + 1)
+                return (
+                    nxt, offs, pk, pv, rngs, done, i + 1, out, pres, n_row
+                )
+
+            out0 = jnp.full((b, n_steps), eos, dtype=jnp.int32)
+            init = (
+                first_tokens,
+                offsets,
+                pool_k,
+                pool_v,
+                rngs,
+                done0,
+                jnp.int32(0),
+                out0,
+                presence,
+                jnp.zeros((b,), dtype=jnp.int32),
+            )
+            *_, out_tokens, _, n_row = jax.lax.while_loop(cond, body, init)
+            return out_tokens, n_row
+
+        self._decode_cache[key] = decode
+        return decode
+
+    def _generate_batch_paged(
+        self,
+        requests: "list[GenerationRequest]",
+        all_prompt_ids: "list[list[int]]",
+    ) -> "list[GenerationResult]":
+        """The paged batch path: per-row prefill at each row's OWN bucket
+        (no padding to the widest prompt), prefill K/V scattered into a
+        shared page pool in whole pages, one paged decode over the pool."""
+        from .paged_kv import PagePool
+
+        model = requests[0].model
+        top_k = requests[0].top_k
+        tf = self._models[model]
+        cfg = tf.cfg
+        tok = self._tokenizer_for(model)
+        page = self.page_size
+
+        def pow2_at_least(n: int, floor: int = 1) -> int:
+            m = floor
+            while m < n:
+                m *= 2
+            return m
+
+        states = []
+        n_real = max(r.max_new_tokens for r in requests) - 1
+        rows_pages: "list[int]" = []
+        for r, ids in zip(requests, all_prompt_ids):
+            # prefill needs only the prompt's own slots: decode writes go
+            # to the pool, not this cache
+            st = self._start(r, cache_len=_prompt_alloc(len(ids)), prompt_ids=ids)
+            states.append(st)
+            budget = min(r.max_new_tokens - 1, max(n_real, 0))
+            rows_pages.append(
+                -(-(st["s_real"] + budget + 1) // page)
+            )
+
+        n = len(states)
+        b_bucket = _bucket(n, BATCH_BUCKETS)
+        pad_rows = b_bucket - n
+        # padding rows enter pre-done and only ever re-write ONE frozen
+        # slot: one private page each (never aliasing a real row's pages —
+        # their garbage writes must not corrupt live caches)
+        total_pages = sum(rows_pages) + pad_rows
+        n_pages = pow2_at_least(total_pages, 4)
+        jmax = pow2_at_least(max(rows_pages or [1]))
+
+        pool = PagePool.create(
+            n_layers=cfg.n_layers,
+            n_pages=n_pages,
+            n_kv_heads=cfg.n_kv_heads,
+            d_head=cfg.d_head,
+            page_size=page,
+            dtype=self.dtype,
+        )
+        from .paged_kv import _paginate, scatter_pages
+
+        table_rows = []
+        chunk_idx: "list[int]" = []
+        chunks_k, chunks_v = [], []
+        for st, need in zip(states, rows_pages):
+            pages = pool.alloc(need)
+            # entries past `need` are never written (per-row budgets gate
+            # the frozen slot inside the allocation) nor read unmasked
+            table_rows.append(jnp.asarray(pages + [0] * (jmax - need), jnp.int32))
+            # [L,1,Hkv,T,D] → [L,Hkv,s_real,D] → page chunks
+            n_prompt_pages = -(-st["s_real"] // page)
+            chunk_idx.extend(pages[:n_prompt_pages])
+            chunks_k.append(
+                _paginate(st["k_cache"][:, 0], st["s_real"], page)
+            )
+            chunks_v.append(
+                _paginate(st["v_cache"][:, 0], st["s_real"], page)
+            )
+        for _ in range(pad_rows):
+            private = pool.alloc(1)[0]
+            table_rows.append(jnp.full((jmax,), private, jnp.int32))
+        # ONE scatter per pool for the whole batch (O(1) pool copies)
+        pool.k, pool.v = scatter_pages(
+            pool.k,
+            pool.v,
+            jnp.asarray(chunk_idx, jnp.int32),
+            jnp.concatenate(chunks_k),
+            jnp.concatenate(chunks_v),
+        )
+        table = jnp.stack(table_rows)
+        rows = states + [states[0]] * pad_rows
+
+        use_top_p = any(st["use_top_p"] for st in states)
+        use_rp = any(st["use_rp"] for st in states)
+        first_tokens = jnp.concatenate([st["first"] for st in rows])
+        offsets = jnp.asarray([st["s_real"] for st in rows], dtype=jnp.int32)
+        presence = jnp.concatenate([st["presence"] for st in rows], axis=0)
+        rngs = jnp.stack([st["rng"] for st in rows])
+        temps = jnp.asarray(
+            [r.temperature for r in requests]
+            + [requests[0].temperature] * pad_rows,
+            dtype=jnp.float32,
+        )
+
+        def _row_top_p(r: GenerationRequest) -> float:
+            return r.top_p if r.top_p < 1.0 else 2.0
+
+        top_ps = jnp.asarray(
+            [_row_top_p(r) for r in requests]
+            + [_row_top_p(requests[0])] * pad_rows,
+            dtype=jnp.float32,
+        )
+        rps = jnp.asarray(
+            [r.repeat_penalty for r in requests]
+            + [requests[0].repeat_penalty] * pad_rows,
+            dtype=jnp.float32,
+        )
+        budgets = jnp.asarray(
+            [r.max_new_tokens - 1 for r in requests] + [0] * pad_rows,
+            dtype=jnp.int32,
+        )
+        done0 = jnp.asarray([False] * n + [True] * pad_rows)
+        g_bucket = _bucket(max(r.max_new_tokens for r in requests), GEN_BUCKETS)
+
+        t1 = time.monotonic()
+        if n_real > 0:
+            decode = self._paged_batch_decode_fn(
+                model, g_bucket, top_k, use_top_p, use_rp, n_pages, jmax
+            )
+            out, n_row = decode(
+                tf.params,
+                first_tokens,
+                offsets,
+                pool.k,
+                pool.v,
+                table,
+                temps,
+                rngs,
+                jnp.int32(n_real),
+                budgets,
+                top_ps,
+                rps,
+                presence,
+                done0,
+            )
+            out = jax.block_until_ready(out)
+            n_row = _to_host_list(n_row)
+        else:
+            out = jnp.zeros((b_bucket, 0), dtype=jnp.int32)
+            n_row = [0] * b_bucket
+        t2 = time.monotonic()
+
+        out_host = _to_host_list(out)
+        first_host = _to_host_list(first_tokens)
+        results = []
+        for r, (request, st) in enumerate(zip(requests, states)):
+            budget = request.max_new_tokens - 1
+            take = min(n_row[r], budget)
+            generated = [int(first_host[r])] + out_host[r][:take]
+            if request.stop_at_eos and tok.eos_id in generated:
+                generated = generated[: generated.index(tok.eos_id)]
+            text = tok.decode(generated)
+            if request.stop:
+                generated, text = _apply_stop(generated, text, tok, request.stop)
+            prefill_s = st["t1"] - st["t0"]
+            results.append(
+                GenerationResult(
+                    request=request,
+                    tokens=generated,
+                    text=text,
+                    prompt_tokens=st["s_real"],
+                    generated_tokens=len(generated),
+                    prefill_s=prefill_s,
+                    decode_s=t2 - t1,
+                    total_s=prefill_s + (t2 - t1),
+                )
+            )
+        return results
+
     def generate_batch(
         self, requests: "list[GenerationRequest]"
     ) -> "list[GenerationResult]":
@@ -1330,10 +1645,20 @@ class JaxEngine(GenerationBackend):
         self.load_model(model)
         cfg = self._models[model].cfg
 
-        # One cache shape for every row: widest prompt bucket + widest
-        # generation bucket.
         tok = self._tokenizer_for(model)
         all_prompt_ids = [tok.encode(r.prompt) for r in requests]
+        if self.paged_kv:
+            for r, ids in zip(requests, all_prompt_ids):
+                if len(ids) + r.max_new_tokens > cfg.max_seq_len:
+                    raise ValueError(
+                        f"{model}: prompt {len(ids)} + generation "
+                        f"{r.max_new_tokens} exceeds max_seq_len "
+                        f"{cfg.max_seq_len}"
+                    )
+            return self._generate_batch_paged(requests, all_prompt_ids)
+
+        # One cache shape for every row: widest prompt bucket + widest
+        # generation bucket.
         s_buckets = [_prompt_alloc(len(ids)) for ids in all_prompt_ids]
         g_bucket = _bucket(max(r.max_new_tokens for r in requests), GEN_BUCKETS)
         cache_len = max(s_buckets) + g_bucket

@@ -52,6 +52,27 @@ DecodeAttentionFn = Callable[
     [jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray
 ]
 
+
+def is_paged_cache(leaf: Any) -> bool:
+    """A paged KV-cache leaf: ``{"pool": [P,Hkv,page,D], "table":
+    [B,Jmax]}`` (engine/paged_kv.py) — pages of a shared pool addressed
+    through a per-request block table."""
+    return isinstance(leaf, dict) and set(leaf) == {"pool", "table"}
+
+
+def _gather_paged(leaf, dtype=jnp.float32) -> jnp.ndarray:
+    """Materialise a paged cache as contiguous [B,Hkv,T,D] — the jnp
+    fallback path only; the Pallas kernel reads through the table."""
+    pool, table = leaf["pool"], leaf["table"]
+    b, jmax = table.shape
+    _, hkv, page, d = pool.shape
+    gathered = pool[table]  # [B, Jmax, Hkv, page, D]
+    return (
+        gathered.transpose(0, 2, 1, 3, 4)
+        .reshape(b, hkv, jmax * page, d)
+        .astype(dtype)
+    )
+
 # Signature: (q[B,S,Hq,D], k_cache[B,Hkv,T,D], v_cache[B,Hkv,T,D], offset) -> [B,S,Hq,D]
 PrefillAttentionFn = Callable[
     [jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray
@@ -177,7 +198,11 @@ def _attention_block(
     b, s, d = x.shape
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
     quant_cache = is_quantized_cache(k_cache)
-    t = (k_cache["q"] if quant_cache else k_cache).shape[2]
+    paged_cache = is_paged_cache(k_cache)
+    if paged_cache:
+        t = k_cache["table"].shape[1] * k_cache["pool"].shape[2]
+    else:
+        t = (k_cache["q"] if quant_cache else k_cache).shape[2]
     per_seq = jnp.ndim(offset) == 1  # batched decode: one offset per sequence
     if per_seq and s != 1:
         raise ValueError(
@@ -187,6 +212,11 @@ def _attention_block(
         raise ValueError(
             "quantized KV caches support decode only (prefill runs on the "
             "bf16 cache; it is quantized afterwards)"
+        )
+    if paged_cache and s != 1:
+        raise ValueError(
+            "paged KV caches support decode only (prefill runs contiguous "
+            "and is scattered into the pool afterwards)"
         )
 
     q = dense_dot(x, layer["wq"])
@@ -202,7 +232,31 @@ def _attention_block(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if quant_cache:
+    if paged_cache:
+        # Write this token's K/V at each row's (page, slot) through the
+        # page table — the block-table indirection that lets mixed-length
+        # requests share one pool. The addressing arithmetic lives in ONE
+        # place (engine/paged_kv.page_slot) shared with the row-level
+        # helpers, so the two writers cannot drift.
+        from ..engine.paged_kv import page_slot
+
+        table = k_cache["table"]  # [B, Jmax]
+        page_size = k_cache["pool"].shape[2]
+        off_b = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b,))
+        pages, slots = page_slot(table, off_b, page_size)  # [B], [B]
+        k_cache = {
+            **k_cache,
+            "pool": k_cache["pool"]
+            .at[pages, :, slots]
+            .set(k[:, 0].astype(k_cache["pool"].dtype)),
+        }
+        v_cache = {
+            **v_cache,
+            "pool": v_cache["pool"]
+            .at[pages, :, slots]
+            .set(v[:, 0].astype(v_cache["pool"].dtype)),
+        }
+    elif quant_cache:
         # Quantize the new entry and write codes + per-vector scale.
         kq, ks = quantize_kv_vector(k[:, 0])  # [B,Hkv,dh]
         vq, vs = quantize_kv_vector(v[:, 0])
@@ -263,16 +317,20 @@ def _attention_block(
     else:
         group = hq // hkv
         qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)
-        kf = (
-            dequant_cache(k_cache)
-            if quant_cache
-            else k_cache.astype(jnp.float32)
-        )
-        vf = (
-            dequant_cache(v_cache)
-            if quant_cache
-            else v_cache.astype(jnp.float32)
-        )
+        if paged_cache:
+            kf = _gather_paged(k_cache)
+            vf = _gather_paged(v_cache)
+        else:
+            kf = (
+                dequant_cache(k_cache)
+                if quant_cache
+                else k_cache.astype(jnp.float32)
+            )
+            vf = (
+                dequant_cache(v_cache)
+                if quant_cache
+                else v_cache.astype(jnp.float32)
+            )
         scores = jnp.einsum("bskgd,bktd->bkgst", qg, kf) * scale
         kpos = jnp.arange(t)
         if per_seq:

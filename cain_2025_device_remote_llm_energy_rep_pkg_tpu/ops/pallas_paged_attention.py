@@ -1,0 +1,214 @@
+"""Pallas paged-KV flash-decode attention (BASELINE.json: "paged-KV
+attention").
+
+The contiguous decode kernel (``pallas_attention.py``) requires each
+request's cache to be one [Hkv, T, D] slab, so a batch must allocate every
+row at the widest shape. Paged attention breaks the cache into fixed-size
+**pages** held in one shared pool:
+
+  k_pool, v_pool: [P, Hkv, page, D]   — P pages shared by all requests
+  page_table:     [B, Jmax] int32     — request b's j-th page index
+  lengths:        [B] int32           — valid tokens per request
+
+so a request holds exactly ``ceil(len/page)`` pages and mixed-length
+concurrent requests waste no HBM on padding — the reason vLLM-class
+servers page their caches, rebuilt here TPU-first.
+
+Kernel design: identical online-softmax accumulation to the contiguous
+kernel (grid (B, Hkv, Jmax), page axis innermost → sequential
+accumulation), but the BlockSpec index_map reads the scalar-prefetched
+page table to DMA the right [page, D] tile from the pool: the indirection
+costs nothing — the DMA engine is handed a different base offset per
+step, there is no gather. Pages past a request's length are clamped to
+its last valid page (Pallas elides the repeated DMA) and their compute is
+gated off with ``pl.when``.
+
+Parity is pinned against a gather-then-attend reference on scattered page
+permutations (tests/test_paged_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _paged_decode_kernel(
+    page_table_ref,  # SMEM [B, Jmax] int32 (scalar-prefetched)
+    lengths_ref,  # SMEM [B] int32 (scalar-prefetched)
+    q_ref,  # VMEM [1, 1, G, D]
+    k_ref,  # VMEM [1, 1, page, D] — the page named by the table
+    v_ref,  # VMEM [1, 1, page, D]
+    o_ref,  # VMEM [1, 1, G, D]
+    m_ref,  # VMEM scratch [G, 128] f32
+    l_ref,  # VMEM scratch [G, 128] f32
+    acc_ref,  # VMEM scratch [G, D] f32
+    *,
+    page: int,
+    n_pages_per_req: int,
+    scale: float,
+):
+    b_i = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[b_i]
+    block_start = j * page
+
+    @pl.when(block_start < length)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G,D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page,D]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [G,page]
+        idx = block_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(idx < length, s, -jnp.inf)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)  # [page,D]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_pages_per_req - 1)
+    def _finalise():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def pallas_paged_decode_attention(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pool: jnp.ndarray,  # [P, Hkv, page, D]
+    v_pool: jnp.ndarray,  # [P, Hkv, page, D]
+    page_table: jnp.ndarray,  # [B, Jmax] int32 — pool page per request block
+    lengths: jnp.ndarray,  # [B] int32
+    *,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash-decode attention reading K/V through a page table.
+
+    Semantically equal to gathering each request's pages into a contiguous
+    [B, Hkv, Jmax·page, D] cache and running the contiguous decode kernel
+    — without materialising that gather.
+    """
+    b, hq, d = q.shape
+    n_pool, hkv, page, _ = k_pool.shape
+    jmax = page_table.shape[1]
+    group = hq // hkv
+    scale = 1.0 / math.sqrt(d)
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    d_pad = (-d) % 128
+    qr = q.reshape(b, hkv, group, d)
+    if d_pad:
+        pad4 = ((0, 0), (0, 0), (0, 0), (0, d_pad))
+        qr = jnp.pad(qr, pad4)
+        k_pool = jnp.pad(k_pool, pad4)
+        v_pool = jnp.pad(v_pool, pad4)
+    dp = d + d_pad
+
+    # Every table entry the index_map can read must name a valid pool page
+    # (slots past a request's length are clamped again below).
+    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pool - 1)
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        page=page,
+        n_pages_per_req=jmax,
+        scale=scale,
+    )
+
+    def kv_index(b_i, h, j, tab, lens):
+        # Pages wholly past the request's frontier repeat its last valid
+        # page — Pallas elides the DMA when the block index repeats, so
+        # the skipped iterations stream nothing from HBM.
+        last_j = jnp.maximum((lens[b_i] - 1) // page, 0)
+        jj = jnp.minimum(j, last_j)
+        return (tab[b_i, jj], h, 0, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hkv, jmax),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, group, dp),
+                    lambda b_i, h, j, tab, lens: (b_i, h, 0, 0),
+                ),
+                pl.BlockSpec((1, 1, page, dp), kv_index),
+                pl.BlockSpec((1, 1, page, dp), kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, group, dp),
+                lambda b_i, h, j, tab, lens: (b_i, h, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, dp), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, dp), q.dtype),
+        interpret=interpret,
+    )(table, lengths.astype(jnp.int32), qr, k_pool, v_pool)
+
+    if d_pad:
+        out = out[..., :d]
+    return out.reshape(b, hq, d)
+
+
+def paged_decode_attention_reference(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """jnp reference: gather pages into a contiguous cache, then plain
+    masked attention — the materialised gather the kernel exists to avoid;
+    used only to pin its numerics."""
+    b, hq, d = q.shape
+    _, hkv, page, _ = k_pool.shape
+    jmax = page_table.shape[1]
+    group = hq // hkv
+    # [B, Jmax, Hkv, page, D] → [B, Hkv, Jmax·page, D]
+    k = k_pool[page_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, hkv, jmax * page, d
+    )
+    v = v_pool[page_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, hkv, jmax * page, d
+    )
+    qg = q.reshape(b, hkv, group, d).astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bktd->bkgt", qg, k.astype(jnp.float32))
+    scores = scores / math.sqrt(d)
+    mask = jnp.arange(jmax * page)[None, :] < lengths[:, None]  # [B,T]
+    scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgt,bktd->bkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, hq, d).astype(q.dtype)

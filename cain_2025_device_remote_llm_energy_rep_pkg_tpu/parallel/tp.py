@@ -35,6 +35,11 @@ class TensorParallelEngine(JaxEngine):
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, **kwargs) -> None:
+        if kwargs.get("paged_kv"):
+            raise ValueError(
+                "paged_kv is not supported on the tensor-parallel engine "
+                "yet (the page pool has no sharding rules)"
+            )
         super().__init__(**kwargs)
         self.mesh = mesh if mesh is not None else build_mesh(MeshSpec.tp_only())
 

@@ -135,7 +135,10 @@ commands:
                        "m1=int8,m2=int4,default=int8" (int8 for speed, int4
                        for HBM fit), --kv-quantize int8 (halve the decode
                        KV stream), --speculative target=draft[:k]
-                       (draft-verify), --prefix-cache N (prompt-prefix KV LRU)
+                       (draft-verify), --prefix-cache N (prompt-prefix KV
+                       LRU), --paged-kv (batched decode over a paged KV
+                       pool: mixed-length batches stop paying the widest
+                       row's padding)
   help                 show this message
 """
 
@@ -154,6 +157,7 @@ def serve_command(args: List[str]) -> None:
     hf_checkpoints = {}
     quantize = None
     kv_quantize = None
+    paged_kv = False
     speculative = {}
     prefix_cache = 0
     it = iter(args)
@@ -225,6 +229,8 @@ def serve_command(args: List[str]) -> None:
             kv_quantize = next(it, "int8")
             if kv_quantize == "none":
                 kv_quantize = None
+        elif arg == "--paged-kv":
+            paged_kv = True
         else:
             raise CommandError(f"serve: unrecognised option {arg!r}")
 
@@ -249,6 +255,10 @@ def serve_command(args: List[str]) -> None:
             decode_attention="auto",
             hf_checkpoints=hf_checkpoints or None,
             quantize=quantize,
+            kv_quantize=kv_quantize,
+            # forwarded so the unsupported combination fails LOUDLY at
+            # startup instead of silently serving unpaged decode
+            paged_kv=paged_kv,
             speculative=speculative or None,
             prefix_cache_size=prefix_cache,
         )
@@ -260,6 +270,7 @@ def serve_command(args: List[str]) -> None:
             hf_checkpoints=hf_checkpoints or None,
             quantize=quantize,
             kv_quantize=kv_quantize,
+            paged_kv=paged_kv,
             speculative=speculative or None,
             prefix_cache_size=prefix_cache,
         )
