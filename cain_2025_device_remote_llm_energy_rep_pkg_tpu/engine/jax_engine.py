@@ -172,13 +172,14 @@ class JaxEngine(GenerationBackend):
         # sweep can then serve small models at int8 (speed) and large ones
         # at int4 (capacity) from ONE engine, like Ollama's per-model GGUF
         # quant choices.
+        valid_modes = (None, "int8", "int4", "int4-i32")
         if isinstance(quantize, dict):
             for name, mode in quantize.items():
-                if mode not in (None, "int8", "int4"):
+                if mode not in valid_modes:
                     raise ValueError(
                         f"unsupported quantize mode for {name!r}: {mode!r}"
                     )
-        elif quantize not in (None, "int8", "int4"):
+        elif quantize not in valid_modes:
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
         if prefix_cache_size < 0:
             raise ValueError(
@@ -1458,13 +1459,16 @@ class JaxEngine(GenerationBackend):
 
         states = []
         n_real = max(r.max_new_tokens for r in requests) - 1
+        # ONE definition of each row's token budget, used both for page
+        # sizing here and for the decode loop's done-condition below —
+        # the two must never drift apart.
+        row_budgets = [r.max_new_tokens - 1 for r in requests]
         rows_pages: "list[int]" = []
-        for r, ids in zip(requests, all_prompt_ids):
+        for r, ids, budget in zip(requests, all_prompt_ids, row_budgets):
             # prefill needs only the prompt's own slots: decode writes go
             # to the pool, not this cache
             st = self._start(r, cache_len=_prompt_alloc(len(ids)), prompt_ids=ids)
             states.append(st)
-            budget = min(r.max_new_tokens - 1, max(n_real, 0))
             rows_pages.append(
                 -(-(st["s_real"] + budget + 1) // page)
             )
@@ -1473,9 +1477,10 @@ class JaxEngine(GenerationBackend):
         b_bucket = _bucket(n, BATCH_BUCKETS)
         pad_rows = b_bucket - n
         # padding rows enter pre-done and only ever re-write ONE frozen
-        # slot: one private page each (never aliasing a real row's pages —
-        # their garbage writes must not corrupt live caches)
-        total_pages = sum(rows_pages) + pad_rows
+        # slot with garbage, all at the same (page, slot) — ONE shared
+        # private page covers every pad row (never aliasing a real row's
+        # pages, whose live caches garbage writes would corrupt)
+        total_pages = sum(rows_pages) + (1 if pad_rows else 0)
         n_pages = pow2_at_least(total_pages, 4)
         jmax = pow2_at_least(max(rows_pages or [1]))
 
@@ -1506,9 +1511,10 @@ class JaxEngine(GenerationBackend):
             chunks_v.append(
                 _paginate(st["v_cache"][:, 0], st["s_real"], page)
             )
-        for _ in range(pad_rows):
+        if pad_rows:
             private = pool.alloc(1)[0]
-            table_rows.append(jnp.full((jmax,), private, jnp.int32))
+            for _ in range(pad_rows):
+                table_rows.append(jnp.full((jmax,), private, jnp.int32))
         # ONE scatter per pool for the whole batch (O(1) pool copies)
         pool.k, pool.v = scatter_pages(
             pool.k,
@@ -1545,10 +1551,7 @@ class JaxEngine(GenerationBackend):
             + [requests[0].repeat_penalty] * pad_rows,
             dtype=jnp.float32,
         )
-        budgets = jnp.asarray(
-            [r.max_new_tokens - 1 for r in requests] + [0] * pad_rows,
-            dtype=jnp.int32,
-        )
+        budgets = jnp.asarray(row_budgets + [0] * pad_rows, dtype=jnp.int32)
         done0 = jnp.asarray([False] * n + [True] * pad_rows)
         g_bucket = _bucket(max(r.max_new_tokens for r in requests), GEN_BUCKETS)
 

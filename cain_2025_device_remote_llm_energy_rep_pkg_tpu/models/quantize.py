@@ -119,8 +119,38 @@ def quantize_tensor_int4(w: jnp.ndarray) -> QuantLeaf:
     return {"q4": packed, "s": scale}
 
 
+@jax.jit
+def quantize_tensor_int4_i32(w: jnp.ndarray) -> QuantLeaf:
+    """Symmetric 4-bit quantization packed EIGHT k-consecutive nibbles per
+    int32 lane: ``{"q32": int32 [..., in/8, out], "s": f32 [..., 1, out]}``.
+
+    Alternative layout to :func:`quantize_tensor_int4` (halves-packed
+    int8): the kernel loads native i32 vectors, so the unpack is pure
+    i32 shift arithmetic — no i8→i32 convert, no 4-per-lane → 1-per-lane
+    Mosaic relayout. Nibble p of a lane holds weight row ``8k + p``
+    (little-endian); sign is recovered with a shl/ashr pair per plane.
+    """
+    if w.shape[-2] % 8 != 0:
+        raise ValueError(
+            f"i32 nibble packing needs in-dim divisible by 8, got {w.shape}"
+        )
+    wf = w.astype(jnp.float32)
+    max_abs = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.maximum(max_abs, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int32)
+    k8 = w.shape[-2] // 8
+    # [..., in, out] → [..., in/8, 8, out]; combine nibbles little-endian
+    qg = q.reshape(*q.shape[:-2], k8, 8, q.shape[-1])
+    packed = jnp.zeros(qg.shape[:-2] + (qg.shape[-1],), jnp.int32)
+    for p in range(8):
+        packed = packed | ((qg[..., p, :] & 0xF) << (4 * p))
+    return {"q32": packed, "s": scale}
+
+
 def is_quantized(leaf: Any) -> bool:
-    return isinstance(leaf, dict) and set(leaf) in ({"q", "s"}, {"q4", "s"})
+    return isinstance(leaf, dict) and set(leaf) in (
+        {"q", "s"}, {"q4", "s"}, {"q32", "s"},
+    )
 
 
 def maybe_dequant(
@@ -135,6 +165,15 @@ def maybe_dequant(
         lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
         hi = jnp.right_shift(packed, 4)
         q = jnp.concatenate([lo, hi], axis=-2)  # halves layout
+    elif "q32" in leaf:
+        packed = leaf["q32"]  # [..., in/8, out] int32, 8 nibbles per lane
+        planes = [
+            jnp.right_shift(jnp.left_shift(packed, 28 - 4 * p), 28)
+            for p in range(8)
+        ]
+        q = jnp.stack(planes, axis=-2).reshape(
+            *packed.shape[:-2], packed.shape[-2] * 8, packed.shape[-1]
+        )
     else:
         q = leaf["q"]
     return (q.astype(jnp.float32) * leaf["s"]).astype(dtype)
@@ -161,7 +200,7 @@ def dense_dot(x: jnp.ndarray, leaf: Union[jnp.ndarray, QuantLeaf]) -> jnp.ndarra
     """``x [B,S,IN] @ weight [IN,OUT]`` for any leaf form.
 
     Decode-shaped int4 matmuls (B·S ≤ 8 rows, tile-compatible dims) route
-    through the Pallas kernel so the packed bytes cross HBM packed;
+    through the Pallas kernels so the packed bytes cross HBM packed;
     everything else uses the einsum with XLA-fused dequant (a no-op for
     plain tensors)."""
     if (
@@ -176,6 +215,19 @@ def dense_dot(x: jnp.ndarray, leaf: Union[jnp.ndarray, QuantLeaf]) -> jnp.ndarra
         in_half, out_dim = leaf["q4"].shape
         if int4_matmul_supported(b * s, in_half, out_dim):
             out = int4_matmul(x.reshape(b * s, d), leaf["q4"], leaf["s"])
+            return out.reshape(b, s, out_dim)
+    if (
+        is_quantized(leaf)
+        and "q32" in leaf
+        and leaf["q32"].ndim == 2
+        and _INT4_KERNEL.get()
+    ):
+        from ..ops.pallas_quant import MAX_KERNEL_ROWS, int4_matmul_i32
+
+        b, s, d = x.shape
+        k8, out_dim = leaf["q32"].shape
+        if b * s <= MAX_KERNEL_ROWS and k8 % 128 == 0 and out_dim % 128 == 0:
+            out = int4_matmul_i32(x.reshape(b * s, d), leaf["q32"], leaf["s"])
             return out.reshape(b, s, out_dim)
     return jnp.einsum("bsd,dh->bsh", x, maybe_dequant(leaf, x.dtype))
 
@@ -200,13 +252,19 @@ def quantize_leaf(
 ) -> Any:
     """The per-leaf quantization rule: named matmul weights at ``mode``,
     embeddings at int8 (per-row scales), untied lm_head at int8
-    (per-output-channel), everything else passes through."""
-    if mode not in ("int8", "int4"):
+    (per-output-channel), everything else passes through. ``int4-i32``
+    is the experimental i32-lane nibble layout (scripts/int4_i32_bench.py
+    decides whether it replaces the halves layout)."""
+    if mode not in ("int8", "int4", "int4-i32"):
         raise ValueError(f"unknown quantization mode {mode!r}")
     if is_quantized(leaf):
         return leaf
     if name in keys:
-        qt = quantize_tensor if mode == "int8" else quantize_tensor_int4
+        qt = {
+            "int8": quantize_tensor,
+            "int4": quantize_tensor_int4,
+            "int4-i32": quantize_tensor_int4_i32,
+        }[mode]
         return qt(leaf)
     if name == "embed":
         # [V, D] with per-row scales (see quantize_tensor_rowwise)

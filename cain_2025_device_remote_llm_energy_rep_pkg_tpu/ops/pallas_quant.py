@@ -105,6 +105,107 @@ def int4_matmul_supported(m: int, in_half: int, out_dim: int) -> bool:
     )
 
 
+def _int4_matmul_kernel_i32(
+    x_ref,  # VMEM [8, 8*k8_pad] activations, plane-major (see int4_matmul_i32)
+    p_ref,  # VMEM [block_k8, block_n] int32 — 8 nibbles per lane
+    s_ref,  # VMEM [1, block_n] f32
+    o_ref,  # VMEM [8, block_n]
+    acc_ref,  # VMEM scratch [8, block_n] f32
+    *,
+    block_k8: int,
+    k8_pad: int,
+    n_k_blocks: int,
+):
+    """The VERDICT-suggested alternative unpack: weights arrive as native
+    i32 vectors (8 k-consecutive nibbles per lane), so extraction is pure
+    i32 shift arithmetic — shl + arithmetic-shr sign-extends each plane,
+    with no i8→i32 convert and no 4-per-lane relayout. Eight small MXU
+    dots (one per nibble plane) replace the halves layout's two; the
+    activation planes are pre-sliced host-side so each dot's operand is a
+    contiguous VMEM slice."""
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    p32 = p_ref[...]
+    dims = (((1,), (0,)), ((), ()))
+    acc = acc_ref[...]
+    for plane in range(8):
+        w = jnp.right_shift(
+            jnp.left_shift(p32, 28 - 4 * plane), 28
+        ).astype(jnp.bfloat16)
+        xp = x_ref[
+            :, pl.ds(plane * k8_pad + k * block_k8, block_k8)
+        ].astype(jnp.bfloat16)
+        acc += jax.lax.dot_general(
+            xp, w, dims, preferred_element_type=jnp.float32
+        )
+    acc_ref[...] = acc
+
+    @pl.when(k == n_k_blocks - 1)
+    def _finish():
+        o_ref[...] = (acc_ref[...] * s_ref[...]).astype(o_ref.dtype)
+
+
+def int4_matmul_i32(
+    x: jnp.ndarray,  # [M, IN], M <= 8
+    packed32: jnp.ndarray,  # [IN/8, OUT] int32 (8 nibbles per lane)
+    scale: jnp.ndarray,  # [1, OUT] f32
+) -> jnp.ndarray:
+    """``x @ dequant(packed32, scale)`` with the i32-lane nibble layout
+    (quantize.quantize_tensor_int4_i32)."""
+    m, in_dim = x.shape
+    k8, out_dim = packed32.shape
+    if in_dim != 8 * k8:
+        raise ValueError(f"x in-dim {in_dim} != 8 * packed rows {k8}")
+    if m > MAX_KERNEL_ROWS or k8 % 128 or out_dim % 128:
+        raise ValueError(
+            f"shape (m={m}, k8={k8}, out={out_dim}) outside the kernel "
+            "envelope (k8 and out must be multiples of 128)"
+        )
+    # largest 128-multiple ≤ 512 dividing k8 (128 always does — the shape
+    # gate above guarantees k8 % 128 == 0)
+    block_k8 = next(
+        cand
+        for cand in range(128 * (min(512, k8) // 128), 127, -128)
+        if k8 % cand == 0
+    )
+    block_n = 512 if out_dim >= 512 else _pick_block(out_dim, 512)
+    n_k_blocks = k8 // block_k8
+    k8_pad = k8  # divisible blocks only — no tail padding
+    grid = (-(-out_dim // block_n), n_k_blocks)
+
+    # Plane-major activation repack: plane p (weight rows 8k+p) lives at
+    # [p*k8, (p+1)*k8). Cheap — x is [M, IN], thousands of elements vs the
+    # megabytes of weight bytes each step streams.
+    x_planes = x.reshape(m, k8, 8).transpose(0, 2, 1).reshape(m, 8 * k8)
+    x8 = jnp.zeros((MAX_KERNEL_ROWS, 8 * k8_pad), x.dtype)
+    x8 = x8.at[:m].set(x_planes)
+
+    kernel = functools.partial(
+        _int4_matmul_kernel_i32,
+        block_k8=block_k8,
+        k8_pad=k8_pad,
+        n_k_blocks=n_k_blocks,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((MAX_KERNEL_ROWS, 8 * k8_pad), lambda o, k: (0, 0)),
+            pl.BlockSpec((block_k8, block_n), lambda o, k: (k, o)),
+            pl.BlockSpec((1, block_n), lambda o, k: (0, o)),
+        ],
+        out_specs=pl.BlockSpec((MAX_KERNEL_ROWS, block_n), lambda o, k: (0, o)),
+        out_shape=jax.ShapeDtypeStruct((MAX_KERNEL_ROWS, out_dim), x.dtype),
+        scratch_shapes=[pltpu.VMEM((MAX_KERNEL_ROWS, block_n), jnp.float32)],
+        interpret=jax.default_backend() not in ("tpu", "axon"),
+    )(x8, packed32, scale.astype(jnp.float32))
+    return out[:m]
+
+
 def int4_matmul(
     x: jnp.ndarray,  # [M, IN], M <= 8
     packed: jnp.ndarray,  # [IN/2, OUT] int8 (halves-packed)

@@ -126,11 +126,12 @@ def shard_model(params: Dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Dict[st
     for name, leaf in params.items():
         spec = specs[name]
         if is_quantized(leaf):
-            # int8 stores "q" [..., in, out]; int4 stores "q4" with the
-            # input axis packed to in/2 — the same spec applies (axis order
-            # is unchanged; halving the input dim preserves divisibility
-            # for the even tp sizes the sharder accepts).
-            qkey = "q4" if "q4" in leaf else "q"
+            # int8 stores "q" [..., in, out]; int4 stores "q4" (input axis
+            # packed to in/2), int4-i32 stores "q32" (in/8) — the same spec
+            # applies (axis order is unchanged; dividing the input dim
+            # preserves divisibility for the even tp sizes the sharder
+            # accepts).
+            qkey = next(k for k in ("q4", "q32", "q") if k in leaf)
             parts = list(spec) + [None] * (leaf[qkey].ndim - len(spec))
             # The scale has size 1 on whichever axis was reduced (the input
             # axis for matmul weights, the feature axis for row-wise

@@ -1,0 +1,98 @@
+"""Microbench: int4 halves-packed kernel vs i32-lane nibble layout.
+
+VERDICT round-2 item 8: before Mosaic grows i8 elementwise support, try
+an alternative nibble layout whose unpack is pure i32 lane arithmetic.
+Run on the real chip (NOT while another process holds it):
+
+    python scripts/int4_i32_bench.py
+
+Prints per-matmul-shape times for qwen2:1.5b's decode matmuls and the
+projected per-step totals for both layouts; docs/PERF.md records the
+verdict.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+    quantize_tensor_int4,
+    quantize_tensor_int4_i32,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_quant import (
+    int4_matmul,
+    int4_matmul_i32,
+)
+
+REPEATS = 200
+
+
+def timed(fn, *args):
+    jfn = jax.jit(fn)
+    jax.block_until_ready(jfn(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        out = jfn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / REPEATS
+
+
+def main() -> None:
+    cfg = get_model_config("qwen2:1.5b")
+    d, f = cfg.d_model, cfg.d_ff
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+    # the decode-step matmul shapes (one layer): wq, wk/wv, wo, gate/up, down
+    shapes = [
+        ("wq", d, hq * dh, 1),
+        ("wkv", d, hkv * dh, 2),
+        ("wo", hq * dh, d, 1),
+        ("gate/up", d, f, 2),
+        ("down", f, d, 1),
+    ]
+    key = jax.random.PRNGKey(0)
+    total_h = total_i = 0.0
+    print(f"backend={jax.default_backend()} layers={cfg.n_layers}")
+    for name, in_dim, out_dim, count in shapes:
+        key, kw, kx = jax.random.split(key, 3)
+        w = jax.random.normal(kw, (in_dim, out_dim), jnp.float32) * 0.05
+        x = jax.random.normal(kx, (1, in_dim), jnp.bfloat16)
+        leaf_h = quantize_tensor_int4(w)
+        leaf_i = quantize_tensor_int4_i32(w)
+        try:
+            t_h = timed(
+                lambda a, q, s: int4_matmul(a, q, s), x, leaf_h["q4"], leaf_h["s"]
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(f"{name}: halves kernel failed: {exc}")
+            t_h = float("nan")
+        try:
+            t_i = timed(
+                lambda a, q, s: int4_matmul_i32(a, q, s),
+                x,
+                leaf_i["q32"],
+                leaf_i["s"],
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(f"{name}: i32 kernel failed: {exc}")
+            t_i = float("nan")
+        total_h += count * t_h
+        total_i += count * t_i
+        print(
+            f"{name:8s} [{in_dim}x{out_dim}]x{count}: "
+            f"halves {t_h*1e6:8.1f} us   i32 {t_i*1e6:8.1f} us   "
+            f"ratio {t_i/t_h:5.2f}"
+        )
+    n_l = cfg.n_layers
+    print(
+        f"\nper-step matmul total: halves {total_h*n_l*1e3:.3f} ms, "
+        f"i32 {total_i*n_l*1e3:.3f} ms "
+        f"({'i32 WINS' if total_i < total_h else 'halves wins'})"
+    )
+
+
+if __name__ == "__main__":
+    main()

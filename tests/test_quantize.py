@@ -246,6 +246,40 @@ def test_int4_pallas_matmul_matches_dequant():
     )
 
 
+def test_int4_i32_pack_roundtrip_and_kernel_parity():
+    """The i32-lane nibble layout (VERDICT round-2 item 8 experiment):
+    pack/dequant round-trips exactly against the halves layout, and the
+    i32 kernel matches the dequantized reference."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
+        quantize_tensor_int4,
+        quantize_tensor_int4_i32,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_quant import (
+        int4_matmul_i32,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (2048, 256), jnp.float32) * 0.1
+    leaf_h = quantize_tensor_int4(w)
+    leaf_i = quantize_tensor_int4_i32(w)
+    assert leaf_i["q32"].shape == (256, 256)
+    assert leaf_i["q32"].dtype == jnp.int32
+    # identical quantized values, independent of packing layout
+    np.testing.assert_array_equal(
+        np.asarray(maybe_dequant(leaf_i, jnp.float32)),
+        np.asarray(maybe_dequant(leaf_h, jnp.float32)),
+    )
+
+    for rows in (1, 5):
+        x = jax.random.normal(jax.random.PRNGKey(rows), (rows, 2048), jnp.float32)
+        got = int4_matmul_i32(x, leaf_i["q32"], leaf_i["s"])
+        want = x.astype(jnp.bfloat16).astype(jnp.float32) @ maybe_dequant(
+            leaf_i, jnp.float32
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+        )
+
+
 def test_int4_dense_dot_routes_and_matches():
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (
         dense_dot,
