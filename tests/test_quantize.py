@@ -212,6 +212,18 @@ def test_tp_engine_with_int4():
     req = GenerationRequest("t4", "int4 tensor parallel", max_new_tokens=10)
     assert single.generate(req).tokens == tp.generate(req).tokens
 
+    # the i32-lane nibble layout shards the same way ({"q32","s"} leaves)
+    single_i = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, quantize="int4-i32"
+    )
+    tp_i = TensorParallelEngine(
+        mesh=build_mesh(MeshSpec.tp_only()),
+        registry=dict(registry),
+        dtype=jnp.float32,
+        quantize="int4-i32",
+    )
+    assert single_i.generate(req).tokens == tp_i.generate(req).tokens
+
 
 def test_int4_pallas_matmul_matches_dequant():
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.quantize import (

@@ -136,6 +136,59 @@ def test_concurrent_requests_serialised(server):
         assert result.tokens == expected.tokens
 
 
+def test_concurrent_mixed_length_requests_through_paged_batching():
+    """End-to-end serving path of the paged KV pool: concurrent
+    mixed-length HTTP posts coalesce through the scheduler into one paged
+    batched decode, and each response equals a lone generate (the paged
+    batch is token-identical per row)."""
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    backend = JaxEngine(registry=dict(registry), dtype=jnp.float32, paged_kv=True)
+    srv = GenerationServer(
+        backend,
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        batch_window_ms=150,
+        max_batch=4,
+    )
+    srv.start()
+    try:
+        client = RemoteHTTPBackend(f"http://127.0.0.1:{srv.port}")
+        cases = [("short", 6), ("a much longer prompt here", 20), ("third", 12)]
+        results = {}
+
+        def go(i, prompt, n):
+            results[i] = client.generate(
+                GenerationRequest("tiny", prompt, max_new_tokens=n)
+            )
+
+        threads = [
+            threading.Thread(target=go, args=(i, p, n))
+            for i, (p, n) in enumerate(cases)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        solo = JaxEngine(registry=dict(registry), dtype=jnp.float32)
+        for i, (p, n) in enumerate(cases):
+            want = solo.generate(
+                GenerationRequest("tiny", p, max_new_tokens=n)
+            )
+            assert results[i].tokens == want.tokens
+    finally:
+        srv.stop()
+
+
 def test_load_falls_back_to_generate_on_plain_ollama(server):
     """Against a server with no /api/load (real Ollama), load/warmup degrade
     to a 1-token generate instead of failing the run."""
