@@ -1340,18 +1340,7 @@ class JaxEngine(GenerationBackend):
         cfg = tf.cfg
         eos = self._tokenizer_for(model).eos_id
 
-        if self.decode_attention is not None:
-            from ..ops.pallas_paged_attention import (
-                pallas_paged_decode_attention,
-            )
-
-            def decode_attention(q, kc, vc, lengths):
-                return pallas_paged_decode_attention(
-                    q, kc["pool"], vc["pool"], kc["table"], lengths
-                )
-
-        else:  # jnp fallback gathers through the table (CPU tests)
-            decode_attention = None
+        decode_attention = self._paged_decode_attention()
 
         from ..ops.sampling import sample_token_per_row
 
@@ -1433,6 +1422,27 @@ class JaxEngine(GenerationBackend):
 
         self._decode_cache[key] = decode
         return decode
+
+    def _paged_decode_attention(self):
+        """The attention impl for paged caches: the Pallas page-table
+        kernel where a decode kernel is configured, else None (the jnp
+        fallback gathers through the table — CPU tests, and meshes where
+        the kernel has no GSPMD partition rule)."""
+        if self.decode_attention is None:
+            return None
+        from ..ops.pallas_paged_attention import pallas_paged_decode_attention
+
+        def decode_attention(q, kc, vc, lengths):
+            return pallas_paged_decode_attention(
+                q, kc["pool"], vc["pool"], kc["table"], lengths
+            )
+
+        return decode_attention
+
+    def _place_pool(self, cfg: ModelConfig, pool_k, pool_v, table):
+        """Placement hook for the assembled page pool — the TP engine
+        overrides to shard the pool's heads over the mesh."""
+        return pool_k, pool_v, table
 
     def _generate_batch_paged(
         self,
@@ -1524,6 +1534,7 @@ class JaxEngine(GenerationBackend):
             jnp.concatenate(chunks_v),
         )
         table = jnp.stack(table_rows)
+        pool.k, pool.v, table = self._place_pool(cfg, pool.k, pool.v, table)
         rows = states + [states[0]] * pad_rows
 
         use_top_p = any(st["use_top_p"] for st in states)

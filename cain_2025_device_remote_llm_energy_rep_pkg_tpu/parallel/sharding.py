@@ -98,6 +98,20 @@ def cache_shardings(
     return NamedSharding(mesh, cache_spec(cfg, mesh, batch_axis))
 
 
+def paged_pool_shardings(
+    cfg: ModelConfig, mesh: Mesh
+) -> Dict[str, NamedSharding]:
+    """Shardings for a paged KV pool (engine/paged_kv.py): the pool
+    ``[L, P, Hkv, page, D]`` has the contiguous cache's exact layout with
+    pages in the batch-like position — reuse ``cache_shardings`` (ONE
+    definition of the head-axis divisibility rule); the page table is
+    replicated (tiny int32 metadata every device needs)."""
+    return {
+        "pool": cache_shardings(cfg, mesh),
+        "table": NamedSharding(mesh, P()),
+    }
+
+
 def quant_cache_shardings(
     cfg: ModelConfig, mesh: Mesh, batch_axis: str | None = None
 ) -> Dict[str, NamedSharding]:

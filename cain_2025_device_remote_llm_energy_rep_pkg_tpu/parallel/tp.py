@@ -22,7 +22,12 @@ from ..engine.jax_engine import JaxEngine
 from ..models.config import ModelConfig
 from ..models.quantize import int4_kernel_disabled
 from .mesh import MeshSpec, build_mesh
-from .sharding import cache_shardings, quant_cache_shardings, shard_model
+from .sharding import (
+    cache_shardings,
+    paged_pool_shardings,
+    quant_cache_shardings,
+    shard_model,
+)
 
 
 class TensorParallelEngine(JaxEngine):
@@ -35,11 +40,6 @@ class TensorParallelEngine(JaxEngine):
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, **kwargs) -> None:
-        if kwargs.get("paged_kv"):
-            raise ValueError(
-                "paged_kv is not supported on the tensor-parallel engine "
-                "yet (the page pool has no sharding rules)"
-            )
         super().__init__(**kwargs)
         self.mesh = mesh if mesh is not None else build_mesh(MeshSpec.tp_only())
 
@@ -109,6 +109,24 @@ class TensorParallelEngine(JaxEngine):
             self._place_quant_cache(cfg, kq),
             self._place_quant_cache(cfg, vq),
         )
+
+    def _place_pool(self, cfg: ModelConfig, pool_k, pool_v, table):
+        """Shard the page pool's heads over the mesh (pages replicated,
+        like the contiguous cache's batch axis; table replicated)."""
+        shardings = paged_pool_shardings(cfg, self.mesh)
+        return (
+            jax.device_put(pool_k, shardings["pool"]),
+            jax.device_put(pool_v, shardings["pool"]),
+            jax.device_put(table, shardings["table"]),
+        )
+
+    def _paged_decode_attention(self):
+        """The paged Pallas kernel has no GSPMD partition rule — use the
+        jnp gather-through-the-table fallback on multi-device meshes (it
+        partitions like any other gather + attention)."""
+        if self.n_devices > 1:
+            return None
+        return super()._paged_decode_attention()
 
     def _decode_attention_for_cache(self):
         """The int8 flash-decode Pallas kernel has no GSPMD partitioning

@@ -256,8 +256,6 @@ def serve_command(args: List[str]) -> None:
             hf_checkpoints=hf_checkpoints or None,
             quantize=quantize,
             kv_quantize=kv_quantize,
-            # forwarded so the unsupported combination fails LOUDLY at
-            # startup instead of silently serving unpaged decode
             paged_kv=paged_kv,
             speculative=speculative or None,
             prefix_cache_size=prefix_cache,

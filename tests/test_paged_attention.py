@@ -217,6 +217,29 @@ def test_paged_kv_guards():
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
         get_model_config,
     )
+
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    with pytest.raises(ValueError, match="page_size"):
+        JaxEngine(registry=registry, paged_kv=True, page_size=100)
+    with pytest.raises(ValueError, match="paged_kv"):
+        JaxEngine(registry=registry, paged_kv=True, kv_quantize="int8")
+
+
+def test_paged_batch_on_tensor_parallel_engine():
+    """Paged decode composes with TP: the pool's heads shard over the
+    mesh (pages/table replicated) and every row matches the single-device
+    paged engine token for token."""
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.mesh import (
         MeshSpec,
         build_mesh,
@@ -225,14 +248,27 @@ def test_paged_kv_guards():
         TensorParallelEngine,
     )
 
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 (virtual) devices")
     registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
-    with pytest.raises(ValueError, match="page_size"):
-        JaxEngine(registry=registry, paged_kv=True, page_size=100)
-    with pytest.raises(ValueError, match="paged_kv"):
-        JaxEngine(registry=registry, paged_kv=True, kv_quantize="int8")
-    mesh = build_mesh(MeshSpec.tp_only(2), devices=jax.devices()[:2])
-    with pytest.raises(ValueError, match="paged_kv"):
-        TensorParallelEngine(mesh=mesh, registry=registry, paged_kv=True)
+    tp = TensorParallelEngine(
+        mesh=build_mesh(MeshSpec.tp_only(2), devices=jax.devices()[:2]),
+        registry=dict(registry),
+        dtype=jnp.float32,
+        paged_kv=True,
+    )
+    single = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, paged_kv=True
+    )
+    reqs = [
+        GenerationRequest("tiny", "sharded paged row", max_new_tokens=8),
+        GenerationRequest("tiny", "another longer sharded paged row here",
+                          max_new_tokens=14),
+    ]
+    got = tp.generate_batch(reqs)
+    want = single.generate_batch(reqs)
+    for g, w in zip(got, want):
+        assert g.tokens == w.tokens
 
 
 def test_write_token_appends_through_the_table():
