@@ -123,6 +123,12 @@ commands:
   config-create [dir]  scaffold a new config file (default dir: examples/)
   analyze <exp_dir>    (re)run the statistics pipeline over an experiment's
                        run_table.csv, writing analysis_report.{json,md} + plots
+  recompute-energy <exp_dir> [--chips loc=n,...]
+                       recompute the modelled energy columns from the table's
+                       persisted raw measurements (timings + token counts)
+                       under the current energy model, then re-analyze;
+                       --chips is the fallback topology for tables predating
+                       the per-row `chips` column
   prepare              validate the environment (JAX devices, RAPL access)
   serve [opts]         start the HTTP generation server (the framework-native
                        Ollama-equivalent): --host H --port N (default 11434),
@@ -345,6 +351,36 @@ def main(argv: Optional[List[str]] = None) -> int:
             if len(args) < 2:
                 raise CommandError("analyze requires an experiment directory")
             analyze_command(Path(args[1]))
+        elif cmd == "recompute-energy":
+            if len(args) < 2:
+                raise CommandError(
+                    "recompute-energy requires an experiment directory"
+                )
+            from ..experiments.llm_energy import recompute_energy
+
+            # --chips loc=n[,loc=n...]: fallback chip map for tables from
+            # before the per-row `chips` column (rows carrying the column
+            # always win)
+            chips = None
+            rest = args[2:]
+            if rest and rest[0] == "--chips":
+                if len(rest) < 2:
+                    raise CommandError(
+                        "recompute-energy: --chips expects loc=n[,loc=n...]"
+                    )
+                chips = {}
+                for entry in rest[1].split(","):
+                    loc, _, count = entry.partition("=")
+                    if not loc or not count.isdigit():
+                        raise CommandError(
+                            "recompute-energy: --chips expects loc=n[,loc=n...]"
+                        )
+                    chips[loc] = int(count)
+            n = recompute_energy(Path(args[1]), n_chips_by_location=chips)
+            term.log_ok(
+                f"recomputed modelled energy for {n} rows from their "
+                f"persisted raw measurements; analysis re-run"
+            )
         elif cmd == "prepare":
             prepare()
         elif cmd == "serve":

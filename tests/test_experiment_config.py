@@ -97,11 +97,11 @@ def test_energy_model_profiler_without_stats(tmp_path):
 
 
 def test_energy_window_excludes_transport_time(tmp_path):
-    """Modelled energy integrates over the GENERATION window (prefill +
-    decode, the serving side's own clocks), not the request wall time —
-    HTTP/tunnel jitter in ``total_s`` must not leak into Joules (VERDICT
-    round-2 item 1: every >5%-CV cell was a short run riding transport
-    jitter)."""
+    """Modelled energy's idle-power window is the fence-timed DECODE loop
+    (the serving side's own clock), not the request wall time — HTTP and
+    tunnel-dispatch jitter (both ``total_s`` and the dispatch-dominated
+    ``prefill_s`` of short prompts) must not leak into Joules; prefill is
+    charged through the FLOPs term (VERDICT round-2 item 1)."""
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
         GenerationResult,
     )
@@ -141,11 +141,46 @@ def test_energy_window_excludes_transport_time(tmp_path):
     config.start_run(ctx)
     config.interact(ctx)
     stats = ctx.scratch["generation_stats"]
-    assert stats["duration_s"] == pytest.approx(0.51)
+    assert stats["duration_s"] == pytest.approx(0.5)  # decode_s only
+    # flops cover ALL processed tokens — prefill's compute is charged
+    # through the FLOPs term, not a dispatch-dominated wall window
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        MODEL_REGISTRY,
+    )
+
+    cfg = MODEL_REGISTRY["qwen2:1.5b"]
+    r = ctx.scratch["result"]
+    total = r.prompt_tokens + r.generated_tokens
+    assert stats["flops"] == pytest.approx(cfg.flops_per_token(total) * total)
     # and execution_time_s (the reference's client-observed wall time)
     # still records the full request duration
     data = config.populate_run_data(ctx)
     assert data["execution_time_s"] == pytest.approx(3.0)
+
+
+def test_recompute_energy_reproduces_modelled_columns(tmp_path):
+    """Modelled energy is a pure function of persisted raw measurements:
+    recomputing an existing table under the current model reproduces the
+    live-run values exactly (and lets a model refinement be applied
+    post-hoc, like the reference's derived J column)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        recompute_energy,
+    )
+
+    config = _hermetic_config(tmp_path)
+    ExperimentController(config, echo=False).do_experiment()
+    exp = tmp_path / "llm_energy_tpu"
+    before = {
+        r["__run_id"]: r["energy_model_J"] for r in RunTableStore(exp).read()
+    }
+    assert any(v is not None for v in before.values())
+    n = recompute_energy(exp, reanalyze=False)
+    after = {
+        r["__run_id"]: r["energy_model_J"] for r in RunTableStore(exp).read()
+    }
+    assert n == len(before)
+    for rid, v in before.items():
+        assert after[rid] == pytest.approx(v, rel=1e-6), rid
 
 
 def _hermetic_config(tmp_path, **kw):
