@@ -1,6 +1,7 @@
-"""The round-2 real-hardware capstone study.
+"""The real-hardware capstone study — the FULL reference protocol.
 
-3 model families × 2 locations × 3 content lengths × 10 repetitions, with
+7 model families × 2 locations × 3 content lengths × 30 repetitions
+(1,260 runs, experiment/RunnerConfig.py:80-88), with
 the faithful client/server split of the reference (its on-device treatment
 curls a LOCAL Ollama server on 11434; remote curls another machine's —
 experiment/RunnerConfig.py:122-131):
@@ -8,7 +9,7 @@ experiment/RunnerConfig.py:122-131):
   terminal 1 (owns the chip):
     python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu serve \
         --host 127.0.0.1 --port 11434 \
-        --quantize "qwen2:1.5b=int8,gemma:2b=int8,phi3:3.8b=int4"
+        --quantize "qwen2:1.5b=int8,gemma:2b=int8,default=int4"
 
   terminal 2 (pure HTTP client; NEVER initialises a JAX backend):
     python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu \
@@ -20,12 +21,12 @@ chip, the remote treatment's *network* hop is loopback — the serving-side
 energy for remote is still modelled as the 8-chip mesh via
 ``n_chips_by_location`` (documented in docs/sample_run/README.md).
 
-Model/quantization plan (what fits the relay's ~4.5 GB program budget):
-qwen2:1.5b and gemma:2b at int8 (speed mode), phi3:3.8b at int4
-(capacity mode) — mirroring Ollama's default 4-bit GGUF for the big
-model. Cooldown is 2 s, not the reference's 90 s: the modelled energy is
-thermal-state-free, so long cooldowns only stretch wall-clock (recorded
-as a protocol deviation).
+Quantization: the two small models at int8 (speed mode), everything from
+phi3:3.8b up at int4 (capacity mode — all four 7B/8B-class models fit the
+chip's program budget at int4, validated by direct decode) — mirroring
+Ollama's default 4-bit GGUF quants for the large models. Cooldown follows
+the channel-typed policy: 2 s on this modelled-energy host (thermal-state
+-free), the reference's 90 s wherever a measured channel is active.
 """
 
 import os
@@ -37,9 +38,24 @@ from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy impor
 
 SERVER_URL = os.environ.get("CAPSTONE_SERVER_URL", "http://127.0.0.1:11434")
 
-CAPSTONE_MODELS = ["qwen2:1.5b", "gemma:2b", "phi3:3.8b"]
+# The FULL reference sweep (experiment/RunnerConfig.py:80): all 7 families.
+CAPSTONE_MODELS = [
+    "qwen2:1.5b",
+    "gemma:2b",
+    "phi3:3.8b",
+    "gemma:7b",
+    "qwen2:7b",
+    "mistral:7b",
+    "llama3.1:8b",
+]
 # Served by the `serve` process; recorded here for the study metadata.
-CAPSTONE_QUANT = {"qwen2:1.5b": "int8", "gemma:2b": "int8", "phi3:3.8b": "int4"}
+# Small models at int8 (speed), 3.8B+ at int4 (fits the chip) — mirroring
+# Ollama's default 4-bit GGUF quants for the large models.
+CAPSTONE_QUANT = {
+    "qwen2:1.5b": "int8",
+    "gemma:2b": "int8",
+    "default": "int4",
+}
 
 
 class RunnerConfig(LlmEnergyConfig):
@@ -47,8 +63,12 @@ class RunnerConfig(LlmEnergyConfig):
         super().__init__(
             models=CAPSTONE_MODELS,
             lengths=[100, 500, 1000],
-            repetitions=10,
-            cooldown_ms=2000,
+            # The EXACT reference protocol: 30 repetitions per cell →
+            # 7 × 2 × 3 × 30 = 1,260 runs (experiment/RunnerConfig.py:87).
+            repetitions=30,
+            # cooldown deliberately unset: the channel-typed policy picks
+            # 2 s on modelled-only hosts and the reference's 90 s when a
+            # measured energy channel is active.
             results_output_path=Path("experiments_output"),
             on_device_url=SERVER_URL,
             remote_url=SERVER_URL,
