@@ -226,7 +226,9 @@ def dense_dot(x: jnp.ndarray, leaf: Union[jnp.ndarray, QuantLeaf]) -> jnp.ndarra
 
         b, s, d = x.shape
         k8, out_dim = leaf["q32"].shape
-        if b * s <= MAX_KERNEL_ROWS and k8 % 128 == 0 and out_dim % 128 == 0:
+        # non-128-multiple k8 is allowed: the kernel zero-pads the packed
+        # rows (a per-call copy — see docs/PERF.md's measured verdict)
+        if b * s <= MAX_KERNEL_ROWS and out_dim % 128 == 0:
             out = int4_matmul_i32(x.reshape(b * s, d), leaf["q32"], leaf["s"])
             return out.reshape(b, s, out_dim)
     return jnp.einsum("bsd,dh->bsh", x, maybe_dequant(leaf, x.dtype))

@@ -160,29 +160,35 @@ def int4_matmul_i32(
     k8, out_dim = packed32.shape
     if in_dim != 8 * k8:
         raise ValueError(f"x in-dim {in_dim} != 8 * packed rows {k8}")
-    if m > MAX_KERNEL_ROWS or k8 % 128 or out_dim % 128:
+    if m > MAX_KERNEL_ROWS or out_dim % 128:
         raise ValueError(
             f"shape (m={m}, k8={k8}, out={out_dim}) outside the kernel "
-            "envelope (k8 and out must be multiples of 128)"
+            "envelope (out must be a multiple of 128)"
         )
-    # largest 128-multiple ≤ 512 dividing k8 (128 always does — the shape
-    # gate above guarantees k8 % 128 == 0)
+    # Mosaic needs 128-lane-aligned slice offsets on the x planes and
+    # sublane-tileable k blocks: pad k8 up to a 128 multiple with zero
+    # lanes (zero nibbles decode to zero weights — they add nothing to the
+    # dots, but their bytes DO stream; the padding overhead is part of
+    # this layout's honest cost on non-aligned dims like 1536/8 = 192).
+    k8_pad = -(-k8 // 128) * 128
+    if k8_pad != k8:
+        packed32 = jnp.pad(packed32, ((0, k8_pad - k8), (0, 0)))
     block_k8 = next(
         cand
-        for cand in range(128 * (min(512, k8) // 128), 127, -128)
-        if k8 % cand == 0
+        for cand in range(128 * (min(512, k8_pad) // 128), 127, -128)
+        if k8_pad % cand == 0
     )
     block_n = 512 if out_dim >= 512 else _pick_block(out_dim, 512)
-    n_k_blocks = k8 // block_k8
-    k8_pad = k8  # divisible blocks only — no tail padding
+    n_k_blocks = k8_pad // block_k8
     grid = (-(-out_dim // block_n), n_k_blocks)
 
     # Plane-major activation repack: plane p (weight rows 8k+p) lives at
-    # [p*k8, (p+1)*k8). Cheap — x is [M, IN], thousands of elements vs the
-    # megabytes of weight bytes each step streams.
-    x_planes = x.reshape(m, k8, 8).transpose(0, 2, 1).reshape(m, 8 * k8)
-    x8 = jnp.zeros((MAX_KERNEL_ROWS, 8 * k8_pad), x.dtype)
-    x8 = x8.at[:m].set(x_planes)
+    # [p*k8_pad, p*k8_pad + k8). Cheap — x is [M, IN], thousands of
+    # elements vs the megabytes of weight bytes each step streams.
+    x_planes = x.reshape(m, k8, 8).transpose(0, 2, 1)  # [m, 8, k8]
+    x8 = jnp.zeros((MAX_KERNEL_ROWS, 8, k8_pad), x.dtype)
+    x8 = x8.at[:m, :, :k8].set(x_planes)
+    x8 = x8.reshape(MAX_KERNEL_ROWS, 8 * k8_pad)
 
     kernel = functools.partial(
         _int4_matmul_kernel_i32,
