@@ -182,6 +182,91 @@ def estimate_weight_bytes(
     )
 
 
+def decode_weight_stream_bytes(
+    cfg, quantize: Optional[str], dtype_bytes: int = 2
+) -> float:
+    """HBM bytes of WEIGHTS streamed by one single-row decode step.
+
+    Matches :func:`estimate_weight_bytes`'s quantization rules, with two
+    decode-specific differences:
+
+    - the embedding table is read ONCE as the logits head (a full
+      ``vocab×d`` stream), never a second time for the input token — that
+      is a single-row gather, not a stream;
+    - only the routed ``top_k_experts`` of an MoE layer are streamed per
+      token (matching ``flops_per_token``'s active-expert accounting).
+    """
+    d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
+    active = cfg.top_k_experts if cfg.n_experts else 1
+
+    matmul_per_layer = (
+        d * hq * dh  # wq
+        + 2 * d * hkv * dh  # wk, wv
+        + hq * dh * d  # wo
+        + 3 * d * f * active  # gate, up, down (routed experts only)
+        + (d * cfg.n_experts if cfg.n_experts else 0)  # router
+    )
+    matmul_out_channels = hq * dh + 2 * hkv * dh + d + (2 * f + d) * active
+    norms_biases = 2 * l * d + d
+    if cfg.qkv_bias:
+        norms_biases += l * (hq * dh + 2 * hkv * dh)
+
+    if quantize is None:
+        return float(
+            dtype_bytes
+            * (cfg.vocab_size * d + l * matmul_per_layer + norms_biases)
+        )
+    weight_b = 1.0 if quantize == "int8" else 0.5
+    return float(
+        cfg.vocab_size * d  # logits head: int8 in every quantized mode
+        + 4 * cfg.vocab_size  # its per-row f32 scales
+        + l * matmul_per_layer * weight_b
+        + 4 * l * matmul_out_channels  # per-output-channel f32 scales
+        + dtype_bytes * norms_biases
+    )
+
+
+def decode_kv_stream_bytes(
+    cfg,
+    context_len: int,
+    kv_quantize: Optional[str] = None,
+    dtype_bytes: int = 2,
+) -> float:
+    """HBM bytes of KV CACHE read by one single-row decode step at the
+    given context (the per-step single-position write is negligible and
+    excluded). Kept as the single source of the KV formula — the TP
+    roofline needs the weight/KV split because sharding treats them
+    differently (KV replicates when heads don't divide the mesh)."""
+    l, hkv, dh = cfg.n_layers, cfg.n_kv_heads, cfg.d_head
+    kv_b = 1 if kv_quantize == "int8" else dtype_bytes
+    kv_bytes = 2 * l * hkv * dh * context_len * kv_b
+    if kv_quantize == "int8":
+        kv_bytes += 2 * l * hkv * context_len * 4  # per-position f32 scales
+    return float(kv_bytes)
+
+
+def estimate_decode_read_bytes_per_step(
+    cfg,
+    quantize: Optional[str],
+    context_len: int,
+    kv_quantize: Optional[str] = None,
+    dtype_bytes: int = 2,
+) -> float:
+    """HBM bytes READ by one single-row decode step (single chip).
+
+    Decode is memory-bound: every step streams the full weight set once
+    plus the KV cache up to ``context_len``. This is the bytes term of the
+    energy model's bandwidth duty cycle (profilers/tpu.py) and of the TP
+    decode-time roofline (parallel/roofline.py).
+    """
+    return decode_weight_stream_bytes(
+        cfg, quantize, dtype_bytes=dtype_bytes
+    ) + decode_kv_stream_bytes(
+        cfg, context_len, kv_quantize=kv_quantize, dtype_bytes=dtype_bytes
+    )
+
+
 class ModelMemoryError(RuntimeError):
     """A model's estimated weight bytes exceed the probed device budget."""
 

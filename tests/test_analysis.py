@@ -278,9 +278,15 @@ def test_variance_check_judges_cells_against_their_own_distribution():
                 }
             )
             i += 1
+    # sanity: the rounds-1-3 POOLED filter really does drop the slow rows
+    # (the bias this test exists to guard against, kept reproducible)
+    pooled = analyze(
+        rows, metrics=("energy_J", "execution_time_s"), filter_scope="pooled"
+    )
+    assert pooled["n_after_iqr"] < len(rows)
+    # the default per-cell scope keeps every cell's rows
     report = analyze(rows, metrics=("energy_J", "execution_time_s"))
-    # sanity: the global filter really does drop the slow rows
-    assert report["n_after_iqr"] < len(rows)
+    assert report["n_after_iqr"] == len(rows)
     vc = report["variance_check"]
     assert set(vc["cells"]) == {"fast|on_device|100", "slow|on_device|100"}
     assert vc["cells"]["slow|on_device|100"]["n"] >= 4
@@ -398,3 +404,162 @@ def test_latex_descriptives_table(tmp_path):
     assert "on\\_device / 100" in tex and "remote / 200" in tex
     assert "on_device" not in tex.replace("on\\_device", "")
     assert tex == render_latex_descriptives(report, "energy_J")
+
+
+def test_subset_filter_scope_matches_notebook_order():
+    """filter_scope='subset' reproduces the reference notebook's exact
+    procedure (cells 11-13): subset by location×length FIRST, IQR within.
+    A value that is an outlier of the pooled table but typical of its own
+    subset must survive."""
+    rows = []
+    i = 0
+    # remote is a small minority → the pooled fences sit tight around the
+    # on_device rows and (pooled) drop every remote row
+    for loc, base, reps in (("on_device", 1.0, 24), ("remote", 1000.0, 5)):
+        for rep in range(reps):
+            rows.append(
+                {
+                    "__run_id": f"run_{i}_repetition_{rep}",
+                    "__done": RunProgress.DONE,
+                    "model": "m",
+                    "location": loc,
+                    "length": 100,
+                    "energy_J": base * (1.0 + 0.01 * (rep % 3)),
+                }
+            )
+            i += 1
+    # pooled: the remote rows straddle the pooled fences → rows vanish
+    pooled = analyze(rows, metrics=("energy_J",), filter_scope="pooled")
+    # per-subset: each location is its own stratum → everything survives
+    subset = analyze(rows, metrics=("energy_J",), filter_scope="subset")
+    assert subset["n_after_iqr"] == len(rows)
+    assert pooled["n_after_iqr"] < len(rows)
+    assert subset["filter_scope"] == "subset"
+    # descriptives reflect the subset's own (unbiased) mean
+    d = subset["descriptives"]["remote|100"]["energy_J"]
+    assert 1000.0 <= d["mean"] <= 1015.0
+
+
+def test_cell_filter_scope_preserves_every_cells_assessability():
+    """The default per-cell scope (VERDICT round-3 directive 2): with 7
+    models spanning ~500× in energy, every model×location×length cell
+    must keep ≥ its non-outlier rows — no cell may be erased by another
+    model's distribution, and the published mean must match the raw
+    direction (remote|long ≈ its raw mean, not 3.8× low)."""
+    rows = []
+    i = 0
+    scales = {"tiny": 26.0, "mid": 800.0, "big": 13035.0}
+    for model, scale in scales.items():
+        for loc in ("on_device", "remote"):
+            for length in (100, 1000):
+                for rep in range(8):
+                    rows.append(
+                        {
+                            "__run_id": f"run_{i}_repetition_{rep}",
+                            "__done": RunProgress.DONE,
+                            "model": model,
+                            "location": loc,
+                            "length": length,
+                            "energy_J": scale
+                            * (10 if length == 1000 else 1)
+                            * (2 if loc == "remote" else 1)
+                            * (1.0 + 0.01 * (rep % 4)),
+                        }
+                    )
+                    i += 1
+    report = analyze(rows, metrics=("energy_J",), filter_scope="cell")
+    assert report["n_after_iqr"] == len(rows)
+    # every cell assessable in the variance check AND represented in
+    # the filtered descriptives
+    vc = report["variance_check"]
+    assert vc["n_cells"] == len(scales) * 2 * 2
+    raw_remote_long = [
+        r["energy_J"]
+        for r in rows
+        if r["location"] == "remote" and r["length"] == 1000
+    ]
+    raw_mean = sum(raw_remote_long) / len(raw_remote_long)
+    d = report["descriptives"]["remote|1000"]["energy_J"]
+    assert d["mean"] == pytest.approx(raw_mean, rel=0.02)
+    # and the means are monotone in length (the round-3 report was not)
+    assert (
+        report["descriptives"]["remote|1000"]["energy_J"]["mean"]
+        > report["descriptives"]["remote|100"]["energy_J"]["mean"]
+    )
+
+
+def test_h2_definitional_metrics_annotated_under_modelled_energy():
+    """When energy is MODEL-derived, ρ between the model and its own
+    inputs (decode_s, execution_time_s, ...) is arithmetic. Those metrics
+    must be flagged definitional, excluded from the rendered H2 table,
+    and genuinely independent metrics (cpu_usage) left unrestricted. A
+    measured energy metric gets no flags at all (VERDICT round-3 dir 5)."""
+    import random
+
+    rng = random.Random(7)
+    rows = []
+    for i in range(30):
+        decode = 1.0 + 0.1 * i
+        rows.append(
+            {
+                "__run_id": f"run_{i}_repetition_0",
+                "__done": RunProgress.DONE,
+                "model": "m",
+                "location": "on_device",
+                "length": 100,
+                "energy_model_J": 55.0 * decode,  # deterministic in decode_s
+                "decode_s": decode,
+                "execution_time_s": decode + 0.2,
+                "cpu_usage": rng.uniform(5, 95),
+            }
+        )
+    metrics = ("energy_model_J", "decode_s", "execution_time_s", "cpu_usage")
+    report = analyze(
+        rows, metrics=metrics, energy_metric="energy_model_J"
+    )
+    assert report["h2_energy_is_modelled"] is True
+    h2 = report["h2_spearman"]["on_device"]
+    assert h2["decode_s"]["definitional"] is True
+    assert h2["execution_time_s"]["definitional"] is True
+    assert "definitional" not in h2["cpu_usage"]
+    md = render_markdown(report)
+    assert "Definitional (excluded from the table)" in md
+    # the ρ=1.000 row must not appear as a table row
+    assert "| decode_s | 1.000" not in md
+
+    # measured energy: same table shape, no flags, no exclusion note
+    for r in rows:
+        r["energy_J"] = r.pop("energy_model_J") * 1.1
+    measured = analyze(
+        rows,
+        metrics=("energy_J", "decode_s", "cpu_usage"),
+        energy_metric="energy_J",
+    )
+    assert measured["h2_energy_is_modelled"] is False
+    assert "definitional" not in measured["h2_spearman"]["on_device"]["decode_s"]
+    assert "Definitional" not in render_markdown(measured)
+
+
+def test_tpu_util_rendered_as_percent():
+    """The utilisation column mirrors the reference's GPU-residency
+    metric; a 61% duty must render as a percentage, not '0.61' (and
+    never the round-3 report's flat '0.00')."""
+    rows = [
+        {
+            "__run_id": f"run_{i}_repetition_0",
+            "__done": RunProgress.DONE,
+            "model": "m",
+            "location": "on_device",
+            "length": 100,
+            "energy_model_J": 100.0 + i,
+            "tpu_util_est": 0.61 + 0.001 * (i % 3),
+        }
+        for i in range(6)
+    ]
+    report = analyze(
+        rows,
+        metrics=("energy_model_J", "tpu_util_est"),
+        energy_metric="energy_model_J",
+    )
+    md = render_markdown(report)
+    assert "61%" in md

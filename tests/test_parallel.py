@@ -185,3 +185,91 @@ def test_train_step_dp_tp_runs_and_learns():
     assert all(np.isfinite(losses))
     # memorising a fixed batch: loss must drop
     assert losses[-1] < losses[0]
+
+
+# -- TP decode-time roofline (the remote treatment's duration model) ---------
+
+
+def test_roofline_single_chip_matches_measured():
+    """n=1 (no ICI term) must reproduce the measured single-chip decode:
+    qwen2:1.5b int8 runs 3.0-3.07 ms/step on the real chip
+    (docs/PERF.md component ablation). The model's only inputs are the
+    bytes accounting and the calibrated ~490 GB/s sustained stream, so
+    landing within ~7% validates both."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.roofline import (
+        modeled_tp_decode_step_s,
+    )
+
+    cfg = get_model_config("qwen2:1.5b")
+    t = modeled_tp_decode_step_s(cfg, "int8", 1, 320)
+    assert 0.00293 * 0.95 < t < 0.00307 * 1.07
+
+
+def test_roofline_tp_mesh_is_faster_but_sublinear():
+    """The mesh must be FASTER than one chip (the reference's remote
+    machine is faster, BASELINE.md:27-32) but SUBLINEAR: per-layer psums
+    sit on the ICI latency floor, so a small model cannot speed up 8×."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.roofline import (
+        modeled_tp_decode_step_s,
+    )
+
+    small = get_model_config("qwen2:1.5b")
+    big = get_model_config("llama3.1:8b")
+    for cfg in (small, big):
+        t1 = modeled_tp_decode_step_s(cfg, "int8", 1, 320)
+        t8 = modeled_tp_decode_step_s(cfg, "int8", 8, 320)
+        assert t8 < t1
+        assert t1 / t8 < 8.0
+    # the bigger model amortises the latency floor better: its speedup
+    # must exceed the small model's
+    s_small = modeled_tp_decode_step_s(
+        small, "int8", 1, 320
+    ) / modeled_tp_decode_step_s(small, "int8", 8, 320)
+    s_big = modeled_tp_decode_step_s(
+        big, "int8", 1, 320
+    ) / modeled_tp_decode_step_s(big, "int8", 8, 320)
+    assert s_big > s_small
+
+
+def test_roofline_kv_replication_rule_follows_sharding():
+    """sharding.py replicates the KV cache when n_kv_heads % tp != 0
+    (qwen2's 2 KV heads on tp=8); replicated cache bytes must NOT shrink
+    with the mesh. phi3's 32 heads shard cleanly — its long-context KV
+    stream does shrink, so its TP speedup at 2k context beats qwen2-like
+    replication."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.roofline import (
+        modeled_tp_decode_step_s,
+    )
+
+    phi3 = get_model_config("phi3:3.8b")  # 32 % 8 == 0 → sharded
+    assert phi3.n_kv_heads % 8 == 0
+    t1 = modeled_tp_decode_step_s(phi3, "int8", 8, 2048)
+    # force the replicated branch by comparing against a 3-chip mesh
+    # (32 % 3 != 0): KV replicated, weights still sharded
+    t3 = modeled_tp_decode_step_s(phi3, "int8", 3, 2048)
+    kv_bytes = 2 * 32 * 32 * 96 * 2048 * 2
+    # the 8-way mesh keeps only 1/8 of the KV stream per chip; the 3-way
+    # mesh pays it in full — check the modelled per-chip KV cost gap
+    # is visible in the step times (t3's mem term carries full KV)
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.roofline import (
+        V5E_SUSTAINED_HBM_GBPS,
+    )
+
+    bw = V5E_SUSTAINED_HBM_GBPS * 1e9
+    assert t3 > kv_bytes / bw  # full KV alone bounds the 3-chip step
+    assert t1 < t3
+
+
+def test_roofline_whole_generation_uses_mid_context():
+    """The closed-form loop sum: N steps at the mid-loop context equal
+    the linear model's exact sum."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.roofline import (
+        modeled_tp_decode_s,
+        modeled_tp_decode_step_s,
+    )
+
+    cfg = get_model_config("qwen2:1.5b")
+    total = modeled_tp_decode_s(cfg, "int8", 8, 64, 256)
+    per_mid = modeled_tp_decode_step_s(cfg, "int8", 8, 64 + 128)
+    assert total == pytest.approx(256 * per_mid)
+    assert modeled_tp_decode_s(cfg, "int8", 8, 64, 0) == 0.0

@@ -161,3 +161,78 @@ def test_load_model_budget_counts_resident_models(monkeypatch):
         engine.load_model("b")
     engine.unload_all()
     engine.load_model("b")  # fits alone once the first is unloaded
+
+
+# -- decode bytes-per-step accounting (the energy model's HBM term) ----------
+
+
+def test_decode_read_bytes_match_measured_traffic():
+    """The bytes accounting must reproduce docs/PERF.md's measured decode
+    traffic for qwen2:1.5b int8: ~1.31 GB transformer body + 233 MB
+    logits head + ~9 MB KV at short context ⇒ ~1.55 GB/step."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        estimate_decode_read_bytes_per_step,
+    )
+
+    cfg = get_model_config("qwen2:1.5b")
+    b = estimate_decode_read_bytes_per_step(cfg, "int8", 320)
+    assert 1.45e9 < b < 1.65e9
+    # bf16 doubles the matmul stream (PERF.md: 2.62 GB body)
+    b16 = estimate_decode_read_bytes_per_step(cfg, None, 320)
+    assert 2.7e9 < b16 < 3.3e9
+    # int4 halves the matmul body relative to int8 (logits head stays int8)
+    b4 = estimate_decode_read_bytes_per_step(cfg, "int4", 320)
+    assert b4 < 0.75 * b
+    # KV term grows linearly with context: qwen2's GQA cache is
+    # 2·28·2·128·2 B = 28.7 KB per position
+    delta = estimate_decode_read_bytes_per_step(
+        cfg, "int8", 1320
+    ) - estimate_decode_read_bytes_per_step(cfg, "int8", 320)
+    assert delta == pytest.approx(1000 * 2 * 28 * 2 * 128 * 2)
+
+
+def test_decode_read_bytes_kv_quantize_halves_cache_term():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        estimate_decode_read_bytes_per_step,
+    )
+
+    # phi3 is the KV-heavy family (32 full-width heads, PERF.md): at 2k
+    # context its cache stream dominates, so int8 KV must cut the step's
+    # bytes by roughly the cache half (minus the f32 position scales)
+    cfg = get_model_config("phi3:3.8b")
+    full = estimate_decode_read_bytes_per_step(cfg, "int8", 2048)
+    kvq = estimate_decode_read_bytes_per_step(
+        cfg, "int8", 2048, kv_quantize="int8"
+    )
+    kv_bf16 = 2 * 32 * 32 * 96 * 2048 * 2
+    assert full - kvq == pytest.approx(
+        kv_bf16 / 2 - 2 * 32 * 32 * 2048 * 4, rel=0.01
+    )
+
+
+def test_decode_read_bytes_moe_streams_active_experts_only():
+    """Per decode step only the routed top-k experts leave HBM — an
+    8-expert Mixtral layer streams 2 experts' MLPs, not 8 (matching
+    flops_per_token's active-expert accounting)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        estimate_decode_read_bytes_per_step,
+        estimate_weight_bytes,
+    )
+
+    cfg = get_model_config("mixtral:8x7b")
+    per_step = estimate_decode_read_bytes_per_step(cfg, "int8", 128)
+    resident = estimate_weight_bytes(cfg, "int8")
+    # streamed bytes are far below residency (2 of 8 experts active) ...
+    assert per_step < 0.45 * resident
+    # ... but still dominated by the two active experts' MLPs
+    active_mlp = cfg.n_layers * 3 * cfg.d_model * cfg.d_ff * 2
+    assert per_step > active_mlp
