@@ -123,12 +123,15 @@ commands:
   config-create [dir]  scaffold a new config file (default dir: examples/)
   analyze <exp_dir>    (re)run the statistics pipeline over an experiment's
                        run_table.csv, writing analysis_report.{json,md} + plots
-  recompute-energy <exp_dir> [--chips loc=n,...]
+  recompute-energy <exp_dir> [--chips loc=n,...] [--quantize m=q,...]
                        recompute the modelled energy columns from the table's
                        persisted raw measurements (timings + token counts)
                        under the current energy model, then re-analyze;
-                       --chips is the fallback topology for tables predating
-                       the per-row `chips` column
+                       --chips is the fallback topology and --quantize the
+                       fallback per-model serving modes (model=mode with a
+                       `default=` entry, the serve CLI's spec shape) for
+                       tables predating the per-row `chips`/`quantize`
+                       columns
   prepare              validate the environment (JAX devices, RAPL access)
   serve [opts]         start the HTTP generation server (the framework-native
                        Ollama-equivalent): --host H --port N (default 11434),
@@ -362,21 +365,58 @@ def main(argv: Optional[List[str]] = None) -> int:
             # before the per-row `chips` column (rows carrying the column
             # always win)
             chips = None
+            quantize = None
             rest = args[2:]
-            if rest and rest[0] == "--chips":
-                if len(rest) < 2:
-                    raise CommandError(
-                        "recompute-energy: --chips expects loc=n[,loc=n...]"
-                    )
-                chips = {}
-                for entry in rest[1].split(","):
-                    loc, _, count = entry.partition("=")
-                    if not loc or not count.isdigit():
+            while rest:
+                flag = rest[0]
+                if flag == "--chips":
+                    if len(rest) < 2:
                         raise CommandError(
                             "recompute-energy: --chips expects loc=n[,loc=n...]"
                         )
-                    chips[loc] = int(count)
-            n = recompute_energy(Path(args[1]), n_chips_by_location=chips)
+                    chips = {}
+                    for entry in rest[1].split(","):
+                        loc, _, count = entry.partition("=")
+                        if not loc or not count.isdigit():
+                            raise CommandError(
+                                "recompute-energy: --chips expects "
+                                "loc=n[,loc=n...]"
+                            )
+                        chips[loc] = int(count)
+                elif flag == "--quantize":
+                    if len(rest) < 2:
+                        raise CommandError(
+                            "recompute-energy: --quantize expects "
+                            "model=mode[,model=mode...]"
+                        )
+                    quantize = {}
+                    valid_modes = ("bf16", "int8", "int4", "int4-i32")
+                    for entry in rest[1].split(","):
+                        model, sep, mode = entry.partition("=")
+                        if not model or not sep or not mode:
+                            raise CommandError(
+                                "recompute-energy: --quantize expects "
+                                "model=mode[,model=mode...]"
+                            )
+                        # an unknown mode would silently be billed at
+                        # int4 width by the bytes accounting — refuse
+                        if mode not in valid_modes:
+                            raise CommandError(
+                                f"recompute-energy: unknown quantize mode "
+                                f"{mode!r} for {model!r}; expected one of "
+                                f"{', '.join(valid_modes)}"
+                            )
+                        quantize[model] = mode
+                else:
+                    raise CommandError(
+                        f"recompute-energy: unknown flag {flag!r}"
+                    )
+                rest = rest[2:]
+            n = recompute_energy(
+                Path(args[1]),
+                n_chips_by_location=chips,
+                quantize_by_model=quantize,
+            )
             term.log_ok(
                 f"recomputed modelled energy for {n} rows from their "
                 f"persisted raw measurements; analysis re-run"

@@ -137,20 +137,16 @@ def device_memory_budget(device=None) -> Optional[MemoryBudget]:
     return None
 
 
-def estimate_weight_bytes(
-    cfg, quantize: Optional[str], dtype_bytes: int = 2
-) -> int:
-    """Estimated HBM bytes of one model's parameters under the engine's
-    quantization rules (models/quantize.py): matmul weights at the mode's
-    width (int8 = 1 B, int4 = 0.5 B + f32 per-output-channel scales),
-    embeddings/lm_head at int8 in every quantized mode, norms and biases
-    at full precision.
-    """
+def _per_layer_weight_terms(cfg, experts: int):
+    """The per-layer parameter accounting shared by residency
+    (:func:`estimate_weight_bytes`) and decode streaming
+    (:func:`decode_weight_stream_bytes`) — ONE implementation of the
+    quantization byte rules, parameterised only by how many experts
+    count (all resident vs top-k streamed). Returns
+    ``(matmul_per_layer, matmul_out_channels, norms_biases)`` in
+    parameter counts."""
     d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
-    experts = max(1, cfg.n_experts)
-
-    embed_params = cfg.vocab_size * d * (1 if cfg.tie_embeddings else 2)
     matmul_per_layer = (
         d * hq * dh  # wq
         + 2 * d * hkv * dh  # wk, wv
@@ -164,7 +160,24 @@ def estimate_weight_bytes(
     norms_biases = 2 * l * d + d  # attn/mlp norms + final norm
     if cfg.qkv_bias:
         norms_biases += l * (hq * dh + 2 * hkv * dh)
+    return matmul_per_layer, matmul_out_channels, norms_biases
 
+
+def estimate_weight_bytes(
+    cfg, quantize: Optional[str], dtype_bytes: int = 2
+) -> int:
+    """Estimated HBM bytes of one model's parameters under the engine's
+    quantization rules (models/quantize.py): matmul weights at the mode's
+    width (int8 = 1 B, int4 = 0.5 B + f32 per-output-channel scales),
+    embeddings/lm_head at int8 in every quantized mode, norms and biases
+    at full precision.
+    """
+    d, l = cfg.d_model, cfg.n_layers
+    matmul_per_layer, matmul_out_channels, norms_biases = (
+        _per_layer_weight_terms(cfg, experts=max(1, cfg.n_experts))
+    )
+
+    embed_params = cfg.vocab_size * d * (1 if cfg.tie_embeddings else 2)
     if quantize is None:
         return dtype_bytes * (
             embed_params + l * matmul_per_layer + norms_biases
@@ -196,21 +209,12 @@ def decode_weight_stream_bytes(
     - only the routed ``top_k_experts`` of an MoE layer are streamed per
       token (matching ``flops_per_token``'s active-expert accounting).
     """
-    d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
-    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
-    active = cfg.top_k_experts if cfg.n_experts else 1
-
-    matmul_per_layer = (
-        d * hq * dh  # wq
-        + 2 * d * hkv * dh  # wk, wv
-        + hq * dh * d  # wo
-        + 3 * d * f * active  # gate, up, down (routed experts only)
-        + (d * cfg.n_experts if cfg.n_experts else 0)  # router
+    d, l = cfg.d_model, cfg.n_layers
+    matmul_per_layer, matmul_out_channels, norms_biases = (
+        _per_layer_weight_terms(
+            cfg, experts=cfg.top_k_experts if cfg.n_experts else 1
+        )
     )
-    matmul_out_channels = hq * dh + 2 * hkv * dh + d + (2 * f + d) * active
-    norms_biases = 2 * l * d + d
-    if cfg.qkv_bias:
-        norms_biases += l * (hq * dh + 2 * hkv * dh)
 
     if quantize is None:
         return float(

@@ -335,8 +335,33 @@ def test_on_device_url_builds_http_backend_and_checks_health(tmp_path):
         cfg.experiment_path = tmp_path / "exp"
         cfg.before_experiment()
         assert cfg.describe_backend("on_device") == f"http:{url}"
-        # same URL for both → remote is a distinct client object, NOT aliased
-        assert cfg.describe_backend("remote") == f"http:{url}"
+        # same URL for both treatments → one serving process, one chip:
+        # the remote rows are aliased and must say so (the round-3
+        # capstone recorded identical URLs unmarked, hiding that its
+        # remote timings were single-chip; VERDICT round-3 missing #3)
+        assert (
+            cfg.describe_backend("remote")
+            == f"http:{url}[aliased-on_device]"
+        )
+
+        # a genuinely distinct remote server keeps its own identity
+        srv2 = GenerationServer(FB(), host="127.0.0.1", port=0, quiet=True)
+        srv2.start()
+        try:
+            url2 = f"http://127.0.0.1:{srv2.port}"
+            cfg2 = LlmEnergyConfig(
+                models=["m"],
+                lengths=[100],
+                repetitions=1,
+                results_output_path=tmp_path,
+                on_device_url=url,
+                remote_url=url2,
+            )
+            cfg2.experiment_path = tmp_path / "exp2"
+            cfg2.before_experiment()
+            assert cfg2.describe_backend("remote") == f"http:{url2}"
+        finally:
+            srv2.stop()
     finally:
         srv.stop()
 
@@ -502,3 +527,96 @@ def test_generation_stats_unknown_model_warns_on_aliased_mesh(capsys):
     assert "bytes" not in stats and "modeled_decode_s" not in stats
     err = capsys.readouterr()
     assert "mystery:13b" in err.out + err.err
+
+
+def test_aliased_detection_canonicalizes_urls(tmp_path):
+    """localhost and 127.0.0.1 (and a trailing slash) are one server —
+    one chip. Equivalent spellings must still be detected as aliasing
+    (code-review round-4 finding)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+        FakeBackend as FB,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server import (
+        GenerationServer,
+    )
+
+    srv = GenerationServer(FB(), host="127.0.0.1", port=0, quiet=True)
+    srv.start()
+    try:
+        cfg = LlmEnergyConfig(
+            models=["m"],
+            lengths=[100],
+            repetitions=1,
+            results_output_path=tmp_path,
+            on_device_url=f"http://127.0.0.1:{srv.port}",
+            remote_url=f"http://localhost:{srv.port}/",
+        )
+        cfg.experiment_path = tmp_path / "exp"
+        cfg.before_experiment()
+        assert cfg.describe_backend("remote").endswith("[aliased-on_device]")
+    finally:
+        srv.stop()
+
+
+def test_recompute_energy_skips_rows_missing_raw_inputs(tmp_path):
+    """A legacy table with a hole in ANY raw input column skips that row
+    instead of aborting the whole recompute (code-review round-4
+    finding)."""
+    import csv
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        recompute_energy,
+    )
+
+    exp = tmp_path / "holes"
+    exp.mkdir()
+    cols = [
+        "__run_id", "__done", "model", "location", "length",
+        "prompt_tokens", "generated_tokens", "execution_time_s",
+        "decode_s",
+    ]
+    with (exp / "run_table.csv").open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=cols)
+        w.writeheader()
+        base = {
+            "__done": "DONE", "model": "qwen2:1.5b",
+            "location": "on_device", "length": 100,
+            "prompt_tokens": 64, "generated_tokens": 134,
+            "execution_time_s": 0.6, "decode_s": 0.45,
+        }
+        w.writerow({**base, "__run_id": "run_0_repetition_0"})
+        w.writerow(
+            {**base, "__run_id": "run_1_repetition_0", "prompt_tokens": ""}
+        )
+        w.writerow(
+            {**base, "__run_id": "run_2_repetition_0",
+             "execution_time_s": ""}
+        )
+    assert recompute_energy(exp, reanalyze=False) == 1
+
+
+def test_recompute_energy_warning_names_the_model(tmp_path, capsys):
+    import csv
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        recompute_energy,
+    )
+
+    exp = tmp_path / "unknown"
+    exp.mkdir()
+    cols = [
+        "__run_id", "__done", "model", "location", "length", "chips",
+        "prompt_tokens", "generated_tokens", "execution_time_s", "decode_s",
+    ]
+    with (exp / "run_table.csv").open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=cols)
+        w.writeheader()
+        w.writerow({
+            "__run_id": "run_0_repetition_0", "__done": "DONE",
+            "model": "mystery:13b", "location": "remote", "length": 100,
+            "chips": 8, "prompt_tokens": 64, "generated_tokens": 134,
+            "execution_time_s": 0.6, "decode_s": 0.45,
+        })
+    recompute_energy(exp, reanalyze=False)
+    out = capsys.readouterr()
+    assert "mystery:13b" in out.out + out.err
