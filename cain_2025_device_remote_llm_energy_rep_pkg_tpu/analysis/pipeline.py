@@ -58,10 +58,19 @@ DEFAULT_METRICS = (
 )
 # Every *study-metric* column the framework's profilers/workloads can emit;
 # used by ``detect_metrics`` to analyse whatever table it is handed.
+# ORDER MATTERS for the energy columns: analyze_experiment picks the first
+# populated one as THE energy metric, so measured device channels
+# (counter, wall meter, duty-derived) outrank the model — a capstone
+# re-run on a measured host analyses real Joules automatically
+# (docs/ARCHITECTURE.md measured-host runbook). host_energy_J stays
+# below the model: it meters the client CPU, not the serving chips, and
+# must never silently become the study metric just because RAPL exists.
 KNOWN_METRIC_COLUMNS = (
     "energy_J",
-    "energy_model_J",
     "tpu_energy_J",
+    "wall_energy_J",
+    "energy_duty_J",
+    "energy_model_J",
     "host_energy_J",
     "joules_per_token",
     "execution_time_s",
@@ -72,9 +81,9 @@ KNOWN_METRIC_COLUMNS = (
     "cpu_usage",
     "memory_usage",
     "tpu_util_est",
+    "tpu_duty_cycle_pct",
     "tpu_avg_power_W",
     "host_avg_power_W",
-    "wall_energy_J",
     "wall_avg_power_W",
     # Diagnostic columns the profilers emit (e.g. host_sample_rate_hz) are
     # deliberately NOT listed: they would drag valid rows through the IQR

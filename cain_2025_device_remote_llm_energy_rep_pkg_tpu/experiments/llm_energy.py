@@ -235,12 +235,21 @@ def recompute_energy(
     store = RunTableStore(Path(experiment_dir))
     rows = store.read()
     # Aliasing detection needs cross-row context: a remote row whose
-    # backend string ALSO serves on_device rows came from a shared
-    # single-chip process (the loopback-server capstone records the same
-    # URL for both treatments), even without the [aliased-on_device]
-    # marker the in-process alias appends.
+    # backend ALSO serves on_device rows came from a shared single-chip
+    # process (the loopback-server capstone records the same URL for
+    # both treatments), even without the [aliased-on_device] marker the
+    # in-process alias appends. HTTP backend strings are canonicalized
+    # before comparison — localhost vs 127.0.0.1 is one server.
+    def _canonical_backend(desc: str) -> str:
+        if desc.startswith("http:"):
+            try:
+                return "http:" + _canonical_url(desc[len("http:"):])
+            except ValueError:
+                return desc
+        return desc
+
     on_device_backends = {
-        str(r.get("backend"))
+        _canonical_backend(str(r.get("backend")))
         for r in rows
         if str(r.get("location")) == "on_device" and r.get("backend")
     }
@@ -283,13 +292,23 @@ def recompute_energy(
             if chips is not None
             else fallback_chips.get(str(row.get("location")), 1)
         )
-        row["chips"] = n_chips  # backfill pre-column tables
+        # Backfill the chips column ONLY from an operator-asserted map:
+        # baking the built-in default into the table would make a later
+        # `--chips remote=4` recompute a silent no-op (rows carrying the
+        # column always win), turning a recoverable omission into a
+        # frozen wrong topology.
+        if chips is None and n_chips_by_location is not None:
+            row["chips"] = n_chips
         backend = row.get("backend")
         is_remote = str(row.get("location")) == "remote"
         aliased = (
             (
                 str(backend).endswith("[aliased-on_device]")
-                or (is_remote and str(backend) in on_device_backends)
+                or (
+                    is_remote
+                    and _canonical_backend(str(backend))
+                    in on_device_backends
+                )
             )
             if backend is not None
             else is_remote and n_chips > 1

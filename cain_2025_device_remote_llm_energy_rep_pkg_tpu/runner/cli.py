@@ -325,19 +325,54 @@ def prepare() -> None:
         term.log_warn(f"jax unavailable: {exc}")
     from ..profilers.energy_probe import probe_energy_channels
 
-    measured = False
+    measured_host = False
+    measured_device = False
     for status in probe_energy_channels():
         line = f"energy channel {status.name} ({status.kind}/{status.scope}): {status.detail}"
         if status.available:
             term.log_ok(line)
-            measured = measured or status.kind in ("energy", "power")
+            if status.kind in ("energy", "power"):
+                if status.scope == "host":
+                    measured_host = True
+                else:
+                    measured_device = True
         else:
             term.log_warn(line)
-    if not measured:
+    # The channel audit decides the study's thermal policy — say which
+    # way it will go BEFORE a sweep is launched (VERDICT round-3
+    # directive 7), per scope: host channels (RAPL/native sampler) wire
+    # in every mode, but device channels are skipped in HTTP-client mode
+    # (on_device_url), where the serving process owns the chip — the
+    # promise must match what LlmEnergyConfig will actually do.
+    from ..experiments.llm_energy import LlmEnergyConfig
+
+    cool_measured = LlmEnergyConfig.MEASURED_CHANNEL_COOLDOWN_MS // 1000
+    cool_modelled = LlmEnergyConfig.MODELLED_ONLY_COOLDOWN_MS // 1000
+    if measured_host:
+        term.log_ok(
+            "measured HOST energy channel present - studies wire it in "
+            "every mode, record real host Joules, and use the "
+            f"reference's {cool_measured} s thermal cooldown "
+            "(docs/ARCHITECTURE.md: measured-host runbook)"
+        )
+    elif measured_device:
+        term.log_ok(
+            "measured DEVICE energy channel present - in-process/serving "
+            f"studies wire it ({cool_measured} s thermal cooldown); a "
+            "pure HTTP-client study (on_device_url set) leaves device "
+            "channels to the serving process and runs modelled-only at "
+            f"{cool_modelled} s (docs/ARCHITECTURE.md: measured-host "
+            "runbook)"
+        )
+    else:
         term.log_warn(
             "no measured energy source on this host - studies will record "
-            "modelled Joules (energy_model_J) and say so in "
-            "energy_channels.json"
+            "modelled Joules (energy_model_J), say so in "
+            "energy_channels.json, and drop the cooldown to "
+            f"{cool_modelled} s (modelled energy is thermal-state-free); "
+            "on a host with RAPL/tpu-info/libtpu-monitoring the same "
+            "study re-runs with measured Joules unchanged "
+            "(docs/ARCHITECTURE.md: measured-host runbook)"
         )
 
 

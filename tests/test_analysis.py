@@ -563,3 +563,38 @@ def test_tpu_util_rendered_as_percent():
     )
     md = render_markdown(report)
     assert "61%" in md
+
+
+def test_measured_energy_channel_outranks_the_model(tmp_path):
+    """docs/ARCHITECTURE.md measured-host runbook: a table carrying BOTH
+    a measured device channel (tpu_energy_J) and the modelled column
+    analyses the measured one — and host_energy_J (client CPU) must
+    never outrank the model as the study metric."""
+    rows = [
+        {
+            "__run_id": f"run_{i}_repetition_0",
+            "__done": RunProgress.DONE,
+            "model": "m",
+            "location": "on_device",
+            "length": 100,
+            "tpu_energy_J": 90.0 + i,
+            "energy_model_J": 50.0 + i,
+            "host_energy_J": 10.0 + i,
+            "decode_s": 1.0 + 0.01 * i,
+        }
+        for i in range(6)
+    ]
+    store = RunTableStore(tmp_path)
+    store.write(rows)
+    report = analyze_experiment(tmp_path)
+    assert report["variance_check"]["metric"] == "tpu_energy_J"
+    # measured channel: H2 runs unrestricted (no definitional flags)
+    assert report["h2_energy_is_modelled"] is False
+
+    # model-only table: energy_model_J is the metric, host stays below
+    for r in rows:
+        r["tpu_energy_J"] = None
+    store.write(rows)
+    report = analyze_experiment(tmp_path)
+    assert report["variance_check"]["metric"] == "energy_model_J"
+    assert report["h2_energy_is_modelled"] is True

@@ -620,3 +620,79 @@ def test_recompute_energy_warning_names_the_model(tmp_path, capsys):
     recompute_energy(exp, reanalyze=False)
     out = capsys.readouterr()
     assert "mystery:13b" in out.out + out.err
+
+
+def test_recompute_cross_row_aliasing_canonicalizes_backend_urls(tmp_path):
+    """A legacy table recorded with localhost for one treatment and
+    127.0.0.1 for the other (one loopback server) must still be detected
+    as aliased by recompute (code-review round-4 finding)."""
+    import csv
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        recompute_energy,
+    )
+
+    exp = tmp_path / "spellings"
+    exp.mkdir()
+    cols = [
+        "__run_id", "__done", "model", "location", "length", "backend",
+        "chips", "prompt_tokens", "generated_tokens",
+        "execution_time_s", "decode_s",
+    ]
+    with (exp / "run_table.csv").open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=cols)
+        w.writeheader()
+        for i, (loc, url, chips) in enumerate([
+            ("on_device", "http:http://127.0.0.1:11434", 1),
+            ("remote", "http:http://localhost:11434/", 8),
+        ]):
+            w.writerow({
+                "__run_id": f"run_{i}_repetition_0", "__done": "DONE",
+                "model": "qwen2:1.5b", "location": loc, "length": 100,
+                "backend": url, "chips": chips, "prompt_tokens": 64,
+                "generated_tokens": 134, "execution_time_s": 0.6,
+                "decode_s": 0.45,
+            })
+    recompute_energy(exp, reanalyze=False)
+    rows = {r["location"]: r for r in RunTableStore(exp).read()}
+    assert rows["remote"]["remote_modeled_decode_s"] is not None
+    assert rows["on_device"]["remote_modeled_decode_s"] is None
+
+
+def test_recompute_does_not_bake_default_chips(tmp_path):
+    """Without an explicit --chips map the fallback topology is USED but
+    not persisted — a later recompute with the correct map must still
+    take effect (code-review round-4 finding)."""
+    import csv
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        recompute_energy,
+    )
+
+    exp = tmp_path / "nochips"
+    exp.mkdir()
+    cols = [
+        "__run_id", "__done", "model", "location", "length",
+        "prompt_tokens", "generated_tokens", "execution_time_s", "decode_s",
+    ]
+    with (exp / "run_table.csv").open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=cols)
+        w.writeheader()
+        w.writerow({
+            "__run_id": "run_0_repetition_0", "__done": "DONE",
+            "model": "qwen2:1.5b", "location": "remote", "length": 100,
+            "prompt_tokens": 64, "generated_tokens": 134,
+            "execution_time_s": 0.6, "decode_s": 0.45,
+        })
+    recompute_energy(exp, reanalyze=False)  # default topology: remote=8
+    (row,) = RunTableStore(exp).read()
+    e_default = row["energy_model_J"]
+    assert row["chips"] is None  # fallback not baked in
+    # the corrected topology still takes effect on a second pass...
+    recompute_energy(
+        exp, reanalyze=False, n_chips_by_location={"remote": 4}
+    )
+    (row,) = RunTableStore(exp).read()
+    assert row["energy_model_J"] != e_default
+    # ...and an operator-asserted map IS persisted
+    assert row["chips"] == 4
