@@ -157,6 +157,15 @@ def generation_stats_from(
                 cfg, mid_context, kv_quantize=kv_quantize
             )
         ) * result.generated_tokens
+        # VPU unpack work (int4 decode is VPU-bound, not HBM-bound —
+        # docs/PERF.md); on a TP mesh each chip unpacks its own weight
+        # shard, so total ops don't scale with chips
+        from ..utils.memory import decode_vpu_unpack_ops_per_step
+
+        stats["vpu_ops"] = (
+            decode_vpu_unpack_ops_per_step(cfg, quantize)
+            * result.generated_tokens
+        )
         if aliased and n_chips > 1:
             from ..parallel.roofline import modeled_tp_decode_s
 

@@ -38,6 +38,10 @@ from .base import Profiler, SamplingProfiler, integrate_power_to_joules
 # not a utilisation denominator.
 V5E_PEAK_BF16_TFLOPS = 394.0
 V5E_SPEC_HBM_GBPS = 819.0
+# VPU elementwise throughput: the (8,128) vector unit at ~1 op/lane/cycle
+# and ~940 MHz ≈ 0.96e12 ops/s — and the repo's own measurement agrees
+# (int4 unpack: 3.3e9 ops in a 3.3 ms step, docs/PERF.md:33-38).
+V5E_VPU_OPS_PER_S = 1.0e12
 V5E_PEAK_W = 200.0
 V5E_IDLE_W = 55.0
 
@@ -95,12 +99,13 @@ class TpuEnergyModelProfiler(Profiler):
     ``generation_stats_from``). ``bytes`` — total HBM bytes moved over the
     window — may be omitted (0), degrading to the FLOPs-only model.
 
-    Utilisation = max(MXU duty, HBM duty): the chip draws power for
-    whichever engine it is keeping busy. A memory-bound decode has MXU
-    duty ≈ 0 but streams a large fraction of spec bandwidth — that is a
-    working power state, not idle (the reference's measured Joules see
-    this for free, CodecarbonWrapper.py:43-99; a model has to know the
-    physics).
+    Utilisation = max(MXU duty, HBM duty, VPU duty): the chip draws
+    power for whichever engine it is keeping busy. A memory-bound int8
+    decode has MXU duty ≈ 0 but streams ~60% of spec bandwidth; an int4
+    decode additionally saturates the vector unit unpacking nibbles
+    (``vpu_ops`` in the stats, docs/PERF.md) — both are working power
+    states, not idle (the reference's measured Joules see this for free,
+    CodecarbonWrapper.py:43-99; a model has to know the physics).
     """
 
     data_columns = ("energy_model_J", "joules_per_token", "tpu_util_est")
@@ -112,12 +117,14 @@ class TpuEnergyModelProfiler(Profiler):
         idle_w: float = V5E_IDLE_W,
         n_chips: int = 1,
         spec_hbm_gbps: float = V5E_SPEC_HBM_GBPS,
+        vpu_ops_per_s: float = V5E_VPU_OPS_PER_S,
     ) -> None:
         self.peak_flops = peak_tflops * 1e12
         self.peak_w = peak_w
         self.idle_w = idle_w
         self.n_chips = n_chips
         self.spec_hbm_bps = spec_hbm_gbps * 1e9
+        self.vpu_ops_per_s = vpu_ops_per_s
         self._t0 = 0.0
         self._window_s = 0.0
 
@@ -138,13 +145,16 @@ class TpuEnergyModelProfiler(Profiler):
         duration = float(stats.get("duration_s") or self._window_s)
         flops = float(stats.get("flops", 0.0))
         hbm_bytes = float(stats.get("bytes", 0.0))
+        vpu_ops = float(stats.get("vpu_ops", 0.0))
         tokens = int(stats.get("generated_tokens", 0))
         peak = self.peak_flops * self.n_chips
         peak_bw = self.spec_hbm_bps * self.n_chips
+        peak_vpu = self.vpu_ops_per_s * self.n_chips
         if duration > 0:
             mxu_duty = flops / (peak * duration)
             hbm_duty = hbm_bytes / (peak_bw * duration)
-            util = min(max(mxu_duty, hbm_duty), 1.0)
+            vpu_duty = vpu_ops / (peak_vpu * duration)
+            util = min(max(mxu_duty, hbm_duty, vpu_duty), 1.0)
         else:
             util = 0.0
         energy = (

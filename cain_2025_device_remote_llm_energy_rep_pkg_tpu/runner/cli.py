@@ -325,19 +325,38 @@ def prepare() -> None:
         term.log_warn(f"jax unavailable: {exc}")
     from ..profilers.energy_probe import probe_energy_channels
 
+    # The cooldown promise is derived from the channels the study's
+    # profilers actually CONSUME, not from raw probe kinds: rapl feeds
+    # RaplEnergyProfiler/NativeHostProfiler (host, every mode);
+    # tpu_info feeds TpuPowerCounterProfiler and libtpu_monitoring's
+    # duty cycle feeds TpuDutyCycleProfiler (device, in-process only —
+    # and duty counts as measured even though its probe kind is
+    # "utilization"). hwmon/battery are audited for the channel report
+    # but no profiler wires them yet — they must not inflate the
+    # promise (code-review round-4 finding).
+    HOST_CONSUMED = {"rapl"}
+    DEVICE_CONSUMED = {"tpu_info", "libtpu_monitoring"}
     measured_host = False
     measured_device = False
+    unconsumed = []
     for status in probe_energy_channels():
         line = f"energy channel {status.name} ({status.kind}/{status.scope}): {status.detail}"
         if status.available:
             term.log_ok(line)
-            if status.kind in ("energy", "power"):
-                if status.scope == "host":
-                    measured_host = True
-                else:
-                    measured_device = True
+            if status.name in HOST_CONSUMED:
+                measured_host = True
+            elif status.name in DEVICE_CONSUMED:
+                measured_device = True
+            else:
+                unconsumed.append(status.name)
         else:
             term.log_warn(line)
+    if unconsumed:
+        term.log_warn(
+            f"channel(s) {', '.join(unconsumed)} are live but no profiler "
+            "consumes them yet - they appear in energy_channels.json only "
+            "and do not change the study's cooldown policy"
+        )
     # The channel audit decides the study's thermal policy — say which
     # way it will go BEFORE a sweep is launched (VERDICT round-3
     # directive 7), per scope: host channels (RAPL/native sampler) wire

@@ -250,6 +250,42 @@ def decode_kv_stream_bytes(
     return float(kv_bytes)
 
 
+# VPU elementwise ops per PACKED WEIGHT BYTE to turn the quantized
+# stream into MXU operands, measured/derived in docs/PERF.md:33-46:
+# int4 halves layout ≈ 5 (three i32 sign-extension shifts + two
+# converts per nibble pair), int4-i32 ≈ 3 (shl/ashr per plane + one
+# convert), int8 ≈ 1 (one i8→bf16 convert per byte). bf16 streams are
+# MXU operands already.
+VPU_UNPACK_OPS_PER_BYTE = {
+    "int8": 1.0,
+    "int4": 5.0,
+    "int4-i32": 3.0,
+}
+
+
+def decode_vpu_unpack_ops_per_step(cfg, quantize: Optional[str]) -> float:
+    """VPU elementwise ops one decode step spends unpacking the quantized
+    weight stream (the bytes × per-byte cost above). This is the third
+    duty term of the energy model: int4 decode is VPU-BOUND
+    (docs/PERF.md — the unpack arithmetic, not HBM, sets its 3.6 ms
+    step), so billing it at its ~31% bytes-duty would understate a chip
+    whose vector unit is saturated."""
+    if quantize is None:
+        return 0.0
+    ops = VPU_UNPACK_OPS_PER_BYTE.get(quantize)
+    if ops is None:
+        return 0.0
+    # only the matmul weight stream is unpacked in-kernel; scales, norms
+    # and the (int8) logits head are charged at the int8 rate
+    matmul_per_layer, _, _ = _per_layer_weight_terms(
+        cfg, experts=cfg.top_k_experts if cfg.n_experts else 1
+    )
+    weight_b = 1.0 if quantize == "int8" else 0.5
+    body_bytes = cfg.n_layers * matmul_per_layer * weight_b
+    head_bytes = cfg.vocab_size * cfg.d_model  # int8 in every mode
+    return float(body_bytes * ops + head_bytes * 1.0)
+
+
 def estimate_decode_read_bytes_per_step(
     cfg,
     quantize: Optional[str],

@@ -134,3 +134,60 @@ def test_serve_quantize_per_model_spec_parses(monkeypatch):
     assert be._quant_mode("phi3:3.8b") == "int4"
     assert be._quant_mode("gemma:2b") is None
     assert captured["host"] == "127.0.0.1"
+
+
+def test_prepare_cooldown_promise_matches_consumed_channels(monkeypatch, capsys):
+    """prepare's policy line must reflect the channels the study's
+    profilers actually WIRE (code-review round-4): a live battery/hwmon
+    channel (no consumer) must not promise measured Joules, and a live
+    libtpu duty channel (kind 'utilization' but measured_channel=True in
+    the study) must promise the 90 s device policy."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.energy_probe import (
+        ChannelStatus,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+
+    def fake_probe(statuses):
+        return lambda include_device=True: statuses
+
+    # battery-only host: audited, unconsumed → modelled-only promise
+    monkeypatch.setattr(
+        "cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers."
+        "energy_probe.probe_energy_channels",
+        fake_probe([
+            ChannelStatus("battery", "power", "host", True, "power_now ok"),
+            ChannelStatus("rapl", "energy", "host", False, "no powercap"),
+        ]),
+    )
+    cli.prepare()
+    out = capsys.readouterr().out
+    assert "no profiler consumes them yet" in out
+    assert "modelled Joules" in out
+    assert "record real host Joules" not in out
+
+    # live libtpu duty channel → the 90 s device-channel promise
+    monkeypatch.setattr(
+        "cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers."
+        "energy_probe.probe_energy_channels",
+        fake_probe([
+            ChannelStatus(
+                "libtpu_monitoring", "utilization", "device", True, "duty ok"
+            ),
+        ]),
+    )
+    cli.prepare()
+    out = capsys.readouterr().out
+    assert "measured DEVICE energy channel present" in out
+    assert "90 s" in out
+
+    # readable RAPL → the every-mode host promise
+    monkeypatch.setattr(
+        "cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers."
+        "energy_probe.probe_energy_channels",
+        fake_probe([
+            ChannelStatus("rapl", "energy", "host", True, "energy_uj ok"),
+        ]),
+    )
+    cli.prepare()
+    out = capsys.readouterr().out
+    assert "measured HOST energy channel present" in out

@@ -366,3 +366,69 @@ def test_duty_cycle_profiler_summarises_trace(tmp_path, monkeypatch):
     assert span > 0
     # summarise() rounds to 4 decimals — allow exactly that quantisation
     assert out["energy_duty_J"] == pytest.approx(125.0 * span, abs=1e-3)
+
+
+def test_energy_model_vpu_duty_bills_int4_as_saturated(tmp_path):
+    """int4 decode is VPU-bound (docs/PERF.md: ~5 unpack ops per packed
+    byte set its 3.6 ms step, not HBM) — the model must bill the
+    saturated vector unit, not the ~30% bytes-duty lower bound. int8
+    stays HBM-dominated (its VPU duty ~0.5 is below its 0.6 HBM duty)."""
+    import types
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        generation_stats_from,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.tpu import (
+        TpuEnergyModelProfiler,
+    )
+
+    cfg = get_model_config("qwen2:1.5b")
+    # measured steady-state step times (docs/PERF.md component ablation)
+    res4 = types.SimpleNamespace(
+        prompt_tokens=64, generated_tokens=256,
+        decode_s=256 * 0.00363, total_s=1.0,
+    )
+    s4 = generation_stats_from(cfg, res4, quantize="int4")
+    out4 = TpuEnergyModelProfiler().collect(
+        types.SimpleNamespace(scratch={"generation_stats": s4})
+    )
+    assert 0.85 <= out4["tpu_util_est"] <= 1.0
+
+    res8 = types.SimpleNamespace(
+        prompt_tokens=64, generated_tokens=256,
+        decode_s=256 * 0.00314, total_s=1.0,
+    )
+    s8 = generation_stats_from(cfg, res8, quantize="int8")
+    out8 = TpuEnergyModelProfiler().collect(
+        types.SimpleNamespace(scratch={"generation_stats": s8})
+    )
+    assert 0.5 <= out8["tpu_util_est"] <= 0.75
+    # per token, int4 must now cost MORE than int8 (slower AND a
+    # saturated engine) — the capstone's int4 rows stop reading as the
+    # low-power mode
+    assert out4["joules_per_token"] > out8["joules_per_token"]
+
+
+def test_vpu_unpack_ops_accounting():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.memory import (
+        decode_vpu_unpack_ops_per_step,
+    )
+
+    cfg = get_model_config("qwen2:1.5b")
+    # bf16: no quantized stream, no unpack
+    assert decode_vpu_unpack_ops_per_step(cfg, None) == 0.0
+    ops8 = decode_vpu_unpack_ops_per_step(cfg, "int8")
+    ops4 = decode_vpu_unpack_ops_per_step(cfg, "int4")
+    ops4_i32 = decode_vpu_unpack_ops_per_step(cfg, "int4-i32")
+    # int4 halves: 5 ops per packed byte on half the bytes → 2.5x the
+    # int8 body cost; i32 layout cheaper than halves, dearer than int8
+    assert ops4 > ops4_i32 > ops8 > 0
+    # docs/PERF.md arithmetic: qwen2 int4 body ≈ 0.66 GB × 5 ≈ 3.3e9
+    # ops (+0.23e9 for the int8 logits head)
+    assert 3.0e9 < ops4 < 4.0e9
