@@ -154,7 +154,7 @@ class JaxEngine(GenerationBackend):
         self,
         registry: Optional[Dict[str, ModelConfig]] = None,
         dtype: jnp.dtype = jnp.bfloat16,
-        decode_attention: "str | DecodeAttentionFn | None" = None,
+        decode_attention: "str | DecodeAttentionFn | None" = "auto",
         seed: int = 0,
         weight_cache_dir: "Optional[str]" = None,
         quantize: "str | Dict[str, Optional[str]] | None" = None,
@@ -266,8 +266,17 @@ class JaxEngine(GenerationBackend):
         self._prefill_cache: Dict[Tuple, Callable] = {}
         self._decode_cache: Dict[Tuple, Callable] = {}
         self._warmed: set = set()
+        # "auto" = the MEASURED-best policy per cache representation
+        # (round-4 chip A/Bs, docs/PERF.md "attention impl selection"):
+        # plain bf16 decode uses XLA's fused attention — it TIES the
+        # Pallas decode kernel single-stream (327 vs 325 tok/s short,
+        # 354 vs 324 long) and is ~2× faster batched (6.3k vs 3.7k
+        # aggregate at 32 rows) — while the int8-KV and paged paths keep
+        # their kernels on TPU (fused dequant / no gather materialise,
+        # each measured better than its fallback).
+        self._auto_attention = decode_attention == "auto"
         if decode_attention == "auto":
-            decode_attention = self._auto_decode_attention()
+            decode_attention = None
         self.decode_attention: Optional[DecodeAttentionFn] = decode_attention  # type: ignore[assignment]
         # Independent of the decode kernel choice: "auto" (default) uses the
         # Pallas flash prefill on TPU backends, None forces the jnp path.
@@ -276,12 +285,17 @@ class JaxEngine(GenerationBackend):
         self.prefill_attention: Optional[PrefillAttentionFn] = prefill_attention  # type: ignore[assignment]
 
     @staticmethod
-    def _auto_decode_attention() -> Optional[DecodeAttentionFn]:
-        if jax.default_backend() in ("tpu", "axon"):
-            from ..ops.pallas_attention import pallas_decode_attention
+    def _on_tpu_backend() -> bool:
+        return jax.default_backend() in ("tpu", "axon")
 
-            return pallas_decode_attention
-        return None
+    def _specialised_kernels_enabled(self) -> bool:
+        """Whether the cache-specialised kernels (int8-KV, paged) engage:
+        an explicitly injected decode kernel opts in anywhere; "auto"
+        engages them on TPU backends only (their fallbacks are the right
+        CPU/test path)."""
+        return self.decode_attention is not None or (
+            self._auto_attention and self._on_tpu_backend()
+        )
 
     @staticmethod
     def _auto_prefill_attention():
@@ -731,10 +745,14 @@ class JaxEngine(GenerationBackend):
 
     def _decode_attention_for_cache(self) -> Optional[DecodeAttentionFn]:
         """The decode kernel matching the cache representation: the int8
-        variant unpacks the quantized cache's codes+scales; without a
-        kernel (CPU tests) the jnp fallback in the model handles both."""
-        if self.decode_attention is None or not self.kv_quantize:
+        variant unpacks the quantized cache's codes+scales (folding the
+        scales into the online softmax — the fallback would materialise a
+        dequantized cache); without it (CPU tests) the jnp fallback in
+        the model handles both."""
+        if not self.kv_quantize:
             return self.decode_attention
+        if not self._specialised_kernels_enabled():
+            return None
 
         from ..ops.pallas_attention import pallas_decode_attention_int8
 
@@ -1330,17 +1348,22 @@ class JaxEngine(GenerationBackend):
         additionally stop writing once their OWN budget is exhausted, so a
         row's pool allocation is bounded by its own request, not the
         batch's widest."""
+        decode_attention = self._paged_decode_attention()
+        # Stacked-pool mode (kernel present): the pools ride the decode
+        # scan's CARRY and the kernel indexes the layer in its DMA offset
+        # — see run_blocks. The legacy xs/ys mode staged a full pool copy
+        # per step (3× slower than contiguous at 32 rows, docs/PERF.md)
+        # and remains only for the gather-fallback paths.
+        stacked = decode_attention is not None
         key = (
             "paged-batch", model, n_steps, top_k, use_top_p, use_rp,
-            n_pages, jmax,
+            n_pages, jmax, stacked,
         )
         if key in self._decode_cache:
             return self._decode_cache[key]
         tf = self._models[model]
         cfg = tf.cfg
         eos = self._tokenizer_for(model).eos_id
-
-        decode_attention = self._paged_decode_attention()
 
         from ..ops.sampling import sample_token_per_row
 
@@ -1363,7 +1386,13 @@ class JaxEngine(GenerationBackend):
         ):
             b = first_tokens.shape[0]
             l = pool_k.shape[0]
-            table_l = jnp.broadcast_to(table, (l,) + table.shape)
+            # stacked mode: [B,Jmax] table (run_blocks carries the pool);
+            # legacy: per-layer broadcast so scan xs can slice it
+            table_c = (
+                table if stacked else jnp.broadcast_to(
+                    table, (l,) + table.shape
+                )
+            )
 
             def cond(carry):
                 _, _, _, _, _, done, i, _, _, _ = carry
@@ -1372,8 +1401,8 @@ class JaxEngine(GenerationBackend):
             def body(carry):
                 token, offs, pk, pv, rngs, done, i, out, pres, n_row = carry
                 prev_done = done
-                kc = {"pool": pk, "table": table_l}
-                vc = {"pool": pv, "table": table_l}
+                kc = {"pool": pk, "table": table_c}
+                vc = {"pool": pv, "table": table_c}
                 hidden, kc, vc = forward(
                     params, cfg, token[:, None], offs, kc, vc, decode_attention
                 )
@@ -1425,14 +1454,29 @@ class JaxEngine(GenerationBackend):
 
     def _paged_decode_attention(self):
         """The attention impl for paged caches: the Pallas page-table
-        kernel where a decode kernel is configured, else None (the jnp
-        fallback gathers through the table — CPU tests, and meshes where
-        the kernel has no GSPMD partition rule)."""
-        if self.decode_attention is None:
+        kernel where specialised kernels are enabled (explicit injection,
+        or "auto" on TPU — its gather fallback materialises ~1 GB/step at
+        qwen2 32-row shapes and measured 2.1k vs the kernel path's 2.55k
+        aggregate tok/s), else None (CPU tests, and meshes where the
+        kernel has no GSPMD partition rule)."""
+        if not self._specialised_kernels_enabled():
             return None
-        from ..ops.pallas_paged_attention import pallas_paged_decode_attention
+        from ..ops.pallas_paged_attention import (
+            pallas_paged_decode_attention,
+            pallas_paged_decode_attention_parts,
+        )
 
         def decode_attention(q, kc, vc, lengths):
+            if "layer" in kc:  # stacked mode: unnormalised parts for the
+                # caller's self-term merge (transformer.py)
+                return pallas_paged_decode_attention_parts(
+                    q,
+                    kc["pool"],
+                    vc["pool"],
+                    kc["table"],
+                    lengths,
+                    layer=kc["layer"],
+                )
             return pallas_paged_decode_attention(
                 q, kc["pool"], vc["pool"], kc["table"], lengths
             )
@@ -1494,11 +1538,19 @@ class JaxEngine(GenerationBackend):
         n_pages = pow2_at_least(total_pages, 4)
         jmax = pow2_at_least(max(rows_pages or [1]))
 
+        # Stacked-pool mode pre-pads the head dim to the 128-lane tile
+        # ONCE at allocation (phi3's d_head=96 → 128): the stacked kernel
+        # must never pad the GB-scale pool per call, and the write path
+        # pads its [B,Hkv,D] row instead (transformer.py).
+        stacked = self._paged_decode_attention() is not None
+        d_pool = (
+            -(-cfg.d_head // 128) * 128 if stacked else cfg.d_head
+        )
         pool = PagePool.create(
             n_layers=cfg.n_layers,
             n_pages=n_pages,
             n_kv_heads=cfg.n_kv_heads,
-            d_head=cfg.d_head,
+            d_head=d_pool,
             page_size=page,
             dtype=self.dtype,
         )
@@ -1515,12 +1567,14 @@ class JaxEngine(GenerationBackend):
             # [L,1,Hkv,T,D] → [L,Hkv,s_real,D] → page chunks
             n_prompt_pages = -(-st["s_real"] // page)
             chunk_idx.extend(pages[:n_prompt_pages])
-            chunks_k.append(
-                _paginate(st["k_cache"][:, 0], st["s_real"], page)
-            )
-            chunks_v.append(
-                _paginate(st["v_cache"][:, 0], st["s_real"], page)
-            )
+            ck = _paginate(st["k_cache"][:, 0], st["s_real"], page)
+            cv = _paginate(st["v_cache"][:, 0], st["s_real"], page)
+            if d_pool != cfg.d_head:  # stacked pools carry padded D
+                pad = [(0, 0)] * (ck.ndim - 1) + [(0, d_pool - cfg.d_head)]
+                ck = jnp.pad(ck, pad)
+                cv = jnp.pad(cv, pad)
+            chunks_k.append(ck)
+            chunks_v.append(cv)
         if pad_rows:
             private = pool.alloc(1)[0]
             for _ in range(pad_rows):

@@ -56,22 +56,42 @@ DecodeAttentionFn = Callable[
 def is_paged_cache(leaf: Any) -> bool:
     """A paged KV-cache leaf: ``{"pool": [P,Hkv,page,D], "table":
     [B,Jmax]}`` (engine/paged_kv.py) — pages of a shared pool addressed
-    through a per-request block table."""
-    return isinstance(leaf, dict) and set(leaf) == {"pool", "table"}
+    through a per-request block table. The STACKED variant adds a
+    ``"layer"`` scalar and keeps the whole [L,P,Hkv,page,Dp] pool in one
+    leaf, so the decode scan can carry it instead of staging per-layer
+    copies through scan ys (see run_blocks)."""
+    return isinstance(leaf, dict) and set(leaf) in (
+        {"pool", "table"},
+        {"pool", "table", "layer"},
+    )
 
 
-def _gather_paged(leaf, dtype=jnp.float32) -> jnp.ndarray:
+def _gather_paged(leaf, dtype=jnp.float32, d: Optional[int] = None) -> jnp.ndarray:
     """Materialise a paged cache as contiguous [B,Hkv,T,D] — the jnp
-    fallback path only; the Pallas kernel reads through the table."""
+    fallback path only; the Pallas kernels read through the table.
+    Stacked leafs are rejected: their pool excludes the current token
+    (the deferred-write design) and only the kernel+merge path accounts
+    for it — a gather here would silently drop it from attention.
+    ``d`` slices off head-dim padding (no-op otherwise)."""
+    if "layer" in leaf:
+        raise ValueError(
+            "stacked paged caches have no gather fallback (the pool "
+            "excludes the current token; only the parts-kernel path "
+            "merges it) - the engine gates stacked mode on kernel "
+            "presence, so reaching this is a wiring bug"
+        )
     pool, table = leaf["pool"], leaf["table"]
     b, jmax = table.shape
-    _, hkv, page, d = pool.shape
+    _, hkv, page, dpool = pool.shape
     gathered = pool[table]  # [B, Jmax, Hkv, page, D]
-    return (
+    out = (
         gathered.transpose(0, 2, 1, 3, 4)
-        .reshape(b, hkv, jmax * page, d)
+        .reshape(b, hkv, jmax * page, dpool)
         .astype(dtype)
     )
+    if d is not None and d != dpool:
+        out = out[..., :d]
+    return out
 
 # Signature: (q[B,S,Hq,D], k_cache[B,Hkv,T,D], v_cache[B,Hkv,T,D], offset) -> [B,S,Hq,D]
 PrefillAttentionFn = Callable[
@@ -200,7 +220,9 @@ def _attention_block(
     quant_cache = is_quantized_cache(k_cache)
     paged_cache = is_paged_cache(k_cache)
     if paged_cache:
-        t = k_cache["table"].shape[1] * k_cache["pool"].shape[2]
+        # pool is [P,Hkv,page,D] (per-layer) or [L,P,Hkv,page,Dp]
+        # (stacked): the page dim is [-2] in both
+        t = k_cache["table"].shape[1] * k_cache["pool"].shape[-2]
     else:
         t = (k_cache["q"] if quant_cache else k_cache).shape[2]
     per_seq = jnp.ndim(offset) == 1  # batched decode: one offset per sequence
@@ -241,21 +263,34 @@ def _attention_block(
         from ..engine.paged_kv import page_slot
 
         table = k_cache["table"]  # [B, Jmax]
-        page_size = k_cache["pool"].shape[2]
+        page_size = k_cache["pool"].shape[-2]
+        dpool = k_cache["pool"].shape[-1]
         off_b = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b,))
-        pages, slots = page_slot(table, off_b, page_size)  # [B], [B]
-        k_cache = {
-            **k_cache,
-            "pool": k_cache["pool"]
-            .at[pages, :, slots]
-            .set(k[:, 0].astype(k_cache["pool"].dtype)),
-        }
-        v_cache = {
-            **v_cache,
-            "pool": v_cache["pool"]
-            .at[pages, :, slots]
-            .set(v[:, 0].astype(v_cache["pool"].dtype)),
-        }
+        new_k = k[:, 0].astype(k_cache["pool"].dtype)
+        new_v = v[:, 0].astype(v_cache["pool"].dtype)
+        if dpool != dh:  # stacked pools are padded to a 128-multiple
+            pad = ((0, 0), (0, 0), (0, dpool - dh))
+            new_k = jnp.pad(new_k, pad)
+            new_v = jnp.pad(new_v, pad)
+        if "layer" in k_cache:
+            # STACKED mode: the pool write is DEFERRED — the row rides out
+            # as "new_row" and run_blocks scatters every layer's row in one
+            # batched update per step. An in-scan scatter with a traced
+            # layer index measured a full pool copy per layer on real
+            # hardware (~52 ms/step at qwen2 32-row shapes, docs/PERF.md);
+            # attention below merges the current token analytically.
+            k_cache = {**k_cache, "new_row": new_k}
+            v_cache = {**v_cache, "new_row": new_v}
+        else:
+            pages, slots = page_slot(table, off_b, page_size)  # [B], [B]
+            k_cache = {
+                **k_cache,
+                "pool": k_cache["pool"].at[pages, :, slots].set(new_k),
+            }
+            v_cache = {
+                **v_cache,
+                "pool": v_cache["pool"].at[pages, :, slots].set(new_v),
+            }
     elif quant_cache:
         # Quantize the new entry and write codes + per-vector scale.
         kq, ks = quantize_kv_vector(k[:, 0])  # [B,Hkv,dh]
@@ -308,7 +343,31 @@ def _attention_block(
         )
 
     scale = 1.0 / math.sqrt(dh)
-    if s == 1 and decode_attention is not None:
+    if (
+        s == 1
+        and decode_attention is not None
+        and paged_cache
+        and "layer" in k_cache
+    ):
+        # Stacked paged decode: the pool holds only the CACHED tokens
+        # (this step's write is deferred — see above), so the kernel runs
+        # at lengths=offset and emits unnormalised (acc, m, l); the
+        # current token's self-attention term is merged analytically.
+        group = hq // hkv
+        lengths = jnp.broadcast_to(offset, (b,)).astype(jnp.int32)
+        acc, m_c, l_c = decode_attention(q[:, 0], k_cache, v_cache, lengths)
+        qf = q[:, 0].reshape(b, hkv, group, dh).astype(jnp.float32)
+        kn = k[:, 0].astype(jnp.float32)  # [B,Hkv,Dh]
+        vn = v[:, 0].astype(jnp.float32)
+        s_self = jnp.einsum("bkgd,bkd->bkg", qf, kn) * scale
+        m_new = jnp.maximum(m_c, s_self)
+        w_c = jnp.exp(m_c - m_new)  # 0 when the cache is empty (m=-inf)
+        w_s = jnp.exp(s_self - m_new)
+        out = (
+            acc * w_c[..., None] + w_s[..., None] * vn[:, :, None, :]
+        ) / (l_c * w_c + w_s)[..., None]
+        out = out.reshape(b, 1, hq, dh).astype(x.dtype)
+    elif s == 1 and decode_attention is not None:
         lengths = jnp.broadcast_to(offset + 1, (b,)).astype(jnp.int32)
         out = decode_attention(q[:, 0], k_cache, v_cache, lengths)  # [B,Hq,Dh]
         out = out[:, None]  # [B,1,Hq,Dh]
@@ -318,8 +377,8 @@ def _attention_block(
         group = hq // hkv
         qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)
         if paged_cache:
-            kf = _gather_paged(k_cache)
-            vf = _gather_paged(v_cache)
+            kf = _gather_paged(k_cache, d=dh)  # raises on stacked leafs
+            vf = _gather_paged(v_cache, d=dh)
         else:
             kf = (
                 dequant_cache(k_cache)
@@ -410,8 +469,7 @@ def run_blocks(
     correct per architecture quirk (gemma norms, qwen2 biases, …).
     """
 
-    def block(x, scanned):
-        layer, kc, vc = scanned
+    def _layer_step(x, layer, kc, vc):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps, gemma_style=cfg.gemma_norm)
         attn_out, kc, vc = _attention_block(
             cfg, h, layer, kc, vc, offset, cos, sin,
@@ -425,7 +483,53 @@ def run_blocks(
             gate = _activation(cfg, dense_dot(h, layer["w_gate"]))
             up = dense_dot(h, layer["w_up"])
             mlp_out = dense_dot(gate * up, layer["w_down"])
-        return x + mlp_out, (kc, vc)
+        return x + mlp_out, kc, vc
+
+    if is_paged_cache(k_cache) and jnp.ndim(k_cache["table"]) == 2:
+        # STACKED paged mode: the [L,P,Hkv,page,Dp] pools are CLOSED OVER
+        # (scan-invariant — zero per-layer pool traffic); each layer
+        # addresses its slice through the leaf's "layer" index inside the
+        # kernel's DMA offset, defers its write (attention merges the
+        # current token analytically, _attention_block), and emits its
+        # [B,Hkv,Dp] row as scan ys. ONE batched scatter then lands every
+        # layer's row. The alternatives both measured a full-pool copy on
+        # real hardware: pool-as-scan-ys copies once per STEP (~3× slower
+        # than contiguous batched decode), pool-as-carry with an in-scan
+        # traced-layer scatter copies once per LAYER (~52 ms/step) —
+        # docs/PERF.md. The xs/ys mode below survives for paths without a
+        # stacked kernel (multi-device meshes use the gather fallback).
+        from ..engine.paged_kv import page_slot
+
+        table = k_cache["table"]
+        kp0, vp0 = k_cache["pool"], v_cache["pool"]
+
+        def block_paged(carry, layer):
+            x, li = carry
+            kc = {"pool": kp0, "table": table, "layer": li}
+            vc = {"pool": vp0, "table": table, "layer": li}
+            x, kc, vc = _layer_step(x, layer, kc, vc)
+            return (x, li + 1), (kc["new_row"], vc["new_row"])
+
+        (x, _), (k_rows, v_rows) = jax.lax.scan(
+            block_paged, (x, jnp.int32(0)), stacked
+        )
+        n_layers, b_rows = k_rows.shape[0], k_rows.shape[1]
+        page_size = kp0.shape[-2]
+        off_b = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b_rows,))
+        pages, slots = page_slot(table, off_b, page_size)
+        li = jnp.arange(n_layers)[:, None]
+        new_kp = kp0.at[li, pages[None, :], :, slots[None, :]].set(k_rows)
+        new_vp = vp0.at[li, pages[None, :], :, slots[None, :]].set(v_rows)
+        return (
+            x,
+            {"pool": new_kp, "table": table},
+            {"pool": new_vp, "table": table},
+        )
+
+    def block(x, scanned):
+        layer, kc, vc = scanned
+        x, kc, vc = _layer_step(x, layer, kc, vc)
+        return x, (kc, vc)
 
     x, (new_k, new_v) = jax.lax.scan(block, x, (stacked, k_cache, v_cache))
     return x, new_k, new_v

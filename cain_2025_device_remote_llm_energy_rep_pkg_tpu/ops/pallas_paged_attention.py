@@ -23,6 +23,18 @@ step, there is no gather. Pages past a request's length are clamped to
 its last valid page (Pallas elides the repeated DMA) and their compute is
 gated off with ``pl.when``.
 
+Two entry points share the accumulation body (``_accumulate_page``):
+
+- :func:`pallas_paged_decode_attention` — per-layer pools, normalised
+  output (the batched-decode legacy path and the TP gather-fallback's
+  kernel counterpart).
+- :func:`pallas_paged_decode_attention_parts` — STACKED pools
+  ([L, P, Hkv, page, Dp], layer folded into the DMA offset) emitting the
+  UNNORMALISED (acc, m, l) triplet over the cached tokens, for the
+  deferred-write decode loop's analytic self-term merge
+  (models/transformer.py; the measured rationale is in docs/PERF.md
+  "paged batched decode").
+
 Parity is pinned against a gather-then-attend reference on scattered page
 permutations (tests/test_paged_attention.py).
 """
@@ -37,6 +49,53 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+
+def _accumulate_page(
+    q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, block_start, length, scale
+):
+    """One page's online-softmax update — THE shared body of both
+    kernels. Reshape-based K/V reads serve the per-layer block
+    ([1,1,page,D]) and the stacked block ([1,1,1,page,Dp]) alike."""
+    q = q_ref[0, 0].astype(jnp.float32)  # [G,D]
+    k = k_ref[...].reshape(k_ref.shape[-2:]).astype(jnp.float32)  # [page,D]
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [G,page]
+    idx = block_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(idx < length, s, -jnp.inf)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    v = v_ref[...].reshape(v_ref.shape[-2:]).astype(jnp.float32)  # [page,D]
+    pv = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
+def _init_scratch(m_ref, l_ref, acc_ref):
+    m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+
+def _last_valid_page(j, b_i, lens, page: int):
+    """Clamp page index ``j`` to the request's frontier page — Pallas
+    elides the repeated DMA when the block index repeats, so skipped
+    iterations stream nothing from HBM."""
+    last_j = jnp.maximum((lens[b_i] - 1) // page, 0)
+    return jnp.minimum(j, last_j)
 
 
 def _paged_decode_kernel(
@@ -59,44 +118,68 @@ def _paged_decode_kernel(
 
     @pl.when(j == 0)
     def _init():
-        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+        _init_scratch(m_ref, l_ref, acc_ref)
 
     length = lengths_ref[b_i]
     block_start = j * page
 
     @pl.when(block_start < length)
     def _block():
-        q = q_ref[0, 0].astype(jnp.float32)  # [G,D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [page,D]
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        )  # [G,page]
-        idx = block_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(idx < length, s, -jnp.inf)
-
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0, 0].astype(jnp.float32)  # [page,D]
-        pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        _accumulate_page(
+            q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+            block_start, length, scale,
         )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(j == n_pages_per_req - 1)
     def _finalise():
         o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def _paged_decode_parts_kernel(
+    page_table_ref,
+    lengths_ref,
+    _layer_ref,  # consumed by the index maps
+    q_ref,
+    k_ref,  # VMEM [1, 1, 1, page, Dp] — stacked pool block
+    v_ref,
+    acc_out_ref,  # VMEM [1, 1, G, Dp] f32 — UNNORMALISED sum e^{s-m}·v
+    m_out_ref,  # VMEM [1, 1, G, 128] f32 — running max
+    l_out_ref,  # VMEM [1, 1, G, 128] f32 — sum e^{s-m}
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    page: int,
+    n_pages_per_req: int,
+    scale: float,
+):
+    """Stacked-pool variant: same accumulation, raw (acc, m, l) out —
+    the caller merges the current token's self-attention term
+    analytically, which is what lets the decode loop defer every pool
+    write to one batched scatter per step. A zero-length row exits with
+    (0, -inf, 0), which the merge maps to pure self-attention."""
+    b_i = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        _init_scratch(m_ref, l_ref, acc_ref)
+
+    length = lengths_ref[b_i]
+    block_start = j * page
+
+    @pl.when(block_start < length)
+    def _block():
+        _accumulate_page(
+            q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+            block_start, length, scale,
+        )
+
+    @pl.when(j == n_pages_per_req - 1)
+    def _emit():
+        acc_out_ref[0, 0] = acc_ref[...]
+        m_out_ref[0, 0] = m_ref[...]
+        l_out_ref[0, 0] = l_ref[...]
 
 
 def pallas_paged_decode_attention(
@@ -144,12 +227,7 @@ def pallas_paged_decode_attention(
     )
 
     def kv_index(b_i, h, j, tab, lens):
-        # Pages wholly past the request's frontier repeat its last valid
-        # page — Pallas elides the DMA when the block index repeats, so
-        # the skipped iterations stream nothing from HBM.
-        last_j = jnp.maximum((lens[b_i] - 1) // page, 0)
-        jj = jnp.minimum(j, last_j)
-        return (tab[b_i, jj], h, 0, 0)
+        return (tab[b_i, _last_valid_page(j, b_i, lens, page)], h, 0, 0)
 
     out = pl.pallas_call(
         kernel,
@@ -181,6 +259,102 @@ def pallas_paged_decode_attention(
     if d_pad:
         out = out[..., :d]
     return out.reshape(b, hq, d)
+
+
+def pallas_paged_decode_attention_parts(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pool: jnp.ndarray,  # [L, P, Hkv, page, Dp] — STACKED pools only
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, Jmax] int32
+    lengths: jnp.ndarray,  # [B] int32 — CACHED tokens (current excluded)
+    *,
+    layer: jnp.ndarray,  # scalar int32
+    interpret: Optional[bool] = None,
+) -> "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]":
+    """Unnormalised flash-decode parts over the cached tokens of a
+    stacked pool: returns ``(acc [B,Hkv,G,D] f32, m [B,Hkv,G] f32,
+    l [B,Hkv,G] f32)`` for the caller's self-term merge.
+
+    Stacked pools must be pre-padded to a 128-multiple head dim (the
+    engine allocates them that way); per-call padding of a GB-scale pool
+    would reintroduce the copy this path exists to avoid.
+    """
+    b, hq, d = q.shape
+    _, n_pool, hkv, page, dp = k_pool.shape
+    if dp % 128:
+        raise ValueError(
+            f"stacked pools must be pre-padded to a 128-multiple head "
+            f"dim, got {dp} (per-call padding would copy the pool)"
+        )
+    d_pad = dp - d
+    jmax = page_table.shape[1]
+    group = hq // hkv
+    scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    qr = q.reshape(b, hkv, group, d)
+    if d_pad:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, 0), (0, d_pad)))
+    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pool - 1)
+
+    kernel = functools.partial(
+        _paged_decode_parts_kernel,
+        page=page,
+        n_pages_per_req=jmax,
+        scale=scale,
+    )
+
+    def q_index(b_i, h, j, tab, lens, lay):
+        return (b_i, h, 0, 0)
+
+    def kv_index(b_i, h, j, tab, lens, lay):
+        return (
+            lay[0],
+            tab[b_i, _last_valid_page(j, b_i, lens, page)],
+            h,
+            0,
+            0,
+        )
+
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, hkv, jmax),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, dp), q_index),
+                pl.BlockSpec((1, 1, 1, page, dp), kv_index),
+                pl.BlockSpec((1, 1, 1, page, dp), kv_index),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, group, dp), q_index),
+                pl.BlockSpec((1, 1, group, 128), q_index),
+                pl.BlockSpec((1, 1, group, 128), q_index),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, dp), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, group, dp), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, group, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, group, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        table,
+        lengths.astype(jnp.int32),
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        qr,
+        k_pool,
+        v_pool,
+    )
+    if d_pad:
+        acc = acc[..., :d]
+    return acc, m[..., 0], l[..., 0]
 
 
 def paged_decode_attention_reference(
