@@ -1349,11 +1349,14 @@ class JaxEngine(GenerationBackend):
         row's pool allocation is bounded by its own request, not the
         batch's widest."""
         decode_attention = self._paged_decode_attention()
-        # Stacked-pool mode (kernel present): the pools ride the decode
-        # scan's CARRY and the kernel indexes the layer in its DMA offset
-        # — see run_blocks. The legacy xs/ys mode staged a full pool copy
-        # per step (3× slower than contiguous at 32 rows, docs/PERF.md)
-        # and remains only for the gather-fallback paths.
+        # Stacked-hybrid mode (kernel present): the pool holds ONLY the
+        # prefill pages and is read-only during the loop (closed over —
+        # zero per-step pool traffic); generated tokens live in small
+        # contiguous side caches in the while carry, and attention merges
+        # the kernel's prompt parts with the side's fused-XLA part — see
+        # run_blocks/_attention_block. The legacy xs/ys mode staged a
+        # full pool copy per step (3× slower than contiguous at 32 rows,
+        # docs/PERF.md) and remains only for the gather-fallback paths.
         stacked = decode_attention is not None
         key = (
             "paged-batch", model, n_steps, top_k, use_top_p, use_rp,
@@ -1386,13 +1389,14 @@ class JaxEngine(GenerationBackend):
         ):
             b = first_tokens.shape[0]
             l = pool_k.shape[0]
-            # stacked mode: [B,Jmax] table (run_blocks carries the pool);
+            # stacked mode: [B,Jmax] table (pools closed over, read-only);
             # legacy: per-layer broadcast so scan xs can slice it
             table_c = (
                 table if stacked else jnp.broadcast_to(
                     table, (l,) + table.shape
                 )
             )
+            prompt_lens = offsets  # static through the loop
 
             def cond(carry):
                 _, _, _, _, _, done, i, _, _, _ = carry
@@ -1401,12 +1405,30 @@ class JaxEngine(GenerationBackend):
             def body(carry):
                 token, offs, pk, pv, rngs, done, i, out, pres, n_row = carry
                 prev_done = done
-                kc = {"pool": pk, "table": table_c}
-                vc = {"pool": pv, "table": table_c}
+                if stacked:
+                    # pk/pv are the SIDE caches here; the read-only pools
+                    # come in from the enclosing scope
+                    kc = {
+                        "pool": pool_k, "table": table_c, "side": pk,
+                        "write_pos": offs - prompt_lens,
+                        "prompt_lens": prompt_lens,
+                    }
+                    vc = {
+                        "pool": pool_v, "table": table_c, "side": pv,
+                        "write_pos": offs - prompt_lens,
+                        "prompt_lens": prompt_lens,
+                    }
+                else:
+                    kc = {"pool": pk, "table": table_c}
+                    vc = {"pool": pv, "table": table_c}
                 hidden, kc, vc = forward(
                     params, cfg, token[:, None], offs, kc, vc, decode_attention
                 )
-                pk, pv = kc["pool"], vc["pool"]
+                pk, pv = (
+                    (kc["side"], vc["side"])
+                    if stacked
+                    else (kc["pool"], vc["pool"])
+                )
                 logits = logits_for(params, cfg, hidden[:, 0])
                 split = jax.vmap(jax.random.split)(rngs)
                 rngs, subs = split[:, 0], split[:, 1]
@@ -1434,11 +1456,21 @@ class JaxEngine(GenerationBackend):
                 )
 
             out0 = jnp.full((b, n_steps), eos, dtype=jnp.int32)
+            if stacked:
+                # side caches: this call's generated tokens, one column
+                # per step (done rows rewrite their frozen column)
+                side0 = jnp.zeros(
+                    (l, b, cfg.n_kv_heads, n_steps, cfg.d_head),
+                    dtype=pool_k.dtype,
+                )
+                cache0_k, cache0_v = side0, side0
+            else:
+                cache0_k, cache0_v = pool_k, pool_v
             init = (
                 first_tokens,
                 offsets,
-                pool_k,
-                pool_v,
+                cache0_k,
+                cache0_v,
                 rngs,
                 done0,
                 jnp.int32(0),
@@ -1468,7 +1500,10 @@ class JaxEngine(GenerationBackend):
 
         def decode_attention(q, kc, vc, lengths):
             if "layer" in kc:  # stacked mode: unnormalised parts for the
-                # caller's self-term merge (transformer.py)
+                # caller's merge (transformer.py). A gather+fused-XLA
+                # parts variant was measured SLOWER than this kernel even
+                # at jmax=1 (2.4-2.6k vs 2.8k aggregate, docs/PERF.md) —
+                # the kernel is the parts path.
                 return pallas_paged_decode_attention_parts(
                     q,
                     kc["pool"],
@@ -1511,6 +1546,12 @@ class JaxEngine(GenerationBackend):
                 m *= 2
             return m
 
+        # Stacked-hybrid mode (kernel present): pool pages hold the
+        # PROMPT only — generated tokens live in the decode loop's side
+        # caches, so the pool is read-only during decode and pages are
+        # not allocated for budgets. Legacy (gather-fallback) mode writes
+        # decode tokens into pages and sizes for prompt + budget.
+        stacked = self._paged_decode_attention() is not None
         states = []
         n_real = max(r.max_new_tokens for r in requests) - 1
         # ONE definition of each row's token budget, used both for page
@@ -1520,11 +1561,13 @@ class JaxEngine(GenerationBackend):
         rows_pages: "list[int]" = []
         for r, ids, budget in zip(requests, all_prompt_ids, row_budgets):
             # prefill needs only the prompt's own slots: decode writes go
-            # to the pool, not this cache
+            # to the pool (legacy) or the side caches (stacked)
             st = self._start(r, cache_len=_prompt_alloc(len(ids)), prompt_ids=ids)
             states.append(st)
             rows_pages.append(
-                -(-(st["s_real"] + budget + 1) // page)
+                -(-st["s_real"] // page)
+                if stacked
+                else -(-(st["s_real"] + budget + 1) // page)
             )
 
         n = len(states)
@@ -1538,11 +1581,11 @@ class JaxEngine(GenerationBackend):
         n_pages = pow2_at_least(total_pages, 4)
         jmax = pow2_at_least(max(rows_pages or [1]))
 
-        # Stacked-pool mode pre-pads the head dim to the 128-lane tile
-        # ONCE at allocation (phi3's d_head=96 → 128): the stacked kernel
-        # must never pad the GB-scale pool per call, and the write path
-        # pads its [B,Hkv,D] row instead (transformer.py).
-        stacked = self._paged_decode_attention() is not None
+        # Stacked mode pre-pads the head dim to the 128-lane tile ONCE at
+        # allocation (phi3's d_head=96 → 128): the stacked kernel must
+        # never pad the pool per call; prefill page chunks are padded to
+        # match below (the side caches stay unpadded — XLA's fused
+        # attention reads them directly).
         d_pool = (
             -(-cfg.d_head // 128) * 128 if stacked else cfg.d_head
         )

@@ -53,45 +53,51 @@ DecodeAttentionFn = Callable[
 ]
 
 
+STACKED_PAGED_KEYS = frozenset(
+    {"pool", "table", "layer", "side", "write_pos", "prompt_lens"}
+)
+
+
 def is_paged_cache(leaf: Any) -> bool:
     """A paged KV-cache leaf: ``{"pool": [P,Hkv,page,D], "table":
     [B,Jmax]}`` (engine/paged_kv.py) — pages of a shared pool addressed
-    through a per-request block table. The STACKED variant adds a
-    ``"layer"`` scalar and keeps the whole [L,P,Hkv,page,Dp] pool in one
-    leaf, so the decode scan can carry it instead of staging per-layer
-    copies through scan ys (see run_blocks)."""
-    return isinstance(leaf, dict) and set(leaf) in (
-        {"pool", "table"},
-        {"pool", "table", "layer"},
+    through a per-request block table. The STACKED-HYBRID variant (the
+    fast batched-decode path) additionally carries: the whole
+    [L,P,Hkv,page,Dp] pool (READ-ONLY during decode — prefill pages
+    only), a contiguous ``side`` cache [B,Hkv,Tgen,D] per layer holding
+    the tokens generated this call, ``write_pos``/``prompt_lens`` [B]
+    row vectors, and (inside the layer scan) a ``layer`` index."""
+    if not isinstance(leaf, dict):
+        return False
+    keys = set(leaf)
+    return keys == {"pool", "table"} or (
+        {"pool", "table", "side"} <= keys <= STACKED_PAGED_KEYS
     )
 
 
-def _gather_paged(leaf, dtype=jnp.float32, d: Optional[int] = None) -> jnp.ndarray:
+def _gather_paged(leaf, dtype=jnp.float32) -> jnp.ndarray:
     """Materialise a paged cache as contiguous [B,Hkv,T,D] — the jnp
     fallback path only; the Pallas kernels read through the table.
-    Stacked leafs are rejected: their pool excludes the current token
-    (the deferred-write design) and only the kernel+merge path accounts
-    for it — a gather here would silently drop it from attention.
-    ``d`` slices off head-dim padding (no-op otherwise)."""
-    if "layer" in leaf:
+    Stacked-hybrid leafs are rejected: their pool holds only the prompt
+    (generated tokens live in the side caches) and only the
+    parts-kernel + merge path composes the two — a gather here would
+    silently drop every generated token from attention."""
+    if "side" in leaf or "layer" in leaf:
         raise ValueError(
             "stacked paged caches have no gather fallback (the pool "
-            "excludes the current token; only the parts-kernel path "
-            "merges it) - the engine gates stacked mode on kernel "
+            "holds only the prompt; the parts-kernel path merges the "
+            "side cache) - the engine gates stacked mode on kernel "
             "presence, so reaching this is a wiring bug"
         )
     pool, table = leaf["pool"], leaf["table"]
     b, jmax = table.shape
     _, hkv, page, dpool = pool.shape
     gathered = pool[table]  # [B, Jmax, Hkv, page, D]
-    out = (
+    return (
         gathered.transpose(0, 2, 1, 3, 4)
         .reshape(b, hkv, jmax * page, dpool)
         .astype(dtype)
     )
-    if d is not None and d != dpool:
-        out = out[..., :d]
-    return out
 
 # Signature: (q[B,S,Hq,D], k_cache[B,Hkv,T,D], v_cache[B,Hkv,T,D], offset) -> [B,S,Hq,D]
 PrefillAttentionFn = Callable[
@@ -260,36 +266,46 @@ def _attention_block(
         # requests share one pool. The addressing arithmetic lives in ONE
         # place (engine/paged_kv.page_slot) shared with the row-level
         # helpers, so the two writers cannot drift.
-        from ..engine.paged_kv import page_slot
-
         table = k_cache["table"]  # [B, Jmax]
-        page_size = k_cache["pool"].shape[-2]
-        dpool = k_cache["pool"].shape[-1]
-        off_b = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b,))
-        new_k = k[:, 0].astype(k_cache["pool"].dtype)
-        new_v = v[:, 0].astype(v_cache["pool"].dtype)
-        if dpool != dh:  # stacked pools are padded to a 128-multiple
-            pad = ((0, 0), (0, 0), (0, dpool - dh))
-            new_k = jnp.pad(new_k, pad)
-            new_v = jnp.pad(new_v, pad)
-        if "layer" in k_cache:
-            # STACKED mode: the pool write is DEFERRED — the row rides out
-            # as "new_row" and run_blocks scatters every layer's row in one
-            # batched update per step. An in-scan scatter with a traced
-            # layer index measured a full pool copy per layer on real
-            # hardware (~52 ms/step at qwen2 32-row shapes, docs/PERF.md);
-            # attention below merges the current token analytically.
-            k_cache = {**k_cache, "new_row": new_k}
-            v_cache = {**v_cache, "new_row": new_v}
-        else:
-            pages, slots = page_slot(table, off_b, page_size)  # [B], [B]
+        if "side" in k_cache:
+            # STACKED-HYBRID mode: the pool is READ-ONLY during decode
+            # (prefill pages only); this step's K/V row lands in the
+            # contiguous side cache at the row's generated-token index —
+            # the cheap arange-rows write the contiguous batched path
+            # uses. (Both pool-write alternatives measured a full pool
+            # copy on real hardware: per-STEP via scan ys, per-LAYER via
+            # a traced-layer scatter — docs/PERF.md.)
+            rows = jnp.arange(b)
+            wp = k_cache["write_pos"]  # [B]
             k_cache = {
                 **k_cache,
-                "pool": k_cache["pool"].at[pages, :, slots].set(new_k),
+                "side": k_cache["side"]
+                .at[rows, :, wp]
+                .set(k[:, 0].astype(k_cache["side"].dtype)),
             }
             v_cache = {
                 **v_cache,
-                "pool": v_cache["pool"].at[pages, :, slots].set(new_v),
+                "side": v_cache["side"]
+                .at[rows, :, wp]
+                .set(v[:, 0].astype(v_cache["side"].dtype)),
+            }
+        else:
+            from ..engine.paged_kv import page_slot
+
+            page_size = k_cache["pool"].shape[-2]
+            off_b = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b,))
+            pages, slots = page_slot(table, off_b, page_size)  # [B], [B]
+            k_cache = {
+                **k_cache,
+                "pool": k_cache["pool"]
+                .at[pages, :, slots]
+                .set(k[:, 0].astype(k_cache["pool"].dtype)),
+            }
+            v_cache = {
+                **v_cache,
+                "pool": v_cache["pool"]
+                .at[pages, :, slots]
+                .set(v[:, 0].astype(v_cache["pool"].dtype)),
             }
     elif quant_cache:
         # Quantize the new entry and write codes + per-vector scale.
@@ -347,25 +363,37 @@ def _attention_block(
         s == 1
         and decode_attention is not None
         and paged_cache
-        and "layer" in k_cache
+        and "side" in k_cache
     ):
-        # Stacked paged decode: the pool holds only the CACHED tokens
-        # (this step's write is deferred — see above), so the kernel runs
-        # at lengths=offset and emits unnormalised (acc, m, l); the
-        # current token's self-attention term is merged analytically.
+        # Stacked-hybrid paged decode: the kernel emits unnormalised
+        # (acc, m, l) over the PROMPT pages (static lengths — the pool
+        # never changes during the loop); the generated tokens, including
+        # this step's (written above), attend through the side cache with
+        # XLA's fused path (measured best for batched decode, PERF.md);
+        # the two online-softmax parts merge exactly.
         group = hq // hkv
-        lengths = jnp.broadcast_to(offset, (b,)).astype(jnp.int32)
-        acc, m_c, l_c = decode_attention(q[:, 0], k_cache, v_cache, lengths)
-        qf = q[:, 0].reshape(b, hkv, group, dh).astype(jnp.float32)
-        kn = k[:, 0].astype(jnp.float32)  # [B,Hkv,Dh]
-        vn = v[:, 0].astype(jnp.float32)
-        s_self = jnp.einsum("bkgd,bkd->bkg", qf, kn) * scale
-        m_new = jnp.maximum(m_c, s_self)
-        w_c = jnp.exp(m_c - m_new)  # 0 when the cache is empty (m=-inf)
-        w_s = jnp.exp(s_self - m_new)
-        out = (
-            acc * w_c[..., None] + w_s[..., None] * vn[:, :, None, :]
-        ) / (l_c * w_c + w_s)[..., None]
+        acc1, m1, l1 = decode_attention(
+            q[:, 0], k_cache, v_cache, k_cache["prompt_lens"]
+        )
+        wp = k_cache["write_pos"]
+        qg = q[:, 0].reshape(b, hkv, group, dh).astype(jnp.float32)
+        ks = k_cache["side"].astype(jnp.float32)  # [B,Hkv,Tgen,D]
+        vs = v_cache["side"].astype(jnp.float32)
+        s2 = jnp.einsum("bkgd,bktd->bkgt", qg, ks) * scale
+        tpos = jnp.arange(ks.shape[2])
+        s2 = jnp.where(
+            (tpos[None, :] <= wp[:, None])[:, None, None, :], s2, -jnp.inf
+        )
+        m2 = jnp.max(s2, axis=-1)  # finite: the current token is col wp
+        p2 = jnp.exp(s2 - m2[..., None])
+        l2 = jnp.sum(p2, axis=-1)
+        acc2 = jnp.einsum("bkgt,bktd->bkgd", p2, vs)
+        m_t = jnp.maximum(m1, m2)
+        w1 = jnp.exp(m1 - m_t)  # 0 for empty prompts (m1=-inf)
+        w2 = jnp.exp(m2 - m_t)
+        out = (acc1 * w1[..., None] + acc2 * w2[..., None]) / (
+            l1 * w1 + l2 * w2
+        )[..., None]
         out = out.reshape(b, 1, hq, dh).astype(x.dtype)
     elif s == 1 and decode_attention is not None:
         lengths = jnp.broadcast_to(offset + 1, (b,)).astype(jnp.int32)
@@ -377,8 +405,8 @@ def _attention_block(
         group = hq // hkv
         qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)
         if paged_cache:
-            kf = _gather_paged(k_cache, d=dh)  # raises on stacked leafs
-            vf = _gather_paged(v_cache, d=dh)
+            kf = _gather_paged(k_cache)  # raises on stacked leafs
+            vf = _gather_paged(v_cache)
         else:
             kf = (
                 dequant_cache(k_cache)
@@ -485,45 +513,49 @@ def run_blocks(
             mlp_out = dense_dot(gate * up, layer["w_down"])
         return x + mlp_out, kc, vc
 
-    if is_paged_cache(k_cache) and jnp.ndim(k_cache["table"]) == 2:
-        # STACKED paged mode: the [L,P,Hkv,page,Dp] pools are CLOSED OVER
-        # (scan-invariant — zero per-layer pool traffic); each layer
-        # addresses its slice through the leaf's "layer" index inside the
-        # kernel's DMA offset, defers its write (attention merges the
-        # current token analytically, _attention_block), and emits its
-        # [B,Hkv,Dp] row as scan ys. ONE batched scatter then lands every
-        # layer's row. The alternatives both measured a full-pool copy on
-        # real hardware: pool-as-scan-ys copies once per STEP (~3× slower
-        # than contiguous batched decode), pool-as-carry with an in-scan
-        # traced-layer scatter copies once per LAYER (~52 ms/step) —
-        # docs/PERF.md. The xs/ys mode below survives for paths without a
-        # stacked kernel (multi-device meshes use the gather fallback).
-        from ..engine.paged_kv import page_slot
-
+    if is_paged_cache(k_cache) and "side" in k_cache:
+        # STACKED-HYBRID paged mode: the [L,P,Hkv,page,Dp] pools are
+        # CLOSED OVER (scan-invariant AND read-only during decode — they
+        # hold only prefill pages, rebuilt per batch call); each layer
+        # addresses its pool slice through the "layer" index inside the
+        # kernel's DMA offset, and only the small contiguous side caches
+        # ([L,B,Hkv,Tgen,D], this call's generated tokens) ride scan
+        # xs/ys. The rejected alternatives each measured a full-pool copy
+        # on real hardware: pool-as-scan-ys copies once per STEP (~3×
+        # slower than contiguous batched decode), pool-as-carry with an
+        # in-scan traced-layer scatter copies once per LAYER (~52
+        # ms/step), and even a single deferred batched scatter per step
+        # still staged both pools (~+7.6 ms/step) — docs/PERF.md. The
+        # xs/ys mode below survives for paths without a stacked kernel
+        # (multi-device meshes use the gather fallback).
         table = k_cache["table"]
         kp0, vp0 = k_cache["pool"], v_cache["pool"]
+        wp = k_cache["write_pos"]
+        plens = k_cache["prompt_lens"]
 
-        def block_paged(carry, layer):
+        def block_paged(carry, scanned):
             x, li = carry
-            kc = {"pool": kp0, "table": table, "layer": li}
-            vc = {"pool": vp0, "table": table, "layer": li}
+            layer, ks, vs = scanned
+            kc = {
+                "pool": kp0, "table": table, "layer": li,
+                "side": ks, "write_pos": wp, "prompt_lens": plens,
+            }
+            vc = {
+                "pool": vp0, "table": table, "layer": li,
+                "side": vs, "write_pos": wp, "prompt_lens": plens,
+            }
             x, kc, vc = _layer_step(x, layer, kc, vc)
-            return (x, li + 1), (kc["new_row"], vc["new_row"])
+            return (x, li + 1), (kc["side"], vc["side"])
 
-        (x, _), (k_rows, v_rows) = jax.lax.scan(
-            block_paged, (x, jnp.int32(0)), stacked
+        (x, _), (new_ks, new_vs) = jax.lax.scan(
+            block_paged,
+            (x, jnp.int32(0)),
+            (stacked, k_cache["side"], v_cache["side"]),
         )
-        n_layers, b_rows = k_rows.shape[0], k_rows.shape[1]
-        page_size = kp0.shape[-2]
-        off_b = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b_rows,))
-        pages, slots = page_slot(table, off_b, page_size)
-        li = jnp.arange(n_layers)[:, None]
-        new_kp = kp0.at[li, pages[None, :], :, slots[None, :]].set(k_rows)
-        new_vp = vp0.at[li, pages[None, :], :, slots[None, :]].set(v_rows)
         return (
             x,
-            {"pool": new_kp, "table": table},
-            {"pool": new_vp, "table": table},
+            {**k_cache, "side": new_ks},
+            {**v_cache, "side": new_vs},
         )
 
     def block(x, scanned):

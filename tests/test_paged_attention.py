@@ -322,9 +322,10 @@ def test_write_token_appends_through_the_table():
 
 
 def test_engine_paged_stacked_pool_matches_contiguous():
-    """The STACKED-pool decode path (pool as scan carry + layer-indexed
-    kernel DMA — the fix for the full-pool-copy-per-step that made paged
-    3× slower than contiguous, docs/PERF.md): forcing the kernel on CPU
+    """The STACKED-HYBRID decode path (read-only prompt pool closed over
+    the layer scan + contiguous side caches for generated tokens +
+    parts-kernel/side online-softmax merge — the design that removed the
+    full-pool-copy-per-step, docs/PERF.md): forcing the kernel on CPU
     (interpret) must produce token-identical output to the contiguous
     engine, including the head-dim pad path (tiny d_head=16 → pool padded
     to 128)."""
