@@ -673,7 +673,7 @@ class JaxEngine(GenerationBackend):
             return self._decode_cache[key]
         tf = self._models[model]
         cfg = tf.cfg
-        decode_attention = self._decode_attention_for_cache()
+        decode_attention = self._decode_attention_for_cache(cfg)
         eos = self._tokenizer_for(model).eos_id
 
         @jax.jit
@@ -743,15 +743,24 @@ class JaxEngine(GenerationBackend):
         self._decode_cache[key] = decode
         return decode
 
-    def _decode_attention_for_cache(self) -> Optional[DecodeAttentionFn]:
+    def _decode_attention_for_cache(
+        self, cfg: Optional[ModelConfig] = None
+    ) -> Optional[DecodeAttentionFn]:
         """The decode kernel matching the cache representation: the int8
         variant unpacks the quantized cache's codes+scales (folding the
         scales into the online softmax — the fallback would materialise a
         dequantized cache); without it (CPU tests) the jnp fallback in
-        the model handles both."""
+        the model handles both. Models whose head dim is not a 128-lane
+        multiple (phi3's 96) take the fallback too: the int8 kernel's
+        block shapes require it, and engaging it anyway aborts the trace
+        (a crash the round-4 'auto' policy would otherwise have
+        introduced for exactly the KV-heavy model kv-quantize exists
+        for)."""
         if not self.kv_quantize:
             return self.decode_attention
         if not self._specialised_kernels_enabled():
+            return None
+        if cfg is not None and cfg.d_head % 128:
             return None
 
         from ..ops.pallas_attention import pallas_decode_attention_int8
@@ -1256,7 +1265,7 @@ class JaxEngine(GenerationBackend):
         cfg = tf.cfg
         # the attention matching the cache representation (int8 codes +
         # per-(row, head, position) scales under kv_quantize)
-        decode_attention = self._decode_attention_for_cache()
+        decode_attention = self._decode_attention_for_cache(cfg)
         eos = self._tokenizer_for(model).eos_id
 
         from ..ops.sampling import sample_token_per_row

@@ -128,10 +128,10 @@ class TensorParallelEngine(JaxEngine):
             return None
         return super()._paged_decode_attention()
 
-    def _decode_attention_for_cache(self):
+    def _decode_attention_for_cache(self, cfg=None):
         """The int8 flash-decode Pallas kernel has no GSPMD partitioning
         rule (like the int4 matmul kernel) — under a real multi-device
         mesh the jnp fallback path partitions fine, so use it there."""
         if self.kv_quantize and self.n_devices > 1:
             return None
-        return super()._decode_attention_for_cache()
+        return super()._decode_attention_for_cache(cfg)

@@ -213,3 +213,26 @@ def test_installed_models_never_evicted(monkeypatch):
         np.asarray(eng._models["trained"].params["embed"]),
         np.asarray(trained["embed"]),
     )
+
+
+def test_int8_kernel_selection_respects_head_dim(monkeypatch):
+    """The int8 flash-decode kernel requires a 128-multiple head dim;
+    phi3 (d_head=96) must take the jnp fallback even where specialised
+    kernels are enabled — engaging the kernel aborts the trace on real
+    hardware (found by a round-4 chip A/B after the 'auto' policy change
+    widened kernel engagement)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+
+    engine = JaxEngine(kv_quantize="int8")
+    monkeypatch.setattr(
+        JaxEngine, "_specialised_kernels_enabled", lambda self: True
+    )
+    phi3 = get_model_config("phi3:3.8b")  # d_head 96
+    qwen = get_model_config("qwen2:1.5b")  # d_head 128
+    assert engine._decode_attention_for_cache(phi3) is None
+    assert engine._decode_attention_for_cache(qwen) is not None
