@@ -342,7 +342,10 @@ def test_engine_paged_stacked_pool_matches_contiguous():
         pallas_decode_attention,
     )
 
-    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    registry = {
+        "tiny": get_model_config("qwen2:1.5b").tiny(),  # GQA
+        "tiny-mha": get_model_config("phi3:3.8b").tiny(),  # MHA (d pads)
+    }
     contiguous = JaxEngine(registry=dict(registry), dtype=jnp.float32)
     stacked = JaxEngine(
         registry=dict(registry),
@@ -369,6 +372,17 @@ def test_engine_paged_stacked_pool_matches_contiguous():
     for g, w in zip(got, want):
         assert g.tokens == w.tokens
         assert g.text == w.text
+    # MHA coverage (a real-chip phi3 smoke showed bf16 near-tie argmax
+    # divergence between impls; this pins the f32 math is exact for the
+    # MHA + padded-head-dim combination too)
+    mha_reqs = [
+        GenerationRequest("tiny-mha", "row one", max_new_tokens=8),
+        GenerationRequest("tiny-mha", "row two is longer", max_new_tokens=14),
+    ]
+    want = contiguous.generate_batch(mha_reqs)
+    got = stacked.generate_batch(mha_reqs)
+    for g, w in zip(got, want):
+        assert g.tokens == w.tokens
 
 
 def test_paged_parts_kernel_matches_per_layer_kernel():
