@@ -438,3 +438,33 @@ def test_paged_parts_kernel_rejects_unpadded_head_dim():
         pallas_paged_decode_attention_parts(
             q, pool, pool, table, lengths, layer=jnp.int32(0), interpret=True
         )
+
+
+def test_paged_kernel_gating_follows_auto_policy():
+    """"auto" engages the paged kernel only on TPU backends (its gather
+    fallback is the right CPU/test path); an explicitly injected kernel
+    opts in anywhere. Pinned because the whole stacked-hybrid path hangs
+    off this gate."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_attention import (
+        pallas_decode_attention,
+    )
+
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    auto_cpu = JaxEngine(registry=dict(registry), paged_kv=True)
+    assert auto_cpu._paged_decode_attention() is None  # CPU: fallback
+    explicit = JaxEngine(
+        registry=dict(registry),
+        paged_kv=True,
+        decode_attention=pallas_decode_attention,
+    )
+    assert explicit._paged_decode_attention() is not None
+    none_ = JaxEngine(
+        registry=dict(registry), paged_kv=True, decode_attention=None
+    )
+    assert none_._paged_decode_attention() is None  # explicit XLA-fused
