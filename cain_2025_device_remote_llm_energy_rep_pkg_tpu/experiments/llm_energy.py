@@ -195,6 +195,22 @@ def generation_stats_from(
                 kv_quantize=kv_quantize,
             )
             if t1 > 0 and tn > 0 and duration > 0:
+                if tn >= t1:
+                    # Physically honest — per-layer psums sit on the ICI
+                    # latency floor, so toy/tiny models DO decode slower
+                    # on a mesh — but a study billing mesh windows slower
+                    # than one chip is almost certainly misconfigured
+                    # (e.g. tiny test models with the real 8-chip
+                    # topology; see examples/llm_energy_smoke.py).
+                    from ..runner import term
+
+                    term.log_warn(
+                        f"TP-{n_chips} roofline predicts a SLOWDOWN "
+                        f"({t1 / tn:.2f}× speedup) for this workload - "
+                        f"the mesh window is being billed honestly, but "
+                        f"check the topology fits the model scale "
+                        f"(n_chips_by_location)"
+                    )
                 modeled = duration * (tn / t1)
                 stats["modeled_decode_s"] = round(modeled, 4)
                 stats["duration_s"] = modeled

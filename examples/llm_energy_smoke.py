@@ -30,4 +30,11 @@ class RunnerConfig(LlmEnergyConfig):
             cooldown_ms=500,
             results_output_path=Path("experiments_output"),
             backends={"on_device": _ENGINE, "remote": _ENGINE},
+            # Tiny models make the 8-chip mesh model meaningless — the
+            # TP roofline (correctly) says a toy model's decode step sits
+            # on the ICI latency floor and the mesh would be ~70× SLOWER,
+            # so aliased remote rows would be billed absurd mesh windows.
+            # The smoke serves both treatments from one chip; the real
+            # topology belongs to the full study (llm_energy_study.py).
+            n_chips_by_location={"on_device": 1, "remote": 1},
         )
