@@ -1508,18 +1508,20 @@ class JaxEngine(GenerationBackend):
         )
 
         def decode_attention(q, kc, vc, lengths):
-            if "layer" in kc:  # stacked mode: unnormalised parts for the
-                # caller's merge (transformer.py). A gather+fused-XLA
-                # parts variant was measured SLOWER than this kernel even
-                # at jmax=1 (2.4-2.6k vs 2.8k aggregate, docs/PERF.md) —
-                # the kernel is the parts path.
+            if "side" in kc:  # stacked-hybrid mode: unnormalised parts
+                # for the caller's merge (transformer.py). A
+                # gather+fused-XLA parts variant was measured SLOWER than
+                # this kernel even at jmax=1 (2.4-2.6k vs 2.8k aggregate,
+                # docs/PERF.md) — the kernel is the parts path. The pool
+                # is a per-layer xs slice unless a "layer" index says it
+                # is the whole stacked pool.
                 return pallas_paged_decode_attention_parts(
                     q,
                     kc["pool"],
                     vc["pool"],
                     kc["table"],
                     lengths,
-                    layer=kc["layer"],
+                    layer=kc.get("layer"),
                 )
             return pallas_paged_decode_attention(
                 q, kc["pool"], vc["pool"], kc["table"], lengths

@@ -62,11 +62,14 @@ def is_paged_cache(leaf: Any) -> bool:
     """A paged KV-cache leaf: ``{"pool": [P,Hkv,page,D], "table":
     [B,Jmax]}`` (engine/paged_kv.py) — pages of a shared pool addressed
     through a per-request block table. The STACKED-HYBRID variant (the
-    fast batched-decode path) additionally carries: the whole
-    [L,P,Hkv,page,Dp] pool (READ-ONLY during decode — prefill pages
-    only), a contiguous ``side`` cache [B,Hkv,Tgen,D] per layer holding
-    the tokens generated this call, ``write_pos``/``prompt_lens`` [B]
-    row vectors, and (inside the layer scan) a ``layer`` index."""
+    fast batched-decode path) additionally carries: the pool (READ-ONLY
+    during decode — prefill pages only; [L,P,Hkv,page,Dp] at the engine
+    boundary, a per-layer [P,Hkv,page,Dp] xs slice inside the layer
+    scan), a contiguous ``side`` cache [B,Hkv,Tgen,D] per layer holding
+    the tokens generated this call, and ``write_pos``/``prompt_lens``
+    [B] row vectors. An optional ``layer`` index marks a whole stacked
+    pool addressed inside the kernel's DMA offset (the non-default
+    variant, kept parity-tested)."""
     if not isinstance(leaf, dict):
         return False
     keys = set(leaf)
@@ -515,42 +518,51 @@ def run_blocks(
 
     if is_paged_cache(k_cache) and "side" in k_cache:
         # STACKED-HYBRID paged mode: the [L,P,Hkv,page,Dp] pools are
-        # CLOSED OVER (scan-invariant AND read-only during decode — they
-        # hold only prefill pages, rebuilt per batch call); each layer
-        # addresses its pool slice through the "layer" index inside the
-        # kernel's DMA offset, and only the small contiguous side caches
-        # ([L,B,Hkv,Tgen,D], this call's generated tokens) ride scan
-        # xs/ys. The rejected alternatives each measured a full-pool copy
-        # on real hardware: pool-as-scan-ys copies once per STEP (~3×
-        # slower than contiguous batched decode), pool-as-carry with an
-        # in-scan traced-layer scatter copies once per LAYER (~52
-        # ms/step), and even a single deferred batched scatter per step
-        # still staged both pools (~+7.6 ms/step) — docs/PERF.md. The
-        # xs/ys mode below survives for paths without a stacked kernel
-        # (multi-device meshes use the gather fallback).
+        # READ-ONLY during decode (they hold only prefill pages, rebuilt
+        # per batch call) and stream through scan xs WITHOUT ys — XLA
+        # pipelines the per-layer slices like the weights, with no
+        # copy-back and no dynamic layer indexing. Only the small
+        # contiguous side caches ([L,B,Hkv,Tgen,D], this call's
+        # generated tokens) ride xs AND ys. The rejected write designs
+        # each measured a full-pool copy on real hardware: pool-as-ys
+        # copies once per STEP (~3× slower than contiguous batched
+        # decode), pool-as-carry with an in-scan traced-layer scatter
+        # copies once per LAYER (~52 ms/step), a single deferred batched
+        # scatter per step still staged both pools (~+7.6 ms/step) —
+        # docs/PERF.md. The legacy xs/ys mode below survives for paths
+        # without the parts kernel (multi-device meshes use the gather
+        # fallback).
         table = k_cache["table"]
-        kp0, vp0 = k_cache["pool"], v_cache["pool"]
         wp = k_cache["write_pos"]
         plens = k_cache["prompt_lens"]
 
         def block_paged(carry, scanned):
-            x, li = carry
-            layer, ks, vs = scanned
+            x = carry
+            layer, kp_l, vp_l, ks, vs = scanned
             kc = {
-                "pool": kp0, "table": table, "layer": li,
+                "pool": kp_l, "table": table,
                 "side": ks, "write_pos": wp, "prompt_lens": plens,
             }
             vc = {
-                "pool": vp0, "table": table, "layer": li,
+                "pool": vp_l, "table": table,
                 "side": vs, "write_pos": wp, "prompt_lens": plens,
             }
             x, kc, vc = _layer_step(x, layer, kc, vc)
-            return (x, li + 1), (kc["side"], vc["side"])
+            return x, (kc["side"], vc["side"])
 
-        (x, _), (new_ks, new_vs) = jax.lax.scan(
+        # pools ride scan xs WITHOUT ys: read-only per-layer slices that
+        # XLA streams/pipelines like the weights — no copy-back, and no
+        # traced-layer dynamic indexing to defeat the scan's schedule
+        x, (new_ks, new_vs) = jax.lax.scan(
             block_paged,
-            (x, jnp.int32(0)),
-            (stacked, k_cache["side"], v_cache["side"]),
+            x,
+            (
+                stacked,
+                k_cache["pool"],
+                v_cache["pool"],
+                k_cache["side"],
+                v_cache["side"],
+            ),
         )
         return (
             x,

@@ -28,12 +28,14 @@ Two entry points share the accumulation body (``_accumulate_page``):
 - :func:`pallas_paged_decode_attention` — per-layer pools, normalised
   output (the batched-decode legacy path and the TP gather-fallback's
   kernel counterpart).
-- :func:`pallas_paged_decode_attention_parts` — STACKED pools
-  ([L, P, Hkv, page, Dp], layer folded into the DMA offset) emitting the
-  UNNORMALISED (acc, m, l) triplet over the cached tokens, for the
-  deferred-write decode loop's analytic self-term merge
-  (models/transformer.py; the measured rationale is in docs/PERF.md
-  "paged batched decode").
+- :func:`pallas_paged_decode_attention_parts` — emits the UNNORMALISED
+  (acc, m, l) triplet over the cached tokens for the stacked-hybrid
+  decode loop's side-cache merge (models/transformer.py; measured
+  rationale in docs/PERF.md "paged batched decode"). Default/shipped
+  mode takes per-layer [P, Hkv, page, Dp] pools (the decode scan
+  streams the read-only pool as xs); passing ``layer`` instead takes
+  the whole [L, P, Hkv, page, Dp] stacked pool with the layer folded
+  into the DMA offset.
 
 Parity is pinned against a gather-then-attend reference on scattered page
 permutations (tests/test_paged_attention.py).
@@ -263,27 +265,34 @@ def pallas_paged_decode_attention(
 
 def pallas_paged_decode_attention_parts(
     q: jnp.ndarray,  # [B, Hq, D]
-    k_pool: jnp.ndarray,  # [L, P, Hkv, page, Dp] — STACKED pools only
+    k_pool: jnp.ndarray,  # [P, Hkv, page, Dp] — or [L, P, ...] with layer
     v_pool: jnp.ndarray,
     page_table: jnp.ndarray,  # [B, Jmax] int32
     lengths: jnp.ndarray,  # [B] int32 — CACHED tokens (current excluded)
     *,
-    layer: jnp.ndarray,  # scalar int32
+    layer: Optional[jnp.ndarray] = None,  # scalar int32: stacked pools
     interpret: Optional[bool] = None,
 ) -> "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]":
-    """Unnormalised flash-decode parts over the cached tokens of a
-    stacked pool: returns ``(acc [B,Hkv,G,D] f32, m [B,Hkv,G] f32,
-    l [B,Hkv,G] f32)`` for the caller's self-term merge.
+    """Unnormalised flash-decode parts over the cached tokens of a pool:
+    returns ``(acc [B,Hkv,G,D] f32, m [B,Hkv,G] f32, l [B,Hkv,G] f32)``
+    for the caller's self/side-term merge.
 
-    Stacked pools must be pre-padded to a 128-multiple head dim (the
-    engine allocates them that way); per-call padding of a GB-scale pool
-    would reintroduce the copy this path exists to avoid.
+    Without ``layer`` the pool is a per-layer slice [P,Hkv,page,Dp] (the
+    decode scan streams the read-only pool as xs, letting XLA pipeline
+    it with the weight stream); with ``layer`` the whole stacked pool is
+    passed and the index map folds the layer into the DMA offset. Pools
+    must be pre-padded to a 128-multiple head dim either way (the engine
+    allocates them so); per-call padding would copy the pool.
     """
     b, hq, d = q.shape
-    _, n_pool, hkv, page, dp = k_pool.shape
+    stacked = layer is not None
+    if stacked:
+        _, n_pool, hkv, page, dp = k_pool.shape
+    else:
+        n_pool, hkv, page, dp = k_pool.shape
     if dp % 128:
         raise ValueError(
-            f"stacked pools must be pre-padded to a 128-multiple head "
+            f"pools must be pre-padded to a 128-multiple head "
             f"dim, got {dp} (per-call padding would copy the pool)"
         )
     d_pad = dp - d
@@ -298,34 +307,60 @@ def pallas_paged_decode_attention_parts(
         qr = jnp.pad(qr, ((0, 0), (0, 0), (0, 0), (0, d_pad)))
     table = jnp.clip(page_table.astype(jnp.int32), 0, n_pool - 1)
 
-    kernel = functools.partial(
+    base_kernel = functools.partial(
         _paged_decode_parts_kernel,
         page=page,
         n_pages_per_req=jmax,
         scale=scale,
     )
 
-    def q_index(b_i, h, j, tab, lens, lay):
-        return (b_i, h, 0, 0)
-
-    def kv_index(b_i, h, j, tab, lens, lay):
-        return (
-            lay[0],
-            tab[b_i, _last_valid_page(j, b_i, lens, page)],
-            h,
-            0,
-            0,
+    if stacked:
+        kernel = base_kernel
+        num_prefetch = 3
+        prefetch_args = (
+            table,
+            lengths.astype(jnp.int32),
+            jnp.reshape(layer, (1,)).astype(jnp.int32),
         )
+
+        def q_index(b_i, h, j, tab, lens, lay):
+            return (b_i, h, 0, 0)
+
+        def kv_index(b_i, h, j, tab, lens, lay):
+            return (
+                lay[0],
+                tab[b_i, _last_valid_page(j, b_i, lens, page)],
+                h,
+                0,
+                0,
+            )
+
+        kv_block = (1, 1, 1, page, dp)
+    else:
+        # per-layer pools: same kernel body, no layer ref
+        def kernel(table_ref, lengths_ref, *rest):
+            return base_kernel(table_ref, lengths_ref, None, *rest)
+
+        num_prefetch = 2
+        prefetch_args = (table, lengths.astype(jnp.int32))
+
+        def q_index(b_i, h, j, tab, lens):
+            return (b_i, h, 0, 0)
+
+        def kv_index(b_i, h, j, tab, lens):
+            return (tab[b_i, _last_valid_page(j, b_i, lens, page)], h, 0, 0)
+
+        kv_block = (1, 1, page, dp)
 
     acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=num_prefetch,
             grid=(b, hkv, jmax),
             in_specs=[
                 pl.BlockSpec((1, 1, group, dp), q_index),
-                pl.BlockSpec((1, 1, 1, page, dp), kv_index),
-                pl.BlockSpec((1, 1, 1, page, dp), kv_index),
+                pl.BlockSpec(kv_block, kv_index),
+                pl.BlockSpec(kv_block, kv_index),
             ],
             out_specs=[
                 pl.BlockSpec((1, 1, group, dp), q_index),
@@ -344,14 +379,7 @@ def pallas_paged_decode_attention_parts(
             jax.ShapeDtypeStruct((b, hkv, group, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(
-        table,
-        lengths.astype(jnp.int32),
-        jnp.reshape(layer, (1,)).astype(jnp.int32),
-        qr,
-        k_pool,
-        v_pool,
-    )
+    )(*prefetch_args, qr, k_pool, v_pool)
     if d_pad:
         acc = acc[..., :d]
     return acc, m[..., 0], l[..., 0]

@@ -415,6 +415,12 @@ def test_paged_parts_kernel_matches_per_layer_kernel():
         )
         got = (acc / l[..., None]).reshape(B, HQ, D)
         assert jnp.allclose(got, want, atol=1e-5), layer
+        # the per-layer (xs-streamed) mode must agree too
+        acc2, m2, l2 = pallas_paged_decode_attention_parts(
+            q, k_pool[layer], v_pool[layer], table, lengths, interpret=True
+        )
+        got2 = (acc2 / l2[..., None]).reshape(B, HQ, D)
+        assert jnp.allclose(got2, want, atol=1e-5), layer
     # zero-length rows exit with the sentinel triplet the self-term
     # merge relies on: (0, -inf, 0)
     acc, m, l = pallas_paged_decode_attention_parts(
