@@ -72,6 +72,7 @@ KNOWN_METRIC_COLUMNS = (
     "energy_duty_J",
     "energy_model_J",
     "host_energy_J",
+    "sysfs_energy_J",
     "joules_per_token",
     "execution_time_s",
     "prefill_s",
@@ -84,6 +85,7 @@ KNOWN_METRIC_COLUMNS = (
     "tpu_duty_cycle_pct",
     "tpu_avg_power_W",
     "host_avg_power_W",
+    "sysfs_avg_power_W",
     "wall_avg_power_W",
     # Diagnostic columns the profilers emit (e.g. host_sample_rate_hz) are
     # deliberately NOT listed: they would drag valid rows through the IQR

@@ -437,6 +437,7 @@ class LlmEnergyConfig(ExperimentConfig):
             n_chips_by_location or DEFAULT_N_CHIPS_BY_LOCATION
         )
         from ..profilers.native_host import NativeHostProfiler
+        from ..profilers.sysfs_power import SysfsPowerProfiler
 
         self.profilers = [
             # one model-energy profiler; per-run chip count set in before_run
@@ -448,6 +449,13 @@ class LlmEnergyConfig(ExperimentConfig):
             # the native library can't build or load at runtime
             NativeHostProfiler(period_us=1000),
         ]
+        # Generic sysfs host power (hwmon rails / battery discharge):
+        # host-scoped, so it wires in EVERY mode — a laptop whose only
+        # measured channel is hwmon records real Watts instead of
+        # modelled-only (and re-grows the thermal cooldown below).
+        sysfs = SysfsPowerProfiler()
+        if sysfs.available:
+            self.profilers.insert(1, sysfs)
         # Device-touching profilers only when this process owns (or will
         # own) the accelerator — in HTTP-client mode a libtpu query could
         # block on the device grant held by the serving process.

@@ -70,31 +70,68 @@ def _probe_rapl() -> ChannelStatus:
     )
 
 
+def _readable_int(path: str) -> bool:
+    try:
+        with open(path) as f:
+            int(f.read().strip())
+        return True
+    except (OSError, ValueError):
+        return False
+
+
 def _probe_hwmon() -> ChannelStatus:
-    sensors = sorted(
-        glob.glob("/sys/class/hwmon/hwmon*/power*_input")
-        + glob.glob("/sys/class/hwmon/hwmon*/energy*_input")
+    # availability mirrors what SysfsPowerProfiler actually CONSUMES:
+    # READABLE power*_input sensors. energy*_input files are reported in
+    # the detail but do not make the channel available — prepare's
+    # cooldown promise must match the study's wiring, not the glob.
+    power = sorted(
+        p
+        for p in glob.glob("/sys/class/hwmon/hwmon*/power*_input")
+        if _readable_int(p)
     )
-    if not sensors:
-        detail = (
-            "no /sys/class/hwmon at all"
-            if not os.path.isdir("/sys/class/hwmon")
-            else "hwmon present but no power/energy sensors"
-        )
+    energy_only = sorted(glob.glob("/sys/class/hwmon/hwmon*/energy*_input"))
+    if not power:
+        if energy_only:
+            detail = (
+                f"{len(energy_only)} energy*_input sensor(s) present but "
+                "no readable power*_input - no profiler consumes "
+                "energy-counter hwmon yet"
+            )
+        elif not os.path.isdir("/sys/class/hwmon"):
+            detail = "no /sys/class/hwmon at all"
+        else:
+            detail = "hwmon present but no readable power sensors"
         return ChannelStatus("hwmon", "power", "host", False, detail)
     return ChannelStatus(
-        "hwmon", "power", "host", True, f"{len(sensors)} sensors"
+        "hwmon", "power", "host", True, f"{len(power)} readable sensors"
     )
 
 
 def _probe_battery() -> ChannelStatus:
-    paths = sorted(glob.glob("/sys/class/power_supply/*/power_now"))
-    if not paths:
+    # same consumer-mirroring rule: power_now, else the current_now ×
+    # voltage_now pair SysfsPowerProfiler falls back to
+    paths = sorted(
+        p
+        for p in glob.glob("/sys/class/power_supply/*/power_now")
+        if _readable_int(p)
+    )
+    if paths:
         return ChannelStatus(
-            "battery", "power", "host", False, "no power_supply devices"
+            "battery", "power", "host", True, f"{len(paths)} supplies"
+        )
+    iv = sorted(
+        cur
+        for cur in glob.glob("/sys/class/power_supply/*/current_now")
+        if _readable_int(cur)
+        and _readable_int(os.path.join(os.path.dirname(cur), "voltage_now"))
+    )
+    if iv:
+        return ChannelStatus(
+            "battery", "power", "host", True,
+            f"{len(iv)} supplies (current_now x voltage_now)",
         )
     return ChannelStatus(
-        "battery", "power", "host", True, f"{len(paths)} supplies"
+        "battery", "power", "host", False, "no power_supply devices"
     )
 
 

@@ -1,0 +1,120 @@
+"""Host power via generic Linux sysfs sensors: hwmon and battery.
+
+The channel probe (``energy_probe.py``) has always AUDITED these two
+sources; this profiler makes them CONSUMED, so a laptop/VM whose only
+measured channel is a hwmon power rail or the battery's discharge rate
+records real Watts instead of falling back to the modelled column
+(VERDICT round-4 follow-through on the ``prepare`` policy line: a live
+channel must change the study, not just the audit).
+
+Two source families, probed in priority order:
+
+- **hwmon** (``/sys/class/hwmon/hwmon*/power*_input``, microwatts):
+  board/CPU power rails. All readable sensors are summed — a multi-rail
+  board reports total measured draw.
+- **battery** (``/sys/class/power_supply/*/power_now``, microwatts,
+  falling back to ``current_now``·``voltage_now``): the discharge rate.
+  Only meaningful on battery power (status "Discharging"); on AC the
+  reading is charger flow, not load, so the profiler reports it but the
+  audit detail says which.
+
+The reference's CodeCarbon meter does the same class of fallback chain
+internally (RAPL → psutil estimates); here each hop is a separate,
+auditable profiler. Columns reuse the host-power names the RAPL/native
+profilers emit (``wall_energy_J``-style naming is reserved for the
+serial meter): ``sysfs_energy_J`` / ``sysfs_avg_power_W`` so a host with
+BOTH RAPL and hwmon keeps the two measurements distinguishable.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, List, Optional
+
+from .base import SamplingProfiler, integrate_power_to_joules
+
+HWMON_GLOB = "/sys/class/hwmon/hwmon*/power*_input"
+BATTERY_GLOB = "/sys/class/power_supply/*/power_now"
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+class SysfsPowerProfiler(SamplingProfiler):
+    """Samples summed hwmon power rails, else battery discharge power."""
+
+    data_columns = ("sysfs_energy_J", "sysfs_avg_power_W")
+    artifact_name = "sysfs_power"
+    measured_channel = True
+
+    def __init__(
+        self,
+        period_s: float = 0.1,
+        hwmon_glob: Optional[str] = None,
+        battery_glob: Optional[str] = None,
+    ) -> None:
+        super().__init__(period_s=period_s)
+        # late-bound module constants so tests (and operators) can point
+        # the default construction at a fake/alternate sysfs tree
+        hwmon_glob = HWMON_GLOB if hwmon_glob is None else hwmon_glob
+        battery_glob = BATTERY_GLOB if battery_glob is None else battery_glob
+        self._hwmon = sorted(
+            p for p in glob.glob(hwmon_glob) if _read_int(p) is not None
+        )
+        self._battery = sorted(
+            p for p in glob.glob(battery_glob) if _read_int(p) is not None
+        )
+        # battery current*voltage fallback for kernels without power_now
+        self._battery_iv = []
+        if not self._battery:
+            for cur in sorted(
+                glob.glob(os.path.dirname(battery_glob) + "/current_now")
+            ):
+                volt = os.path.join(os.path.dirname(cur), "voltage_now")
+                if _read_int(cur) is not None and _read_int(volt) is not None:
+                    self._battery_iv.append((cur, volt))
+
+    @property
+    def available(self) -> bool:
+        return bool(self._hwmon or self._battery or self._battery_iv)
+
+    @staticmethod
+    def _sum_microwatts(paths) -> Optional[float]:
+        vals = [_read_int(p) for p in paths]
+        vals = [v for v in vals if v is not None]
+        return sum(vals) / 1e6 if vals else None
+
+    def _power_w(self) -> Optional[float]:
+        if self._hwmon:
+            return self._sum_microwatts(self._hwmon)
+        if self._battery:
+            return self._sum_microwatts(self._battery)
+        if self._battery_iv:
+            total = 0.0
+            seen = False
+            for cur, volt in self._battery_iv:
+                i, v = _read_int(cur), _read_int(volt)
+                if i is not None and v is not None:
+                    total += (i / 1e6) * (v / 1e6)
+                    seen = True
+            return total if seen else None
+        return None
+
+    def sample(self) -> Dict[str, Any]:
+        return {"power_W": self._power_w()}
+
+    def summarise(self, samples: List[Dict[str, Any]]) -> Dict[str, Any]:
+        joules = integrate_power_to_joules(samples, "power_W")
+        if joules == 0.0 and not any(s.get("power_W") for s in samples):
+            return {"sysfs_energy_J": None, "sysfs_avg_power_W": None}
+        span = samples[-1]["t_s"] - samples[0]["t_s"] if len(samples) > 1 else 0.0
+        return {
+            "sysfs_energy_J": round(joules, 4),
+            "sysfs_avg_power_W": round(joules / span, 3) if span > 0 else None,
+        }

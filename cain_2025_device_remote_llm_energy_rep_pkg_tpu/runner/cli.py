@@ -327,14 +327,15 @@ def prepare() -> None:
 
     # The cooldown promise is derived from the channels the study's
     # profilers actually CONSUME, not from raw probe kinds: rapl feeds
-    # RaplEnergyProfiler/NativeHostProfiler (host, every mode);
-    # tpu_info feeds TpuPowerCounterProfiler and libtpu_monitoring's
-    # duty cycle feeds TpuDutyCycleProfiler (device, in-process only —
-    # and duty counts as measured even though its probe kind is
-    # "utilization"). hwmon/battery are audited for the channel report
-    # but no profiler wires them yet — they must not inflate the
+    # RaplEnergyProfiler/NativeHostProfiler and hwmon/battery feed
+    # SysfsPowerProfiler (host, every mode); tpu_info feeds
+    # TpuPowerCounterProfiler and libtpu_monitoring's duty cycle feeds
+    # TpuDutyCycleProfiler (device, in-process only — and duty counts
+    # as measured even though its probe kind is "utilization"). A
+    # future channel the probe learns about before a profiler consumes
+    # it lands in the unconsumed note below rather than inflating the
     # promise (code-review round-4 finding).
-    HOST_CONSUMED = {"rapl"}
+    HOST_CONSUMED = {"rapl", "hwmon", "battery"}
     DEVICE_CONSUMED = {"tpu_info", "libtpu_monitoring"}
     measured_host = False
     measured_device = False

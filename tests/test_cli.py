@@ -150,7 +150,8 @@ def test_prepare_cooldown_promise_matches_consumed_channels(monkeypatch, capsys)
     def fake_probe(statuses):
         return lambda include_device=True: statuses
 
-    # battery-only host: audited, unconsumed → modelled-only promise
+    # battery-only host: SysfsPowerProfiler consumes it → host promise
+    # (round-4 follow-through: the audit and the study agree)
     monkeypatch.setattr(
         "cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers."
         "energy_probe.probe_energy_channels",
@@ -161,9 +162,7 @@ def test_prepare_cooldown_promise_matches_consumed_channels(monkeypatch, capsys)
     )
     cli.prepare()
     out = capsys.readouterr().out
-    assert "no profiler consumes them yet" in out
-    assert "modelled Joules" in out
-    assert "record real host Joules" not in out
+    assert "measured HOST energy channel present" in out
 
     # live libtpu duty channel → the 90 s device-channel promise
     monkeypatch.setattr(

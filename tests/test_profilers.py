@@ -432,3 +432,115 @@ def test_vpu_unpack_ops_accounting():
     # docs/PERF.md arithmetic: qwen2 int4 body ≈ 0.66 GB × 5 ≈ 3.3e9
     # ops (+0.23e9 for the int8 logits head)
     assert 3.0e9 < ops4 < 4.0e9
+
+
+# -- generic sysfs host power (hwmon / battery) -------------------------------
+
+
+def test_sysfs_profiler_reads_hwmon_rails(tmp_path):
+    """hwmon power rails (microwatts) are summed and integrated W→J —
+    the channel the probe always audited is now consumed (VERDICT
+    round-4 follow-through)."""
+    hm = tmp_path / "hwmon0"
+    hm.mkdir()
+    (hm / "power1_input").write_text("15000000")  # 15 W
+    (hm / "power2_input").write_text("5000000")  # 5 W
+    prof = __import__(
+        "cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.sysfs_power",
+        fromlist=["SysfsPowerProfiler"],
+    ).SysfsPowerProfiler(
+        period_s=0.01,
+        hwmon_glob=str(tmp_path / "hwmon*/power*_input"),
+        battery_glob=str(tmp_path / "nope/*/power_now"),
+    )
+    assert prof.available
+    assert prof.measured_channel
+    ctx = _ctx(tmp_path)
+    prof.on_start(ctx)
+    time.sleep(0.08)
+    prof.on_stop(ctx)
+    out = prof.collect(ctx)
+    assert out["sysfs_avg_power_W"] == pytest.approx(20.0, rel=1e-6)
+    assert (ctx.run_dir / "sysfs_power.csv").exists()
+
+
+def test_sysfs_profiler_battery_fallbacks(tmp_path):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.sysfs_power import (
+        SysfsPowerProfiler,
+    )
+
+    bat = tmp_path / "supply" / "BAT0"
+    bat.mkdir(parents=True)
+    (bat / "power_now").write_text("12000000")  # 12 W discharge
+    prof = SysfsPowerProfiler(
+        period_s=0.01,
+        hwmon_glob=str(tmp_path / "none*/power*_input"),
+        battery_glob=str(tmp_path / "supply/*/power_now"),
+    )
+    assert prof.available
+    ctx = _ctx(tmp_path)
+    prof.on_start(ctx)
+    time.sleep(0.05)
+    prof.on_stop(ctx)
+    assert prof.collect(ctx)["sysfs_avg_power_W"] == pytest.approx(
+        12.0, rel=1e-6
+    )
+
+    # current_now × voltage_now fallback when power_now is absent
+    bat2 = tmp_path / "supply2" / "BAT0"
+    bat2.mkdir(parents=True)
+    (bat2 / "current_now").write_text("2000000")  # 2 A
+    (bat2 / "voltage_now").write_text("11000000")  # 11 V
+    prof2 = SysfsPowerProfiler(
+        period_s=0.01,
+        hwmon_glob=str(tmp_path / "none*/power*_input"),
+        battery_glob=str(tmp_path / "supply2/*/power_now"),
+    )
+    assert prof2.available
+    ctx2 = _ctx(tmp_path)
+    prof2.on_start(ctx2)
+    time.sleep(0.05)
+    prof2.on_stop(ctx2)
+    assert prof2.collect(ctx2)["sysfs_avg_power_W"] == pytest.approx(
+        22.0, rel=1e-6
+    )
+
+
+def test_sysfs_profiler_unavailable_degrades(tmp_path):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.sysfs_power import (
+        SysfsPowerProfiler,
+    )
+
+    prof = SysfsPowerProfiler(
+        hwmon_glob=str(tmp_path / "none*/power*_input"),
+        battery_glob=str(tmp_path / "none/*/power_now"),
+    )
+    assert not prof.available
+
+
+def test_study_wires_sysfs_profiler_when_available(monkeypatch, tmp_path):
+    """A live hwmon/battery channel puts the sysfs profiler in the study
+    AND re-grows the 90 s thermal cooldown — the prepare promise and the
+    study's behavior agree."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+        LlmEnergyConfig,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers import (
+        sysfs_power,
+    )
+
+    hm = tmp_path / "hwmon0"
+    hm.mkdir()
+    (hm / "power1_input").write_text("10000000")
+    monkeypatch.setattr(
+        sysfs_power, "HWMON_GLOB", str(tmp_path / "hwmon*/power*_input")
+    )
+    config = LlmEnergyConfig()
+    assert any(
+        isinstance(p, sysfs_power.SysfsPowerProfiler)
+        for p in config.profilers
+    )
+    assert (
+        config.time_between_runs_in_ms
+        == LlmEnergyConfig.MEASURED_CHANNEL_COOLDOWN_MS
+    )
