@@ -106,6 +106,32 @@ def main() -> int:
                     batch_tokens_per_s, batch_tokens / batch_decode_s
                 )
 
+    # The study's energy model applied to this very run (max of MXU/HBM/
+    # VPU duty × the v5e envelope, docs/PERF.md + profilers/tpu.py): the
+    # bench line carries the modelled J/token and utilisation so the
+    # recorded perf artifact and the energy story stay joined.
+    energy_extra = {}
+    try:
+        import types as _types
+
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (
+            generation_stats_from,
+        )
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.profilers.tpu import (
+            TpuEnergyModelProfiler,
+        )
+
+        stats = generation_stats_from(cfg, result, quantize=quantize)
+        ctx = _types.SimpleNamespace(scratch={"generation_stats": stats})
+        cols = TpuEnergyModelProfiler().collect(ctx)
+        if cols["joules_per_token"] is not None:
+            energy_extra = {
+                "joules_per_token_model": cols["joules_per_token"],
+                "tpu_util_est": cols["tpu_util_est"],
+            }
+    except Exception:  # the perf line must never die on the energy extra
+        pass
+
     line = {
         "metric": "decode_tokens_per_s",
         "value": round(tokens_per_s, 2),
@@ -120,6 +146,7 @@ def main() -> int:
         "prefill_s": round(result.prefill_s, 4),
         "warmup_compile_s": round(warm_s, 1),
         "baseline_tokens_per_s": round(BASELINE_TOKENS_PER_S, 2),
+        **energy_extra,
     }
     if batch_tokens_per_s is not None:
         line.update(
