@@ -758,3 +758,36 @@ def test_lru_recency_updated_on_use(monkeypatch):
     eng.load_model("c")  # must evict b, not a
     assert "a" in eng._models and "c" in eng._models
     assert "b" not in eng._models
+
+
+def test_auto_policy_engages_specialised_kernels_on_tpu(monkeypatch):
+    """The "auto" attention policy's TPU side (unreachable on the CPU
+    suite without a mock): specialised kernels engage for the int8-KV
+    and paged cache representations while the plain path stays on XLA's
+    fused attention (decode_attention None) — the measured round-4
+    policy, docs/PERF.md."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+
+    monkeypatch.setattr(
+        JaxEngine, "_on_tpu_backend", staticmethod(lambda: True)
+    )
+    plain = JaxEngine(registry={"t": get_model_config("qwen2:1.5b").tiny()})
+    assert plain._auto_attention
+    assert plain.decode_attention is None  # plain cache: XLA fused
+    assert plain._specialised_kernels_enabled()
+    assert plain._paged_decode_attention() is not None
+
+    kv = JaxEngine(kv_quantize="int8")
+    assert (
+        kv._decode_attention_for_cache(get_model_config("qwen2:1.5b"))
+        is not None  # d_head 128: int8 kernel
+    )
+    assert (
+        kv._decode_attention_for_cache(get_model_config("phi3:3.8b"))
+        is None  # d_head 96: fallback
+    )
