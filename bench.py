@@ -25,6 +25,10 @@ import sys
 import time
 
 BASELINE_TOKENS_PER_S = 1000.0 * (4.0 / 3.0) / 43.35  # ≈ 30.75 (BASELINE.md)
+# Batch timing discipline — used by BOTH the measurement loop and the
+# emitted JSON so the self-describing metadata cannot drift from what ran.
+BATCH_TIMED_RUNS = 2
+BATCH_STAT = "best"  # max over the timed windows (relay sessions land low)
 
 
 def main() -> int:
@@ -94,10 +98,11 @@ def main() -> int:
             for i in range(batch_rows)
         ]
         engine.generate_batch(batch_reqs)  # compile the batched loop
-        # best of 2 warm runs: a single timed window through the relay
-        # can land 30% low (docs/PERF.md session-noise analysis)
+        # best of BATCH_TIMED_RUNS warm runs: a single timed window
+        # through the relay can land 30% low (docs/PERF.md session-noise
+        # analysis)
         batch_tokens_per_s = 0.0
-        for _ in range(2):
+        for _ in range(BATCH_TIMED_RUNS):
             batch_results = engine.generate_batch(batch_reqs)
             batch_tokens = sum(r.generated_tokens for r in batch_results)
             batch_decode_s = batch_results[0].decode_s  # shared batch window
@@ -106,8 +111,8 @@ def main() -> int:
                     batch_tokens_per_s, batch_tokens / batch_decode_s
                 )
 
-    # The study's energy model applied to this very run (max of MXU/HBM/
-    # VPU duty × the v5e envelope, docs/PERF.md + profilers/tpu.py): the
+    # The study's energy model applied to this very run (per-engine
+    # MXU/HBM/VPU power states, docs/PERF.md + profilers/tpu.py): the
     # bench line carries the modelled J/token and utilisation so the
     # recorded perf artifact and the energy story stay joined.
     energy_extra = {}
@@ -128,6 +133,7 @@ def main() -> int:
             energy_extra = {
                 "joules_per_token_model": cols["joules_per_token"],
                 "tpu_util_est": cols["tpu_util_est"],
+                "tpu_power_model_W": cols["tpu_power_model_W"],
             }
     except Exception:  # the perf line must never die on the energy extra
         pass
@@ -149,8 +155,14 @@ def main() -> int:
         **energy_extra,
     }
     if batch_tokens_per_s is not None:
+        # batch_rows + the timing discipline are recorded so cross-round
+        # artifacts under the same key stay self-describing (ADVICE
+        # round-4: r01-r03 ran 8 rows / 1 window, r04+ runs 128 rows /
+        # best-of-2 — the numbers are not comparable without these)
         line.update(
             batch_rows=batch_rows,
+            batch_timed_runs=BATCH_TIMED_RUNS,
+            batch_stat=BATCH_STAT,
             batch_tokens_per_s=round(batch_tokens_per_s, 2),
             batch_vs_baseline=round(
                 batch_tokens_per_s / BASELINE_TOKENS_PER_S, 3

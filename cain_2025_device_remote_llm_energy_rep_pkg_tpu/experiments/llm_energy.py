@@ -223,6 +223,7 @@ def recompute_energy(
     registry: Optional[Dict[str, Any]] = None,
     reanalyze: bool = True,
     quantize_by_model: Optional[Dict[str, str]] = None,
+    assume_aliased_without_backend: bool = True,
 ) -> int:
     """Recompute the modelled energy columns of an existing run table from
     its persisted RAW measurements (timings + token counts) under the
@@ -244,9 +245,13 @@ def recompute_energy(
     self-contained for future recomputes. A row whose ``backend`` column carries
     the ``[aliased-on_device]`` marker (or, for pre-backend-column
     tables, any remote row served by >1 chip — aliasing was the only way
-    such a row could exist then) gets the TP-roofline modelled duration
-    as its energy window and a ``remote_modeled_decode_s`` column (see
-    ``generation_stats_from``). ``registry`` maps model name →
+    such a row could exist then, and how many rows took that ASSUMPTION
+    is warned about, since a genuinely multi-chip remote measurement fed
+    through it would have its window silently rewritten; pass
+    ``assume_aliased_without_backend=False`` for tables known to carry
+    real remote measurements, ADVICE round-4) gets the TP-roofline
+    modelled duration as its energy window and a
+    ``remote_modeled_decode_s`` column (see ``generation_stats_from``). ``registry`` maps model name →
     ModelConfig for the FLOPs term (default: the full-size
     ``MODEL_REGISTRY``; pass the study's own registry for tables produced
     with custom/miniature configs)."""
@@ -279,6 +284,7 @@ def recompute_energy(
         if str(r.get("location")) == "on_device" and r.get("backend")
     }
     updated = 0
+    assumed_aliased = 0
     for row in rows:
         # uniform keys: RunTableStore.write derives the header from the
         # first row, so every row must carry the new columns
@@ -326,18 +332,20 @@ def recompute_energy(
             row["chips"] = n_chips
         backend = row.get("backend")
         is_remote = str(row.get("location")) == "remote"
-        aliased = (
-            (
-                str(backend).endswith("[aliased-on_device]")
-                or (
-                    is_remote
-                    and _canonical_backend(str(backend))
-                    in on_device_backends
-                )
+        if backend is not None:
+            aliased = str(backend).endswith("[aliased-on_device]") or (
+                is_remote
+                and _canonical_backend(str(backend)) in on_device_backends
             )
-            if backend is not None
-            else is_remote and n_chips > 1
-        )
+        else:
+            # pre-backend-column table: aliasing was the only way a
+            # multi-chip remote row could exist then — but it is an
+            # ASSUMPTION here, counted and warned about below
+            aliased = (
+                assume_aliased_without_backend and is_remote and n_chips > 1
+            )
+            if aliased:
+                assumed_aliased += 1
         # persisted as "bf16" for unquantized serving (CSV cannot
         # distinguish None from a missing pre-column cell); missing →
         # the caller's per-model map, then the study default int8
@@ -359,6 +367,18 @@ def recompute_energy(
         row.update(profiler.collect(ctx))
         row["remote_modeled_decode_s"] = stats.get("modeled_decode_s")
         updated += 1
+    if assumed_aliased:
+        from ..runner import term
+
+        term.log_warn(
+            f"{assumed_aliased} remote row(s) predate the backend column "
+            f"and were ASSUMED aliased (single-chip measurement of a "
+            f"multi-chip treatment): their energy window is the "
+            f"TP-roofline modelled mesh duration, not their measured "
+            f"decode_s. If this table came from a genuinely multi-chip "
+            f"remote server, re-run with "
+            f"assume_aliased_without_backend=False"
+        )
     if updated:
         # one atomic whole-table rewrite, not one per row (update_row
         # re-reads and rewrites the full CSV each call — O(n²) here)

@@ -81,16 +81,22 @@ def _readable_int(path: str) -> bool:
 
 def _probe_hwmon() -> ChannelStatus:
     # availability mirrors what SysfsPowerProfiler actually CONSUMES:
-    # READABLE power*_input sensors. energy*_input files are reported in
-    # the detail but do not make the channel available — prepare's
-    # cooldown promise must match the study's wiring, not the glob.
-    power = sorted(
+    # one readable power*_input per hwmon device (multi-rail boards are
+    # deliberately not summed within a device — ADVICE round-4; the
+    # shared selector keeps probe and profiler in lockstep).
+    # energy*_input files are reported in the detail but do not make the
+    # channel available — prepare's cooldown promise must match the
+    # study's wiring, not the glob.
+    from .sysfs_power import select_hwmon_sensors
+
+    consumed = select_hwmon_sensors()
+    all_power = sorted(
         p
         for p in glob.glob("/sys/class/hwmon/hwmon*/power*_input")
         if _readable_int(p)
     )
     energy_only = sorted(glob.glob("/sys/class/hwmon/hwmon*/energy*_input"))
-    if not power:
+    if not consumed:
         if energy_only:
             detail = (
                 f"{len(energy_only)} energy*_input sensor(s) present but "
@@ -102,36 +108,62 @@ def _probe_hwmon() -> ChannelStatus:
         else:
             detail = "hwmon present but no readable power sensors"
         return ChannelStatus("hwmon", "power", "host", False, detail)
-    return ChannelStatus(
-        "hwmon", "power", "host", True, f"{len(power)} readable sensors"
-    )
+    detail = f"{len(consumed)} device rail(s) consumed"
+    if len(all_power) > len(consumed):
+        detail += (
+            f" (of {len(all_power)} readable sensors - one per hwmon "
+            "device to avoid double-counting hierarchical rails)"
+        )
+    return ChannelStatus("hwmon", "power", "host", True, detail)
 
 
 def _probe_battery() -> ChannelStatus:
     # same consumer-mirroring rule: power_now, else the current_now ×
-    # voltage_now pair SysfsPowerProfiler falls back to
+    # voltage_now pair SysfsPowerProfiler falls back to — and, like the
+    # consumer, a supply only counts while DISCHARGING: on AC the
+    # reading is charger flow, not system load (ADVICE round-4 medium),
+    # and the per-supply status is emitted in the detail either way.
+    from .sysfs_power import battery_is_discharging, battery_status
+
+    def _status_detail(paths) -> str:
+        return ", ".join(
+            f"{os.path.basename(os.path.dirname(p))}="
+            f"{battery_status(p) or 'no-status-file'}"
+            for p in paths
+        )
+
     paths = sorted(
         p
         for p in glob.glob("/sys/class/power_supply/*/power_now")
         if _readable_int(p)
     )
-    if paths:
-        return ChannelStatus(
-            "battery", "power", "host", True, f"{len(paths)} supplies"
+    if not paths:
+        paths = sorted(
+            cur
+            for cur in glob.glob("/sys/class/power_supply/*/current_now")
+            if _readable_int(cur)
+            and _readable_int(
+                os.path.join(os.path.dirname(cur), "voltage_now")
+            )
         )
-    iv = sorted(
-        cur
-        for cur in glob.glob("/sys/class/power_supply/*/current_now")
-        if _readable_int(cur)
-        and _readable_int(os.path.join(os.path.dirname(cur), "voltage_now"))
-    )
-    if iv:
+        source = " (current_now x voltage_now)"
+    else:
+        source = ""
+    if not paths:
+        return ChannelStatus(
+            "battery", "power", "host", False, "no power_supply devices"
+        )
+    discharging = [p for p in paths if battery_is_discharging(p)]
+    if discharging:
         return ChannelStatus(
             "battery", "power", "host", True,
-            f"{len(iv)} supplies (current_now x voltage_now)",
+            f"{len(discharging)}/{len(paths)} supplies discharging"
+            f"{source}: {_status_detail(paths)}",
         )
     return ChannelStatus(
-        "battery", "power", "host", False, "no power_supply devices"
+        "battery", "power", "host", False,
+        f"on AC - charger flow, not system load{source}: "
+        f"{_status_detail(paths)}",
     )
 
 

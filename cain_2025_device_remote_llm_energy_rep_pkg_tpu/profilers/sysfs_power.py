@@ -10,13 +10,22 @@ channel must change the study, not just the audit).
 Two source families, probed in priority order:
 
 - **hwmon** (``/sys/class/hwmon/hwmon*/power*_input``, microwatts):
-  board/CPU power rails. All readable sensors are summed — a multi-rail
-  board reports total measured draw.
+  board/CPU power rails. ONE sensor per hwmon device (the lowest-indexed
+  readable ``power*_input``) — boards exposing hierarchical rails from
+  one chip (package plus per-core) must not be double-counted (ADVICE
+  round-4; the reference's CodeCarbon likewise restricts itself to the
+  RAPL package domain). Distinct hwmon devices (separate chips) still
+  sum.
 - **battery** (``/sys/class/power_supply/*/power_now``, microwatts,
   falling back to ``current_now``·``voltage_now``): the discharge rate.
-  Only meaningful on battery power (status "Discharging"); on AC the
-  reading is charger flow, not load, so the profiler reports it but the
-  audit detail says which.
+  Only meaningful on battery power: on AC the reading is charger/charge
+  flow, not system load (ADVICE round-4 medium), so a supply is sampled
+  ONLY while its sibling ``status`` file reads "Discharging" — checked
+  per sample, so plugging in mid-run stops the channel instead of
+  polluting it — and counts toward availability (and therefore the 90 s
+  measured-channel cooldown) only when discharging at construction. A
+  supply with no ``status`` file is treated as discharging (unknown —
+  the audit detail says so).
 
 The reference's CodeCarbon meter does the same class of fallback chain
 internally (RAPL → psutil estimates); here each hop is a separate,
@@ -46,6 +55,46 @@ def _read_int(path: str) -> Optional[int]:
         return None
 
 
+def _sensor_index(path: str) -> int:
+    """Numeric index of a ``power<N>_input`` file (fallback: a large
+    sentinel). Lexicographic sort would place power10 before power1."""
+    import re
+
+    m = re.search(r"power(\d+)_input$", path)
+    return int(m.group(1)) if m else 1 << 30
+
+
+def select_hwmon_sensors(hwmon_glob: str = HWMON_GLOB) -> List[str]:
+    """One readable ``power*_input`` per hwmon DEVICE (lowest NUMERIC
+    index — by hwmon convention the first sensor is the
+    top-level/package rail). Shared by the profiler and the channel
+    probe so prepare's audit mirrors exactly what the study consumes."""
+    by_device: Dict[str, str] = {}
+    for p in sorted(glob.glob(hwmon_glob), key=lambda p: (os.path.dirname(p), _sensor_index(p))):
+        if _read_int(p) is None:
+            continue
+        by_device.setdefault(os.path.dirname(p), p)
+    return sorted(by_device.values())
+
+
+def battery_status(supply_file: str) -> Optional[str]:
+    """Charge status from the supply's sibling ``status`` file
+    (Discharging / Charging / Full / ...), or None when absent."""
+    try:
+        with open(os.path.join(os.path.dirname(supply_file), "status")) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def battery_is_discharging(supply_file: str) -> bool:
+    """Whether the supply's reading is system load rather than charger
+    flow: status "Discharging", or no status file at all (unknown — the
+    audit detail flags that case)."""
+    status = battery_status(supply_file)
+    return status is None or status == "Discharging"
+
+
 class SysfsPowerProfiler(SamplingProfiler):
     """Samples summed hwmon power rails, else battery discharge power."""
 
@@ -64,9 +113,7 @@ class SysfsPowerProfiler(SamplingProfiler):
         # the default construction at a fake/alternate sysfs tree
         hwmon_glob = HWMON_GLOB if hwmon_glob is None else hwmon_glob
         battery_glob = BATTERY_GLOB if battery_glob is None else battery_glob
-        self._hwmon = sorted(
-            p for p in glob.glob(hwmon_glob) if _read_int(p) is not None
-        )
+        self._hwmon = select_hwmon_sensors(hwmon_glob)
         self._battery = sorted(
             p for p in glob.glob(battery_glob) if _read_int(p) is not None
         )
@@ -82,7 +129,17 @@ class SysfsPowerProfiler(SamplingProfiler):
 
     @property
     def available(self) -> bool:
-        return bool(self._hwmon or self._battery or self._battery_iv)
+        # A battery on AC is NOT an available measured channel: its
+        # reading is charger flow, and availability here is what flips
+        # the study to the 90 s measured-channel cooldown (ADVICE
+        # round-4 medium).
+        return bool(
+            self._hwmon
+            or any(battery_is_discharging(p) for p in self._battery)
+            or any(
+                battery_is_discharging(cur) for cur, _ in self._battery_iv
+            )
+        )
 
     @staticmethod
     def _sum_microwatts(paths) -> Optional[float]:
@@ -94,11 +151,16 @@ class SysfsPowerProfiler(SamplingProfiler):
         if self._hwmon:
             return self._sum_microwatts(self._hwmon)
         if self._battery:
-            return self._sum_microwatts(self._battery)
+            # status re-checked per sample: plugging into AC mid-run must
+            # stop the channel (None samples), not record charger flow
+            active = [p for p in self._battery if battery_is_discharging(p)]
+            return self._sum_microwatts(active) if active else None
         if self._battery_iv:
             total = 0.0
             seen = False
             for cur, volt in self._battery_iv:
+                if not battery_is_discharging(cur):
+                    continue
                 i, v = _read_int(cur), _read_int(volt)
                 if i is not None and v is not None:
                     total += (i / 1e6) * (v / 1e6)

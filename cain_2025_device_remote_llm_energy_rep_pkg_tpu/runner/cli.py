@@ -121,9 +121,14 @@ HELP = """usage: python -m cain_2025_device_remote_llm_energy_rep_pkg_tpu <comma
 commands:
   <config.py>          run the experiment defined by the config file
   config-create [dir]  scaffold a new config file (default dir: examples/)
-  analyze <exp_dir>    (re)run the statistics pipeline over an experiment's
-                       run_table.csv, writing analysis_report.{json,md} + plots
+  analyze <exp_dir> [--filter-scope cell|subset|pooled]
+                       (re)run the statistics pipeline over an experiment's
+                       run_table.csv, writing analysis_report.{json,md} + plots;
+                       --filter-scope picks the IQR strata (default `cell` =
+                       model×location×length; `subset` = location×length, the
+                       reference notebook's exact order, nb cells 11-13)
   recompute-energy <exp_dir> [--chips loc=n,...] [--quantize m=q,...]
+                   [--trust-remote-timings]
                        recompute the modelled energy columns from the table's
                        persisted raw measurements (timings + token counts)
                        under the current energy model, then re-analyze;
@@ -131,7 +136,9 @@ commands:
                        fallback per-model serving modes (model=mode with a
                        `default=` entry, the serve CLI's spec shape) for
                        tables predating the per-row `chips`/`quantize`
-                       columns
+                       columns; --trust-remote-timings keeps such tables'
+                       multi-chip remote windows as measured (disables the
+                       rows-were-aliased assumption)
   prepare              validate the environment (JAX devices, RAPL access)
   serve [opts]         start the HTTP generation server (the framework-native
                        Ollama-equivalent): --host H --port N (default 11434),
@@ -299,17 +306,26 @@ def serve_command(args: List[str]) -> None:
     server.serve_forever()
 
 
-def analyze_command(experiment_dir: Path) -> None:
+def analyze_command(
+    experiment_dir: Path, filter_scope: str = "cell"
+) -> None:
     """Standalone analysis pass (reference equivalent: opening the R notebook
-    on run_table.csv, data-analysis/analysis-visualization.ipynb)."""
+    on run_table.csv, data-analysis/analysis-visualization.ipynb).
+    ``filter_scope`` picks the IQR strata: the default ``cell`` is finer
+    than the notebook's procedure; ``subset`` reproduces the notebook's
+    exact order (ADVICE round-4: the divergent default must be a visible
+    choice, not a silent one — the report header says which ran)."""
     if not (experiment_dir / "run_table.csv").exists():
         raise CommandError(f"no run_table.csv under {experiment_dir}")
     from ..analysis.pipeline import analyze_experiment
 
-    report = analyze_experiment(experiment_dir, make_plots=True)
+    report = analyze_experiment(
+        experiment_dir, make_plots=True, filter_scope=filter_scope
+    )
     term.log_ok(
         f"analysis written to {experiment_dir}/analysis_report.md "
-        f"({report['n_after_iqr']}/{report['n_rows']} rows after IQR)"
+        f"({report['n_after_iqr']}/{report['n_rows']} rows after IQR, "
+        f"filter scope: {filter_scope})"
     )
 
 
@@ -408,7 +424,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif cmd == "analyze":
             if len(args) < 2:
                 raise CommandError("analyze requires an experiment directory")
-            analyze_command(Path(args[1]))
+            scope = "cell"
+            rest = args[2:]
+            while rest:
+                if rest[0] == "--filter-scope":
+                    if len(rest) < 2 or rest[1] not in (
+                        "cell",
+                        "subset",
+                        "pooled",
+                    ):
+                        raise CommandError(
+                            "analyze: --filter-scope expects "
+                            "cell|subset|pooled"
+                        )
+                    scope = rest[1]
+                    rest = rest[2:]
+                else:
+                    raise CommandError(f"analyze: unknown flag {rest[0]!r}")
+            analyze_command(Path(args[1]), filter_scope=scope)
         elif cmd == "recompute-energy":
             if len(args) < 2:
                 raise CommandError(
@@ -421,9 +454,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             # always win)
             chips = None
             quantize = None
+            trust_remote_timings = False
             rest = args[2:]
             while rest:
                 flag = rest[0]
+                if flag == "--trust-remote-timings":
+                    # pre-backend-column tables only: disable the
+                    # remote-rows-were-aliased assumption so genuinely
+                    # multi-chip remote measurements keep their own
+                    # windows (the warning recompute_energy emits names
+                    # this flag's library twin)
+                    trust_remote_timings = True
+                    rest = rest[1:]
+                    continue
                 if flag == "--chips":
                     if len(rest) < 2:
                         raise CommandError(
@@ -471,6 +514,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 Path(args[1]),
                 n_chips_by_location=chips,
                 quantize_by_model=quantize,
+                assume_aliased_without_backend=not trust_remote_timings,
             )
             term.log_ok(
                 f"recomputed modelled energy for {n} rows from their "

@@ -681,3 +681,158 @@ def test_shipped_capstone_recompute_is_deterministic(tmp_path):
         for r in RunTableStore(exp).read()
     }
     assert before == after
+
+def _speed_rows():
+    """Synthetic 2-location table with modelled mesh windows on the
+    remote rows (the aliased-capstone shape)."""
+    rows = []
+    for i in range(8):
+        rows.append({
+            "model": "m", "location": "on_device", "length": 100,
+            "energy_model_J": 100.0 + i, "decode_s": 10.0 + 0.1 * i,
+            "remote_modeled_decode_s": None,
+        })
+        rows.append({
+            "model": "m", "location": "remote", "length": 100,
+            "energy_model_J": 150.0 + i, "decode_s": 10.0 + 0.1 * i,
+            "remote_modeled_decode_s": 2.5 + 0.05 * i,
+        })
+    return rows
+
+
+def test_h1_speed_section_uses_modelled_remote_window_with_provenance():
+    """VERDICT round-4 missing #2: the speed axis of the study's research
+    question gets a tested, labelled home in the published analysis — the
+    remote side rides remote_modeled_decode_s (never the aliased
+    single-chip measurement) and the provenance label says so."""
+    report = analyze(
+        _speed_rows(),
+        metrics=("energy_model_J", "decode_s", "remote_modeled_decode_s"),
+        energy_metric="energy_model_J",
+    )
+    h = report["h1_speed_by_length"]["100"]
+    # remote side ≈ 2.5 s modelled vs on-device ≈ 10 s measured → ~4×
+    assert 3.5 < h["mean_ratio"] < 4.5
+    assert h["remote_provenance"] == "modelled (TP roofline)"
+    assert h["n_modelled"] == h["n_remote"] == 8
+    assert h["stars"]  # significant at n=8 vs n=8 with disjoint ranges
+
+    # the joint statement: remote faster AND more Joules, both axes
+    # labelled with their provenance
+    t = report["speed_energy_tradeoff"]
+    lo, hi = t["speedup_range"]
+    assert 3.5 < lo <= hi < 4.5
+    e_lo, e_hi = t["energy_multiple_range"]
+    assert 1.3 < e_lo <= e_hi < 1.7
+    assert t["speed_provenance"] == ["modelled (TP roofline)"]
+    assert t["energy_provenance"] == "modelled (energy_model_J)"
+
+    md = render_markdown(report)
+    assert "## H1-speed: serving decode time, on-device vs remote" in md
+    assert "**modelled** mesh window" in md
+    assert "## Speed–energy trade-off (the study's joint result)" in md
+    assert "faster at" in md and "× the Joules" in md
+
+
+def test_h1_speed_measured_remote_has_measured_label():
+    """A genuinely distinct remote server (no modelled column) must NOT be
+    labelled modelled."""
+    rows = _speed_rows()
+    for r in rows:
+        if r["location"] == "remote":
+            r["remote_modeled_decode_s"] = None
+            r["decode_s"] = 3.0
+    report = analyze(
+        rows,
+        metrics=("energy_model_J", "decode_s"),
+        energy_metric="energy_model_J",
+    )
+    h = report["h1_speed_by_length"]["100"]
+    assert h["remote_provenance"] == "measured"
+    assert h["n_modelled"] == 0
+    md = render_markdown(report)
+    assert "Both sides of this comparison are **measured**" in md
+
+
+def test_shipped_capstone_publishes_speed_energy_tradeoff():
+    """The committed capstone report must carry the trade-off tables —
+    the reference's research question (RunnerConfig.py:122-131) was in no
+    published table through round 4 (VERDICT round-4 missing #2)."""
+    import json
+    from pathlib import Path
+
+    sample = Path(__file__).parent.parent / "docs" / "sample_run"
+    if not (sample / "analysis_report.md").exists():
+        pytest.skip("sample run not present")
+    md = (sample / "analysis_report.md").read_text()
+    assert "## H1-speed: serving decode time, on-device vs remote" in md
+    assert "## Speed–energy trade-off (the study's joint result)" in md
+    # the provenance label: the capstone topology is aliased, so the
+    # speed table must declare the remote side modelled
+    assert "modelled (TP roofline)" in md
+    report = json.loads((sample / "analysis_report.json").read_text())
+    t = report["speed_energy_tradeoff"]
+    s_lo, s_hi = t["speedup_range"]
+    e_lo, e_hi = t["energy_multiple_range"]
+    # remote: faster (sublinear on 8 chips) at a modest Joule premium
+    assert 1.5 < s_lo <= s_hi < 8.0
+    assert 1.0 < e_lo <= e_hi < 3.0
+
+
+def test_shipped_capstone_power_states_are_per_engine():
+    """Round-5 directive #1 'done' criterion on the deliverable: no
+    decode row bills the flat 200 W matmul envelope (the round-4
+    artifact for util-capped int4 rows), every row bills a working state
+    above idle, and int4 rows are distinguishable from int8 rows in
+    billed watts."""
+    from pathlib import Path
+
+    sample = Path(__file__).parent.parent / "docs" / "sample_run"
+    if not (sample / "run_table.csv").exists():
+        pytest.skip("sample run not present")
+    rows = RunTableStore(sample).read()
+    powers = []
+    for r in rows:
+        w = r.get("tpu_power_model_W")
+        assert w is not None
+        assert 55.0 < w < 150.0, r["__run_id"]  # working state, not envelope
+        powers.append(w)
+    # power is a per-row engine-mix outcome, not a constant: the table
+    # must span a real range (the round-4 model pinned whole treatment
+    # groups at identical peak watts)
+    assert max(powers) - min(powers) > 20.0
+    # and no util-capped row sits at the envelope: the rows with
+    # tpu_util_est == 1.0 (saturated engine) still bill engine watts
+    capped = [
+        r["tpu_power_model_W"] for r in rows if r.get("tpu_util_est") == 1.0
+    ]
+    assert capped and all(w < 150.0 for w in capped)
+    # (same-model int4-vs-int8 watt separation is pinned in
+    # test_per_engine_power_int4_vs_int8_distinguishable — across the
+    # capstone's per-model quantize assignment the pooled means are
+    # confounded and deliberately not compared here)
+
+
+def test_h1_speed_modelled_window_not_keyed_on_location_label():
+    """A two-location table whose remote arm uses a custom label but
+    carries remote_modeled_decode_s must still substitute the modelled
+    window and declare it modelled — never publish the aliased
+    single-chip measurement as 'measured' (round-5 review finding)."""
+    rows = _speed_rows()
+    for r in rows:
+        if r["location"] == "remote":
+            r["location"] = "cloud"
+    report = analyze(
+        rows,
+        metrics=("energy_model_J", "decode_s", "remote_modeled_decode_s"),
+        energy_metric="energy_model_J",
+    )
+    h = report["h1_speed_by_length"]["100"]
+    assert h["remote_provenance"] == "modelled (TP roofline)"
+    assert h["n_modelled"] == h["n_remote"] == 8
+    # modelled ≈2.5 s vs measured ≈10 s → ~4× either way; with 'cloud'
+    # sorting before 'on_device' the ratio inverts direction but the
+    # magnitude must reflect the modelled window, not the aliased one
+    assert 3.5 < max(h["mean_ratio"], 1.0 / h["mean_ratio"]) < 4.5
+    # the remote-named trade-off block is gated on canonical labels
+    assert report["speed_energy_tradeoff"] == {}

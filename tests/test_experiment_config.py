@@ -72,7 +72,9 @@ def test_cooldown_policy_follows_channel_type(monkeypatch):
 
 
 def test_energy_model_profiler_math(tmp_path):
-    prof = TpuEnergyModelProfiler(peak_tflops=100.0, peak_w=200.0, idle_w=50.0)
+    prof = TpuEnergyModelProfiler(
+        peak_tflops=100.0, peak_w=200.0, idle_w=50.0, mxu_active_w=150.0
+    )
     ctx = RunContext("r", 1, 1, {}, tmp_path, tmp_path)
     ctx.scratch["generation_stats"] = {
         "flops": 50.0e12,  # half of peak over 1 s → util 0.5
@@ -82,10 +84,11 @@ def test_energy_model_profiler_math(tmp_path):
     prof.on_start(ctx)
     prof.on_stop(ctx)
     data = prof.collect(ctx)
-    # 50 W idle + 0.5·150 W active = 125 J over 1 s
+    # 50 W idle + 0.5 MXU duty × 150 W engine coefficient = 125 J over 1 s
     assert data["energy_model_J"] == pytest.approx(125.0)
     assert data["joules_per_token"] == pytest.approx(1.25)
     assert data["tpu_util_est"] == 0.5
+    assert data["tpu_power_model_W"] == pytest.approx(125.0)
 
 
 def test_energy_model_profiler_without_stats(tmp_path):
