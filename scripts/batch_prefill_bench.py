@@ -1,0 +1,99 @@
+"""A/B: grouped vs sequential prefill ahead of one batched decode.
+
+VERDICT round-4 missing #3 / round-5 directive #3: the server's batch
+path decoded in lockstep but prefilled one row at a time — at 128 rows,
+128 sequential dispatches stood behind a ~1.3 s decode. This script
+measures the end-to-end `generate_batch` wall time on the real chip with
+the grouped `[G, S]` prefill (shipped) and with grouping forced off
+(per-row `_start`, the round-4 behavior), same requests, both warm.
+
+Prints one JSON line per mode; run on the TPU chip (no JAX process may
+run concurrently — see .claude/skills/verify gotchas).
+"""
+
+import dataclasses
+import json
+import time
+
+import jax
+
+
+def main() -> int:
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    backend = jax.default_backend()
+    rows = 128
+    gen_tokens = 256
+
+    engine = JaxEngine(quantize="int8", decode_attention="auto")
+    base = GenerationRequest(
+        "qwen2:1.5b",
+        "In 1000 words, please give me information about the solar system",
+        max_new_tokens=gen_tokens,
+    )
+    reqs = [dataclasses.replace(base, seed=10 + i) for i in range(rows)]
+
+    # Windows are identified by their (t0, t1) timestamp pair from the
+    # state dicts — exact, unlike deduping per-row prefill_s floats,
+    # where two solo prefills with bit-equal durations would collapse
+    # into one window and understate the sequential baseline.
+    windows: "set[tuple[float, float]]" = set()
+    inner_states = engine._batch_states
+
+    def spy_states(requests, all_prompt_ids, cache_lens):
+        states = inner_states(requests, all_prompt_ids, cache_lens)
+        windows.update((st["t0"], st["t1"]) for st in states)
+        return states
+
+    engine._batch_states = spy_states
+
+    def timed(tag: str) -> None:
+        engine.generate_batch(reqs)  # warm/compile
+        windows.clear()
+        t0 = time.monotonic()
+        results = engine.generate_batch(reqs)
+        wall = time.monotonic() - t0
+        print(
+            json.dumps(
+                {
+                    "mode": tag,
+                    "backend": backend,
+                    "rows": rows,
+                    "gen_tokens": gen_tokens,
+                    "wall_s": round(wall, 3),
+                    "decode_s": round(results[0].decode_s, 3),
+                    "prefill_total_s": round(
+                        sum(t1 - t0 for t0, t1 in windows), 3
+                    ),
+                    "n_prefill_windows": len(windows),
+                }
+            )
+        )
+
+    timed("grouped")
+
+    # force the round-4 behavior: per-row solo prefill
+    def solo_states(requests, all_prompt_ids, cache_lens):
+        return [
+            engine._start(r, cache_len=c, prompt_ids=ids)
+            for r, ids, c in zip(requests, all_prompt_ids, cache_lens)
+        ]
+
+    inner_states = solo_states
+    timed("sequential")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
