@@ -1506,7 +1506,9 @@ class JaxEngine(GenerationBackend):
         additionally stop writing once their OWN budget is exhausted, so a
         row's pool allocation is bounded by its own request, not the
         batch's widest."""
-        decode_attention = self._paged_decode_attention()
+        decode_attention = self._paged_decode_attention(
+            self._models[model].cfg
+        )
         # Stacked-hybrid mode (kernel present): the pool holds ONLY the
         # prefill pages and is read-only during the loop (closed over —
         # zero per-step pool traffic); generated tokens live in small
@@ -1642,13 +1644,14 @@ class JaxEngine(GenerationBackend):
         self._decode_cache[key] = decode
         return decode
 
-    def _paged_decode_attention(self):
+    def _paged_decode_attention(self, cfg: Optional[ModelConfig] = None):
         """The attention impl for paged caches: the Pallas page-table
         kernel where specialised kernels are enabled (explicit injection,
         or "auto" on TPU — its gather fallback materialises ~1 GB/step at
         qwen2 32-row shapes and measured 2.1k vs the kernel path's 2.55k
-        aggregate tok/s), else None (CPU tests, and meshes where the
-        kernel has no GSPMD partition rule)."""
+        aggregate tok/s), else None (CPU tests). ``cfg`` is unused here;
+        the TP engine's override needs it to decide whether the model's
+        heads divide the mesh (its shard_map partition rule)."""
         if not self._specialised_kernels_enabled():
             return None
         from ..ops.pallas_paged_attention import (
@@ -1711,7 +1714,7 @@ class JaxEngine(GenerationBackend):
         # caches, so the pool is read-only during decode and pages are
         # not allocated for budgets. Legacy (gather-fallback) mode writes
         # decode tokens into pages and sizes for prompt + budget.
-        stacked = self._paged_decode_attention() is not None
+        stacked = self._paged_decode_attention(cfg) is not None
         n_real = max(r.max_new_tokens for r in requests) - 1
         # ONE definition of each row's token budget, used both for page
         # sizing here and for the decode loop's done-condition below —

