@@ -783,17 +783,14 @@ class LlmEnergyConfig(ExperimentConfig):
             try:
                 analyze_experiment(
                     self.experiment_path,
-                    metrics=(
-                        "energy_model_J",
-                        "execution_time_s",
-                        "decode_s",
-                        "remote_modeled_decode_s",
-                        "cpu_usage",
-                        "memory_usage",
-                        "tokens_per_s",
-                        "joules_per_token",
-                        "tpu_util_est",
-                    ),
+                    # metrics auto-detect from the table (KNOWN_METRIC_COLUMNS
+                    # order): a fixed list here silently EXCLUDED measured
+                    # channels — a host with a live power counter would have
+                    # had its tpu_energy_J column ignored by the study's own
+                    # post-hoc analysis while the pipeline's
+                    # measured-outranks-model selection sat unused (caught
+                    # by the round-5 fake-counter e2e test)
+                    metrics=None,
                     # the notebook's figure families are part of the study's
                     # deliverable (nb cells 21-28, 39-40), not an opt-in
                     make_plots=True,

@@ -134,8 +134,7 @@ class TensorParallelEngine(JaxEngine):
         (docs/PERF.md), but the only correct one without a head shard."""
         if self.n_devices == 1:
             return super()._paged_decode_attention(cfg)
-        inner = super()._paged_decode_attention(cfg)
-        if inner is None:
+        if not self._specialised_kernels_enabled():
             return None
         from .sharding import cache_spec
 
