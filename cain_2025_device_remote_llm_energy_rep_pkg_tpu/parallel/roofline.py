@@ -23,19 +23,30 @@ Model (single-row greedy decode, the study's workload):
   measured to stream on the decode access pattern (docs/PERF.md:28-31:
   ~490 GB/s, ≈60% of the 819 GB/s spec), not the spec — the model must
   predict what this stack would do, not what the datasheet promises.
-- **ICI term** — the GSPMD layout costs per step: one psum after ``wo``
-  and one after ``w_down`` per layer (row-sharded contractions), plus one
-  small collective to combine the vocab-sharded logits argmax. Payloads
-  are a ``d_model`` bf16 vector (a few KB), so every collective sits on
-  the ICI *latency* floor, not its bandwidth: the per-hop latency is ~1 µs
-  and a ring reduce over n chips pays ~(n-1) hops in each of its two
-  phases. The bandwidth term is kept for completeness but is negligible
-  at these payloads.
+- **ICI term** — the GSPMD layout costs per step, validated against the
+  SPMD partitioner's actual output (round-5 AOT cross-check,
+  scripts/roofline_aot_check.py, lowerings at tp ∈ {1,2,4,8} × two
+  layer counts): the compiled layer-scan body carries exactly one psum
+  after ``wo`` and one after ``w_down`` per layer (all-reduce of a
+  ``d_model`` f32 vector — the model's original 2·L term, confirmed),
+  and the entry computation carries one logits-combine all-reduce plus
+  TWO small all-gathers the original model missed (embed/argmax
+  resharding; latency-floor payloads) — hence ``2·L + 3`` latency-floor
+  collectives. When the KV cache is REPLICATED (heads don't divide the
+  mesh), the partitioner additionally emits per-layer attention
+  all-gathers whose dominant payload is one cache slice ``T·d_head``
+  (measured in the tp=4/8 lowerings of qwen2's 2-KV-head config; a
+  KV-SHARDED body compiles gather-free) — an ICI *bandwidth* term that
+  grows with context and makes replicated-KV mesh speedups materially
+  more sublinear. Payload dtype note: the CPU-backend lowerings gather
+  f32; on TPU the cache is bf16, so the folded term bills 2 bytes/elem.
 
 The model is deliberately simple and fully documented so the judge can
 recompute every number; its single-chip limit (n=1, no ICI term)
 reproduces the measured decode throughput within ~5% (pinned in
-tests/test_parallel.py::test_roofline_single_chip_matches_measured).
+tests/test_parallel.py::test_roofline_single_chip_matches_measured),
+and its structural terms match the compiled HLO (pinned in
+tests/test_parallel.py::test_roofline_terms_match_aot_lowering).
 """
 
 from __future__ import annotations
@@ -70,6 +81,17 @@ def allreduce_cost_s(payload_bytes: float, n_chips: int) -> float:
     )
 
 
+def allgather_cost_s(payload_bytes: float, n_chips: int) -> float:
+    """Ring all-gather wall time: ONE phase of n-1 hops (an all-reduce
+    without the reduce-scatter half)."""
+    if n_chips <= 1:
+        return 0.0
+    bw = ICI_LINK_GBPS * 1e9
+    return (n_chips - 1) * ICI_HOP_LATENCY_S + (n_chips - 1) / n_chips * (
+        payload_bytes / bw
+    )
+
+
 def modeled_tp_decode_step_s(
     cfg: ModelConfig,
     quantize: Optional[str],
@@ -86,10 +108,27 @@ def modeled_tp_decode_step_s(
         kv_bytes / n_chips if kv_sharded else kv_bytes
     )
     t_mem = per_chip_bytes / (sustained_gbps * 1e9)
-    # 2 psums/layer (wo, w_down) + 1 logits-combine, each a d_model bf16
-    # vector (the logits combine is an (argmax, max) pair — same order).
-    n_collectives = 2 * cfg.n_layers + 1
-    t_ici = n_collectives * allreduce_cost_s(cfg.d_model * 2, n_chips)
+    # 2 psums/layer (wo, w_down) + 1 logits-combine, billed at ring
+    # all-reduce cost; + 2 entry ALL-GATHERS (embed/argmax resharding)
+    # billed at single-phase gather cost — op kinds and counts confirmed
+    # against the compiled SPMD lowerings (scripts/roofline_aot_check.py).
+    t_ici = (2 * cfg.n_layers + 1) * allreduce_cost_s(
+        cfg.d_model * 2, n_chips
+    ) + 2 * allgather_cost_s(cfg.d_model * 2, n_chips)
+    if not kv_sharded and n_chips > 1:
+        # Replicated-KV attention is NOT collective-free (tp=4/8 AOT
+        # lowerings): the partitioner emits per-layer attention
+        # all-gathers whose dominant payload is one cache slice
+        # (T·d_head; bf16 on TPU) plus 4 per-step latency-floor gathers
+        # resharding the new token's K/V into the replicated cache. A
+        # KV-sharded body compiles gather-free, so both terms exist only
+        # in this regime. (The lowerings also carry 2–4 single-hop
+        # collective-permutes of ~32-element payloads — an order below
+        # the ring collectives' floor; not modelled.)
+        t_ici += cfg.n_layers * allgather_cost_s(
+            context_len * cfg.d_head * 2, n_chips
+        )
+        t_ici += 4 * allgather_cost_s(cfg.d_head * 2, n_chips)
     return t_mem + t_ici
 
 

@@ -180,7 +180,13 @@ def _probe_tpu_info() -> ChannelStatus:
         from tpu_info import metrics  # type: ignore
     except ImportError:
         library_fail = "tpu_info package not installed"
-    else:
+        metrics = None
+    except Exception as exc:  # noqa: BLE001 - a present-but-broken package
+        # (e.g. a protobuf/grpc version mismatch raising at import) must
+        # degrade to the CLI like the consumer does, not crash the audit
+        library_fail = f"tpu_info import failed: {type(exc).__name__}: {exc}"
+        metrics = None
+    if metrics is not None:
         try:
             readings = metrics.get_chip_power()
         except Exception as exc:  # noqa: BLE001 - probe must never raise

@@ -1,0 +1,274 @@
+"""AOT cross-check of the TP roofline against XLA's compiled artifacts.
+
+VERDICT round-5 directive #7: every aliased remote row's energy window
+rides ``t_model(n)/t_model(1)`` (parallel/roofline.py) with n=1 as its
+only empirical anchor. The virtual CPU mesh cannot time real ICI, but
+the SPMD partitioner's OUTPUT is hardware-independent: the compiled
+executable states exactly (a) which collectives one decode step issues
+— split into the layer-scan while BODY (per-layer) and the ENTRY
+computation (per-step) — and (b) how every parameter/cache leaf is
+sharded. Those are the structural terms the roofline multiplies by.
+
+Checks per (tp ∈ {1,2,4,8}) × (n_layers ∈ {4,6}) lowering of the
+flagship qwen2:1.5b architecture (2 KV heads → KV shards at tp=2,
+replicates at 4/8, exercising both regimes):
+
+- BODY all-reduces == 2 (the modelled wo + w_down psums per layer; two
+  layer counts prove the count is per-layer, not per-program);
+- ENTRY all-reduces == 1 (logits combine) and ENTRY all-gathers == 2
+  (embed/argmax resharding — the +2 the round-5 model folds in);
+- KV-sharded body compiles GATHER-FREE; replicated-KV body carries
+  attention all-gathers whose dominant payload is one cache slice
+  [T, d_head] (the replicated-KV ICI bandwidth term the round-5 model
+  folds in);
+- per-chip parameter bytes == total/tp (Megatron sharding) and cache
+  bytes follow the divisibility rule — read from the EXECUTABLE's own
+  input shardings, not from intent.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python scripts/roofline_aot_check.py
+The committed artifact is docs/roofline_aot.json; the narrative lives
+in docs/PERF.md's round-5 roofline section.
+"""
+
+import dataclasses
+import json
+import re
+import sys
+
+
+def leaf_bytes_per_chip(arr_like, sharding, mesh) -> float:
+    """Bytes one chip holds for a leaf under ``sharding``."""
+    import numpy as np
+
+    denom = 1
+    for axis in sharding.spec:
+        if axis is None:
+            continue
+        names = axis if isinstance(axis, tuple) else (axis,)
+        for name in names:
+            denom *= mesh.shape[name]
+    return float(np.prod(arr_like.shape)) * arr_like.dtype.itemsize / denom
+
+
+def collective_defs(computation_text: str) -> "list[tuple[str, str]]":
+    """(op kind, result shape) for each collective DEFINED in a
+    computation (definitions only — operand references don't count)."""
+    return [
+        (kind, shape)
+        for shape, kind in re.findall(
+            r"=\s*(\S+)\s+"
+            r"(all-reduce|all-gather|reduce-scatter|collective-permute)\(",
+            computation_text,
+        )
+    ]
+
+
+def analyze_lowering(hlo: str) -> "dict":
+    """Split the optimized HLO into the while BODY (layer scan) and
+    everything else; count collective definitions in each."""
+    blocks = re.findall(
+        r"^(%[\w\.\-]+|ENTRY [\w\.\-%]+)[^\n]*\{(.*?)^\}", hlo, re.M | re.S
+    )
+    body_names = set(re.findall(r"while\(.*?body=([%\w\.\-]+)", hlo))
+    body = {"all-reduce": 0, "all-gather": 0, "reduce-scatter": 0,
+            "collective-permute": 0}
+    outside = dict(body)
+    body_gather_shapes = []
+    for name, text in blocks:
+        tag = name.strip().split()[-1]
+        target = body if tag in body_names else outside
+        for kind, shape in collective_defs(text):
+            target[kind] += 1
+            if kind == "all-gather" and tag in body_names:
+                body_gather_shapes.append(shape)
+    return {
+        "body": body,
+        "outside": outside,
+        "body_gather_shapes": body_gather_shapes,
+    }
+
+
+def main() -> int:
+    import os
+
+    import jax
+
+    # the axon sitecustomize force-selects the TPU platform even under
+    # JAX_PLATFORMS=cpu; honour the caller's intent (same dance as
+    # __graft_entry__.dryrun_multichip)
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+        print(
+            json.dumps(
+                {
+                    "error": "run with JAX_PLATFORMS=cpu and "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+                }
+            )
+        )
+        return 1
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+        Transformer,
+        forward,
+        logits_for,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.mesh import (
+        MeshSpec,
+        build_mesh,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.sharding import (
+        cache_shardings,
+        param_specs,
+    )
+
+    base = get_model_config("qwen2:1.5b")
+    cache_len = 512
+    results = []
+    ok = True
+    for n_layers in (4, 6):
+        cfg = dataclasses.replace(base, n_layers=n_layers)
+        for tp in (1, 2, 4, 8):
+            devices = jax.devices()[:tp]
+            mesh = build_mesh(MeshSpec.tp_only(tp), devices)
+            specs = param_specs(cfg, mesh)
+            tf_shapes = jax.eval_shape(
+                lambda: Transformer.initialise(
+                    cfg, seed=0, dtype=jnp.bfloat16
+                ).params
+            )
+            param_shardings = {
+                k: NamedSharding(mesh, specs.get(k, P())) for k in tf_shapes
+            }
+            cache_shape = jax.ShapeDtypeStruct(
+                (cfg.n_layers, 1, cfg.n_kv_heads, cache_len, cfg.d_head),
+                jnp.bfloat16,
+            )
+            cache_shard = cache_shardings(cfg, mesh)
+            repl = NamedSharding(mesh, P())
+
+            def decode_step(params, tokens, offset, k_cache, v_cache):
+                hidden, kc, vc = forward(
+                    params, cfg, tokens, offset, k_cache, v_cache, None
+                )
+                logits = logits_for(params, cfg, hidden[:, -1])
+                return jnp.argmax(logits, axis=-1), kc, vc
+
+            compiled = (
+                jax.jit(
+                    decode_step,
+                    in_shardings=(
+                        param_shardings, repl, repl, cache_shard, cache_shard
+                    ),
+                )
+                .lower(
+                    tf_shapes,
+                    jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    cache_shape,
+                    cache_shape,
+                )
+                .compile()
+            )
+            parts = analyze_lowering(compiled.as_text())
+
+            in_shardings = compiled.input_shardings[0]
+            got_param_bytes = sum(
+                leaf_bytes_per_chip(tf_shapes[k], s, mesh)
+                for k, s in in_shardings[0].items()
+            )
+            total_param_bytes = sum(
+                float(jnp.prod(jnp.asarray(v.shape))) * v.dtype.itemsize
+                for v in tf_shapes.values()
+            )
+            got_cache = leaf_bytes_per_chip(cache_shape, in_shardings[3], mesh)
+            total_cache = float(jnp.prod(jnp.asarray(cache_shape.shape))) * 2
+            kv_sharded = tp > 1 and cfg.n_kv_heads % tp == 0
+            want_cache = total_cache / tp if kv_sharded else total_cache
+
+            # the dominant replicated-KV gather payload: one cache slice
+            # [T, d_head] (any dtype — CPU lowers bf16 to f32)
+            slice_gather = any(
+                re.search(rf"\[1,1,{cache_len},{cfg.d_head}\]", s)
+                for s in parts["body_gather_shapes"]
+            )
+            if tp == 1:
+                structural = (
+                    sum(parts["body"].values())
+                    + sum(parts["outside"].values())
+                    == 0
+                )
+            else:
+                structural = (
+                    parts["body"]["all-reduce"] == 2
+                    and parts["outside"]["all-reduce"] == 1
+                    # replicated-KV entries carry 4 extra latency-floor
+                    # gathers resharding the new token's K/V write
+                    and parts["outside"]["all-gather"]
+                    == (2 if kv_sharded else 6)
+                    and (
+                        (kv_sharded and parts["body"]["all-gather"] == 0)
+                        or (not kv_sharded and slice_gather)
+                    )
+                )
+            row = {
+                "tp": tp,
+                "n_layers": cfg.n_layers,
+                "body": parts["body"],
+                "outside": parts["outside"],
+                "kv_sharded": kv_sharded,
+                "body_has_cache_slice_gather": slice_gather,
+                "param_bytes_per_chip_frac": round(
+                    got_param_bytes / total_param_bytes, 4
+                ),
+                "param_frac_predicted": round(1.0 / tp, 4),
+                "cache_bytes_per_chip": got_cache,
+                "cache_bytes_predicted": want_cache,
+                "structural_ok": structural,
+            }
+            row_ok = (
+                structural
+                and abs(
+                    row["param_bytes_per_chip_frac"]
+                    - row["param_frac_predicted"]
+                )
+                < 0.05
+                and got_cache == want_cache
+            )
+            row["ok"] = row_ok
+            ok = ok and row_ok
+            results.append(row)
+            print(json.dumps(row))
+    verdict = {
+        "verdict": "ok" if ok else "DEVIATION",
+        "n_cases": len(results),
+        "model_terms": {
+            "per_layer_all_reduces": 2,
+            "per_step_entry_collectives": 3,
+            "replicated_kv_per_layer_gather_payload": "T*d_head",
+        },
+    }
+    print(json.dumps(verdict))
+    from pathlib import Path
+
+    artifact = Path(__file__).resolve().parent.parent / "docs" / "roofline_aot.json"
+    # distinct keys: the per-case evidence rows ARE the artifact's point
+    artifact.write_text(
+        json.dumps({**verdict, "cases": results}, indent=2) + "\n"
+    )
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -330,3 +330,94 @@ def test_tp_stacked_paged_parts_kernel_parity():
     for g, w in zip(got, want):
         assert g.tokens == w.tokens
         assert g.text == w.text
+
+
+def test_roofline_terms_match_aot_lowering():
+    """VERDICT round-5 directive #7: the roofline's structural terms must
+    match the SPMD partitioner's actual output. Fast pin of the full
+    sweep in scripts/roofline_aot_check.py (committed artifact:
+    docs/roofline_aot.json): per-layer all-reduces == 2, entry == 1
+    all-reduce + 2 gathers (sharded KV) / 6 (replicated), KV-sharded
+    body gather-free, replicated body carries the cache-slice gather."""
+    import dataclasses
+    import importlib.util
+    from pathlib import Path
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.transformer import (
+        Transformer,
+        forward,
+        logits_for,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.sharding import (
+        cache_shardings,
+        param_specs,
+    )
+
+    spec = importlib.util.spec_from_file_location(
+        "roofline_aot_check",
+        Path(__file__).parent.parent / "scripts" / "roofline_aot_check.py",
+    )
+    aot = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(aot)
+
+    cfg = dataclasses.replace(
+        get_model_config("qwen2:1.5b").tiny(), n_kv_heads=2, n_heads=4
+    )
+    cache_len = 64
+    for tp, kv_sharded in ((2, True), (4, False)):
+        mesh = build_mesh(
+            MeshSpec.tp_only(tp), jax.devices()[:tp]
+        )
+        specs = param_specs(cfg, mesh)
+        shapes = jax.eval_shape(
+            lambda: Transformer.initialise(
+                cfg, seed=0, dtype=jnp.float32
+            ).params
+        )
+        pshard = {
+            k: jax.sharding.NamedSharding(
+                mesh, specs.get(k, jax.sharding.PartitionSpec())
+            )
+            for k in shapes
+        }
+        cache = jax.ShapeDtypeStruct(
+            (cfg.n_layers, 1, cfg.n_kv_heads, cache_len, cfg.d_head),
+            jnp.float32,
+        )
+        repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+        def step(params, tokens, offset, kc, vc):
+            h, kc, vc = forward(params, cfg, tokens, offset, kc, vc, None)
+            return jnp.argmax(logits_for(params, cfg, h[:, -1]), -1), kc, vc
+
+        hlo = (
+            jax.jit(
+                step,
+                in_shardings=(
+                    pshard, repl, repl,
+                    cache_shardings(cfg, mesh), cache_shardings(cfg, mesh),
+                ),
+            )
+            .lower(
+                shapes,
+                jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                cache,
+                cache,
+            )
+            .compile()
+            .as_text()
+        )
+        parts = aot.analyze_lowering(hlo)
+        assert parts["body"]["all-reduce"] == 2, (tp, parts)
+        assert parts["outside"]["all-reduce"] == 1, (tp, parts)
+        if kv_sharded:
+            assert parts["body"]["all-gather"] == 0, parts
+            assert parts["outside"]["all-gather"] == 2, parts
+        else:
+            assert parts["outside"]["all-gather"] == 6, parts
+            # the replicated regime's dominant extra: a cache-slice gather
+            assert any(
+                f"{cache_len},{cfg.d_head}]" in s
+                for s in parts["body_gather_shapes"]
+            ), parts
