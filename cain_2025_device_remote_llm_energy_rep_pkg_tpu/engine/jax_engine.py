@@ -1038,6 +1038,7 @@ class JaxEngine(GenerationBackend):
         requests: "list[GenerationRequest]",
         all_prompt_ids: "list[list[int]]",
         cache_lens: "list[int]",
+        group_refs: bool = False,
     ) -> "list[Dict[str, Any]]":
         """Per-row decode states with GROUPED prefill (VERDICT round-4
         missing #3: the server's continuous batching decoded in lockstep
@@ -1057,7 +1058,16 @@ class JaxEngine(GenerationBackend):
         ``decode_s`` already uses for the shared batch window. Grouped
         prefills do not populate the prompt-prefix cache (per-row slices
         of the shared cache would pin HBM per row; the solo path still
-        stores)."""
+        stores).
+
+        ``group_refs=True`` (the paged path): grouped rows carry a shared
+        ``st["group"]`` dict (the group's whole k/v caches, firsts,
+        presence and rng arrays) plus their index ``st["gi"]``, and the
+        per-row ``first``/``k_cache``/``v_cache``/``presence``/``rng``
+        slices are NOT created — each slice is a separate host→device
+        dispatch, and on a tunneled chip those RPCs (not their device
+        time) dominated paged batch assembly (docs/paged_trace.json).
+        The caller assembles rows with per-group gathers instead."""
         model = requests[0].model
         self.load_model(model)
         tf = self._models[model]
@@ -1163,6 +1173,13 @@ class JaxEngine(GenerationBackend):
                 presence = presence.at[jnp.arange(gb), firsts].set(True)
             jax.block_until_ready(firsts)
             t1 = time.monotonic()
+            shared = {
+                "k": k_cache,
+                "v": v_cache,
+                "first": firsts,
+                "presence": presence,
+                "rng": rngs,
+            }
             for gi, i in enumerate(idxs):
                 r = requests[i]
                 states[i] = {
@@ -1170,16 +1187,22 @@ class JaxEngine(GenerationBackend):
                     "tok": tok,
                     "s_real": len(all_prompt_ids[i]),
                     "g_bucket": _bucket(r.max_new_tokens, GEN_BUCKETS),
-                    "first": firsts[gi : gi + 1],
-                    "rng": rngs[gi],
-                    "k_cache": k_cache[:, gi : gi + 1],
-                    "v_cache": v_cache[:, gi : gi + 1],
-                    "presence": presence[gi : gi + 1],
                     "use_top_p": r.top_p < 1.0,
                     "use_rp": r.repeat_penalty != 1.0,
                     "t0": t0,
                     "t1": t1,
                 }
+                if group_refs:
+                    states[i]["group"] = shared
+                    states[i]["gi"] = gi
+                else:
+                    states[i].update(
+                        first=firsts[gi : gi + 1],
+                        rng=rngs[gi],
+                        k_cache=k_cache[:, gi : gi + 1],
+                        v_cache=v_cache[:, gi : gi + 1],
+                        presence=presence[gi : gi + 1],
+                    )
         return states  # type: ignore[return-value]
 
     def _finish(
@@ -1722,11 +1745,18 @@ class JaxEngine(GenerationBackend):
         row_budgets = [r.max_new_tokens - 1 for r in requests]
         # prefill needs only the prompt's own slots: decode writes go
         # to the pool (legacy) or the side caches (stacked). Grouped
-        # prefill: same-bucket prompts run as one padded forward.
+        # prefill: same-bucket prompts run as one padded forward, and
+        # group_refs hands back the group's stacked arrays instead of
+        # per-row slices — pool assembly below consumes them with ONE
+        # fused call per group (docs/paged_trace.json: the per-row
+        # slice/paginate chain's host dispatches, each an RPC through
+        # the relay, dominated the paged path's measured "decode" wall
+        # while its device time ran only ~1.2× contiguous).
         states = self._batch_states(
             requests,
             all_prompt_ids,
             [_prompt_alloc(len(ids)) for ids in all_prompt_ids],
+            group_refs=True,
         )
         rows_pages = [
             -(-st["s_real"] // page)
@@ -1738,11 +1768,19 @@ class JaxEngine(GenerationBackend):
         n = len(states)
         b_bucket = _bucket(n, BATCH_BUCKETS)
         pad_rows = b_bucket - n
+        fused_rows = [r for r, st in enumerate(states) if "group" in st]
         # padding rows enter pre-done and only ever re-write ONE frozen
         # slot with garbage, all at the same (page, slot) — ONE shared
         # private page covers every pad row (never aliasing a real row's
-        # pages, whose live caches garbage writes would corrupt)
-        total_pages = sum(rows_pages) + (1 if pad_rows else 0)
+        # pages, whose live caches garbage writes would corrupt). Fused
+        # groups additionally direct the bucket-tail chunks past each
+        # row's real prompt at one shared garbage page (group_chunks
+        # emits whole-bucket pages so the call stays a single reshape).
+        total_pages = (
+            sum(rows_pages)
+            + (1 if pad_rows else 0)
+            + (1 if fused_rows else 0)
+        )
         n_pages = pow2_at_least(total_pages, 4)
         jmax = pow2_at_least(max(rows_pages or [1]))
 
@@ -1762,19 +1800,61 @@ class JaxEngine(GenerationBackend):
             page_size=page,
             dtype=self.dtype,
         )
-        from .paged_kv import _paginate, scatter_pages
+        import numpy as np
 
-        table_rows = []
-        chunk_idx: "list[int]" = []
-        chunks_k, chunks_v = [], []
-        for st, need in zip(states, rows_pages):
+        from .paged_kv import _paginate, group_chunks, scatter_pages
+
+        # Per-row page allocation + the table, assembled host-side in
+        # numpy and shipped as ONE device array (was: one asarray per
+        # row + a stack — b_bucket+1 dispatches).
+        table_np = np.zeros((b_bucket, jmax), dtype=np.int32)
+        row_pages: "list[list[int]]" = []
+        for r, need in enumerate(rows_pages):
             pages = pool.alloc(need)
             # entries past `need` are never written (per-row budgets gate
             # the frozen slot inside the allocation) nor read unmasked
-            table_rows.append(jnp.asarray(pages + [0] * (jmax - need), jnp.int32))
+            row_pages.append(pages)
+            table_np[r, :need] = pages
+        garbage = pool.alloc(1)[0] if fused_rows else None
+        if pad_rows:
+            private = pool.alloc(1)[0]
+            table_np[n:, :] = private
+
+        # Page chunks: fused rows per group (one compiled group_chunks
+        # call each), fallback rows (solo prefills: multi-chunk prompts,
+        # prefix hits, singleton groups) through the per-row chain.
+        chunk_dest: "list[int]" = []
+        chunks_k, chunks_v = [], []
+        groups: "Dict[int, Tuple[Dict[str, Any], list[int]]]" = {}
+        for r in fused_rows:
+            shared = states[r]["group"]
+            groups.setdefault(id(shared), (shared, []))[1].append(r)
+        group_idx = {
+            gid: jnp.asarray(
+                [states[r]["gi"] for r in members], jnp.int32
+            )
+            for gid, (_, members) in groups.items()
+        }
+        for gid, (shared, members) in groups.items():
+            gi_idx = group_idx[gid]
+            ck, cv = group_chunks(
+                shared["k"], shared["v"], gi_idx, page, d_pool
+            )
+            chunks_k.append(ck)
+            chunks_v.append(cv)
+            tp = -(-shared["k"].shape[3] // page)
+            for r in members:
+                n_prompt_pages = -(-states[r]["s_real"] // page)
+                chunk_dest.extend(
+                    row_pages[r][j] if j < n_prompt_pages else garbage
+                    for j in range(tp)
+                )
+        for r, st in enumerate(states):
+            if "group" in st:
+                continue
             # [L,1,Hkv,T,D] → [L,Hkv,s_real,D] → page chunks
             n_prompt_pages = -(-st["s_real"] // page)
-            chunk_idx.extend(pages[:n_prompt_pages])
+            chunk_dest.extend(row_pages[r][:n_prompt_pages])
             ck = _paginate(st["k_cache"][:, 0], st["s_real"], page)
             cv = _paginate(st["v_cache"][:, 0], st["s_real"], page)
             if d_pool != cfg.d_head:  # stacked pools carry padded D
@@ -1783,28 +1863,73 @@ class JaxEngine(GenerationBackend):
                 cv = jnp.pad(cv, pad)
             chunks_k.append(ck)
             chunks_v.append(cv)
-        if pad_rows:
-            private = pool.alloc(1)[0]
-            for _ in range(pad_rows):
-                table_rows.append(jnp.full((jmax,), private, jnp.int32))
         # ONE scatter per pool for the whole batch (O(1) pool copies)
         pool.k, pool.v = scatter_pages(
             pool.k,
             pool.v,
-            jnp.asarray(chunk_idx, jnp.int32),
-            jnp.concatenate(chunks_k),
-            jnp.concatenate(chunks_v),
+            jnp.asarray(chunk_dest, jnp.int32),
+            chunks_k[0] if len(chunks_k) == 1 else jnp.concatenate(chunks_k),
+            chunks_v[0] if len(chunks_v) == 1 else jnp.concatenate(chunks_v),
         )
-        table = jnp.stack(table_rows)
+        table = jnp.asarray(table_np)
         pool.k, pool.v, table = self._place_pool(cfg, pool.k, pool.v, table)
-        rows = states + [states[0]] * pad_rows
 
         use_top_p = any(st["use_top_p"] for st in states)
         use_rp = any(st["use_rp"] for st in states)
-        first_tokens = jnp.concatenate([st["first"] for st in rows])
-        offsets = jnp.asarray([st["s_real"] for st in rows], dtype=jnp.int32)
-        presence = jnp.concatenate([st["presence"] for st in rows], axis=0)
-        rngs = jnp.stack([st["rng"] for st in rows])
+        # Row-state assembly (firsts / presence / rngs): per-group
+        # gathers + one permutation take, instead of per-row slices —
+        # same dispatch-count reasoning as the chunk assembly above.
+        solo_rows = [r for r, st in enumerate(states) if "group" not in st]
+        perm = np.zeros(b_bucket, dtype=np.int32)
+        first_parts, pres_parts, rng_parts = [], [], []
+        pos = 0
+        for gid, (shared, members) in groups.items():
+            gi_idx = group_idx[gid]
+            first_parts.append(shared["first"][gi_idx])
+            pres_parts.append(shared["presence"][gi_idx])
+            rng_parts.append(shared["rng"][gi_idx])
+            for j, r in enumerate(members):
+                perm[r] = pos + j
+            pos += len(members)
+        if solo_rows:
+            first_parts.append(
+                jnp.concatenate([states[r]["first"] for r in solo_rows])
+            )
+            pres_parts.append(
+                jnp.concatenate(
+                    [states[r]["presence"] for r in solo_rows], axis=0
+                )
+            )
+            rng_parts.append(
+                jnp.stack([states[r]["rng"] for r in solo_rows])
+            )
+            for j, r in enumerate(solo_rows):
+                perm[r] = pos + j
+        perm[n:] = perm[0]  # pad rows replicate row 0 (they enter done)
+        perm_j = jnp.asarray(perm)
+
+        def _take_rows(parts):
+            cat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            return cat[perm_j]
+
+        first_tokens = _take_rows(first_parts)
+        presence = _take_rows(pres_parts)
+        rngs = _take_rows(rng_parts)
+        # The group caches ([L, gb, Hkv, cache_len, D], bucket-padded) are
+        # consumed — everything below reads the assembled arrays. Drop
+        # the references so HBM frees before the decode loop allocates
+        # its side caches (the queued chunk/gather executions hold their
+        # own buffer refs until they retire).
+        for st in states:
+            st.pop("group", None)
+        groups.clear()
+        group_idx.clear()
+        shared = members = gi_idx = None  # loop vars pin the last group
+        offsets = jnp.asarray(
+            [st["s_real"] for st in states]
+            + [states[0]["s_real"]] * pad_rows,
+            dtype=jnp.int32,
+        )
         temps = jnp.asarray(
             [r.temperature for r in requests]
             + [requests[0].temperature] * pad_rows,

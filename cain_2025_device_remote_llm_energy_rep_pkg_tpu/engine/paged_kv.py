@@ -24,6 +24,7 @@ and small enough that the worst-case padding per request is < 1 MiB on
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import List, Tuple
 
 import jax
@@ -145,6 +146,51 @@ def _paginate(seq: jnp.ndarray, s_real: int, page_size: int) -> jnp.ndarray:
     l, hkv, _, d = seq.shape
     # [L, Hkv, n·page, D] → [n, L, Hkv, page, D]
     return seq.reshape(l, hkv, n_pages, page_size, d).transpose(2, 0, 1, 3, 4)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "d_pool"))
+def group_chunks(
+    k_cache: jnp.ndarray,  # [L, G, Hkv, T, D] — a grouped-prefill cache
+    v_cache: jnp.ndarray,
+    rows: jnp.ndarray,  # [R] int32 — group-member indices to paginate
+    page_size: int,
+    d_pool: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Page chunks for R rows of a grouped-prefill cache, in ONE compiled
+    call: [L,G,Hkv,T,D] → ([R·Tp, L, Hkv, page, d_pool] ×2), row-major in
+    (row, page) order with Tp = ceil(T / page).
+
+    This replaces the per-row slice → :func:`_paginate` (slice, pad,
+    reshape, transpose) → head-dim pad chain of batch-pool assembly. The
+    chain's arithmetic was never the cost — its ~8 host dispatches per
+    row were: each tiny op is a separate RPC on a tunneled TPU, and the
+    op-level device trace (docs/paged_trace.json) showed ~800 such
+    dispatches draining INSIDE the decode wall-clock window while the
+    decode loop itself ran only ~1.2× the contiguous loop's device time.
+
+    Chunk positions beyond a row's real prompt length carry whatever the
+    prefill wrote at padded positions. Callers direct every such chunk at
+    a single garbage page (never a row's live pages) and attention masks
+    by real lengths, so the junk is never read.
+    """
+    l, g, hkv, t, d = k_cache.shape
+    tp = -(-t // page_size)
+    r = rows.shape[0]
+
+    def prep(c):
+        c = c[:, rows]  # [L,R,Hkv,T,D]
+        pad_t, pad_d = tp * page_size - t, d_pool - d
+        if pad_t or pad_d:
+            c = jnp.pad(
+                c, ((0, 0), (0, 0), (0, 0), (0, pad_t), (0, pad_d))
+            )
+        c = c.reshape(l, r, hkv, tp, page_size, d_pool)
+        # → [R, Tp, L, Hkv, page, Dp] → [R·Tp, L, Hkv, page, Dp]
+        return c.transpose(1, 3, 0, 2, 4, 5).reshape(
+            r * tp, l, hkv, page_size, d_pool
+        )
+
+    return prep(k_cache), prep(v_cache)
 
 
 def scatter_pages(

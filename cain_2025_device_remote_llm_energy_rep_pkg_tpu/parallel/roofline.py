@@ -124,11 +124,14 @@ def modeled_tp_decode_step_s(
         # KV-sharded body compiles gather-free, so both terms exist only
         # in this regime. (The lowerings also carry 2–4 single-hop
         # collective-permutes of ~32-element payloads — an order below
-        # the ring collectives' floor; not modelled.)
+        # the ring collectives' floor; not modelled.) The gathered
+        # payload is cache-slice bytes, so it shrinks with an int8 KV
+        # cache exactly as the HBM term does.
+        kv_elem_bytes = 1 if kv_quantize == "int8" else 2
         t_ici += cfg.n_layers * allgather_cost_s(
-            context_len * cfg.d_head * 2, n_chips
+            context_len * cfg.d_head * kv_elem_bytes, n_chips
         )
-        t_ici += 4 * allgather_cost_s(cfg.d_head * 2, n_chips)
+        t_ici += 4 * allgather_cost_s(cfg.d_head * kv_elem_bytes, n_chips)
     return t_mem + t_ici
 
 

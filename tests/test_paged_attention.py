@@ -474,3 +474,103 @@ def test_paged_kernel_gating_follows_auto_policy():
         registry=dict(registry), paged_kv=True, decode_attention=None
     )
     assert none_._paged_decode_attention() is None  # explicit XLA-fused
+
+
+def test_group_chunks_matches_per_row_paginate():
+    """The fused assembly call emits, for each selected row, exactly the
+    chunks the per-row `_paginate` chain produced — including tail-page
+    zero padding and the stacked pool's lane-padded head dim. One
+    compiled call per group replaced ~8 host dispatches per row: on a
+    tunneled chip those RPCs, not their device time, dominated paged
+    batch assembly (docs/paged_trace.json)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.paged_kv import (
+        _paginate,
+        group_chunks,
+    )
+
+    l, g, hkv, t, d, page = 2, 4, 2, 192, 64, 128
+    kk, kv = jax.random.split(jax.random.PRNGKey(7))
+    k = jax.random.normal(kk, (l, g, hkv, t, d), jnp.float32)
+    v = jax.random.normal(kv, (l, g, hkv, t, d), jnp.float32)
+    rows = jnp.asarray([2, 0, 3], jnp.int32)
+    tp = -(-t // page)
+
+    ck, cv = group_chunks(k, v, rows, page, d)
+    assert ck.shape == (len(rows) * tp, l, hkv, page, d)
+    for out_i, gi in enumerate([2, 0, 3]):
+        np.testing.assert_array_equal(
+            np.asarray(ck[out_i * tp : (out_i + 1) * tp]),
+            np.asarray(_paginate(k[:, gi], t, page)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cv[out_i * tp : (out_i + 1) * tp]),
+            np.asarray(_paginate(v[:, gi], t, page)),
+        )
+
+    # stacked pools carry a lane-padded head dim (phi3: 96 → 128)
+    ck_p, _ = group_chunks(k, v, rows, page, 96)
+    assert ck_p.shape[-1] == 96
+    np.testing.assert_array_equal(np.asarray(ck_p[..., :d]), np.asarray(ck))
+    assert not np.asarray(ck_p[..., d:]).any()
+
+
+def test_paged_batch_fused_assembly_with_mixed_groups_and_solo_rows():
+    """A paged batch mixing a fused prefill group with a solo fallback
+    row takes exactly one group_chunks call per multi-row group, and
+    every row's tokens still match its solo generate() — covering the
+    permutation that reorders per-group gathers back to row order."""
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.paged_kv as pkv
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+        _prompt_alloc,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    engine = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, paged_kv=True
+    )
+    reqs = [
+        GenerationRequest("tiny", "short row one", max_new_tokens=6),
+        GenerationRequest(
+            "tiny",
+            # long enough for a larger prompt bucket than the short rows
+            # (→ prefills solo), short enough for tiny's max_seq_len
+            "solo " * 8,  # byte-level tiny tokenizer: 40 tokens → bucket 64
+            max_new_tokens=8,
+        ),
+        GenerationRequest(
+            "tiny", "short row two", max_new_tokens=10,
+            temperature=0.6, seed=11,
+        ),
+    ]
+    tok = engine._tokenizer_for("tiny")
+    allocs = [_prompt_alloc(len(tok.encode(r.prompt))) for r in reqs]
+    multi_groups = {
+        a for a in set(allocs) if allocs.count(a) > 1
+    }
+    assert multi_groups and len(set(allocs)) > 1, (
+        "test prompts must produce at least one multi-row group AND a "
+        f"solo row; got allocs {allocs}"
+    )
+
+    calls = []
+    real = pkv.group_chunks
+
+    def spy(*args, **kwargs):
+        calls.append(args[2].shape[0])
+        return real(*args, **kwargs)
+
+    pkv.group_chunks = spy
+    try:
+        batch = engine.generate_batch(reqs)
+    finally:
+        pkv.group_chunks = real
+    assert len(calls) == len(multi_groups)
+    for r, req in zip(batch, reqs):
+        assert r.tokens == engine.generate(req).tokens
