@@ -19,6 +19,7 @@ reference can only clock the whole curl subprocess.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -43,7 +44,24 @@ from .backend import (
 
 PROMPT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048)
 GEN_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
-BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)  # generate_batch rows pad up to these
+# generate_batch rows pad up to these. Decode is HBM-bound, so aggregate
+# throughput scales near-linearly with rows until the MXU saturates (the
+# round-4 sweep measured 26.7k agg tok/s at 128 rows, 50.4k at 256 —
+# docs/PERF.md); what bounds a sub-batch is KV-cache MEMORY, not a fixed
+# row count, so generate_batch picks the widest bucket whose estimated
+# cache fits BATCH_KV_BUDGET_BYTES instead of hard-capping at 32.
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+# Budget for one sub-batch's K+V caches (the dominant per-row memory).
+# Default 2.5 GB: under the ~5 GiB device program budget next to the
+# flagship's 1.55 GB weight stream, and sized so the bench shapes
+# (cache_len 320-ish) run 128 rows in ONE decode loop while a
+# max-context fleet still splits to the round-3-era widths.
+BATCH_KV_BUDGET_BYTES = int(
+    os.environ.get("BATCH_KV_BUDGET_BYTES", 2_500_000_000)
+)
+# Never split below this width whatever the estimate says — the old hard
+# cap, known-safe at max context on the flagship.
+BATCH_MIN_SPLIT_ROWS = 32
 DEFAULT_STREAM_CHUNK = 32  # decode steps per streamed chunk
 
 
@@ -1205,6 +1223,121 @@ class JaxEngine(GenerationBackend):
                     )
         return states  # type: ignore[return-value]
 
+    @staticmethod
+    def _row_field_specs(
+        states: "list[Dict[str, Any]]",
+    ) -> "list[Tuple[str, str, int, Callable]]":
+        """The (first / presence / rng) :meth:`_assemble_rows` specs
+        shared by both batch paths — defined once so the paged and
+        contiguous row assemblies cannot drift; the contiguous path
+        extends the list with its cache fields."""
+        return [
+            (
+                "first", "first", 0,
+                lambda rows: jnp.concatenate(
+                    [states[r]["first"] for r in rows]
+                ),
+            ),
+            (
+                "presence", "presence", 0,
+                lambda rows: jnp.concatenate(
+                    [states[r]["presence"] for r in rows], axis=0
+                ),
+            ),
+            (
+                "rng", "rng", 0,
+                lambda rows: jnp.stack(
+                    [states[r]["rng"] for r in rows]
+                ),
+            ),
+        ]
+
+    def _assemble_rows(
+        self,
+        states: "list[Dict[str, Any]]",
+        b_bucket: int,
+        fields: "list[Tuple[str, str, int, Callable]]",
+    ) -> "Dict[str, Any]":
+        """Assemble per-row batch arrays from grouped-prefill refs: ONE
+        gather per group per field plus one permutation take, instead of
+        per-row slices — each slice is a separate host→device RPC on a
+        tunneled chip, and those dispatches (not their device time)
+        drain inside the decode wall-clock window
+        (docs/paged_trace.json; the paged path measured 2.4× slower
+        from this alone, the contiguous path the same disease at 128
+        rows).
+
+        ``fields`` entries are ``(out_name, group_field_key, axis,
+        solo_builder)``: the group arrays gather along ``axis``; rows
+        from solo-prefilled states (no ``st["group"]``) come from
+        ``solo_builder(solo_row_indices)``. Padding rows (`b_bucket` −
+        len(states)) replicate row 0, which enters decode pre-done.
+
+        Returns the assembled fields plus ``_groups`` / ``_group_idx``
+        (the paged chunk loop reuses them). Callers pop ``st["group"]``
+        when done with the group arrays so the bucket-padded prefill
+        caches free before the decode loop allocates."""
+        import numpy as np
+
+        n = len(states)
+        groups: "Dict[int, Tuple[Dict[str, Any], list[int]]]" = {}
+        for r, st in enumerate(states):
+            if "group" in st:
+                groups.setdefault(
+                    id(st["group"]), (st["group"], [])
+                )[1].append(r)
+        group_idx = {
+            gid: jnp.asarray(
+                [states[r]["gi"] for r in members], jnp.int32
+            )
+            for gid, (_, members) in groups.items()
+        }
+        solo_rows = [r for r, st in enumerate(states) if "group" not in st]
+        perm = np.zeros(b_bucket, dtype=np.int32)
+        pos = 0
+        for _, members in groups.values():
+            for j, r in enumerate(members):
+                perm[r] = pos + j
+            pos += len(members)
+        for j, r in enumerate(solo_rows):
+            perm[r] = pos + j
+        perm[n:] = perm[0]  # pad rows replicate row 0
+        perm_j = jnp.asarray(perm)
+
+        gi_lists = {
+            gid: [states[r]["gi"] for r in members]
+            for gid, (_, members) in groups.items()
+        }
+        perm_identity = bool(np.array_equal(perm, np.arange(b_bucket)))
+
+        out: "Dict[str, Any]" = {
+            "_groups": groups,
+            "_group_idx": group_idx,
+        }
+        for name, key, axis, solo_builder in fields:
+            parts = []
+            for gid, (shared, _) in groups.items():
+                arr = shared[key]
+                # identity gather (members are the whole group in order,
+                # the common all-rows-one-group case) → no device copy
+                if gi_lists[gid] == list(range(arr.shape[axis])):
+                    parts.append(arr)
+                else:
+                    parts.append(jnp.take(arr, group_idx[gid], axis=axis))
+            if solo_rows:
+                parts.append(solo_builder(solo_rows))
+            cat = (
+                parts[0]
+                if len(parts) == 1
+                else jnp.concatenate(parts, axis=axis)
+            )
+            out[name] = (
+                cat
+                if perm_identity and cat.shape[axis] == b_bucket
+                else jnp.take(cat, perm_j, axis=axis)
+            )
+        return out
+
     def _finish(
         self,
         request: GenerationRequest,
@@ -1820,21 +1953,19 @@ class JaxEngine(GenerationBackend):
             private = pool.alloc(1)[0]
             table_np[n:, :] = private
 
+        # Row-state assembly (firsts / presence / rngs): per-group
+        # gathers + one permutation take, instead of per-row slices —
+        # the dispatch-count surgery shared with the contiguous path.
+        asm = self._assemble_rows(
+            states, b_bucket, self._row_field_specs(states)
+        )
+        groups, group_idx = asm["_groups"], asm["_group_idx"]
+
         # Page chunks: fused rows per group (one compiled group_chunks
         # call each), fallback rows (solo prefills: multi-chunk prompts,
         # prefix hits, singleton groups) through the per-row chain.
         chunk_dest: "list[int]" = []
         chunks_k, chunks_v = [], []
-        groups: "Dict[int, Tuple[Dict[str, Any], list[int]]]" = {}
-        for r in fused_rows:
-            shared = states[r]["group"]
-            groups.setdefault(id(shared), (shared, []))[1].append(r)
-        group_idx = {
-            gid: jnp.asarray(
-                [states[r]["gi"] for r in members], jnp.int32
-            )
-            for gid, (_, members) in groups.items()
-        }
         for gid, (shared, members) in groups.items():
             gi_idx = group_idx[gid]
             ck, cv = group_chunks(
@@ -1876,45 +2007,9 @@ class JaxEngine(GenerationBackend):
 
         use_top_p = any(st["use_top_p"] for st in states)
         use_rp = any(st["use_rp"] for st in states)
-        # Row-state assembly (firsts / presence / rngs): per-group
-        # gathers + one permutation take, instead of per-row slices —
-        # same dispatch-count reasoning as the chunk assembly above.
-        solo_rows = [r for r, st in enumerate(states) if "group" not in st]
-        perm = np.zeros(b_bucket, dtype=np.int32)
-        first_parts, pres_parts, rng_parts = [], [], []
-        pos = 0
-        for gid, (shared, members) in groups.items():
-            gi_idx = group_idx[gid]
-            first_parts.append(shared["first"][gi_idx])
-            pres_parts.append(shared["presence"][gi_idx])
-            rng_parts.append(shared["rng"][gi_idx])
-            for j, r in enumerate(members):
-                perm[r] = pos + j
-            pos += len(members)
-        if solo_rows:
-            first_parts.append(
-                jnp.concatenate([states[r]["first"] for r in solo_rows])
-            )
-            pres_parts.append(
-                jnp.concatenate(
-                    [states[r]["presence"] for r in solo_rows], axis=0
-                )
-            )
-            rng_parts.append(
-                jnp.stack([states[r]["rng"] for r in solo_rows])
-            )
-            for j, r in enumerate(solo_rows):
-                perm[r] = pos + j
-        perm[n:] = perm[0]  # pad rows replicate row 0 (they enter done)
-        perm_j = jnp.asarray(perm)
-
-        def _take_rows(parts):
-            cat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-            return cat[perm_j]
-
-        first_tokens = _take_rows(first_parts)
-        presence = _take_rows(pres_parts)
-        rngs = _take_rows(rng_parts)
+        first_tokens = asm["first"]
+        presence = asm["presence"]
+        rngs = asm["rng"]
         # The group caches ([L, gb, Hkv, cache_len, D], bucket-padded) are
         # consumed — everything below reads the assembled arrays. Drop
         # the references so HBM frees before the decode loop allocates
@@ -1924,7 +2019,7 @@ class JaxEngine(GenerationBackend):
             st.pop("group", None)
         groups.clear()
         group_idx.clear()
-        shared = members = gi_idx = None  # loop vars pin the last group
+        asm = shared = members = gi_idx = None  # loop vars pin the last group
         offsets = jnp.asarray(
             [st["s_real"] for st in states]
             + [states[0]["s_real"]] * pad_rows,
@@ -2008,6 +2103,53 @@ class JaxEngine(GenerationBackend):
             )
         return results
 
+    def _max_batch_rows(
+        self,
+        cfg: ModelConfig,
+        requests: "list[GenerationRequest]",
+        all_prompt_ids: "list[list[int]]",
+    ) -> int:
+        """Widest batch bucket whose estimated K+V cache fits
+        BATCH_KV_BUDGET_BYTES (floor: BATCH_MIN_SPLIT_ROWS, the old hard
+        cap, known-safe at max context). Decode throughput scales with
+        rows until the MXU saturates (docs/PERF.md batch sweep), so the
+        right sub-batch width is a memory decision, not a constant: the
+        bench's 128 short-prompt rows run as ONE decode loop (~4× the
+        aggregate of four sequential 32-row loops' wall), while a fleet
+        of max-context requests still splits to the known-safe width.
+
+        The contiguous estimate is the batch cache shape — widest prompt
+        bucket + widest generation bucket at the engine dtype. The paged
+        path can exceed that shape (pow2 page-count rounding can double
+        the pool; the stacked pool lane-pads d_head to 128; side caches
+        add g_bucket columns), so paged engines bill a per-token factor
+        of ``2·d_pool + d_head`` — an upper bound on pool + sides per
+        (layer, head, token) in every mode."""
+        s_bucket = max(
+            _prompt_alloc(len(ids)) for ids in all_prompt_ids
+        )
+        g_bucket = _bucket(
+            max(r.max_new_tokens for r in requests), GEN_BUCKETS
+        )
+        if self.paged_kv:
+            d_pool = -(-cfg.d_head // 128) * 128
+            per_token = 2 * d_pool + cfg.d_head
+        else:
+            per_token = cfg.d_head
+        bytes_per_row = (
+            2  # K and V
+            * cfg.n_layers
+            * cfg.n_kv_heads
+            * (s_bucket + g_bucket)
+            * per_token
+            * jnp.dtype(self.dtype).itemsize
+        )
+        max_rows = BATCH_MIN_SPLIT_ROWS
+        for b in BATCH_BUCKETS:
+            if b > max_rows and b * bytes_per_row <= BATCH_KV_BUDGET_BYTES:
+                max_rows = b
+        return max_rows
+
     def generate_batch(
         self, requests: "list[GenerationRequest]"
     ) -> "list[GenerationResult]":
@@ -2034,14 +2176,6 @@ class JaxEngine(GenerationBackend):
         """
         if not requests:
             return []
-        max_rows = BATCH_BUCKETS[-1]
-        if len(requests) > max_rows:
-            # Larger fleets run as sequential full-width batches rather than
-            # blowing past the widest compiled shape.
-            results = []
-            for i in range(0, len(requests), max_rows):
-                results.extend(self.generate_batch(requests[i : i + max_rows]))
-            return results
         models = {r.model for r in requests}
         if len(models) > 1:
             raise ValueError(f"one model per batch, got {sorted(models)}")
@@ -2054,6 +2188,32 @@ class JaxEngine(GenerationBackend):
 
         tok = self._tokenizer_for(model)
         all_prompt_ids = [tok.encode(r.prompt) for r in requests]
+        max_rows = self._max_batch_rows(cfg, requests, all_prompt_ids)
+        if len(requests) > max_rows:
+            # Larger fleets run as sequential full-width batches rather
+            # than blowing past the memory-bounded shape. Prompts are
+            # tokenized exactly once — the chunks reuse the id slices.
+            results = []
+            for i in range(0, len(requests), max_rows):
+                results.extend(
+                    self._generate_batch_chunk(
+                        requests[i : i + max_rows],
+                        all_prompt_ids[i : i + max_rows],
+                    )
+                )
+            return results
+        return self._generate_batch_chunk(requests, all_prompt_ids)
+
+    def _generate_batch_chunk(
+        self,
+        requests: "list[GenerationRequest]",
+        all_prompt_ids: "list[list[int]]",
+    ) -> "list[GenerationResult]":
+        """One memory-bounded sub-batch of :meth:`generate_batch`
+        (already validated; prompts already tokenized)."""
+        model, top_k = requests[0].model, requests[0].top_k
+        cfg = self._models[model].cfg
+        tok = self._tokenizer_for(model)
         if self.paged_kv:
             for r, ids in zip(requests, all_prompt_ids):
                 if len(ids) + r.max_new_tokens > cfg.max_seq_len:
@@ -2076,25 +2236,60 @@ class JaxEngine(GenerationBackend):
             )
 
         states = self._batch_states(
-            requests, all_prompt_ids, [cache_len] * len(requests)
+            requests,
+            all_prompt_ids,
+            [cache_len] * len(requests),
+            group_refs=True,
         )
         n = len(states)
         b_bucket = _bucket(n, BATCH_BUCKETS)
         use_top_p = any(st["use_top_p"] for st in states)
         use_rp = any(st["use_rp"] for st in states)
-        # Pad to the batch bucket with copies of row 0 that enter pre-done.
-        rows = states + [states[0]] * (b_bucket - n)
-
-        first_tokens = jnp.concatenate([st["first"] for st in rows])
-        offsets = jnp.asarray([st["s_real"] for st in rows], dtype=jnp.int32)
-        k_cache = jnp.concatenate([st["k_cache"] for st in rows], axis=1)
-        v_cache = jnp.concatenate([st["v_cache"] for st in rows], axis=1)
+        # Grouped rows assemble by per-group gather + permutation take
+        # (st["group"] refs) instead of per-row slices: at 128 rows the
+        # slice-and-concat chain's ~260 host dispatches drained inside
+        # the decode window through the relay, measured 8.6k agg tok/s
+        # vs ~20k+ (the same disease _generate_batch_paged had,
+        # docs/paged_trace.json). Padding rows replicate row 0 and enter
+        # pre-done.
+        asm = self._assemble_rows(
+            states,
+            b_bucket,
+            self._row_field_specs(states)
+            + [
+                (
+                    "k", "k", 1,
+                    lambda rows: jnp.concatenate(
+                        [states[r]["k_cache"] for r in rows], axis=1
+                    ),
+                ),
+                (
+                    "v", "v", 1,
+                    lambda rows: jnp.concatenate(
+                        [states[r]["v_cache"] for r in rows], axis=1
+                    ),
+                ),
+            ],
+        )
+        first_tokens = asm["first"]
+        presence = asm["presence"]
+        rngs = asm["rng"]
+        k_cache = asm["k"]
+        v_cache = asm["v"]
+        # group caches are consumed; free the bucket-padded prefill
+        # arrays before the decode loop allocates (see _assemble_rows)
+        for st in states:
+            st.pop("group", None)
+        asm = None
         if self.kv_quantize:
             k_cache, v_cache = self._quantize_batch_cache(
                 model, k_cache, v_cache
             )
-        presence = jnp.concatenate([st["presence"] for st in rows], axis=0)
-        rngs = jnp.stack([st["rng"] for st in rows])
+        offsets = jnp.asarray(
+            [st["s_real"] for st in states]
+            + [states[0]["s_real"]] * (b_bucket - n),
+            dtype=jnp.int32,
+        )
         temps = jnp.asarray(
             [r.temperature for r in requests]
             + [requests[0].temperature] * (b_bucket - n),

@@ -54,7 +54,15 @@ DecodeAttentionFn = Callable[
 
 
 STACKED_PAGED_KEYS = frozenset(
-    {"pool", "table", "layer", "side", "write_pos", "prompt_lens"}
+    {
+        "pool",
+        "table",
+        "layer",
+        "side",
+        "side_layer",
+        "write_pos",
+        "prompt_lens",
+    }
 )
 
 
@@ -76,6 +84,20 @@ def is_paged_cache(leaf: Any) -> bool:
     return keys == {"pool", "table"} or (
         {"pool", "table", "side"} <= keys <= STACKED_PAGED_KEYS
     )
+
+
+def is_carry_cache(leaf: Any) -> bool:
+    """A carry-resident KV-cache leaf: ``{"all": [L,B,Hkv,T,D], "layer":
+    l}`` — the WHOLE stacked cache rides the decode loop's carry and each
+    layer writes only its token's row in place at ``[layer, rows, :,
+    offset]``. Used by batched single-token decode: the alternative
+    (caches as layer-scan xs AND ys) makes XLA write back the full
+    per-layer cache every layer every step — measured 2.2 ms/step /
+    1.4 GB/step of pure copy at 128 rows for a 64 KB actual update
+    (docs/paged_trace_128rows.json), the dominant batch-scaling cost.
+    The per-layer READ stays (attention consumes the whole slice); only
+    the write-back copies go."""
+    return isinstance(leaf, dict) and set(leaf) == {"all", "layer"}
 
 
 def _gather_paged(leaf, dtype=jnp.float32) -> jnp.ndarray:
@@ -228,16 +250,23 @@ def _attention_block(
     hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.d_head
     quant_cache = is_quantized_cache(k_cache)
     paged_cache = is_paged_cache(k_cache)
+    carry_cache = is_carry_cache(k_cache)
     if paged_cache:
         # pool is [P,Hkv,page,D] (per-layer) or [L,P,Hkv,page,Dp]
         # (stacked): the page dim is [-2] in both
         t = k_cache["table"].shape[1] * k_cache["pool"].shape[-2]
+    elif carry_cache:
+        t = k_cache["all"].shape[3]
     else:
         t = (k_cache["q"] if quant_cache else k_cache).shape[2]
     per_seq = jnp.ndim(offset) == 1  # batched decode: one offset per sequence
     if per_seq and s != 1:
         raise ValueError(
             "per-sequence offsets are only supported for single-token decode"
+        )
+    if carry_cache and not (per_seq and s == 1):
+        raise ValueError(
+            "carry-resident caches support batched single-token decode only"
         )
     if quant_cache and s != 1:
         raise ValueError(
@@ -277,21 +306,40 @@ def _attention_block(
             # the cheap arange-rows write the contiguous batched path
             # uses. (Both pool-write alternatives measured a full pool
             # copy on real hardware: per-STEP via scan ys, per-LAYER via
-            # a traced-layer scatter — docs/PERF.md.)
+            # a traced-layer scatter — docs/PERF.md.) With "side_layer"
+            # the side is the whole [L,B,Hkv,Tgen,D] stack riding the
+            # decode carry (is_carry_cache rationale: scan ys wrote back
+            # the full per-layer side every layer), and only this
+            # token's row is written at [layer, row, :, wp].
             rows = jnp.arange(b)
             wp = k_cache["write_pos"]  # [B]
-            k_cache = {
-                **k_cache,
-                "side": k_cache["side"]
-                .at[rows, :, wp]
-                .set(k[:, 0].astype(k_cache["side"].dtype)),
-            }
-            v_cache = {
-                **v_cache,
-                "side": v_cache["side"]
-                .at[rows, :, wp]
-                .set(v[:, 0].astype(v_cache["side"].dtype)),
-            }
+            if "side_layer" in k_cache:
+                sli = k_cache["side_layer"]
+                k_cache = {
+                    **k_cache,
+                    "side": k_cache["side"]
+                    .at[sli, rows, :, wp]
+                    .set(k[:, 0].astype(k_cache["side"].dtype)),
+                }
+                v_cache = {
+                    **v_cache,
+                    "side": v_cache["side"]
+                    .at[sli, rows, :, wp]
+                    .set(v[:, 0].astype(v_cache["side"].dtype)),
+                }
+            else:
+                k_cache = {
+                    **k_cache,
+                    "side": k_cache["side"]
+                    .at[rows, :, wp]
+                    .set(k[:, 0].astype(k_cache["side"].dtype)),
+                }
+                v_cache = {
+                    **v_cache,
+                    "side": v_cache["side"]
+                    .at[rows, :, wp]
+                    .set(v[:, 0].astype(v_cache["side"].dtype)),
+                }
         else:
             from ..engine.paged_kv import page_slot
 
@@ -345,6 +393,24 @@ def _attention_block(
                     v_cache["s"], vs[:, :, None], (0, 0, offset)
                 ),
             }
+    elif carry_cache:
+        # One tiny in-place write into the stacked carry at [layer, row,
+        # :, offset] — the whole point of the carry-resident design (no
+        # per-layer write-back of the untouched 25 MB slice).
+        li = k_cache["layer"]
+        rows = jnp.arange(b)
+        k_cache = {
+            "layer": li,
+            "all": k_cache["all"]
+            .at[li, rows, :, offset]
+            .set(k[:, 0].astype(k_cache["all"].dtype)),
+        }
+        v_cache = {
+            "layer": li,
+            "all": v_cache["all"]
+            .at[li, rows, :, offset]
+            .set(v[:, 0].astype(v_cache["all"].dtype)),
+        }
     elif per_seq:
         # Each sequence writes its token's K/V at its own cache position.
         k_cache = k_cache.at[jnp.arange(b), :, offset].set(
@@ -362,6 +428,18 @@ def _attention_block(
         )
 
     scale = 1.0 / math.sqrt(dh)
+    # Attention reads: carry-resident caches attend over their layer's
+    # slice of the stacked carry (the read is inherent — attention
+    # consumes the whole slice; only the write-back was waste).
+    if carry_cache:
+        k_att = jax.lax.dynamic_index_in_dim(
+            k_cache["all"], k_cache["layer"], 0, keepdims=False
+        )
+        v_att = jax.lax.dynamic_index_in_dim(
+            v_cache["all"], v_cache["layer"], 0, keepdims=False
+        )
+    else:
+        k_att, v_att = k_cache, v_cache
     if (
         s == 1
         and decode_attention is not None
@@ -380,8 +458,16 @@ def _attention_block(
         )
         wp = k_cache["write_pos"]
         qg = q[:, 0].reshape(b, hkv, group, dh).astype(jnp.float32)
-        ks = k_cache["side"].astype(jnp.float32)  # [B,Hkv,Tgen,D]
-        vs = v_cache["side"].astype(jnp.float32)
+        if "side_layer" in k_cache:  # carry-resident: this layer's slice
+            ks = jax.lax.dynamic_index_in_dim(
+                k_cache["side"], k_cache["side_layer"], 0, keepdims=False
+            ).astype(jnp.float32)
+            vs = jax.lax.dynamic_index_in_dim(
+                v_cache["side"], v_cache["side_layer"], 0, keepdims=False
+            ).astype(jnp.float32)
+        else:
+            ks = k_cache["side"].astype(jnp.float32)  # [B,Hkv,Tgen,D]
+            vs = v_cache["side"].astype(jnp.float32)
         s2 = jnp.einsum("bkgd,bktd->bkgt", qg, ks) * scale
         tpos = jnp.arange(ks.shape[2])
         s2 = jnp.where(
@@ -400,10 +486,10 @@ def _attention_block(
         out = out.reshape(b, 1, hq, dh).astype(x.dtype)
     elif s == 1 and decode_attention is not None:
         lengths = jnp.broadcast_to(offset + 1, (b,)).astype(jnp.int32)
-        out = decode_attention(q[:, 0], k_cache, v_cache, lengths)  # [B,Hq,Dh]
+        out = decode_attention(q[:, 0], k_att, v_att, lengths)  # [B,Hq,Dh]
         out = out[:, None]  # [B,1,Hq,Dh]
     elif s > 1 and prefill_attention is not None:
-        out = prefill_attention(q, k_cache, v_cache, offset)  # [B,S,Hq,Dh]
+        out = prefill_attention(q, k_att, v_att, offset)  # [B,S,Hq,Dh]
     else:
         group = hq // hkv
         qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)
@@ -412,14 +498,14 @@ def _attention_block(
             vf = _gather_paged(v_cache)
         else:
             kf = (
-                dequant_cache(k_cache)
+                dequant_cache(k_att)
                 if quant_cache
-                else k_cache.astype(jnp.float32)
+                else k_att.astype(jnp.float32)
             )
             vf = (
-                dequant_cache(v_cache)
+                dequant_cache(v_att)
                 if quant_cache
-                else v_cache.astype(jnp.float32)
+                else v_att.astype(jnp.float32)
             )
         scores = jnp.einsum("bskgd,bktd->bkgst", qg, kf) * scale
         kpos = jnp.arange(t)
@@ -537,31 +623,38 @@ def run_blocks(
         plens = k_cache["prompt_lens"]
 
         def block_paged(carry, scanned):
-            x = carry
-            layer, kp_l, vp_l, ks, vs = scanned
+            x, ks_all, vs_all = carry
+            layer, kp_l, vp_l, li = scanned
             kc = {
                 "pool": kp_l, "table": table,
-                "side": ks, "write_pos": wp, "prompt_lens": plens,
+                "side": ks_all, "side_layer": li,
+                "write_pos": wp, "prompt_lens": plens,
             }
             vc = {
                 "pool": vp_l, "table": table,
-                "side": vs, "write_pos": wp, "prompt_lens": plens,
+                "side": vs_all, "side_layer": li,
+                "write_pos": wp, "prompt_lens": plens,
             }
             x, kc, vc = _layer_step(x, layer, kc, vc)
-            return x, (kc["side"], vc["side"])
+            return (x, kc["side"], vc["side"]), None
 
         # pools ride scan xs WITHOUT ys: read-only per-layer slices that
         # XLA streams/pipelines like the weights — no copy-back, and no
-        # traced-layer dynamic indexing to defeat the scan's schedule
-        x, (new_ks, new_vs) = jax.lax.scan(
+        # traced-layer dynamic indexing to defeat the scan's schedule.
+        # The SIDE caches ride the CARRY as the whole [L,B,Hkv,Tgen,D]
+        # stack with per-layer in-place token writes (side_layer) — as
+        # xs AND ys, XLA wrote back the full per-layer side every layer
+        # (1.5 ms/step at 128 rows, docs/paged_trace_128rows.json), the
+        # same copy tax the contiguous path's carry-resident cache
+        # removed.
+        (x, new_ks, new_vs), _ = jax.lax.scan(
             block_paged,
-            x,
+            (x, k_cache["side"], v_cache["side"]),
             (
                 stacked,
                 k_cache["pool"],
                 v_cache["pool"],
-                k_cache["side"],
-                v_cache["side"],
+                jnp.arange(k_cache["pool"].shape[0]),
             ),
         )
         return (
@@ -569,6 +662,37 @@ def run_blocks(
             {**k_cache, "side": new_ks},
             {**v_cache, "side": new_vs},
         )
+
+    if (
+        isinstance(k_cache, jnp.ndarray)
+        and x.shape[1] == 1
+        and jnp.ndim(offset) == 1
+    ):
+        # Batched single-token decode over plain stacked caches: the
+        # caches ride the scan CARRY and each layer writes only its
+        # token's row in place (is_carry_cache). Scanning them as
+        # xs AND ys instead makes XLA write back the full per-layer
+        # cache every layer — 1.4 GB/step of copy for a 64 KB update
+        # at 128 rows, the dominant wide-batch cost
+        # (docs/paged_trace_128rows.json). The per-layer read is
+        # unchanged either way: attention consumes the whole slice.
+        def block_carry(carry, scanned):
+            x, kc_all, vc_all = carry
+            layer, li = scanned
+            x, kc, vc = _layer_step(
+                x,
+                layer,
+                {"all": kc_all, "layer": li},
+                {"all": vc_all, "layer": li},
+            )
+            return (x, kc["all"], vc["all"]), None
+
+        (x, new_k, new_v), _ = jax.lax.scan(
+            block_carry,
+            (x, k_cache, v_cache),
+            (stacked, jnp.arange(k_cache.shape[0])),
+        )
+        return x, new_k, new_v
 
     def block(x, scanned):
         layer, kc, vc = scanned

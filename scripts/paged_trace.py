@@ -226,10 +226,13 @@ def main() -> int:
     }
     out["delta_ms_per_step_by_category"] = delta
     print(json.dumps({"delta_ms_per_step": delta}), flush=True)
+    # the canonical 32-row artifact keeps the bare name; other widths
+    # get their own file so re-runs never clobber the committed evidence
+    suffix = "" if ROWS == 32 else f"_{ROWS}rows"
     dst = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "docs",
-        "paged_trace.json",
+        f"paged_trace{suffix}.json",
     )
     with open(dst, "w") as f:
         json.dump(out, f, indent=1)

@@ -260,12 +260,14 @@ def test_generate_batch_validates_inputs(engine):
     assert engine.generate_batch([]) == []
 
 
-def test_generate_batch_chunks_oversized_fleets(engine):
-    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
-        BATCH_BUCKETS,
-    )
+def test_generate_batch_chunks_oversized_fleets(engine, monkeypatch):
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine as je
 
-    n = BATCH_BUCKETS[-1] + 3
+    # Force the memory-bounded width down to the floor so the seam logic
+    # is exercised without compiling a 256-row loop on CPU.
+    monkeypatch.setattr(je, "BATCH_KV_BUDGET_BYTES", 1)
+    seam = je.BATCH_MIN_SPLIT_ROWS
+    n = seam + 3
     reqs = [
         GenerationRequest("tiny-a", f"p{i}", max_new_tokens=4, seed=i)
         for i in range(n)
@@ -273,8 +275,36 @@ def test_generate_batch_chunks_oversized_fleets(engine):
     batch = engine.generate_batch(reqs)
     assert len(batch) == n
     # spot-check parity at the chunk seam
-    for i in (0, BATCH_BUCKETS[-1] - 1, BATCH_BUCKETS[-1], n - 1):
+    for i in (0, seam - 1, seam, n - 1):
         assert batch[i].tokens == engine.generate(reqs[i]).tokens
+    # the two chunks decoded in separate windows
+    assert len({r.decode_s for r in batch}) == 2
+
+
+def test_generate_batch_width_is_memory_bounded(engine):
+    """The sub-batch width tracks the estimated KV-cache footprint: tiny
+    rows fit hundreds wide; max-context rows fall back to the known-safe
+    floor (the round-3-era hard cap)."""
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine as je
+
+    engine.load_model("tiny-a")
+    cfg = engine._models["tiny-a"].cfg
+    short = [GenerationRequest("tiny-a", "p", max_new_tokens=4)] * 64
+    ids = [[1, 2, 3]] * 64
+    assert engine._max_batch_rows(cfg, short, ids) == je.BATCH_BUCKETS[-1]
+
+    # a synthetic huge config: one row's cache alone exceeds the budget →
+    # the floor wins (never refuse, never split below the known-safe cap)
+    import dataclasses
+
+    big = dataclasses.replace(
+        cfg, n_layers=4000, d_head=4096, max_seq_len=100000
+    )
+    long_req = [GenerationRequest("tiny-a", "p", max_new_tokens=2048)]
+    assert (
+        engine._max_batch_rows(big, long_req, [[1] * 900])
+        == je.BATCH_MIN_SPLIT_ROWS
+    )
 
 
 def test_generate_batch_mixed_top_p_rows_stay_bit_identical(engine):
