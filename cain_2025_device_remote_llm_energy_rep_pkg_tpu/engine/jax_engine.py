@@ -19,6 +19,7 @@ reference can only clock the whole curl subprocess.
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -62,6 +63,24 @@ BATCH_KV_BUDGET_BYTES = int(
 # Never split below this width whatever the estimate says — the old hard
 # cap, known-safe at max context on the flagship.
 BATCH_MIN_SPLIT_ROWS = 32
+# Monotonic id stamped into every batch result's extras["decode_window"]
+# so consumers (bench.py) can count DISTINCT decode windows explicitly
+# instead of deduplicating decode_s floats — float identity silently
+# miscounts if two sequential windows collide or rows ever get per-row
+# finalized windows.
+_DECODE_WINDOW_IDS = itertools.count()
+# Paged stacked decode: at/above this STATIC batch width the engine
+# computes the prompt parts with the gather+fused-XLA variant instead of
+# the Pallas parts kernel, whose (B, Hkv, Jmax) grid runs ~0.45 µs/cell
+# flat — linear in rows. Measured at 4/8/16/32/128 rows on the chip the
+# XLA variant won at EVERY width (+9% to +27%, docs/PERF.md), so the
+# default is 1 (always); the kernel remains the TP-mesh path (its
+# shard_map rule) and the injectable/parity anchor. Round 4's "gather
+# variant measured slower at 32 rows" predated the fused assembly and
+# carry-resident side caches and no longer holds.
+PAGED_XLA_PARTS_MIN_ROWS = int(
+    os.environ.get("PAGED_XLA_PARTS_MIN_ROWS", 1)
+)
 DEFAULT_STREAM_CHUNK = 32  # decode steps per streamed chunk
 
 
@@ -1813,16 +1832,29 @@ class JaxEngine(GenerationBackend):
         from ..ops.pallas_paged_attention import (
             pallas_paged_decode_attention,
             pallas_paged_decode_attention_parts,
+            xla_paged_decode_attention_parts,
         )
 
         def decode_attention(q, kc, vc, lengths):
             if "side" in kc:  # stacked-hybrid mode: unnormalised parts
-                # for the caller's merge (transformer.py). A
-                # gather+fused-XLA parts variant was measured SLOWER than
-                # this kernel even at jmax=1 (2.4-2.6k vs 2.8k aggregate,
-                # docs/PERF.md) — the kernel is the parts path. The pool
-                # is a per-layer xs slice unless a "layer" index says it
-                # is the whole stacked pool.
+                # for the caller's merge (transformer.py). TWO parts
+                # impls, picked by STATIC batch width
+                # (PAGED_XLA_PARTS_MIN_ROWS, default: XLA always): the
+                # Pallas kernel iterates its (B, Hkv, Jmax) grid at a
+                # flat ~0.45 µs/cell — linear in rows, 3.2 ms/step at
+                # 128 rows (docs/paged_trace*.json) — while the
+                # gather+fused-XLA variant pays a small linear gather
+                # and measured faster at every width tried (+9% @4 rows
+                # to +27% @128, docs/PERF.md). The pool is a per-layer
+                # xs slice unless a "layer" index says it is the whole
+                # stacked pool (kernel-only).
+                if (
+                    kc.get("layer") is None
+                    and q.shape[0] >= PAGED_XLA_PARTS_MIN_ROWS
+                ):
+                    return xla_paged_decode_attention_parts(
+                        q, kc["pool"], vc["pool"], kc["table"], lengths
+                    )
                 return pallas_paged_decode_attention_parts(
                     q,
                     kc["pool"],
@@ -2075,6 +2107,7 @@ class JaxEngine(GenerationBackend):
             out = jnp.zeros((b_bucket, 0), dtype=jnp.int32)
             n_row = [0] * b_bucket
         t2 = time.monotonic()
+        window_id = next(_DECODE_WINDOW_IDS)
 
         out_host = _to_host_list(out)
         first_host = _to_host_list(first_tokens)
@@ -2099,6 +2132,7 @@ class JaxEngine(GenerationBackend):
                     prefill_s=prefill_s,
                     decode_s=t2 - t1,
                     total_s=prefill_s + (t2 - t1),
+                    extras={"decode_window": window_id},
                 )
             )
         return results
@@ -2120,11 +2154,13 @@ class JaxEngine(GenerationBackend):
 
         The contiguous estimate is the batch cache shape — widest prompt
         bucket + widest generation bucket at the engine dtype. The paged
-        path can exceed that shape (pow2 page-count rounding can double
-        the pool; the stacked pool lane-pads d_head to 128; side caches
-        add g_bucket columns), so paged engines bill a per-token factor
-        of ``2·d_pool + d_head`` — an upper bound on pool + sides per
-        (layer, head, token) in every mode."""
+        path's footprint differs per mode and is bounded explicitly
+        (pow2 page-count rounding can double the pool; the stacked pool
+        lane-pads d_head to 128): stacked pools hold only prompt pages
+        plus g_bucket side columns; legacy pools hold prompt + budget
+        pages. An over-broad bound here silently halves batch width —
+        the first dual-engine bench billed stacked rows 3× their real
+        bytes and split the paged fleet at 64 rows."""
         s_bucket = max(
             _prompt_alloc(len(ids)) for ids in all_prompt_ids
         )
@@ -2133,15 +2169,20 @@ class JaxEngine(GenerationBackend):
         )
         if self.paged_kv:
             d_pool = -(-cfg.d_head // 128) * 128
-            per_token = 2 * d_pool + cfg.d_head
+            if self._paged_decode_attention(cfg) is not None:
+                # stacked: pow2-rounded prompt pages (≤ 2·s_bucket
+                # columns) at the padded head dim + side columns
+                row_cols = 2 * s_bucket * d_pool + g_bucket * cfg.d_head
+            else:
+                # legacy: prompt + budget pages, pow2-rounded
+                row_cols = 2 * (s_bucket + g_bucket) * d_pool
         else:
-            per_token = cfg.d_head
+            row_cols = (s_bucket + g_bucket) * cfg.d_head
         bytes_per_row = (
             2  # K and V
             * cfg.n_layers
             * cfg.n_kv_heads
-            * (s_bucket + g_bucket)
-            * per_token
+            * row_cols
             * jnp.dtype(self.dtype).itemsize
         )
         max_rows = BATCH_MIN_SPLIT_ROWS
@@ -2342,6 +2383,7 @@ class JaxEngine(GenerationBackend):
             out = jnp.zeros((b_bucket, 0), dtype=jnp.int32)
             n_row = [0] * b_bucket
         t2 = time.monotonic()
+        window_id = next(_DECODE_WINDOW_IDS)
 
         # batched transfers: whole-array host copies, not per-int reads
         # (one RPC per element on tunneled devices — see generate())
@@ -2368,6 +2410,7 @@ class JaxEngine(GenerationBackend):
                     prefill_s=prefill_s,
                     decode_s=t2 - t1,  # the shared batch decode window
                     total_s=prefill_s + (t2 - t1),
+                    extras={"decode_window": window_id},
                 )
             )
         return results

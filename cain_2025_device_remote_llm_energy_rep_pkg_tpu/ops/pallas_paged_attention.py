@@ -414,3 +414,50 @@ def paged_decode_attention_reference(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgt,bktd->bkgd", probs, v.astype(jnp.float32))
     return out.reshape(b, hq, d).astype(q.dtype)
+
+
+def xla_paged_decode_attention_parts(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pool: jnp.ndarray,  # [P, Hkv, page, Dp] — per-layer pool slice
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, Jmax] int32
+    lengths: jnp.ndarray,  # [B] int32 — cached (prompt) tokens
+) -> "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]":
+    """Gather-based unnormalised flash parts — the WIDE-BATCH sibling of
+    :func:`pallas_paged_decode_attention_parts`, same return contract
+    ``(acc [B,Hkv,G,D] f32, m [B,Hkv,G], l [B,Hkv,G])``.
+
+    The Pallas parts kernel iterates a (B, Hkv, Jmax) grid at a flat
+    ~0.45 µs per cell (device-trace measured, docs/paged_trace*.json) —
+    linear in rows, 3.2 ms/step at 128 rows where the whole contiguous
+    attention runs in XLA fusions. Materialising each row's few prompt
+    pages through the table instead costs a small linear gather
+    (~17 MB/layer-step at qwen2 128-row shapes) and lets XLA fuse the
+    score/softmax-parts math like the contiguous path. The engine picks
+    this variant at wide static batch and keeps the kernel at narrow
+    batch, where the gather variant measured slower (docs/PERF.md).
+
+    Rows with ``lengths == 0`` (empty prompt) return m = -inf, l = 0,
+    acc = 0 — the caller's online-softmax merge weights them to zero.
+    """
+    b, hq, d = q.shape
+    n_pool, hkv, page, dp = k_pool.shape
+    jmax = page_table.shape[1]
+    group = hq // hkv
+    t = jmax * page
+    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pool - 1)
+    # [B, Jmax, Hkv, page, Dp] → [B, Hkv, T, D] (drop lane padding)
+    kf = k_pool[table].transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dp)
+    vf = v_pool[table].transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dp)
+    kf = kf[..., :d].astype(jnp.float32)
+    vf = vf[..., :d].astype(jnp.float32)
+    qg = q.reshape(b, hkv, group, d).astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bktd->bkgt", qg, kf) / math.sqrt(d)
+    mask = jnp.arange(t)[None, :] < lengths[:, None]  # [B, T]
+    scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1)  # -inf when the row has no prompt
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])  # exp(-inf)=0 masks columns
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkgt,bktd->bkgd", p, vf)
+    return acc, m, l

@@ -277,8 +277,8 @@ def test_generate_batch_chunks_oversized_fleets(engine, monkeypatch):
     # spot-check parity at the chunk seam
     for i in (0, seam - 1, seam, n - 1):
         assert batch[i].tokens == engine.generate(reqs[i]).tokens
-    # the two chunks decoded in separate windows
-    assert len({r.decode_s for r in batch}) == 2
+    # the two chunks decoded in separate, explicitly-tagged windows
+    assert len({r.extras["decode_window"] for r in batch}) == 2
 
 
 def test_generate_batch_width_is_memory_bounded(engine):

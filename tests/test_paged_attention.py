@@ -574,3 +574,46 @@ def test_paged_batch_fused_assembly_with_mixed_groups_and_solo_rows():
     assert len(calls) == len(multi_groups)
     for r, req in zip(batch, reqs):
         assert r.tokens == engine.generate(req).tokens
+
+
+def test_xla_parts_match_kernel_parts():
+    """The gather+fused-XLA parts variant (wide-batch sibling) returns
+    the same (acc, m, l) contract as the Pallas parts kernel, including
+    lane-padded head dims and empty-prompt rows (m=-inf, l=0)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_paged_attention import (
+        pallas_paged_decode_attention_parts,
+        xla_paged_decode_attention_parts,
+    )
+
+    b, hq, hkv, d, page, n_pool, jmax = 4, 8, 2, 64, 128, 8, 2
+    dp = 128  # lane-padded pool head dim
+    key = jax.random.PRNGKey(9)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, hq, d), jnp.float32)
+    k_pool = jax.random.normal(kk, (n_pool, hkv, page, dp), jnp.float32)
+    v_pool = jax.random.normal(kv, (n_pool, hkv, page, dp), jnp.float32)
+    # zero the padding lanes as the engine's pools do
+    k_pool = k_pool.at[..., d:].set(0)
+    v_pool = v_pool.at[..., d:].set(0)
+    table = jnp.asarray([[0, 1], [2, 3], [4, 5], [0, 0]], jnp.int32)
+    lengths = jnp.asarray([130, 256, 1, 0], jnp.int32)  # incl. empty row
+
+    acc_k, m_k, l_k = pallas_paged_decode_attention_parts(
+        q, k_pool, v_pool, table, lengths, interpret=True
+    )
+    acc_x, m_x, l_x = xla_paged_decode_attention_parts(
+        q, k_pool, v_pool, table, lengths
+    )
+    assert acc_x.shape == (b, hkv, hq // hkv, d)
+    np.testing.assert_allclose(
+        np.asarray(acc_x), np.asarray(acc_k[..., :d]), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_x), np.asarray(m_k), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(l_x), np.asarray(l_k), rtol=2e-5, atol=2e-5
+    )
+    # empty-prompt row: zero weight in the caller's merge
+    assert not np.isfinite(np.asarray(m_x)[3]).any()
+    assert (np.asarray(l_x)[3] == 0).all()
