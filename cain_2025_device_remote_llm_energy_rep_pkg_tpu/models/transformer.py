@@ -20,6 +20,7 @@ the TPU-native replacement mandated by BASELINE.json's north star.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -96,7 +97,10 @@ def is_carry_cache(leaf: Any) -> bool:
     1.4 GB/step of pure copy at 128 rows for a 64 KB actual update
     (docs/paged_trace_128rows.json), the dominant batch-scaling cost.
     The per-layer READ stays (attention consumes the whole slice); only
-    the write-back copies go."""
+    the write-back copies go. ``all`` is either a plain array or an
+    int8-KV ``{"q": [L,B,Hkv,T,D], "s": [L,B,Hkv,T]}`` dict — the
+    quantized batched path pays the same per-layer write-back tax as the
+    plain one and gets the same cure."""
     return isinstance(leaf, dict) and set(leaf) == {"all", "layer"}
 
 
@@ -256,7 +260,8 @@ def _attention_block(
         # (stacked): the page dim is [-2] in both
         t = k_cache["table"].shape[1] * k_cache["pool"].shape[-2]
     elif carry_cache:
-        t = k_cache["all"].shape[3]
+        _all = k_cache["all"]
+        t = (_all["q"] if isinstance(_all, dict) else _all).shape[3]
     else:
         t = (k_cache["q"] if quant_cache else k_cache).shape[2]
     per_seq = jnp.ndim(offset) == 1  # batched decode: one offset per sequence
@@ -396,21 +401,41 @@ def _attention_block(
     elif carry_cache:
         # One tiny in-place write into the stacked carry at [layer, row,
         # :, offset] — the whole point of the carry-resident design (no
-        # per-layer write-back of the untouched 25 MB slice).
+        # per-layer write-back of the untouched 25 MB slice). Quantized
+        # carries write this token's codes + per-vector scale the same
+        # way the per-layer quant branch below does.
         li = k_cache["layer"]
         rows = jnp.arange(b)
-        k_cache = {
-            "layer": li,
-            "all": k_cache["all"]
-            .at[li, rows, :, offset]
-            .set(k[:, 0].astype(k_cache["all"].dtype)),
-        }
-        v_cache = {
-            "layer": li,
-            "all": v_cache["all"]
-            .at[li, rows, :, offset]
-            .set(v[:, 0].astype(v_cache["all"].dtype)),
-        }
+        if isinstance(k_cache["all"], dict):
+            kq, ksc = quantize_kv_vector(k[:, 0])  # [B,Hkv,dh], [B,Hkv]
+            vq, vsc = quantize_kv_vector(v[:, 0])
+            k_cache = {
+                "layer": li,
+                "all": {
+                    "q": k_cache["all"]["q"].at[li, rows, :, offset].set(kq),
+                    "s": k_cache["all"]["s"].at[li, rows, :, offset].set(ksc),
+                },
+            }
+            v_cache = {
+                "layer": li,
+                "all": {
+                    "q": v_cache["all"]["q"].at[li, rows, :, offset].set(vq),
+                    "s": v_cache["all"]["s"].at[li, rows, :, offset].set(vsc),
+                },
+            }
+        else:
+            k_cache = {
+                "layer": li,
+                "all": k_cache["all"]
+                .at[li, rows, :, offset]
+                .set(k[:, 0].astype(k_cache["all"].dtype)),
+            }
+            v_cache = {
+                "layer": li,
+                "all": v_cache["all"]
+                .at[li, rows, :, offset]
+                .set(v[:, 0].astype(v_cache["all"].dtype)),
+            }
     elif per_seq:
         # Each sequence writes its token's K/V at its own cache position.
         k_cache = k_cache.at[jnp.arange(b), :, offset].set(
@@ -432,12 +457,20 @@ def _attention_block(
     # slice of the stacked carry (the read is inherent — attention
     # consumes the whole slice; only the write-back was waste).
     if carry_cache:
-        k_att = jax.lax.dynamic_index_in_dim(
-            k_cache["all"], k_cache["layer"], 0, keepdims=False
-        )
-        v_att = jax.lax.dynamic_index_in_dim(
-            v_cache["all"], v_cache["layer"], 0, keepdims=False
-        )
+
+        def _layer_view(leaf):
+            sl = functools.partial(
+                jax.lax.dynamic_index_in_dim,
+                index=leaf["layer"],
+                axis=0,
+                keepdims=False,
+            )
+            if isinstance(leaf["all"], dict):  # int8-KV: codes + scales
+                return {"q": sl(leaf["all"]["q"]), "s": sl(leaf["all"]["s"])}
+            return sl(leaf["all"])
+
+        k_att = _layer_view(k_cache)
+        v_att = _layer_view(v_cache)
     else:
         k_att, v_att = k_cache, v_cache
     if (
@@ -497,14 +530,16 @@ def _attention_block(
             kf = _gather_paged(k_cache)  # raises on stacked leafs
             vf = _gather_paged(v_cache)
         else:
+            # the view is a {"q","s"} dict when the cache is quantized
+            # (directly or through a carry leaf)
             kf = (
                 dequant_cache(k_att)
-                if quant_cache
+                if isinstance(k_att, dict)
                 else k_att.astype(jnp.float32)
             )
             vf = (
                 dequant_cache(v_att)
-                if quant_cache
+                if isinstance(v_att, dict)
                 else v_att.astype(jnp.float32)
             )
         scores = jnp.einsum("bskgd,bktd->bkgst", qg, kf) * scale
@@ -664,18 +699,23 @@ def run_blocks(
         )
 
     if (
-        isinstance(k_cache, jnp.ndarray)
+        (isinstance(k_cache, jnp.ndarray) or is_quantized_cache(k_cache))
         and x.shape[1] == 1
         and jnp.ndim(offset) == 1
     ):
-        # Batched single-token decode over plain stacked caches: the
-        # caches ride the scan CARRY and each layer writes only its
-        # token's row in place (is_carry_cache). Scanning them as
-        # xs AND ys instead makes XLA write back the full per-layer
-        # cache every layer — 1.4 GB/step of copy for a 64 KB update
-        # at 128 rows, the dominant wide-batch cost
-        # (docs/paged_trace_128rows.json). The per-layer read is
-        # unchanged either way: attention consumes the whole slice.
+        # Batched single-token decode over stacked caches (plain arrays
+        # or int8-KV {"q","s"} dicts): the caches ride the scan CARRY
+        # and each layer writes only its token's row in place
+        # (is_carry_cache). Scanning them as xs AND ys instead makes
+        # XLA write back the full per-layer cache every layer —
+        # 1.4 GB/step of copy for a 64 KB update at 128 rows, the
+        # dominant wide-batch cost (docs/paged_trace_128rows.json).
+        # The per-layer read is unchanged either way: attention
+        # consumes the whole slice.
+        n_layers = (
+            k_cache["q"] if isinstance(k_cache, dict) else k_cache
+        ).shape[0]
+
         def block_carry(carry, scanned):
             x, kc_all, vc_all = carry
             layer, li = scanned
@@ -690,7 +730,7 @@ def run_blocks(
         (x, new_k, new_v), _ = jax.lax.scan(
             block_carry,
             (x, k_cache, v_cache),
-            (stacked, jnp.arange(k_cache.shape[0])),
+            (stacked, jnp.arange(n_layers)),
         )
         return x, new_k, new_v
 

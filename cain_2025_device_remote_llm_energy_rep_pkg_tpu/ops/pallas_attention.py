@@ -173,9 +173,9 @@ def _decode_kernel_int8(
     lengths_ref,  # SMEM [B] int32 (scalar-prefetched)
     q_ref,  # VMEM [1,1,G,D]
     k_ref,  # VMEM [1,1,block_t,D] int8
-    ks_ref,  # VMEM [1,1,block_t] f32 per-position K scales
+    ks_ref,  # VMEM [1,1,block_t,1] f32 per-position K scales
     v_ref,  # VMEM [1,1,block_t,D] int8
-    vs_ref,  # VMEM [1,1,block_t] f32 per-position V scales
+    vs_ref,  # VMEM [1,1,block_t,1] f32 per-position V scales
     o_ref,  # VMEM [1,1,G,D]
     m_ref,  # VMEM scratch [G,128] f32
     l_ref,  # VMEM scratch [G,128] f32
@@ -206,8 +206,12 @@ def _decode_kernel_int8(
     def _block():
         q = q_ref[0, 0].astype(jnp.float32)  # [G,D]
         k = k_ref[0, 0].astype(jnp.float32)  # [Tb,D] int8 codes
-        ks = ks_ref[0, 0].astype(jnp.float32)  # [Tb]
-        vs = vs_ref[0, 0].astype(jnp.float32)  # [Tb]
+        # scales ride a trailing singleton lane dim: a [...,Tb] block
+        # would put 1 in the sublane slot over Hkv>1, which Mosaic's
+        # tiling rule rejects (the bug that made this kernel fail to
+        # lower on real TPU for ANY batched int8-KV shape)
+        ks = ks_ref[0, 0, :, 0].astype(jnp.float32)  # [Tb]
+        vs = vs_ref[0, 0, :, 0].astype(jnp.float32)  # [Tb]
         s = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -287,9 +291,13 @@ def pallas_decode_attention_int8(
             in_specs=[
                 pl.BlockSpec((1, 1, group, dp), lambda b_i, h, j, L: (b_i, h, 0, 0)),
                 pl.BlockSpec((1, 1, bt, dp), lambda b_i, h, j, L: (b_i, h, j, 0)),
-                pl.BlockSpec((1, 1, bt), lambda b_i, h, j, L: (b_i, h, j)),
+                # scales as [B,Hkv,T,1]: block (1,1,bt,1) puts bt in the
+                # sublane slot (8-divisible) and the full singleton in
+                # the lane slot — a rank-3 (1,1,bt) block leaves 1 over
+                # Hkv in the sublane slot, which Mosaic rejects
+                pl.BlockSpec((1, 1, bt, 1), lambda b_i, h, j, L: (b_i, h, j, 0)),
                 pl.BlockSpec((1, 1, bt, dp), lambda b_i, h, j, L: (b_i, h, j, 0)),
-                pl.BlockSpec((1, 1, bt), lambda b_i, h, j, L: (b_i, h, j)),
+                pl.BlockSpec((1, 1, bt, 1), lambda b_i, h, j, L: (b_i, h, j, 0)),
             ],
             out_specs=pl.BlockSpec(
                 (1, 1, group, dp), lambda b_i, h, j, L: (b_i, h, 0, 0)
@@ -306,9 +314,9 @@ def pallas_decode_attention_int8(
         lengths.astype(jnp.int32),
         q,
         k_q,
-        k_s.astype(jnp.float32),
+        k_s.astype(jnp.float32)[..., None],
         v_q,
-        v_s.astype(jnp.float32),
+        v_s.astype(jnp.float32)[..., None],
     )
 
     if d_pad:
