@@ -787,17 +787,18 @@ class JaxEngine(GenerationBackend):
         variant unpacks the quantized cache's codes+scales (folding the
         scales into the online softmax — the fallback would materialise a
         dequantized cache); without it (CPU tests) the jnp fallback in
-        the model handles both. Models whose head dim is not a 128-lane
-        multiple (phi3's 96) take the fallback too: the int8 kernel's
-        block shapes require it, and engaging it anyway aborts the trace
-        (a crash the round-4 'auto' policy would otherwise have
-        introduced for exactly the KV-heavy model kv-quantize exists
-        for)."""
+        the model handles both. Round 4 gated out non-128-multiple head
+        dims (phi3's 96) after a trace abort on real hardware — round 5
+        traced that abort to the kernel's rank-3 scales BlockSpec, which
+        Mosaic rejected for EVERY int8-KV shape, not to the head dim.
+        With scales shipped as [B,Hkv,T,1] the kernel lowers and runs at
+        d_head 96/128 across 1–128 rows (docs/kernel_lowering.jsonl; the
+        kernel zero-pads the head dim internally), so phi3-class models
+        — the KV-heavy targets kv-quantize exists for — now get the
+        kernel instead of the dequantizing fallback."""
         if not self.kv_quantize:
             return self.decode_attention
         if not self._specialised_kernels_enabled():
-            return None
-        if cfg is not None and cfg.d_head % 128:
             return None
 
         from ..ops.pallas_attention import pallas_decode_attention_int8

@@ -365,39 +365,28 @@ def _attention_block(
             }
     elif quant_cache:
         # Quantize the new entry and write codes + per-vector scale.
+        # Only the solo (scalar-offset) path reaches here: batched
+        # per-seq decode over quantized caches is intercepted by
+        # run_blocks' carry branch, whose quantized carry write above
+        # does the per-row [layer, row, :, offset] update.
         kq, ks = quantize_kv_vector(k[:, 0])  # [B,Hkv,dh]
         vq, vs = quantize_kv_vector(v[:, 0])
-        if per_seq:
-            # Batched decode: each row writes at its own cache position
-            # (scales are per (row, head, position) — the batch axis is
-            # free, which is what lets kv_quantize compose with
-            # generate_batch).
-            rows = jnp.arange(b)
-            k_cache = {
-                "q": k_cache["q"].at[rows, :, offset].set(kq),
-                "s": k_cache["s"].at[rows, :, offset].set(ks),
-            }
-            v_cache = {
-                "q": v_cache["q"].at[rows, :, offset].set(vq),
-                "s": v_cache["s"].at[rows, :, offset].set(vs),
-            }
-        else:
-            k_cache = {
-                "q": jax.lax.dynamic_update_slice(
-                    k_cache["q"], kq[:, :, None, :], (0, 0, offset, 0)
-                ),
-                "s": jax.lax.dynamic_update_slice(
-                    k_cache["s"], ks[:, :, None], (0, 0, offset)
-                ),
-            }
-            v_cache = {
-                "q": jax.lax.dynamic_update_slice(
-                    v_cache["q"], vq[:, :, None, :], (0, 0, offset, 0)
-                ),
-                "s": jax.lax.dynamic_update_slice(
-                    v_cache["s"], vs[:, :, None], (0, 0, offset)
-                ),
-            }
+        k_cache = {
+            "q": jax.lax.dynamic_update_slice(
+                k_cache["q"], kq[:, :, None, :], (0, 0, offset, 0)
+            ),
+            "s": jax.lax.dynamic_update_slice(
+                k_cache["s"], ks[:, :, None], (0, 0, offset)
+            ),
+        }
+        v_cache = {
+            "q": jax.lax.dynamic_update_slice(
+                v_cache["q"], vq[:, :, None, :], (0, 0, offset, 0)
+            ),
+            "s": jax.lax.dynamic_update_slice(
+                v_cache["s"], vs[:, :, None], (0, 0, offset)
+            ),
+        }
     elif carry_cache:
         # One tiny in-place write into the stacked carry at [layer, row,
         # :, offset] — the whole point of the carry-resident design (no

@@ -1,0 +1,145 @@
+"""Compile-only lowering smoke for every Pallas kernel, on the REAL chip.
+
+Round 5 found `pallas_decode_attention_int8` had NEVER lowered on TPU —
+its scales BlockSpec violated Mosaic's tiling rules for every int8-KV
+shape — because CPU tests run the kernels in interpret mode (numerics
+verified, lowering constraints skipped) and no routine chip run selected
+that configuration. This script closes the class of bug: it `.lower()
+.compile()`s each kernel at representative shapes (flagship-like GQA and
+MQA head layouts, solo and batched widths) WITHOUT timing anything, so a
+Mosaic rejection surfaces as a named failure in seconds-per-kernel
+instead of lurking until a user enables the feature.
+
+Run on any TPU-attached host:  python scripts/kernel_lowering_smoke.py
+Prints one JSON line per case; exits non-zero if any case fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        print(json.dumps({"skipped": "no TPU backend; interpret mode "
+                          "would not exercise Mosaic lowering"}))
+        return 0
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_attention import (
+        pallas_decode_attention,
+        pallas_decode_attention_int8,
+        pallas_prefill_attention,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_paged_attention import (
+        pallas_paged_decode_attention,
+        pallas_paged_decode_attention_parts,
+        xla_paged_decode_attention_parts,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_quant import (
+        int4_matmul,
+    )
+
+    f32, bf16, i8, i32 = jnp.float32, jnp.bfloat16, jnp.int8, jnp.int32
+    cases = []
+
+    # (hq, hkv, d): flagship GQA 12/2/128, MQA 8/1/128, padded-head 4/2/96
+    heads = [(12, 2, 128), (8, 1, 128), (4, 2, 96)]
+    for b in (1, 32, 128):
+        for hq, hkv, d in heads:
+            t = 384
+            q = jnp.zeros((b, hq, d), bf16)
+            kc = jnp.zeros((b, hkv, t, d), bf16)
+            lengths = jnp.full((b,), t, i32)
+            cases.append((
+                f"decode b={b} {hq}/{hkv}/{d}",
+                lambda q=q, kc=kc, lengths=lengths: pallas_decode_attention(
+                    q, kc, kc, lengths
+                ),
+            ))
+            kq = jnp.zeros((b, hkv, t, d), i8)
+            ks = jnp.zeros((b, hkv, t), f32)
+            cases.append((
+                f"decode-int8 b={b} {hq}/{hkv}/{d}",
+                lambda q=q, kq=kq, ks=ks, lengths=lengths:
+                pallas_decode_attention_int8(q, kq, ks, kq, ks, lengths),
+            ))
+            dp = -(-d // 128) * 128
+            pool = jnp.zeros((8, hkv, 128, dp), bf16)
+            table = jnp.zeros((b, 2), i32)
+            plens = jnp.full((b,), 130, i32)
+            # the legacy paged kernel takes pools at the RAW head dim
+            # (it pads internally); the stacked parts kernel requires
+            # pre-padded pools
+            raw_pool = jnp.zeros((8, hkv, 128, d), bf16)
+            cases.append((
+                f"paged-decode b={b} {hq}/{hkv}/{d}",
+                lambda q=q, raw_pool=raw_pool, table=table, plens=plens:
+                pallas_paged_decode_attention(
+                    q, raw_pool, raw_pool, table, plens
+                ),
+            ))
+            cases.append((
+                f"paged-parts b={b} {hq}/{hkv}/{d}",
+                lambda q=q, pool=pool, table=table, plens=plens:
+                pallas_paged_decode_attention_parts(
+                    q, pool, pool, table, plens
+                ),
+            ))
+            cases.append((
+                f"paged-parts-xla b={b} {hq}/{hkv}/{d}",
+                lambda q=q, pool=pool, table=table, plens=plens:
+                xla_paged_decode_attention_parts(
+                    q, pool, pool, table, plens
+                ),
+            ))
+    # prefill flash: [B,S] x cache
+    for b, s in ((1, 128), (32, 64)):
+        hq, hkv, d = 12, 2, 128
+        qp = jnp.zeros((b, s, hq, d), bf16)
+        kcp = jnp.zeros((b, hkv, 512, d), bf16)
+        cases.append((
+            f"prefill b={b} s={s}",
+            lambda qp=qp, kcp=kcp: pallas_prefill_attention(
+                qp, kcp, kcp, jnp.int32(0)
+            ),
+        ))
+    # the int4 dequant matmul (flagship MLP shape; int8 weights ride
+    # XLA's own einsum and need no kernel)
+    x1 = jnp.zeros((1, 1536), bf16)
+    w4 = jnp.zeros((768, 8960), i8)  # halves-packed [IN/2, OUT]
+    s4 = jnp.zeros((1, 8960), f32)
+    cases.append(("int4-matmul", lambda: int4_matmul(x1, w4, s4)))
+
+    failed = []
+    for name, fn in cases:
+        try:
+            jax.jit(fn).lower().compile()
+            print(json.dumps({"kernel": name, "lowering": "ok"}), flush=True)
+        except Exception as e:  # noqa: BLE001 — report and continue
+            msg = f"{type(e).__name__}: {str(e).splitlines()[0][:160]}"
+            failed.append(name)
+            print(
+                json.dumps({"kernel": name, "lowering": "FAIL", "error": msg}),
+                flush=True,
+            )
+            if os.environ.get("SMOKE_VERBOSE"):
+                traceback.print_exc()
+    print(
+        json.dumps(
+            {"total": len(cases), "failed": failed or None}
+        ),
+        flush=True,
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

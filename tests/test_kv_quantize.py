@@ -215,12 +215,15 @@ def test_installed_models_never_evicted(monkeypatch):
     )
 
 
-def test_int8_kernel_selection_respects_head_dim(monkeypatch):
-    """The int8 flash-decode kernel requires a 128-multiple head dim;
-    phi3 (d_head=96) must take the jnp fallback even where specialised
-    kernels are enabled — engaging the kernel aborts the trace on real
-    hardware (found by a round-4 chip A/B after the 'auto' policy change
-    widened kernel engagement)."""
+def test_int8_kernel_engages_for_all_head_dims(monkeypatch):
+    """The int8 flash-decode kernel engages for every model, including
+    phi3's d_head=96 (the kernel zero-pads the head dim internally).
+    Round 4 gated d=96 out after a real-hardware trace abort; round 5
+    traced that abort to the kernel's rank-3 scales BlockSpec — Mosaic
+    rejected it for EVERY int8-KV shape — and fixed it by shipping
+    scales as [B,Hkv,T,1] (real-chip lowering sweep:
+    docs/kernel_lowering.jsonl). The gate would have left the KV-heavy
+    model kv-quantize exists for on the dequantizing fallback."""
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
         JaxEngine,
     )
@@ -234,5 +237,39 @@ def test_int8_kernel_selection_respects_head_dim(monkeypatch):
     )
     phi3 = get_model_config("phi3:3.8b")  # d_head 96
     qwen = get_model_config("qwen2:1.5b")  # d_head 128
-    assert engine._decode_attention_for_cache(phi3) is None
+    assert engine._decode_attention_for_cache(phi3) is not None
     assert engine._decode_attention_for_cache(qwen) is not None
+
+
+def test_int8_kernel_parity_at_lane_padded_head_dim():
+    """Exact-math (interpret) parity for the int8 kernel at d_head=96 —
+    the configuration the removed round-4 gate excluded."""
+    import jax
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_attention import (
+        pallas_decode_attention_int8,
+    )
+
+    b, hq, hkv, t, d = 2, 4, 2, 256, 96
+    key = jax.random.PRNGKey(3)
+    kq_, kk, kv_, ks_, vs_ = jax.random.split(key, 5)
+    q = jax.random.normal(kq_, (b, hq, d), jnp.float32)
+    k_q = jax.random.randint(kk, (b, hkv, t, d), -127, 128, jnp.int8)
+    v_q = jax.random.randint(kv_, (b, hkv, t, d), -127, 128, jnp.int8)
+    k_s = jax.random.uniform(ks_, (b, hkv, t), jnp.float32, 0.01, 0.1)
+    v_s = jax.random.uniform(vs_, (b, hkv, t), jnp.float32, 0.01, 0.1)
+    lengths = jnp.asarray([256, 33], jnp.int32)
+    got = pallas_decode_attention_int8(
+        q, k_q, k_s, v_q, v_s, lengths, interpret=True
+    )
+    kf = k_q.astype(jnp.float32) * k_s[..., None]
+    vf = v_q.astype(jnp.float32) * v_s[..., None]
+    qg = q.reshape(b, hkv, hq // hkv, d)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, kf) / jnp.sqrt(jnp.float32(d))
+    mask = jnp.arange(t)[None, :] < lengths[:, None]
+    s = jnp.where(mask[:, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    want = jnp.einsum("bkgt,bktd->bkgd", p, vf).reshape(b, hq, d)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-4
+    )
