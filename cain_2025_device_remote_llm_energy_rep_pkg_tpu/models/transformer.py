@@ -425,15 +425,11 @@ def _attention_block(
                 .at[li, rows, :, offset]
                 .set(v[:, 0].astype(v_cache["all"].dtype)),
             }
-    elif per_seq:
-        # Each sequence writes its token's K/V at its own cache position.
-        k_cache = k_cache.at[jnp.arange(b), :, offset].set(
-            k[:, 0].astype(k_cache.dtype)
-        )
-        v_cache = v_cache.at[jnp.arange(b), :, offset].set(
-            v[:, 0].astype(v_cache.dtype)
-        )
     else:
+        # Scalar-offset (solo / prefill) contiguous write. Batched
+        # per-seq decode over plain caches never reaches here: run_blocks
+        # routes it to the carry branch above (per-row writes land at
+        # [layer, row, :, offset] in the stacked carry).
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, offset, 0)
         )
