@@ -13,7 +13,11 @@ run concurrently — see .claude/skills/verify gotchas).
 
 import dataclasses
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
@@ -49,8 +53,10 @@ def main() -> int:
     windows: "set[tuple[float, float]]" = set()
     inner_states = engine._batch_states
 
-    def spy_states(requests, all_prompt_ids, cache_lens):
-        states = inner_states(requests, all_prompt_ids, cache_lens)
+    def spy_states(requests, all_prompt_ids, cache_lens, group_refs=False):
+        states = inner_states(
+            requests, all_prompt_ids, cache_lens, group_refs=group_refs
+        )
         windows.update((st["t0"], st["t1"]) for st in states)
         return states
 
@@ -70,7 +76,18 @@ def main() -> int:
                     "rows": rows,
                     "gen_tokens": gen_tokens,
                     "wall_s": round(wall, 3),
-                    "decode_s": round(results[0].decode_s, 3),
+                    # sum of DISTINCT decode windows (explicit ids)
+                    "decode_s": round(
+                        sum(
+                            {
+                                (r.extras or {}).get(
+                                    "decode_window", r.decode_s
+                                ): r.decode_s
+                                for r in results
+                            }.values()
+                        ),
+                        3,
+                    ),
                     "prefill_total_s": round(
                         sum(t1 - t0 for t0, t1 in windows), 3
                     ),
@@ -82,7 +99,9 @@ def main() -> int:
     timed("grouped")
 
     # force the round-4 behavior: per-row solo prefill
-    def solo_states(requests, all_prompt_ids, cache_lens):
+    def solo_states(requests, all_prompt_ids, cache_lens, group_refs=False):
+        # group_refs is irrelevant here: solo states always carry the
+        # per-row fields, which the assembly's solo fallback consumes
         return [
             engine._start(r, cache_len=c, prompt_ids=ids)
             for r, ids, c in zip(requests, all_prompt_ids, cache_lens)
@@ -94,6 +113,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    import sys
-
     sys.exit(main())
