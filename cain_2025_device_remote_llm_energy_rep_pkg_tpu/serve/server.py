@@ -50,7 +50,7 @@ class GenerationServer:
         models: Optional[List[str]] = None,
         quiet: bool = False,
         batch_window_ms: float = 0.0,
-        max_batch: int = 8,
+        max_batch: int = 32,
     ) -> None:
         """``batch_window_ms > 0`` enables continuous batching: concurrent
         non-streaming generate requests arriving within the window coalesce
