@@ -169,7 +169,7 @@ def serve_command(args: List[str]) -> None:
     tp = -1
     models: Optional[List[str]] = None
     batch_window_ms = 0.0
-    max_batch = 32
+    max_batch = None  # backend-aware default (serve/scheduler.py)
     hf_checkpoints = {}
     quantize = None
     kv_quantize = None
@@ -191,7 +191,7 @@ def serve_command(args: List[str]) -> None:
         elif arg == "--batch-window-ms":
             batch_window_ms = float(next(it, "0"))
         elif arg == "--max-batch":
-            max_batch = int(next(it, "32"))
+            max_batch = int(next(it, "0")) or None
         elif arg == "--hf":
             # --hf model=/path/to/checkpoint (repeatable): serve the model
             # from a local HF checkpoint (trained weights + its tokenizer)

@@ -47,22 +47,30 @@ class BatchScheduler:
     companions (the classic continuous-batching admission window);
     ``max_batch`` bounds a single decode's row count. Requests that are
     mutually incompatible (different model or top_k) run as separate
-    batches in arrival order. The default of 32 matches the engine's
-    known-safe sub-batch floor: since the round-5 batch work (grouped
-    prefill windows, carry-resident caches, fused assembly,
-    memory-bounded width) wider admission is strictly better under
-    load, and `generate_batch` still splits internally if a fleet's
-    KV estimate exceeds the device budget.
+    batches in arrival order. The default is BACKEND-AWARE: 32 (the
+    engine's known-safe sub-batch floor) for backends with a real
+    batched decode — wider admission is strictly better there since the
+    round-5 batch work, and ``JaxEngine.generate_batch`` still splits
+    internally if a fleet's KV estimate exceeds the device budget — but
+    8 for backends inheriting the base class's sequential
+    ``generate_batch`` loop (fake backend), where a wider batch only
+    multiplies every caller's wait for the sweep to finish.
     """
 
     def __init__(
         self,
         backend: GenerationBackend,
-        max_batch: int = 32,
+        max_batch: Optional[int] = None,
         window_s: float = 0.05,
         lock: Optional[threading.Lock] = None,
     ) -> None:
         self.backend = backend
+        if max_batch is None:
+            batched = (
+                type(backend).generate_batch
+                is not GenerationBackend.generate_batch
+            )
+            max_batch = 32 if batched else 8
         self.max_batch = max_batch
         self.window_s = window_s
         # Shared with the server's streaming path so batched and streamed

@@ -50,7 +50,7 @@ class GenerationServer:
         models: Optional[List[str]] = None,
         quiet: bool = False,
         batch_window_ms: float = 0.0,
-        max_batch: int = 32,
+        max_batch: Optional[int] = None,  # backend-aware (scheduler)
     ) -> None:
         """``batch_window_ms > 0`` enables continuous batching: concurrent
         non-streaming generate requests arriving within the window coalesce

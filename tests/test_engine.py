@@ -307,6 +307,56 @@ def test_generate_batch_width_is_memory_bounded(engine):
     )
 
 
+def test_max_batch_rows_paged_estimates_are_mode_aware(monkeypatch):
+    """The paged estimate differs by mode and must not over-bill: the
+    first dual-engine bench used one conservative factor for both paged
+    modes, billed stacked rows ~3× their real bytes, and silently split
+    a '128-row' fleet at 64 — re-creating the decode-window artifact in
+    a fresh measurement (docs/PERF.md)."""
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine as je
+
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    paged = je.JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, paged_kv=True
+    )
+    paged.load_model("tiny")
+    cfg = paged._models["tiny"].cfg
+    reqs = [GenerationRequest("tiny", "p", max_new_tokens=16)] * 8
+    ids = [[1, 2, 3]] * 8
+
+    legacy = paged._max_batch_rows(cfg, reqs, ids)  # CPU: no kernels
+    monkeypatch.setattr(
+        je.JaxEngine,
+        "_paged_decode_attention",
+        lambda self, c=None: (lambda *a, **k: None),
+    )
+    stacked = paged._max_batch_rows(cfg, reqs, ids)
+    # tiny shapes: everything fits the widest bucket in every mode
+    assert legacy == stacked == je.BATCH_BUCKETS[-1]
+
+    # shrink the budget until the mode difference is visible: stacked
+    # bills prompt pages (padded head dim) + side columns; legacy bills
+    # prompt + budget pages — for a short prompt with a large budget the
+    # legacy footprint is bigger, so its width must be ≤ stacked's
+    wide = [
+        GenerationRequest("tiny", "p", max_new_tokens=128)
+    ] * 8
+    s_bucket = je._prompt_alloc(3)
+    g_bucket = je._bucket(128, je.GEN_BUCKETS)
+    d_pool = -(-cfg.d_head // 128) * 128
+    stacked_row = (
+        2 * cfg.n_layers * cfg.n_kv_heads
+        * (2 * s_bucket * d_pool + g_bucket * cfg.d_head) * 4
+    )
+    monkeypatch.setattr(je, "BATCH_KV_BUDGET_BYTES", 64 * stacked_row)
+    assert paged._max_batch_rows(cfg, wide, ids) == 64  # stacked
+    monkeypatch.setattr(
+        je.JaxEngine, "_paged_decode_attention", lambda self, c=None: None
+    )
+    legacy_width = paged._max_batch_rows(cfg, wide, ids)
+    assert legacy_width <= 64  # legacy bills prompt + budget pages
+
+
 def test_generate_batch_mixed_top_p_rows_stay_bit_identical(engine):
     # a sampled row with top_p disabled next to a top_p row: the disabled
     # row's draw must not be perturbed by the batch-wide nucleus filter
@@ -909,3 +959,18 @@ def test_generate_batch_grouped_prefill_with_prefix_cache():
         assert b.tokens == s.tokens
     # grouped (miss) rows did not store prefixes; the solo hit row re-stored
     assert len(warm._prefix_cache["tiny-p"]) <= n_entries + 1
+
+
+def test_batch_results_carry_explicit_decode_window_ids(engine):
+    """Every generate_batch result carries extras["decode_window"] — the
+    contract bench.py's distinct-window accounting relies on (float
+    equality of decode_s silently miscounts windows; docs/PERF.md)."""
+    reqs = [
+        GenerationRequest("tiny-a", f"w{i}", max_new_tokens=4, seed=i)
+        for i in range(3)
+    ]
+    batch = engine.generate_batch(reqs)
+    wids = {r.extras["decode_window"] for r in batch}
+    assert len(wids) == 1  # one chunk → one shared window id
+    again = engine.generate_batch(reqs)
+    assert {r.extras["decode_window"] for r in again} != wids  # fresh id

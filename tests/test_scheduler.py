@@ -250,3 +250,26 @@ def test_stop_during_inflight_batch_fails_leftovers_after_worker_exit():
         assert results[1] is not None or isinstance(errors[1], RuntimeError)
     finally:
         sched.stop()
+
+
+def test_max_batch_default_is_backend_aware():
+    """32 for backends with a real batched decode; 8 for backends on the
+    base class's sequential generate_batch loop, where wider admission
+    only multiplies every caller's wait for the sweep."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationBackend,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+        FakeBackend,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        BatchScheduler,
+    )
+
+    class Batched(GenerationBackend):
+        def generate_batch(self, requests):  # real batched path
+            raise NotImplementedError
+
+    assert BatchScheduler(FakeBackend()).max_batch == 8  # sequential base
+    assert BatchScheduler(Batched()).max_batch == 32
+    assert BatchScheduler(FakeBackend(), max_batch=16).max_batch == 16
