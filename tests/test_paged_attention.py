@@ -321,14 +321,20 @@ def test_write_token_appends_through_the_table():
     )
 
 
-def test_engine_paged_stacked_pool_matches_contiguous():
+@pytest.mark.parametrize("parts_impl", ["kernel", "xla"])
+def test_engine_paged_stacked_pool_matches_contiguous(
+    parts_impl, monkeypatch
+):
     """The STACKED-HYBRID decode path (read-only prompt pool closed over
-    the layer scan + contiguous side caches for generated tokens +
-    parts-kernel/side online-softmax merge — the design that removed the
+    the layer scan + carry-resident side caches for generated tokens +
+    parts/side online-softmax merge — the design that removed the
     full-pool-copy-per-step, docs/PERF.md): forcing the kernel on CPU
     (interpret) must produce token-identical output to the contiguous
     engine, including the head-dim pad path (tiny d_head=16 → pool padded
-    to 128)."""
+    to 128). BOTH prompt-parts implementations are pinned — the Pallas
+    parts kernel and the gather+fused-XLA variant that is the
+    single-chip default since round 5 (PAGED_XLA_PARTS_MIN_ROWS)."""
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine as je
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
         GenerationRequest,
     )
@@ -340,6 +346,12 @@ def test_engine_paged_stacked_pool_matches_contiguous():
     )
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_attention import (
         pallas_decode_attention,
+    )
+
+    monkeypatch.setattr(
+        je,
+        "PAGED_XLA_PARTS_MIN_ROWS",
+        1 if parts_impl == "xla" else 10**9,
     )
 
     registry = {
