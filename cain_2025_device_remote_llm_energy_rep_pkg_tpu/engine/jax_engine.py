@@ -2197,10 +2197,13 @@ class JaxEngine(GenerationBackend):
     ) -> "list[GenerationResult]":
         """Generate for several requests in one batched decode.
 
-        Prefill runs per request (reusing the single-request compiled
-        prefills); decode runs all rows together, reading the weights from
-        HBM once per step for the whole batch — decode is bandwidth-bound,
-        so batch throughput scales near-linearly until the MXU saturates.
+        Prefill runs grouped by prompt bucket (see :meth:`_batch_states`);
+        decode runs all rows together, reading the weights from HBM once
+        per step for the whole batch. The weight stream amortises over
+        rows but KV/cache-update/sampling traffic scales with them, so
+        aggregate throughput grows sublinearly (measured ~2.7× from 32 →
+        128 rows — docs/PERF.md "Wide-batch decode made real"; the old
+        "near-linear to 256" claim was a window-accounting artifact).
 
         Per-row rng streams, offsets and sampling knobs make each row's
         output token-identical to ``generate(request)`` alone. Constraints:
