@@ -974,3 +974,131 @@ def test_batch_results_carry_explicit_decode_window_ids(engine):
     assert len(wids) == 1  # one chunk → one shared window id
     again = engine.generate_batch(reqs)
     assert {r.extras["decode_window"] for r in again} != wids  # fresh id
+
+
+def test_assemble_rows_matches_naive_assembly_randomized():
+    """Property test for the fused row assembly: for random mixtures of
+    grouped and solo states, group sizes, member orderings and padding,
+    _assemble_rows' gather+permutation output must equal the naive
+    per-row construction (the pre-round-5 slice-and-concat semantics).
+    The identity-skip fast paths make this worth fuzzing: they engage
+    only for full in-order groups, and a wrong skip would scramble rows
+    silently."""
+    import numpy as np
+
+    registry = {"tiny-a": get_model_config("qwen2:1.5b").tiny()}
+    eng = JaxEngine(registry=registry, dtype=jnp.float32)
+    rng = np.random.default_rng(7)
+
+    for trial in range(12):
+        n_groups = int(rng.integers(0, 3))
+        groups = []
+        for g in range(n_groups):
+            gb = int(rng.choice([2, 4]))
+            shared = {
+                "first": jnp.asarray(
+                    rng.integers(0, 99, gb), jnp.int32
+                ),
+                "presence": jnp.asarray(rng.random((gb, 5)) < 0.5),
+                "rng": jnp.asarray(
+                    rng.integers(0, 2**31, (gb, 2)), jnp.uint32
+                ),
+            }
+            members = list(rng.permutation(gb))[: int(rng.integers(1, gb + 1))]
+            groups.append((shared, members))
+        n_solo = int(rng.integers(0 if n_groups else 1, 3))
+        solo_vals = []
+        for s in range(n_solo):
+            solo_vals.append(
+                {
+                    "first": jnp.asarray(
+                        rng.integers(0, 99, 1), jnp.int32
+                    ),
+                    "presence": jnp.asarray(rng.random((1, 5)) < 0.5),
+                    "rng": jnp.asarray(
+                        rng.integers(0, 2**31, 2), jnp.uint32
+                    ),
+                }
+            )
+        # interleave grouped and solo rows in a random global order
+        entries = []
+        for gi_, (shared, members) in enumerate(groups):
+            for m in members:
+                entries.append(("g", gi_, m))
+        for si in range(n_solo):
+            entries.append(("s", si, None))
+        order = rng.permutation(len(entries))
+        states = []
+        for idx in order:
+            kind, a, b_ = entries[idx]
+            if kind == "g":
+                states.append({"group": groups[a][0], "gi": int(b_)})
+            else:
+                states.append(dict(solo_vals[a]))
+        n = len(states)
+        b_bucket = _bucket(n, (1, 2, 4, 8, 16))
+        asm = eng._assemble_rows(
+            states, b_bucket, eng._row_field_specs(states)
+        )
+        # naive reference: per-row values + row-0 padding
+        def naive(field, solo_key):
+            rows = []
+            for st in states:
+                if "group" in st:
+                    rows.append(np.asarray(st["group"][field])[st["gi"]])
+                else:
+                    v = np.asarray(st[solo_key])
+                    rows.append(v[0] if field != "rng" else v)
+            rows += [rows[0]] * (b_bucket - n)
+            return np.stack(rows)
+
+        np.testing.assert_array_equal(
+            np.asarray(asm["first"]), naive("first", "first")
+        )
+        np.testing.assert_array_equal(
+            np.asarray(asm["presence"]), naive("presence", "presence")
+        )
+        np.testing.assert_array_equal(
+            np.asarray(asm["rng"]), naive("rng", "rng")
+        )
+
+
+def test_assemble_rows_identity_fast_paths():
+    """Deterministic pin for _assemble_rows' two zero-copy skips, which
+    the randomized trials rarely generate: ONE full group whose members
+    appear in gi-order and fill the batch bucket exactly engages both
+    the identity gather (members == range(gb)) and the identity take
+    (perm == arange, no padding). A wrong skip scrambles rows silently —
+    so the output is checked value-for-value, not just for shape."""
+    import numpy as np
+
+    registry = {"tiny-a": get_model_config("qwen2:1.5b").tiny()}
+    eng = JaxEngine(registry=registry, dtype=jnp.float32)
+    gb = 4
+    shared = {
+        "first": jnp.asarray([10, 11, 12, 13], jnp.int32),
+        "presence": jnp.asarray(np.arange(gb * 5).reshape(gb, 5) % 3 == 0),
+        "rng": jnp.asarray(
+            np.arange(gb * 2).reshape(gb, 2), jnp.uint32
+        ),
+    }
+    states = [{"group": shared, "gi": i} for i in range(gb)]
+    asm = eng._assemble_rows(states, gb, eng._row_field_specs(states))
+    np.testing.assert_array_equal(
+        np.asarray(asm["first"]), np.asarray(shared["first"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(asm["presence"]), np.asarray(shared["presence"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(asm["rng"]), np.asarray(shared["rng"])
+    )
+
+    # and the NEAR-miss: same group with members reversed must NOT take
+    # the identity path — rows come back in the reversed request order
+    rev = [{"group": shared, "gi": gb - 1 - i} for i in range(gb)]
+    asm_rev = eng._assemble_rows(rev, gb, eng._row_field_specs(rev))
+    np.testing.assert_array_equal(
+        np.asarray(asm_rev["first"]),
+        np.asarray(shared["first"])[::-1],
+    )
