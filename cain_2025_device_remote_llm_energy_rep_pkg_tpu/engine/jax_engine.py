@@ -81,6 +81,16 @@ _DECODE_WINDOW_IDS = itertools.count()
 PAGED_XLA_PARTS_MIN_ROWS = int(
     os.environ.get("PAGED_XLA_PARTS_MIN_ROWS", 1)
 )
+# ...but not when the page table is WIDE: the XLA variant gathers
+# Jmax·page columns for EVERY row (the longest row taxes all), while the
+# kernel's per-cell skip bounds each row's work by its own pages.
+# Measured on a 26–3,700-token mixed fleet (Jmax ≈ 30): kernel 1,704 vs
+# XLA 1,536 agg tok/s — the reverse of every uniform-length width. The
+# default of 8 pages (1k tokens of spread) sits between the measured
+# points; env-overridable.
+PAGED_XLA_PARTS_MAX_JMAX = int(
+    os.environ.get("PAGED_XLA_PARTS_MAX_JMAX", 8)
+)
 DEFAULT_STREAM_CHUNK = 32  # decode steps per streamed chunk
 
 
@@ -1839,19 +1849,22 @@ class JaxEngine(GenerationBackend):
         def decode_attention(q, kc, vc, lengths):
             if "side" in kc:  # stacked-hybrid mode: unnormalised parts
                 # for the caller's merge (transformer.py). TWO parts
-                # impls, picked by STATIC batch width
-                # (PAGED_XLA_PARTS_MIN_ROWS, default: XLA always): the
-                # Pallas kernel iterates its (B, Hkv, Jmax) grid at a
-                # flat ~0.45 µs/cell — linear in rows, 3.2 ms/step at
-                # 128 rows (docs/paged_trace*.json) — while the
-                # gather+fused-XLA variant pays a small linear gather
-                # and measured faster at every width tried (+9% @4 rows
-                # to +27% @128, docs/PERF.md). The pool is a per-layer
-                # xs slice unless a "layer" index says it is the whole
-                # stacked pool (kernel-only).
+                # impls, picked by STATIC shapes: the gather+fused-XLA
+                # variant wins at every batch width when the page table
+                # is NARROW (+9% @4 rows to +32% @128, docs/PERF.md),
+                # but its gather reads Jmax·page columns for EVERY row,
+                # so at wide tables (high length variance) the Pallas
+                # kernel — whose per-cell skip bounds each row's work by
+                # its own pages — wins instead (measured on a Jmax≈30
+                # mixed fleet). Hence the two gates below
+                # (PAGED_XLA_PARTS_MIN_ROWS / _MAX_JMAX, defaults at
+                # the module constants with the measurement brackets).
+                # The pool is a per-layer xs slice unless a "layer"
+                # index says it is the whole stacked pool (kernel-only).
                 if (
                     kc.get("layer") is None
                     and q.shape[0] >= PAGED_XLA_PARTS_MIN_ROWS
+                    and kc["table"].shape[1] <= PAGED_XLA_PARTS_MAX_JMAX
                 ):
                     return xla_paged_decode_attention_parts(
                         q, kc["pool"], vc["pool"], kc["table"], lengths

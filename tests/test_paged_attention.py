@@ -629,3 +629,54 @@ def test_xla_parts_match_kernel_parts():
     # empty-prompt row: zero weight in the caller's merge
     assert not np.isfinite(np.asarray(m_x)[3]).any()
     assert (np.asarray(l_x)[3] == 0).all()
+
+
+def test_paged_parts_policy_is_width_and_jmax_aware(monkeypatch):
+    """The stacked parts impl choice is static-shape-driven: XLA parts
+    for wide batches with NARROW page tables; the Pallas kernel below
+    the row threshold OR when the table is wide (the XLA gather reads
+    Jmax pages for every row, so the longest row taxes all —
+    docs/PERF.md mixed-length A/B)."""
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine as je
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_paged_attention as ppa
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_attention import (
+        pallas_decode_attention,
+    )
+
+    monkeypatch.setattr(je, "PAGED_XLA_PARTS_MIN_ROWS", 4)
+    monkeypatch.setattr(je, "PAGED_XLA_PARTS_MAX_JMAX", 8)
+    monkeypatch.setattr(
+        ppa, "xla_paged_decode_attention_parts",
+        lambda *a, **k: "xla",
+    )
+    monkeypatch.setattr(
+        ppa, "pallas_paged_decode_attention_parts",
+        lambda *a, **k: "kernel",
+    )
+    engine = JaxEngine(
+        registry={"tiny": get_model_config("qwen2:1.5b").tiny()},
+        paged_kv=True,
+        decode_attention=pallas_decode_attention,  # enables kernels
+    )
+    da = engine._paged_decode_attention()
+
+    def kc(b, jmax):
+        return {
+            "pool": jnp.zeros((4, 2, 128, 128)),
+            "table": jnp.zeros((b, jmax), jnp.int32),
+            "side": jnp.zeros((b, 2, 8, 16)),
+        }
+
+    q = jnp.zeros((8, 4, 16))
+    lengths = jnp.zeros((8,), jnp.int32)
+    assert da(q, kc(8, 2), kc(8, 2), lengths) == "xla"  # wide B, narrow table
+    assert da(q, kc(8, 16), kc(8, 16), lengths) == "kernel"  # wide table
+    q2 = jnp.zeros((2, 4, 16))
+    l2 = jnp.zeros((2,), jnp.int32)
+    assert da(q2, kc(2, 2), kc(2, 2), l2) == "kernel"  # below row threshold
