@@ -33,6 +33,12 @@ import jax.numpy as jnp
 DEFAULT_PAGE_SIZE = 128
 
 
+def _codes(leaf):
+    """The array that carries a pool leaf's page/shape layout: the int8
+    codes of a quantized ``{"q","s"}`` leaf, the array itself otherwise."""
+    return leaf["q"] if isinstance(leaf, dict) else leaf
+
+
 class PagePoolExhausted(RuntimeError):
     """No free pages left — the scheduler must evict or defer admission."""
 
@@ -43,10 +49,20 @@ class PagePool:
 
     The arrays are functional (every write returns new arrays); the
     allocator is host state owned by whoever schedules requests.
+
+    ``quantized=True`` makes each pool leaf an int8 ``{"q": codes
+    [L, P, Hkv, page, D], "s": f32 scales [L, P, Hkv, page]}`` dict —
+    one symmetric scale per (layer, page, head, position) vector, the
+    exact scheme of the contiguous int8 KV cache
+    (models/quantize.quantize_kv_cache), so a row's quantized stream is
+    bit-identical whichever cache layout holds it. Codes are 1 byte and
+    the scale is 4 bytes per D-vector: pages are ~(D+4)/2D the bytes of
+    bf16 pages — the density that lets paged+int8 admit the larger
+    fleet at a fixed KV budget (docs/PERF.md admission A/B).
     """
 
-    k: jnp.ndarray  # [L, P, Hkv, page, D]
-    v: jnp.ndarray
+    k: "jnp.ndarray | dict"  # [L, P, Hkv, page, D] — or {"q","s"}
+    v: "jnp.ndarray | dict"
     page_size: int
     _free: List[int] = dataclasses.field(default_factory=list)
 
@@ -59,18 +75,32 @@ class PagePool:
         d_head: int,
         page_size: int = DEFAULT_PAGE_SIZE,
         dtype=jnp.bfloat16,
+        quantized: bool = False,
     ) -> "PagePool":
         shape = (n_layers, n_pages, n_kv_heads, page_size, d_head)
+
+        def leaf():
+            if quantized:
+                return {
+                    "q": jnp.zeros(shape, jnp.int8),
+                    "s": jnp.zeros(shape[:-1], jnp.float32),
+                }
+            return jnp.zeros(shape, dtype)
+
         return cls(
-            k=jnp.zeros(shape, dtype),
-            v=jnp.zeros(shape, dtype),
+            k=leaf(),
+            v=leaf(),
             page_size=page_size,
             _free=list(range(n_pages)),
         )
 
     @property
+    def quantized(self) -> bool:
+        return isinstance(self.k, dict)
+
+    @property
     def n_pages(self) -> int:
-        return self.k.shape[1]
+        return _codes(self.k).shape[1]
 
     @property
     def free_pages(self) -> int:
@@ -112,8 +142,8 @@ def page_slot(table, lengths, page_size: int):
 
 
 def write_token(
-    pool_k: jnp.ndarray,  # [L, P, Hkv, page, D]
-    pool_v: jnp.ndarray,
+    pool_k: "jnp.ndarray | dict",  # [L, P, Hkv, page, D] — or {"q","s"}
+    pool_v: "jnp.ndarray | dict",
     page_table_row: jnp.ndarray,  # [Jmax] int32 — ONE request's pages
     length: jnp.ndarray,  # scalar int32: tokens already written
     k_vec: jnp.ndarray,  # [L, Hkv, D] — this token's K across layers
@@ -124,15 +154,32 @@ def write_token(
     Single-row convenience over :func:`page_slot`; the engine's batched
     decode loop does the same addressing per row inside
     ``models/transformer._attention_block`` (also via :func:`page_slot`).
+    Quantized pools quantize the vector with the decode-step scale math
+    (models/quantize.quantize_kv_vector) and write codes + scale.
     """
-    page_size = pool_k.shape[3]
+    page_size = _codes(pool_k).shape[3]
     page, slot = page_slot(page_table_row, length, page_size)
-    # [L, Hkv, D] → [L, 1, Hkv, 1, D] at (layer 0, page, head 0, slot, 0)
-    kv = k_vec[:, None, :, None, :].astype(pool_k.dtype)
-    vv = v_vec[:, None, :, None, :].astype(pool_v.dtype)
-    pool_k = jax.lax.dynamic_update_slice(pool_k, kv, (0, page, 0, slot, 0))
-    pool_v = jax.lax.dynamic_update_slice(pool_v, vv, (0, page, 0, slot, 0))
-    return pool_k, pool_v
+
+    def write(pool, vec):
+        if isinstance(pool, dict):
+            from ..models.quantize import quantize_kv_vector
+
+            q, s = quantize_kv_vector(vec)  # [L,Hkv,D] int8, [L,Hkv] f32
+            return {
+                "q": jax.lax.dynamic_update_slice(
+                    pool["q"], q[:, None, :, None, :], (0, page, 0, slot, 0)
+                ),
+                "s": jax.lax.dynamic_update_slice(
+                    pool["s"], s[:, None, :, None], (0, page, 0, slot)
+                ),
+            }
+        # [L, Hkv, D] → [L, 1, Hkv, 1, D] at (layer 0, page, head 0, slot, 0)
+        return jax.lax.dynamic_update_slice(
+            pool, vec[:, None, :, None, :].astype(pool.dtype),
+            (0, page, 0, slot, 0),
+        )
+
+    return write(pool_k, k_vec), write(pool_v, v_vec)
 
 
 def _paginate(seq: jnp.ndarray, s_real: int, page_size: int) -> jnp.ndarray:
@@ -193,30 +240,59 @@ def group_chunks(
     return prep(k_cache), prep(v_cache)
 
 
-def scatter_pages(
-    pool_k: jnp.ndarray,  # [L, P, Hkv, page, D]
-    pool_v: jnp.ndarray,
-    page_indices: jnp.ndarray,  # [N] int32 — destination pool pages
-    k_chunks: jnp.ndarray,  # [N, L, Hkv, page, D]
+def quantize_chunks(
+    k_chunks: jnp.ndarray,  # [N, L, Hkv, page, D] bf16/f32
     v_chunks: jnp.ndarray,
+) -> Tuple[dict, dict]:
+    """Per-position int8 quantization of page chunks, for scattering
+    into a quantized pool: ``{"q": int8 [N,L,Hkv,page,D], "s": f32
+    [N,L,Hkv,page]}``. Routes through ``quantize_kv_vector`` — the ONE
+    source of the scale math — so every real position's codes/scale are
+    bit-identical to the contiguous int8 path's bulk quantization of the
+    same vectors (tail-page padding quantizes to zero codes at the
+    epsilon scale; attention masks those positions by real lengths)."""
+    from ..models.quantize import quantize_kv_vector
+
+    kq, ks = quantize_kv_vector(k_chunks)
+    vq, vs = quantize_kv_vector(v_chunks)
+    return {"q": kq, "s": ks}, {"q": vq, "s": vs}
+
+
+def scatter_pages(
+    pool_k: "jnp.ndarray | dict",  # [L, P, Hkv, page, D] — or {"q","s"}
+    pool_v: "jnp.ndarray | dict",
+    page_indices: jnp.ndarray,  # [N] int32 — destination pool pages
+    k_chunks: "jnp.ndarray | dict",  # [N, L, Hkv, page, D] — or {"q","s"}
+    v_chunks: "jnp.ndarray | dict",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Write N pages into the pool in ONE scatter per pool (a single
     full-pool copy), instead of one ``dynamic_update_slice`` — and one
     full-pool copy — per page. This is what makes batch assembly O(1)
-    pool copies regardless of how many pages the batch holds."""
+    pool copies regardless of how many pages the batch holds. Quantized
+    pools take :func:`quantize_chunks` output and scatter codes and
+    scales alike (two scatters per pool — still O(1) pool copies)."""
     idx = jnp.asarray(page_indices, jnp.int32)
-    pool_k = pool_k.at[:, idx].set(
-        k_chunks.transpose(1, 0, 2, 3, 4).astype(pool_k.dtype)
-    )
-    pool_v = pool_v.at[:, idx].set(
-        v_chunks.transpose(1, 0, 2, 3, 4).astype(pool_v.dtype)
-    )
-    return pool_k, pool_v
+
+    def scatter(pool, chunks):
+        if isinstance(pool, dict):
+            return {
+                "q": pool["q"].at[:, idx].set(
+                    chunks["q"].transpose(1, 0, 2, 3, 4).astype(jnp.int8)
+                ),
+                "s": pool["s"].at[:, idx].set(
+                    chunks["s"].transpose(1, 0, 2, 3).astype(jnp.float32)
+                ),
+            }
+        return pool.at[:, idx].set(
+            chunks.transpose(1, 0, 2, 3, 4).astype(pool.dtype)
+        )
+
+    return scatter(pool_k, k_chunks), scatter(pool_v, v_chunks)
 
 
 def write_prefill(
-    pool_k: jnp.ndarray,
-    pool_v: jnp.ndarray,
+    pool_k: "jnp.ndarray | dict",
+    pool_v: "jnp.ndarray | dict",
     page_table_row: jnp.ndarray,  # [Jmax]
     k_seq: jnp.ndarray,  # [L, Hkv, S, D] — a prefilled contiguous slab
     v_seq: jnp.ndarray,
@@ -224,15 +300,16 @@ def write_prefill(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Scatter one request's contiguous prefill result into its pages:
     prefill stays a dense contiguous computation — paging only changes
-    where the result lives. One scatter for all its pages; batch callers
-    should paginate every row and make a single :func:`scatter_pages`
-    call instead."""
-    page_size = pool_k.shape[3]
+    where the result lives (quantized pools quantize the chunks on the
+    way in). One scatter for all its pages; batch callers should
+    paginate every row and make a single :func:`scatter_pages` call
+    instead."""
+    page_size = _codes(pool_k).shape[3]
     n_pages = -(-s_real // page_size)
+    k_chunks = _paginate(k_seq, s_real, page_size)
+    v_chunks = _paginate(v_seq, s_real, page_size)
+    if isinstance(pool_k, dict):
+        k_chunks, v_chunks = quantize_chunks(k_chunks, v_chunks)
     return scatter_pages(
-        pool_k,
-        pool_v,
-        page_table_row[:n_pages],
-        _paginate(k_seq, s_real, page_size),
-        _paginate(v_seq, s_real, page_size),
+        pool_k, pool_v, page_table_row[:n_pages], k_chunks, v_chunks
     )

@@ -120,8 +120,14 @@ def _gather_paged(leaf, dtype=jnp.float32) -> jnp.ndarray:
         )
     pool, table = leaf["pool"], leaf["table"]
     b, jmax = table.shape
-    _, hkv, page, dpool = pool.shape
-    gathered = pool[table]  # [B, Jmax, Hkv, page, D]
+    if isinstance(pool, dict):  # int8 pages: dequant the gathered pages
+        _, hkv, page, dpool = pool["q"].shape
+        gathered = pool["q"][table].astype(jnp.float32) * (
+            pool["s"][table].astype(jnp.float32)[..., None]
+        )  # [B, Jmax, Hkv, page, D]
+    else:
+        _, hkv, page, dpool = pool.shape
+        gathered = pool[table]
     return (
         gathered.transpose(0, 2, 1, 3, 4)
         .reshape(b, hkv, jmax * page, dpool)
@@ -257,8 +263,14 @@ def _attention_block(
     carry_cache = is_carry_cache(k_cache)
     if paged_cache:
         # pool is [P,Hkv,page,D] (per-layer) or [L,P,Hkv,page,Dp]
-        # (stacked): the page dim is [-2] in both
-        t = k_cache["table"].shape[1] * k_cache["pool"].shape[-2]
+        # (stacked) — possibly an int8 {"q","s"} dict (codes share the
+        # bf16 layout): the page dim is [-2] in all forms
+        pool_codes = (
+            k_cache["pool"]["q"]
+            if isinstance(k_cache["pool"], dict)
+            else k_cache["pool"]
+        )
+        t = k_cache["table"].shape[1] * pool_codes.shape[-2]
     elif carry_cache:
         _all = k_cache["all"]
         t = (_all["q"] if isinstance(_all, dict) else _all).shape[3]
@@ -315,54 +327,67 @@ def _attention_block(
             # the side is the whole [L,B,Hkv,Tgen,D] stack riding the
             # decode carry (is_carry_cache rationale: scan ys wrote back
             # the full per-layer side every layer), and only this
-            # token's row is written at [layer, row, :, wp].
+            # token's row is written at [layer, row, :, wp]. An int8-KV
+            # engine's side caches are {"q","s"} dicts: the step's
+            # vector quantizes with the decode-step scale math
+            # (quantize_kv_vector) so generated tokens see the same
+            # quantization as the contiguous int8 path's.
             rows = jnp.arange(b)
             wp = k_cache["write_pos"]  # [B]
-            if "side_layer" in k_cache:
-                sli = k_cache["side_layer"]
-                k_cache = {
-                    **k_cache,
-                    "side": k_cache["side"]
-                    .at[sli, rows, :, wp]
-                    .set(k[:, 0].astype(k_cache["side"].dtype)),
-                }
-                v_cache = {
-                    **v_cache,
-                    "side": v_cache["side"]
-                    .at[sli, rows, :, wp]
-                    .set(v[:, 0].astype(v_cache["side"].dtype)),
-                }
-            else:
-                k_cache = {
-                    **k_cache,
-                    "side": k_cache["side"]
-                    .at[rows, :, wp]
-                    .set(k[:, 0].astype(k_cache["side"].dtype)),
-                }
-                v_cache = {
-                    **v_cache,
-                    "side": v_cache["side"]
-                    .at[rows, :, wp]
-                    .set(v[:, 0].astype(v_cache["side"].dtype)),
-                }
+
+            def side_write(cache, vec):  # vec [B,Hkv,D]
+                side = cache["side"]
+                sli = cache.get("side_layer")
+                if isinstance(side, dict):
+                    q_, s_ = quantize_kv_vector(vec)
+                    if sli is not None:
+                        new = {
+                            "q": side["q"].at[sli, rows, :, wp].set(q_),
+                            "s": side["s"].at[sli, rows, :, wp].set(s_),
+                        }
+                    else:
+                        new = {
+                            "q": side["q"].at[rows, :, wp].set(q_),
+                            "s": side["s"].at[rows, :, wp].set(s_),
+                        }
+                elif sli is not None:
+                    new = side.at[sli, rows, :, wp].set(
+                        vec.astype(side.dtype)
+                    )
+                else:
+                    new = side.at[rows, :, wp].set(vec.astype(side.dtype))
+                return {**cache, "side": new}
+
+            k_cache = side_write(k_cache, k[:, 0])
+            v_cache = side_write(v_cache, v[:, 0])
         else:
             from ..engine.paged_kv import page_slot
 
-            page_size = k_cache["pool"].shape[-2]
+            pool_k_leaf = k_cache["pool"]
+            page_size = (
+                pool_k_leaf["q"]
+                if isinstance(pool_k_leaf, dict)
+                else pool_k_leaf
+            ).shape[-2]
             off_b = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b,))
             pages, slots = page_slot(table, off_b, page_size)  # [B], [B]
-            k_cache = {
-                **k_cache,
-                "pool": k_cache["pool"]
-                .at[pages, :, slots]
-                .set(k[:, 0].astype(k_cache["pool"].dtype)),
-            }
-            v_cache = {
-                **v_cache,
-                "pool": v_cache["pool"]
-                .at[pages, :, slots]
-                .set(v[:, 0].astype(v_cache["pool"].dtype)),
-            }
+
+            def pool_write(cache, vec):  # vec [B,Hkv,D]
+                pool = cache["pool"]
+                if isinstance(pool, dict):  # int8 pages: codes + scale
+                    q_, s_ = quantize_kv_vector(vec)
+                    new = {
+                        "q": pool["q"].at[pages, :, slots].set(q_),
+                        "s": pool["s"].at[pages, :, slots].set(s_),
+                    }
+                else:
+                    new = pool.at[pages, :, slots].set(
+                        vec.astype(pool.dtype)
+                    )
+                return {**cache, "pool": new}
+
+            k_cache = pool_write(k_cache, k[:, 0])
+            v_cache = pool_write(v_cache, v[:, 0])
     elif quant_cache:
         # Quantize the new entry and write codes + per-vector scale.
         # Only the solo (scalar-offset) path reaches here: batched
@@ -476,16 +501,25 @@ def _attention_block(
         )
         wp = k_cache["write_pos"]
         qg = q[:, 0].reshape(b, hkv, group, dh).astype(jnp.float32)
-        if "side_layer" in k_cache:  # carry-resident: this layer's slice
-            ks = jax.lax.dynamic_index_in_dim(
-                k_cache["side"], k_cache["side_layer"], 0, keepdims=False
-            ).astype(jnp.float32)
-            vs = jax.lax.dynamic_index_in_dim(
-                v_cache["side"], v_cache["side_layer"], 0, keepdims=False
-            ).astype(jnp.float32)
-        else:
-            ks = k_cache["side"].astype(jnp.float32)  # [B,Hkv,Tgen,D]
-            vs = v_cache["side"].astype(jnp.float32)
+
+        def side_view(cache):  # → f32 [B,Hkv,Tgen,D]
+            side = cache["side"]
+            sli = cache.get("side_layer")
+            if sli is not None:  # carry-resident: this layer's slice
+                take = functools.partial(
+                    jax.lax.dynamic_index_in_dim,
+                    index=sli, axis=0, keepdims=False,
+                )
+            else:
+                take = lambda a: a  # noqa: E731
+            if isinstance(side, dict):  # int8 side: dequant the slice
+                return take(side["q"]).astype(jnp.float32) * take(
+                    side["s"]
+                ).astype(jnp.float32)[..., None]
+            return take(side).astype(jnp.float32)
+
+        ks = side_view(k_cache)
+        vs = side_view(v_cache)
         s2 = jnp.einsum("bkgd,bktd->bkgt", qg, ks) * scale
         tpos = jnp.arange(ks.shape[2])
         s2 = jnp.where(
@@ -667,6 +701,11 @@ def run_blocks(
         # (1.5 ms/step at 128 rows, docs/paged_trace_128rows.json), the
         # same copy tax the contiguous path's carry-resident cache
         # removed.
+        pool_codes = (
+            k_cache["pool"]["q"]
+            if isinstance(k_cache["pool"], dict)
+            else k_cache["pool"]
+        )
         (x, new_ks, new_vs), _ = jax.lax.scan(
             block_paged,
             (x, k_cache["side"], v_cache["side"]),
@@ -674,7 +713,7 @@ def run_blocks(
                 stacked,
                 k_cache["pool"],
                 v_cache["pool"],
-                jnp.arange(k_cache["pool"].shape[0]),
+                jnp.arange(pool_codes.shape[0]),
             ),
         )
         return (

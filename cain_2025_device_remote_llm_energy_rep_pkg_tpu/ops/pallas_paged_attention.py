@@ -36,9 +36,20 @@ Two entry points share the accumulation body (``_accumulate_page``):
   streams the read-only pool as xs); passing ``layer`` instead takes
   the whole [L, P, Hkv, page, Dp] stacked pool with the layer folded
   into the DMA offset.
+- :func:`pallas_paged_decode_attention_parts_int8` — the same parts
+  contract over an int8 page pool (codes + per-position scales,
+  engine/paged_kv.py quantized mode). Dequantization never
+  materialises: K's per-position scale multiplies the score column it
+  produced and V's scale folds into the probability row — the identical
+  trick the solo ``pallas_decode_attention_int8`` kernel uses. Scales
+  ship with a trailing singleton lane dim ([..., page, 1]) for the same
+  Mosaic tiling reason (the round-5 int8-KV lowering lesson).
+- :func:`xla_paged_decode_attention_parts_int8` — the gather+fused-XLA
+  sibling for wide batches with narrow tables, dequantizing only the
+  gathered pages.
 
 Parity is pinned against a gather-then-attend reference on scattered page
-permutations (tests/test_paged_attention.py).
+permutations (tests/test_paged_attention.py, tests/test_paged_int8.py).
 """
 
 from __future__ import annotations
@@ -174,6 +185,96 @@ def _paged_decode_parts_kernel(
     def _block():
         _accumulate_page(
             q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+            block_start, length, scale,
+        )
+
+    @pl.when(j == n_pages_per_req - 1)
+    def _emit():
+        acc_out_ref[0, 0] = acc_ref[...]
+        m_out_ref[0, 0] = m_ref[...]
+        l_out_ref[0, 0] = l_ref[...]
+
+
+def _accumulate_page_int8(
+    q_ref, k_ref, ks_ref, v_ref, vs_ref, m_ref, l_ref, acc_ref,
+    block_start, length, scale,
+):
+    """One int8 page's online-softmax update: K's per-position scale is
+    applied to the score COLUMN it produced (scales commute with the q·k
+    dot over D) and V's scale folds into the probability row before the
+    p·v dot — two [G,page] multiplies instead of a [page,D] dequant.
+    Reshapes serve the per-layer ([1,1,page,Dp]) and stacked
+    ([1,1,1,page,Dp]) blocks alike; scales ride a trailing singleton
+    lane dim (see the module docstring)."""
+    q = q_ref[0, 0].astype(jnp.float32)  # [G,D]
+    k = k_ref[...].reshape(k_ref.shape[-2:]).astype(jnp.float32)  # codes
+    ks = ks_ref[...].reshape(ks_ref.shape[-2:])[:, 0].astype(jnp.float32)
+    vs = vs_ref[...].reshape(vs_ref.shape[-2:])[:, 0].astype(jnp.float32)
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+        * ks[None, :]
+    )  # [G,page]
+    idx = block_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(idx < length, s, -jnp.inf)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    v = v_ref[...].reshape(v_ref.shape[-2:]).astype(jnp.float32)  # codes
+    pv = jax.lax.dot_general(
+        p * vs[None, :],  # v dequant folded into the probability row
+        v,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
+def _paged_decode_parts_int8_kernel(
+    page_table_ref,
+    lengths_ref,
+    _layer_ref,  # consumed by the index maps
+    q_ref,
+    k_ref,  # VMEM [1, 1, (1,) page, Dp] int8 codes
+    ks_ref,  # VMEM [1, 1, (1,) page, 1] f32 per-position K scales
+    v_ref,
+    vs_ref,
+    acc_out_ref,
+    m_out_ref,
+    l_out_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    page: int,
+    n_pages_per_req: int,
+    scale: float,
+):
+    """Int8 twin of :func:`_paged_decode_parts_kernel`: same grid, same
+    (acc, m, l) contract, codes+scales instead of bf16 pages."""
+    b_i = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        _init_scratch(m_ref, l_ref, acc_ref)
+
+    length = lengths_ref[b_i]
+    block_start = j * page
+
+    @pl.when(block_start < length)
+    def _block():
+        _accumulate_page_int8(
+            q_ref, k_ref, ks_ref, v_ref, vs_ref, m_ref, l_ref, acc_ref,
             block_start, length, scale,
         )
 
@@ -385,6 +486,135 @@ def pallas_paged_decode_attention_parts(
     return acc, m[..., 0], l[..., 0]
 
 
+def pallas_paged_decode_attention_parts_int8(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pool: jnp.ndarray,  # int8 codes [P, Hkv, page, Dp] — or [L, P, ...]
+    k_scale: jnp.ndarray,  # f32 [P, Hkv, page] — or [L, P, Hkv, page]
+    v_pool: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, Jmax] int32
+    lengths: jnp.ndarray,  # [B] int32 — CACHED tokens (current excluded)
+    *,
+    layer: Optional[jnp.ndarray] = None,  # scalar int32: stacked pools
+    interpret: Optional[bool] = None,
+) -> "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]":
+    """Unnormalised flash-decode parts over an INT8 page pool — the
+    quantized twin of :func:`pallas_paged_decode_attention_parts`, math-
+    identical to running it on the dequantized pool (scales commute with
+    the dots). Same ``(acc [B,Hkv,G,D] f32, m, l)`` contract, same
+    per-layer-xs vs stacked-``layer`` duality, same pre-padded-Dp
+    requirement (codes at the 128-lane-padded head dim; pad lanes carry
+    zero codes, contributing nothing)."""
+    b, hq, d = q.shape
+    stacked = layer is not None
+    if stacked:
+        _, n_pool, hkv, page, dp = k_pool.shape
+    else:
+        n_pool, hkv, page, dp = k_pool.shape
+    if dp % 128:
+        raise ValueError(
+            f"pools must be pre-padded to a 128-multiple head "
+            f"dim, got {dp} (per-call padding would copy the pool)"
+        )
+    d_pad = dp - d
+    jmax = page_table.shape[1]
+    group = hq // hkv
+    scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    qr = q.reshape(b, hkv, group, d)
+    if d_pad:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, 0), (0, d_pad)))
+    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pool - 1)
+    # scales ride a trailing singleton lane dim: a [..., page] block
+    # would put 1 in the sublane slot over Hkv>1, which Mosaic's tiling
+    # rule rejects (the round-5 int8-KV lowering bug, fixed the same way
+    # in ops/pallas_attention._decode_kernel_int8)
+    ks = k_scale.astype(jnp.float32)[..., None]
+    vs = v_scale.astype(jnp.float32)[..., None]
+
+    base_kernel = functools.partial(
+        _paged_decode_parts_int8_kernel,
+        page=page,
+        n_pages_per_req=jmax,
+        scale=scale,
+    )
+
+    if stacked:
+        kernel = base_kernel
+        num_prefetch = 3
+        prefetch_args = (
+            table,
+            lengths.astype(jnp.int32),
+            jnp.reshape(layer, (1,)).astype(jnp.int32),
+        )
+
+        def q_index(b_i, h, j, tab, lens, lay):
+            return (b_i, h, 0, 0)
+
+        def kv_index(b_i, h, j, tab, lens, lay):
+            return (
+                lay[0],
+                tab[b_i, _last_valid_page(j, b_i, lens, page)],
+                h,
+                0,
+                0,
+            )
+
+        kv_block = (1, 1, 1, page, dp)
+        scale_block = (1, 1, 1, page, 1)
+    else:
+        def kernel(table_ref, lengths_ref, *rest):
+            return base_kernel(table_ref, lengths_ref, None, *rest)
+
+        num_prefetch = 2
+        prefetch_args = (table, lengths.astype(jnp.int32))
+
+        def q_index(b_i, h, j, tab, lens):
+            return (b_i, h, 0, 0)
+
+        def kv_index(b_i, h, j, tab, lens):
+            return (tab[b_i, _last_valid_page(j, b_i, lens, page)], h, 0, 0)
+
+        kv_block = (1, 1, page, dp)
+        scale_block = (1, 1, page, 1)
+
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=num_prefetch,
+            grid=(b, hkv, jmax),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, dp), q_index),
+                pl.BlockSpec(kv_block, kv_index),
+                pl.BlockSpec(scale_block, kv_index),
+                pl.BlockSpec(kv_block, kv_index),
+                pl.BlockSpec(scale_block, kv_index),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, group, dp), q_index),
+                pl.BlockSpec((1, 1, group, 128), q_index),
+                pl.BlockSpec((1, 1, group, 128), q_index),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, dp), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, group, dp), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, group, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, group, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*prefetch_args, qr, k_pool, ks, v_pool, vs)
+    if d_pad:
+        acc = acc[..., :d]
+    return acc, m[..., 0], l[..., 0]
+
+
 def paged_decode_attention_reference(
     q: jnp.ndarray,
     k_pool: jnp.ndarray,
@@ -443,7 +673,6 @@ def xla_paged_decode_attention_parts(
     b, hq, d = q.shape
     n_pool, hkv, page, dp = k_pool.shape
     jmax = page_table.shape[1]
-    group = hq // hkv
     t = jmax * page
     table = jnp.clip(page_table.astype(jnp.int32), 0, n_pool - 1)
     # [B, Jmax, Hkv, page, Dp] → [B, Hkv, T, D] (drop lane padding)
@@ -451,6 +680,16 @@ def xla_paged_decode_attention_parts(
     vf = v_pool[table].transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dp)
     kf = kf[..., :d].astype(jnp.float32)
     vf = vf[..., :d].astype(jnp.float32)
+    return _dense_parts(q, kf, vf, lengths)
+
+
+def _dense_parts(q, kf, vf, lengths):
+    """The shared score/softmax-parts math of the gather-based variants:
+    ``q [B,Hq,D]`` against dense f32 ``kf/vf [B,Hkv,T,D]`` → the
+    unnormalised ``(acc, m, l)`` contract, mask by ``lengths``."""
+    b, hq, d = q.shape
+    _, hkv, t, _ = kf.shape
+    group = hq // hkv
     qg = q.reshape(b, hkv, group, d).astype(jnp.float32)
     scores = jnp.einsum("bkgd,bktd->bkgt", qg, kf) / math.sqrt(d)
     mask = jnp.arange(t)[None, :] < lengths[:, None]  # [B, T]
@@ -461,3 +700,37 @@ def xla_paged_decode_attention_parts(
     l = jnp.sum(p, axis=-1)
     acc = jnp.einsum("bkgt,bktd->bkgd", p, vf)
     return acc, m, l
+
+
+def xla_paged_decode_attention_parts_int8(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pool: jnp.ndarray,  # int8 codes [P, Hkv, page, Dp]
+    k_scale: jnp.ndarray,  # f32 [P, Hkv, page]
+    v_pool: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, Jmax] int32
+    lengths: jnp.ndarray,  # [B] int32
+) -> "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]":
+    """Gather-based int8 parts — the wide-batch sibling of
+    :func:`pallas_paged_decode_attention_parts_int8`. Only the pages the
+    table names are dequantized (the small linear gather the XLA variant
+    already pays; dequant fuses into it), so the POOL stays int8-dense in
+    HBM — the capacity point of the quantized pool is untouched."""
+    b, hq, d = q.shape
+    n_pool, hkv, page, dp = k_pool.shape
+    jmax = page_table.shape[1]
+    t = jmax * page
+    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pool - 1)
+
+    def gather_dequant(codes, scales):
+        g = codes[table].astype(jnp.float32) * (
+            scales[table].astype(jnp.float32)[..., None]
+        )  # [B, Jmax, Hkv, page, Dp]
+        return g.transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, dp)[..., :d]
+
+    return _dense_parts(
+        q,
+        gather_dequant(k_pool, k_scale),
+        gather_dequant(v_pool, v_scale),
+        lengths,
+    )

@@ -1,0 +1,41 @@
+"""``jax.shard_map`` version compatibility — one shim, three callers.
+
+The public ``jax.shard_map`` (with its replication-check flag named
+``check_vma``) only exists on newer jax releases; 0.4.x stacks expose the
+same transform as ``jax.experimental.shard_map.shard_map`` with the flag
+named ``check_rep``. Every parallel module (tp/pp/ring) imports
+:func:`shard_map` from here so the repo runs on both stacks — the
+alternative was a hard collection-time ImportError that took the whole
+TP/PP/ring suite (and every test importing ``parallel``) down on older
+jax, exactly the failure mode the tier-1 suite showed on a 0.4.37 image.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # newer jax: public API, flag named check_vma
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental API, flag named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the modern ``check_vma`` spelling accepted
+    on both stacks (translated to ``check_rep`` where needed)."""
+    if "check_vma" in kwargs and _CHECK_KW != "check_vma":
+        kwargs[_CHECK_KW] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)
+
+
+def axis_size(axis_name) -> "jax.numpy.ndarray":
+    """``jax.lax.axis_size`` on stacks that have it; 0.4.x spells the
+    same query ``psum(1, axis)`` (constant-folded by the partitioner, so
+    no collective actually runs)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

@@ -21,7 +21,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .compat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -55,7 +55,7 @@ def ring_attention(
     axis_name: str = "sp",
 ) -> jnp.ndarray:
     """Causal attention across the ``axis_name`` ring. Call inside shard_map."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     b, s_loc, hq, d = q.shape
     hkv = k.shape[2]

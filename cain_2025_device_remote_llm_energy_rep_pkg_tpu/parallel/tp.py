@@ -112,11 +112,22 @@ class TensorParallelEngine(JaxEngine):
 
     def _place_pool(self, cfg: ModelConfig, pool_k, pool_v, table):
         """Shard the page pool's heads over the mesh (pages replicated,
-        like the contiguous cache's batch axis; table replicated)."""
+        like the contiguous cache's batch axis; table replicated). Int8
+        pools place codes with the pool spec and the per-position scales
+        with the head-reduced ``pool_scale`` spec."""
         shardings = paged_pool_shardings(cfg, self.mesh)
+
+        def put(pool):
+            if isinstance(pool, dict):
+                return {
+                    "q": jax.device_put(pool["q"], shardings["pool"]),
+                    "s": jax.device_put(pool["s"], shardings["pool_scale"]),
+                }
+            return jax.device_put(pool, shardings["pool"])
+
         return (
-            jax.device_put(pool_k, shardings["pool"]),
-            jax.device_put(pool_v, shardings["pool"]),
+            put(pool_k),
+            put(pool_v),
             jax.device_put(table, shardings["table"]),
         )
 
@@ -144,16 +155,19 @@ class TensorParallelEngine(JaxEngine):
         # actually has, or every step pays a hidden reshard.
         if cfg is None or tuple(cache_spec(cfg, self.mesh))[2] != "tp":
             return None  # gather fallback: heads can't shard
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from .compat import shard_map
 
         from ..ops.pallas_paged_attention import (
             pallas_paged_decode_attention_parts,
+            pallas_paged_decode_attention_parts_int8,
         )
 
         mesh = self.mesh
         q_spec = P(None, "tp", None)  # [B, Hq, D]
         pool_spec = P(None, "tp", None, None)  # [P, Hkv, page, D]
+        scale_spec = P(None, "tp", None)  # [P, Hkv, page]
         acc_spec = P(None, "tp", None, None)  # [B, Hkv, G, D]
         ml_spec = P(None, "tp", None)  # [B, Hkv, G]
 
@@ -165,6 +179,31 @@ class TensorParallelEngine(JaxEngine):
                 raise NotImplementedError(
                     "TP paged rule covers the per-layer stacked parts "
                     "path only"
+                )
+            if isinstance(kc["pool"], dict):
+                # int8 pool: codes shard like the pool, the per-position
+                # scales like the head-reduced pool_scale placement —
+                # the kernel's head-independence is unchanged (each
+                # device folds its own head shard's scales)
+                def inner_int8(q_, kq_, ks_, vq_, vs_, t_, l_):
+                    return pallas_paged_decode_attention_parts_int8(
+                        q_, kq_, ks_, vq_, vs_, t_, l_
+                    )
+
+                return shard_map(
+                    inner_int8,
+                    mesh=mesh,
+                    in_specs=(
+                        q_spec, pool_spec, scale_spec,
+                        pool_spec, scale_spec, P(), P(),
+                    ),
+                    out_specs=(acc_spec, ml_spec, ml_spec),
+                    check_vma=False,
+                )(
+                    q,
+                    kc["pool"]["q"], kc["pool"]["s"],
+                    vc["pool"]["q"], vc["pool"]["s"],
+                    kc["table"], lengths,
                 )
 
             def inner_fn(q_, k_, v_, t_, l_):

@@ -144,7 +144,10 @@ commands:
                        Ollama-equivalent): --host H --port N (default 11434),
                        --backend jax|jax-tp|fake, --tp N, --models a,b,c,
                        --batch-window-ms W --max-batch B (continuous batching
-                       of concurrent requests; off by default),
+                       of concurrent requests; off by default;
+                       --no-budget-admission pins the cap at --max-batch
+                       instead of raising it to the engine's KV-budget
+                       estimate),
                        --hf model=/ckpt/dir (serve trained weights + that
                        checkpoint's tokenizer; repeatable),
                        --quantize int8|int4|none or per-model
@@ -170,6 +173,7 @@ def serve_command(args: List[str]) -> None:
     models: Optional[List[str]] = None
     batch_window_ms = 0.0
     max_batch = None  # backend-aware default (serve/scheduler.py)
+    budget_aware = None  # auto: KV-budget admission when estimable
     hf_checkpoints = {}
     quantize = None
     kv_quantize = None
@@ -192,6 +196,8 @@ def serve_command(args: List[str]) -> None:
             batch_window_ms = float(next(it, "0"))
         elif arg == "--max-batch":
             max_batch = int(next(it, "0")) or None
+        elif arg == "--no-budget-admission":
+            budget_aware = False
         elif arg == "--hf":
             # --hf model=/path/to/checkpoint (repeatable): serve the model
             # from a local HF checkpoint (trained weights + its tokenizer)
@@ -302,6 +308,7 @@ def serve_command(args: List[str]) -> None:
         models=models,
         batch_window_ms=batch_window_ms,
         max_batch=max_batch,
+        budget_aware=budget_aware,
     )
     server.serve_forever()
 

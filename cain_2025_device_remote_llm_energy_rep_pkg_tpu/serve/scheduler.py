@@ -55,6 +55,17 @@ class BatchScheduler:
     8 for backends inheriting the base class's sequential
     ``generate_batch`` loop (fake backend), where a wider batch only
     multiplies every caller's wait for the sweep to finish.
+
+    Admission is additionally BUDGET-AWARE on backends that expose
+    ``max_admission_rows`` (``JaxEngine.max_admission_rows`` — the
+    widest batch bucket whose estimated K+V footprint fits
+    ``BATCH_KV_BUDGET_BYTES`` under the engine's cache layout): each
+    batch's cap is the LARGER of ``max_batch`` and that estimate for the
+    batch's first request. Denser cache layouts therefore admit more
+    concurrent callers into one decode window at the same device budget
+    — paged+int8 serving admits the 2–4× fleet its pages pay for
+    (docs/PERF.md admission A/B) instead of stopping at the static cap.
+    ``budget_aware=False`` opts out (fixed-cap behavior).
     """
 
     def __init__(
@@ -63,6 +74,7 @@ class BatchScheduler:
         max_batch: Optional[int] = None,
         window_s: float = 0.05,
         lock: Optional[threading.Lock] = None,
+        budget_aware: Optional[bool] = None,
     ) -> None:
         self.backend = backend
         if max_batch is None:
@@ -72,6 +84,11 @@ class BatchScheduler:
             )
             max_batch = 32 if batched else 8
         self.max_batch = max_batch
+        if budget_aware is None:  # auto: on when the backend can estimate
+            budget_aware = hasattr(backend, "max_admission_rows")
+        self.budget_aware = bool(
+            budget_aware and hasattr(backend, "max_admission_rows")
+        )
         self.window_s = window_s
         # Shared with the server's streaming path so batched and streamed
         # generations never run concurrently on one accelerator.
@@ -150,14 +167,29 @@ class BatchScheduler:
     def _compatible(a: GenerationRequest, b: GenerationRequest) -> bool:
         return a.model == b.model and a.top_k == b.top_k
 
+    def _admission_cap(self, first: _Ticket) -> int:
+        """Row cap for the batch ``first`` anchors: the static
+        ``max_batch``, raised to the backend's budget-based estimate
+        when it can provide one (see the class docstring). A probe
+        failure (unknown model, bad prompt) falls back to the static cap
+        — admission must never fail a request the backend would serve."""
+        if not self.budget_aware:
+            return self.max_batch
+        try:
+            estimated = self.backend.max_admission_rows(first.request)
+        except Exception:  # noqa: BLE001 — estimate only, never fatal
+            return self.max_batch
+        return max(self.max_batch, int(estimated))
+
     def _collect(self, first: _Ticket) -> List[_Ticket]:
         """Admission: wait up to ``window_s`` for companions compatible with
         ``first``; incompatible arrivals are re-queued (order within each
         compatibility class is preserved)."""
         batch = [first]
         leftovers: List[_Ticket] = []
+        cap = self._admission_cap(first)
         deadline = time.monotonic() + self.window_s
-        while len(batch) < self.max_batch:
+        while len(batch) < cap:
             timeout = deadline - time.monotonic()
             if timeout <= 0:
                 break

@@ -51,12 +51,17 @@ class GenerationServer:
         quiet: bool = False,
         batch_window_ms: float = 0.0,
         max_batch: Optional[int] = None,  # backend-aware (scheduler)
+        budget_aware: Optional[bool] = None,  # KV-budget admission
     ) -> None:
         """``batch_window_ms > 0`` enables continuous batching: concurrent
         non-streaming generate requests arriving within the window coalesce
         into one batched decode (:mod:`.scheduler`). 0 (default) preserves
         strictly serial one-at-a-time semantics — what the reference's
-        measurement model assumes."""
+        measurement model assumes. ``budget_aware`` (default: auto — on
+        for backends exposing ``max_admission_rows``) lets the scheduler
+        raise each batch's cap to the widest fleet the backend's KV
+        budget admits under its cache layout, so paged/int8-KV serving
+        actually admits the larger fleet its denser cache pays for."""
         self.backend = backend
         self.models = list(models) if models else []
         self.quiet = quiet
@@ -70,6 +75,7 @@ class GenerationServer:
                 max_batch=max_batch,
                 window_s=batch_window_ms / 1e3,
                 lock=self._generate_lock,
+                budget_aware=budget_aware,
             )
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None

@@ -41,7 +41,9 @@ def main() -> int:
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_paged_attention import (
         pallas_paged_decode_attention,
         pallas_paged_decode_attention_parts,
+        pallas_paged_decode_attention_parts_int8,
         xla_paged_decode_attention_parts,
+        xla_paged_decode_attention_parts_int8,
     )
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_quant import (
         int4_matmul,
@@ -98,6 +100,41 @@ def main() -> int:
                 lambda q=q, pool=pool, table=table, plens=plens:
                 xla_paged_decode_attention_parts(
                     q, pool, pool, table, plens
+                ),
+            ))
+            # int8 page pool (codes + per-position scales): the paged ×
+            # kv_quantize composition's kernels — exactly the class of
+            # shape the round-5 Mosaic-tiling bug hid in (the scales
+            # block layout), so every head layout and width lowers here.
+            pool8 = jnp.zeros((8, hkv, 128, dp), i8)
+            pscale = jnp.zeros((8, hkv, 128), f32)
+            cases.append((
+                f"paged-parts-int8 b={b} {hq}/{hkv}/{d}",
+                lambda q=q, pool8=pool8, pscale=pscale, table=table,
+                plens=plens:
+                pallas_paged_decode_attention_parts_int8(
+                    q, pool8, pscale, pool8, pscale, table, plens
+                ),
+            ))
+            # the whole-stacked-pool variant folds the layer into the
+            # DMA offset — a different BlockSpec rank, lowered separately
+            pool8_l = jnp.zeros((2, 8, hkv, 128, dp), i8)
+            pscale_l = jnp.zeros((2, 8, hkv, 128), f32)
+            cases.append((
+                f"paged-parts-int8-stacked b={b} {hq}/{hkv}/{d}",
+                lambda q=q, pool8_l=pool8_l, pscale_l=pscale_l,
+                table=table, plens=plens:
+                pallas_paged_decode_attention_parts_int8(
+                    q, pool8_l, pscale_l, pool8_l, pscale_l, table,
+                    plens, layer=jnp.int32(1),
+                ),
+            ))
+            cases.append((
+                f"paged-parts-xla-int8 b={b} {hq}/{hkv}/{d}",
+                lambda q=q, pool8=pool8, pscale=pscale, table=table,
+                plens=plens:
+                xla_paged_decode_attention_parts_int8(
+                    q, pool8, pscale, pool8, pscale, table, plens
                 ),
             ))
     # prefill flash: [B,S] x cache

@@ -39,6 +39,7 @@ from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.distributed import 
     global_device_summary,
     is_coordinator,
 )
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.compat import shard_map
 
 joined = initialize_distributed()
 assert joined, "COORDINATOR_ADDRESS was set; initialize must join"
@@ -58,7 +59,7 @@ global_arr = multihost_utils.host_local_array_to_global_array(
 )
 
 summed = jax.jit(
-    jax.shard_map(
+    shard_map(
         lambda x: jax.lax.psum(x, "dcn"),
         mesh=mesh,
         in_specs=P("dcn"),

@@ -334,27 +334,38 @@ def test_max_batch_rows_paged_estimates_are_mode_aware(monkeypatch):
     # tiny shapes: everything fits the widest bucket in every mode
     assert legacy == stacked == je.BATCH_BUCKETS[-1]
 
-    # shrink the budget until the mode difference is visible: stacked
-    # bills prompt pages (padded head dim) + side columns; legacy bills
-    # prompt + budget pages — for a short prompt with a large budget the
-    # legacy footprint is bigger, so its width must be ≤ stacked's
+    # The estimate now bills each mode its ACTUAL allocation
+    # (per-row pages, chunk-level pow2 pool rounding — PR 1): a budget
+    # set exactly between a mode's own 64- and 128-row chunk needs must
+    # admit exactly 64 in that mode. Checked for BOTH modes — stacked
+    # bills prompt-only pages (at the lane-padded head dim) + side
+    # columns, legacy bills prompt + budget pages at the raw head dim.
     wide = [
         GenerationRequest("tiny", "p", max_new_tokens=128)
-    ] * 8
-    s_bucket = je._prompt_alloc(3)
+    ] * 128
+    wide_ids = [[1, 2, 3]] * 128
     g_bucket = je._bucket(128, je.GEN_BUCKETS)
-    d_pool = -(-cfg.d_head // 128) * 128
-    stacked_row = (
-        2 * cfg.n_layers * cfg.n_kv_heads
-        * (2 * s_bucket * d_pool + g_bucket * cfg.d_head) * 4
-    )
-    monkeypatch.setattr(je, "BATCH_KV_BUDGET_BYTES", 64 * stacked_row)
-    assert paged._max_batch_rows(cfg, wide, ids) == 64  # stacked
-    monkeypatch.setattr(
-        je.JaxEngine, "_paged_decode_attention", lambda self, c=None: None
-    )
-    legacy_width = paged._max_batch_rows(cfg, wide, ids)
-    assert legacy_width <= 64  # legacy bills prompt + budget pages
+    for is_stacked in (True, False):
+        pages_per_row = 1 if is_stacked else -(-(3 + 128) // 128)
+        rows_pages = [pages_per_row] * 128
+        need64 = paged._paged_chunk_bytes(
+            cfg, rows_pages[:64], 64, g_bucket, is_stacked
+        )
+        need128 = paged._paged_chunk_bytes(
+            cfg, rows_pages, 128, g_bucket, is_stacked
+        )
+        assert need64 < need128
+        monkeypatch.setattr(
+            je, "BATCH_KV_BUDGET_BYTES", (need64 + need128) // 2
+        )
+        monkeypatch.setattr(
+            je.JaxEngine,
+            "_paged_decode_attention",
+            (lambda self, c=None: (lambda *a, **k: None))
+            if is_stacked
+            else (lambda self, c=None: None),
+        )
+        assert paged._max_batch_rows(cfg, wide, wide_ids) == 64, is_stacked
 
 
 def test_generate_batch_mixed_top_p_rows_stay_bit_identical(engine):

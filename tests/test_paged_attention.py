@@ -221,8 +221,10 @@ def test_paged_kv_guards():
     registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
     with pytest.raises(ValueError, match="page_size"):
         JaxEngine(registry=registry, paged_kv=True, page_size=100)
-    with pytest.raises(ValueError, match="paged_kv"):
-        JaxEngine(registry=registry, paged_kv=True, kv_quantize="int8")
+    # paged_kv × kv_quantize COMPOSES since the int8 page pool landed
+    # (tests/test_paged_int8.py pins its parity) — the old guard is gone
+    engine = JaxEngine(registry=registry, paged_kv=True, kv_quantize="int8")
+    assert engine.paged_kv and engine.kv_quantize == "int8"
 
 
 def test_paged_batch_on_tensor_parallel_engine():
