@@ -276,6 +276,22 @@ def main() -> int:
                 batch_tokens_per_s / BASELINE_TOKENS_PER_S, 3
             ),
         )
+    # Metrics-registry snapshot (obs): the engines above recorded their
+    # prefill/decode windows, step counts per attention path, pool
+    # occupancy and modelled J/token into the shared registry as they
+    # ran — attach it so BENCH_*.json rows carry the distributions, not
+    # just the aggregate figures. Guarded like the energy extra: the
+    # perf line must never die on telemetry.
+    try:
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+            REGISTRY as _obs_registry,
+        )
+
+        snap = _obs_registry.snapshot()
+        if snap:
+            line["obs_metrics"] = snap
+    except Exception:
+        pass
     print(json.dumps(line))
     return 0
 

@@ -23,6 +23,12 @@ A ground-up rebuild of the capabilities of the CAIN 2025 replication package
                    delta, Spearman). (reference L9: R notebook)
 - ``experiments``— the study config: 7 models × 2 locations × 3 lengths.
                    (reference L7: ``experiment/RunnerConfig.py``)
+- ``obs``        — serving-path observability: metrics registry with a
+                   Prometheus ``/metrics`` surface, host-side span tracer
+                   (Chrome-trace export), live per-request J/token
+                   attribution from the energy model's coefficient box.
+                   (no reference equivalent; docs/ARCHITECTURE.md
+                   "Observability")
 
 The package root imports only the hardware-free experiment kernel so the
 orchestration layer works without JAX present; accelerator modules import JAX

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 
 from ..models.config import MODEL_REGISTRY, ModelConfig, get_model_config
+from ..obs.metrics import REGISTRY as _OBS, enabled as _obs_enabled
+from ..obs.trace import TRACER as _TRACER
 from ..models.transformer import (
     DecodeAttentionFn,
     PrefillAttentionFn,
@@ -92,6 +94,36 @@ PAGED_XLA_PARTS_MAX_JMAX = int(
     os.environ.get("PAGED_XLA_PARTS_MAX_JMAX", 8)
 )
 DEFAULT_STREAM_CHUNK = 32  # decode steps per streamed chunk
+
+# Engine telemetry (obs): the fence-timed prefill/decode windows the
+# engine already measures, published as metric families + spans. The
+# (path, kv) labels name the attention-path the step actually ran —
+# contiguous/paged cache × bf16/int8 KV — so a scrape can tell WHICH
+# cache representation produced a latency/J figure without re-deriving
+# it from CLI flags.
+_PREFILL_H = _OBS.histogram(
+    "llm_engine_prefill_seconds",
+    "Wall time of one prefill window (solo request or grouped rows)",
+)
+_DECODE_H = _OBS.histogram(
+    "llm_engine_decode_seconds",
+    "Wall time of one decode window (solo request or shared batch)",
+)
+_TOKENS_C = _OBS.counter(
+    "llm_engine_generated_tokens_total",
+    "Generated tokens, by attention path and KV representation",
+    labels=("path", "kv"),
+)
+_STEPS_C = _OBS.counter(
+    "llm_engine_decode_steps_total",
+    "Decode-loop steps executed, by attention path and KV representation",
+    labels=("path", "kv"),
+)
+_TOKS_PER_S_G = _OBS.gauge(
+    "llm_engine_tokens_per_s",
+    "Aggregate tokens/s of the most recent decode window",
+    labels=("path", "kv"),
+)
 
 
 def _to_host_list(arr) -> "list":
@@ -1068,6 +1100,12 @@ class JaxEngine(GenerationBackend):
             presence = presence.at[jnp.arange(1), first].set(True)
         jax.block_until_ready(first)
         t1 = time.monotonic()
+        if _obs_enabled():
+            _PREFILL_H.observe(t1 - t0)
+            _TRACER.add_span(
+                "prefill", t0, t1,
+                attrs={"model": request.model, "prompt_tokens": s_real},
+            )
         return {
             "tf": tf,
             "tok": tok,
@@ -1224,6 +1262,12 @@ class JaxEngine(GenerationBackend):
                 presence = presence.at[jnp.arange(gb), firsts].set(True)
             jax.block_until_ready(firsts)
             t1 = time.monotonic()
+            if _obs_enabled():
+                _PREFILL_H.observe(t1 - t0)
+                _TRACER.add_span(
+                    "prefill", t0, t1,
+                    attrs={"model": model, "rows": g, "bucket": bucket},
+                )
             shared = {
                 "k": k_cache,
                 "v": v_cache,
@@ -1371,6 +1415,117 @@ class JaxEngine(GenerationBackend):
             )
         return out
 
+    # -- observability --------------------------------------------------------
+    def _obs_labels(self) -> Dict[str, str]:
+        """The attention-path labels of every step this engine runs."""
+        return {
+            "path": "paged" if self.paged_kv else "contiguous",
+            "kv": "int8" if self.kv_quantize else "bf16",
+        }
+
+    def _observe_decode_window(
+        self, t1: float, t2: float, tokens: int, steps: int, rows: int = 1
+    ) -> None:
+        """One decode window into the registry + a span (parented under
+        the serving request's root when the scheduler attached one)."""
+        labels = self._obs_labels()
+        _DECODE_H.observe(t2 - t1)
+        _TOKENS_C.labels(**labels).inc(tokens)
+        _STEPS_C.labels(**labels).inc(steps)
+        if t2 > t1 and tokens:
+            _TOKS_PER_S_G.labels(**labels).set(tokens / (t2 - t1))
+        _TRACER.add_span(
+            "decode", t1, t2,
+            attrs={"tokens": tokens, "rows": rows, **labels},
+        )
+
+    def _observe_result(self, result: GenerationResult, st: Dict[str, Any], t2: float) -> None:
+        """Solo-window telemetry + live energy attribution: the run-table
+        energy model evaluated on this result (nominal + the coefficient
+        box), attached as ``extras["energy_model"]`` and recorded in the
+        ``llm_request_*`` families. Telemetry must never fail a request."""
+        if not _obs_enabled():
+            return
+        try:
+            self._observe_decode_window(
+                st["t1"], t2, result.generated_tokens, result.generated_tokens
+            )
+            from ..obs import energy as obs_energy
+
+            model = result.request.model
+            tf = self._models.get(model)
+            if tf is None:
+                return
+            est = obs_energy.attribute_result(
+                tf.cfg,
+                result,
+                quantize=self._quant_mode(model),
+                kv_quantize=self.kv_quantize,
+                n_chips=max(1, getattr(self, "n_devices", 1)),
+            )
+            if est is not None:
+                result.extras = {**(result.extras or {}), "energy_model": est}
+                obs_energy.observe_estimate(est)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    def _observe_batch_window(
+        self, model: str, results: "list[GenerationResult]", t1: float, t2: float
+    ) -> None:
+        """Shared-window telemetry for one batched decode: bills the
+        weight stream ONCE per step for the whole window (per-row solo
+        estimates would multiply-count it — the decode_s convention) and
+        attributes each row its token share of the window's Joules."""
+        if not _obs_enabled() or not results:
+            return
+        try:
+            tokens = sum(r.generated_tokens for r in results)
+            steps = max(r.generated_tokens for r in results)
+            self._observe_decode_window(
+                t1, t2, tokens, steps, rows=len(results)
+            )
+            from ..obs import energy as obs_energy
+
+            tf = self._models.get(model)
+            if tf is None or not tokens:
+                return
+            stats = obs_energy.batch_window_stats(
+                tf.cfg,
+                results,
+                quantize=self._quant_mode(model),
+                kv_quantize=self.kv_quantize,
+                duration_s=t2 - t1,
+            )
+            est = (
+                obs_energy.estimate_from_stats(
+                    stats, n_chips=max(1, getattr(self, "n_devices", 1))
+                )
+                if stats
+                else None
+            )
+            if est is None:
+                return
+            obs_energy.observe_estimate(est)
+            for r in results:
+                if not r.generated_tokens:
+                    continue
+                share = r.generated_tokens / tokens
+                r.extras = {
+                    **(r.extras or {}),
+                    "energy_model": {
+                        "J": round(est["J"] * share, 4),
+                        "J_low": round(est["J_low"] * share, 4),
+                        "J_high": round(est["J_high"] * share, 4),
+                        "J_per_token": est["J_per_token"],
+                        "J_per_token_low": est["J_per_token_low"],
+                        "J_per_token_high": est["J_per_token_high"],
+                        "power_model_W": est["power_model_W"],
+                        "window": "shared",  # token-share of the batch
+                    },
+                }
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
     def _finish(
         self,
         request: GenerationRequest,
@@ -1384,7 +1539,7 @@ class JaxEngine(GenerationBackend):
         text = st["tok"].decode(generated)
         if request.stop:
             generated, text = _apply_stop(generated, text, st["tok"], request.stop)
-        return GenerationResult(
+        result = GenerationResult(
             request=request,
             tokens=generated,
             text=text,
@@ -1394,6 +1549,8 @@ class JaxEngine(GenerationBackend):
             decode_s=t2 - st["t1"],
             total_s=t2 - st["t0"],
         )
+        self._observe_result(result, st, t2)
+        return result
 
     def generate(self, request: GenerationRequest) -> GenerationResult:
         if request.stop:
@@ -1574,7 +1731,9 @@ class JaxEngine(GenerationBackend):
         take = min(int(n_em), request.max_new_tokens - 1)
         generated = [int(st["first"][0])] + _to_host_list(out[:take])
         result = self._finish(request, generated, st, t2)
+        # merge, not replace — _finish may have attached energy extras
         result.extras = {
+            **(result.extras or {}),
             "spec_rounds": int(rounds),
             "spec_accepted": int(acc),
             "draft_model": draft_model,
@@ -2204,6 +2363,7 @@ class JaxEngine(GenerationBackend):
                     extras={"decode_window": window_id},
                 )
             )
+        self._observe_batch_window(model, results, t1, t2)
         return results
 
     def _contiguous_row_bytes(
@@ -2575,6 +2735,7 @@ class JaxEngine(GenerationBackend):
                     extras={"decode_window": window_id},
                 )
             )
+        self._observe_batch_window(model, results, t1, t2)
         return results
 
     def generate_stream(

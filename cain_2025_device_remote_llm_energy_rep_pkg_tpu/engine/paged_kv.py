@@ -30,7 +30,49 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.metrics import REGISTRY, enabled as _obs_enabled
+
 DEFAULT_PAGE_SIZE = 128
+
+# Pool-state gauges (obs): pools are per-batch on the stateless batch
+# path, so the gauges track the MOST RECENT pool's state — which is the
+# live one while a decode window runs, exactly when a scrape wants it.
+_POOL_PAGES = REGISTRY.gauge(
+    "llm_paged_pool_pages", "Total pages in the most recent page pool"
+)
+_POOL_FREE = REGISTRY.gauge(
+    "llm_paged_pool_free_pages", "Free pages in the most recent page pool"
+)
+_POOL_OCCUPANCY = REGISTRY.gauge(
+    "llm_paged_pool_occupancy",
+    "Allocated fraction of the most recent page pool (0..1)",
+)
+_POOL_FRAGMENTATION = REGISTRY.gauge(
+    "llm_paged_pool_fragmentation",
+    "1 - (largest contiguous free run / free pages); 0 when free space "
+    "is one run or the pool is full",
+)
+_POOL_EXHAUSTED = REGISTRY.counter(
+    "llm_paged_pool_exhausted_total",
+    "Allocations refused because the pool had too few free pages",
+)
+
+
+def _publish_pool_gauges(free: List[int], total: int) -> None:
+    if not _obs_enabled():
+        return
+    _POOL_PAGES.set(total)
+    _POOL_FREE.set(len(free))
+    _POOL_OCCUPANCY.set(1.0 - len(free) / total if total else 0.0)
+    if not free:
+        _POOL_FRAGMENTATION.set(0.0)
+        return
+    ordered = sorted(free)
+    longest = run = 1
+    for a, b in zip(ordered, ordered[1:]):
+        run = run + 1 if b == a + 1 else 1
+        longest = max(longest, run)
+    _POOL_FRAGMENTATION.set(1.0 - longest / len(free))
 
 
 def _codes(leaf):
@@ -87,12 +129,14 @@ class PagePool:
                 }
             return jnp.zeros(shape, dtype)
 
-        return cls(
+        pool = cls(
             k=leaf(),
             v=leaf(),
             page_size=page_size,
             _free=list(range(n_pages)),
         )
+        _publish_pool_gauges(pool._free, n_pages)
+        return pool
 
     @property
     def quantized(self) -> bool:
@@ -111,15 +155,18 @@ class PagePool:
 
     def alloc(self, n_pages: int) -> List[int]:
         if n_pages > len(self._free):
+            _POOL_EXHAUSTED.inc()
             raise PagePoolExhausted(
                 f"need {n_pages} pages, {len(self._free)} free of "
                 f"{self.n_pages} — evict a finished request or grow the pool"
             )
         pages, self._free = self._free[:n_pages], self._free[n_pages:]
+        _publish_pool_gauges(self._free, self.n_pages)
         return pages
 
     def free(self, pages: List[int]) -> None:
         self._free.extend(pages)
+        _publish_pool_gauges(self._free, self.n_pages)
 
 
 def page_slot(table, lengths, page_size: int):

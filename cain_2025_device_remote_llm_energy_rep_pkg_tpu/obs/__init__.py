@@ -1,0 +1,40 @@
+"""Serving-path observability: metrics, spans, and energy attribution.
+
+The paper's contribution is *measurement* — Joules per fetched response —
+but until this subsystem the serving path that ROADMAP's north star says
+must carry heavy traffic was a black box. Three pieces, all stdlib-only
+and default-on with a shared kill switch (env ``TPU_LLM_OBS=0`` or
+``serve --no-telemetry``):
+
+- :mod:`.metrics` — counters / gauges / fixed-bucket histograms with
+  Prometheus text exposition (served at ``GET /metrics``) and a JSON
+  snapshot (attached to bench lines). One process-wide ``REGISTRY``.
+- :mod:`.trace` — monotonic-clock spans with parent links across the
+  HTTP-handler → scheduler → engine thread hops, exported as Chrome
+  trace events (``SpanTraceProfiler`` writes them per run next to
+  ``jax_trace/``). One process-wide ``TRACER``.
+- :mod:`.energy` — the ``profilers/tpu.py`` energy model (nominal + the
+  documented coefficient box) folded into live per-request J and
+  J/token estimates, surfaced in ``/metrics`` and in each result's
+  ``extras["energy_model"]``.
+
+Instrumented layers: ``serve/server.py`` (HTTP timings, request root
+spans, ``/metrics``), ``serve/scheduler.py`` (queue wait, window
+collect, admission caps, batch composition), ``engine/jax_engine.py``
+(prefill/decode windows, tokens/s, attention-path labels, energy
+attribution), ``engine/paged_kv.py`` (pool occupancy / fragmentation).
+"""
+
+from .metrics import REGISTRY, MetricsRegistry, disable, enable, enabled
+from .trace import TRACER, Span, SpanTracer
+
+__all__ = [
+    "REGISTRY",
+    "MetricsRegistry",
+    "TRACER",
+    "Span",
+    "SpanTracer",
+    "enabled",
+    "enable",
+    "disable",
+]

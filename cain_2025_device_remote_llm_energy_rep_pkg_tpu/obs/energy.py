@@ -1,0 +1,180 @@
+"""Energy-attribution bridge: live per-request Joules from the documented
+power-coefficient box.
+
+The paper's unit of account is Joules per fetched response; the
+framework's energy model (``profilers/tpu.py::TpuEnergyModelProfiler``)
+could only produce that number inside a runner measurement window —
+serving traffic got nothing. This module folds the SAME model (and the
+SAME per-engine coefficient box, now exported as ``*_BOUNDS`` constants
+next to the nominal values) into live per-request estimates:
+
+- each :class:`~..engine.backend.GenerationResult` gains
+  ``extras["energy_model"]`` with nominal J / J-per-token plus the
+  low/high corner of the coefficient box — the per-request twin of the
+  ``recompute-energy`` sensitivity band (ROADMAP #2), so a serving
+  dashboard shows not just a number but how far the model's uncertainty
+  moves it;
+- the shared metrics registry gains ``llm_request_*`` energy families
+  for the ``/metrics`` scrape.
+
+Everything here is an ESTIMATE (the column name says ``model``, matching
+``energy_model_J``'s labelling discipline) and must never fail a
+request: callers wrap in try/except, and inputs the model can't price
+(zero tokens, missing config) return None.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Any, Dict, Optional
+
+from ..profilers.tpu import (
+    TpuEnergyModelProfiler,
+    V5E_HBM_ACTIVE_W_BOUNDS,
+    V5E_IDLE_W_BOUNDS,
+    V5E_MXU_ACTIVE_W_BOUNDS,
+    V5E_VPU_ACTIVE_W_BOUNDS,
+)
+from .metrics import REGISTRY, enabled
+
+# J/token of one chip spans ~0.05 (wide batched decode) to ~10+ (a lone
+# short request paying the whole idle window).
+ENERGY_BUCKETS = (
+    0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+)
+
+REQUEST_J = REGISTRY.histogram(
+    "llm_request_energy_model_joules",
+    "Modelled Joules attributed to one served generation",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0),
+)
+REQUEST_JPT = REGISTRY.histogram(
+    "llm_request_joules_per_token",
+    "Modelled J/token of one served generation (nominal coefficients)",
+    buckets=ENERGY_BUCKETS,
+)
+REQUEST_JPT_BOUND = REGISTRY.gauge(
+    "llm_request_joules_per_token_bound",
+    "Last request's modelled J/token at the coefficient-box corner",
+    labels=("bound",),
+)
+
+
+def _corner(which: str, n_chips: int) -> TpuEnergyModelProfiler:
+    i = 0 if which == "low" else 1
+    return TpuEnergyModelProfiler(
+        n_chips=n_chips,
+        idle_w=V5E_IDLE_W_BOUNDS[i],
+        mxu_active_w=V5E_MXU_ACTIVE_W_BOUNDS[i],
+        hbm_active_w=V5E_HBM_ACTIVE_W_BOUNDS[i],
+        vpu_active_w=V5E_VPU_ACTIVE_W_BOUNDS[i],
+    )
+
+
+def estimate_from_stats(
+    stats: Dict[str, Any], n_chips: int = 1
+) -> Optional[Dict[str, Any]]:
+    """Evaluate the energy model at the nominal coefficients and at both
+    corners of the documented box. ``stats`` is the
+    ``generation_stats`` shape the profiler consumes (flops / bytes /
+    vpu_ops / duration_s / generated_tokens)."""
+    if not stats or not stats.get("duration_s"):
+        return None
+    ctx = SimpleNamespace(scratch={"generation_stats": stats})
+    nominal = TpuEnergyModelProfiler(n_chips=n_chips).collect(ctx)
+    if nominal["energy_model_J"] is None:
+        return None
+    low = _corner("low", n_chips).collect(ctx)
+    high = _corner("high", n_chips).collect(ctx)
+    return {
+        "J": nominal["energy_model_J"],
+        "J_low": low["energy_model_J"],
+        "J_high": high["energy_model_J"],
+        "J_per_token": nominal["joules_per_token"],
+        "J_per_token_low": low["joules_per_token"],
+        "J_per_token_high": high["joules_per_token"],
+        "power_model_W": nominal["tpu_power_model_W"],
+        "util_est": nominal["tpu_util_est"],
+    }
+
+
+def attribute_result(
+    cfg,
+    result,
+    quantize: Optional[str] = None,
+    kv_quantize: Optional[str] = None,
+    n_chips: int = 1,
+) -> Optional[Dict[str, Any]]:
+    """Per-request estimate for a SOLO generation: the run-table stats
+    builder (``generation_stats_from`` — decode-window duration, weight +
+    KV stream bytes at mid-context, VPU unpack ops) evaluated live."""
+    from ..experiments.llm_energy import generation_stats_from
+
+    stats = generation_stats_from(
+        cfg, result, quantize=quantize, kv_quantize=kv_quantize,
+        n_chips=n_chips,
+    )
+    return estimate_from_stats(stats, n_chips=n_chips)
+
+
+def batch_window_stats(
+    cfg,
+    results,
+    quantize: Optional[str] = None,
+    kv_quantize: Optional[str] = None,
+    duration_s: float = 0.0,
+) -> Optional[Dict[str, Any]]:
+    """Energy-model inputs for ONE shared batched decode window.
+
+    Rows in a batch share the weight stream (billed once per step — the
+    amortisation batching exists for) while each row streams its own KV
+    at its own mid-context; summing per-row solo estimates would instead
+    bill the weight stream per row and multiply-count the shared window
+    (the same double-count ``decode_s`` documents for wall time)."""
+    if not results or duration_s <= 0:
+        return None
+    from ..utils.memory import (
+        decode_kv_stream_bytes,
+        decode_vpu_unpack_ops_per_step,
+        decode_weight_stream_bytes,
+    )
+
+    tokens = sum(r.generated_tokens for r in results)
+    if not tokens:
+        return None
+    steps = max(r.generated_tokens for r in results)
+    flops = sum(
+        cfg.flops_per_token(r.prompt_tokens + r.generated_tokens)
+        * (r.prompt_tokens + r.generated_tokens)
+        for r in results
+    )
+    hbm = decode_weight_stream_bytes(cfg, quantize) * steps + sum(
+        decode_kv_stream_bytes(
+            cfg,
+            int(r.prompt_tokens + r.generated_tokens / 2),
+            kv_quantize=kv_quantize,
+        )
+        * r.generated_tokens
+        for r in results
+    )
+    return {
+        "flops": flops,
+        "bytes": hbm,
+        "vpu_ops": decode_vpu_unpack_ops_per_step(cfg, quantize) * steps,
+        "duration_s": duration_s,
+        "generated_tokens": tokens,
+    }
+
+
+def observe_estimate(est: Optional[Dict[str, Any]]) -> None:
+    """Record one request's estimate into the shared registry."""
+    if est is None or not enabled():
+        return
+    if est.get("J") is not None:
+        REQUEST_J.observe(est["J"])
+    if est.get("J_per_token") is not None:
+        REQUEST_JPT.observe(est["J_per_token"])
+        if est.get("J_per_token_low") is not None:
+            REQUEST_JPT_BOUND.labels(bound="low").set(est["J_per_token_low"])
+        if est.get("J_per_token_high") is not None:
+            REQUEST_JPT_BOUND.labels(bound="high").set(est["J_per_token_high"])

@@ -16,6 +16,7 @@ from .host import HostResourceProfiler
 from .native_host import NativeHostProfiler
 from .rapl import RaplEnergyProfiler
 from .serial_power import SerialPowerMeterProfiler
+from .span_trace import SpanTraceProfiler
 from .synthetic import SyntheticPowerProfiler
 from .tpu import TpuEnergyModelProfiler, TpuPowerCounterProfiler
 
@@ -26,6 +27,7 @@ __all__ = [
     "NativeHostProfiler",
     "RaplEnergyProfiler",
     "SerialPowerMeterProfiler",
+    "SpanTraceProfiler",
     "SyntheticPowerProfiler",
     "TpuEnergyModelProfiler",
     "TpuPowerCounterProfiler",

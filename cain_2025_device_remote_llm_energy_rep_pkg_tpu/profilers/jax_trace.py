@@ -24,11 +24,13 @@ class JaxTraceProfiler(Profiler):
     def __init__(self) -> None:
         self._active = False
         self._dir: str = ""
+        self._wrote = False
 
     def on_start(self, context: RunContext) -> None:
         import jax
 
         self._dir = str(context.run_dir / "jax_trace")
+        self._wrote = False
         try:
             jax.profiler.start_trace(self._dir)
             self._active = True
@@ -43,8 +45,12 @@ class JaxTraceProfiler(Profiler):
 
         try:
             jax.profiler.stop_trace()
+            self._wrote = True
         finally:
             self._active = False
 
     def collect(self, context: RunContext) -> Dict[str, Any]:
-        return {"trace_dir": self._dir if self._dir else None}
+        # Only claim a trace that was actually written: when start_trace
+        # failed, ``_dir`` is set but nothing exists there — reporting it
+        # would put phantom trace paths in the run table.
+        return {"trace_dir": self._dir if self._wrote else None}

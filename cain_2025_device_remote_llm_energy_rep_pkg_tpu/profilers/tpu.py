@@ -81,6 +81,16 @@ V5E_IDLE_W = 55.0
 V5E_MXU_ACTIVE_W = 145.0
 V5E_HBM_ACTIVE_W = 55.0
 V5E_VPU_ACTIVE_W = 40.0
+# The documented uncertainty box around each coefficient (the derivation
+# bounds above), as CODE rather than prose: the sensitivity band
+# (ROADMAP #2) and the live per-request J bounds (obs/energy.py) both
+# re-evaluate the model at these corners, so the box has one definition.
+# Idle carries ±10 W — the public "tens of watts" idling figure brackets
+# the 55 W point estimate about that wide.
+V5E_MXU_ACTIVE_W_BOUNDS = (130.0, 160.0)
+V5E_HBM_ACTIVE_W_BOUNDS = (30.0, 75.0)
+V5E_VPU_ACTIVE_W_BOUNDS = (20.0, 60.0)
+V5E_IDLE_W_BOUNDS = (45.0, 65.0)
 
 
 def _read_power_from_library() -> Optional[float]:

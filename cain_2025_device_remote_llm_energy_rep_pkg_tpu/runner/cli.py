@@ -157,7 +157,12 @@ commands:
                        (draft-verify), --prefix-cache N (prompt-prefix KV
                        LRU), --paged-kv (batched decode over a paged KV
                        pool: mixed-length batches stop paying the widest
-                       row's padding)
+                       row's padding),
+                       --access-log (structured per-request log line:
+                       method/path/status/duration; default off),
+                       --no-telemetry (kill switch for /metrics, spans
+                       and per-request energy attribution — default on;
+                       env twin: TPU_LLM_OBS=0)
   help                 show this message
 """
 
@@ -180,6 +185,7 @@ def serve_command(args: List[str]) -> None:
     paged_kv = False
     speculative = {}
     prefix_cache = 0
+    access_log = False
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -253,6 +259,12 @@ def serve_command(args: List[str]) -> None:
                 kv_quantize = None
         elif arg == "--paged-kv":
             paged_kv = True
+        elif arg == "--access-log":
+            access_log = True
+        elif arg == "--no-telemetry":
+            from ..obs import disable as obs_disable
+
+            obs_disable()
         else:
             raise CommandError(f"serve: unrecognised option {arg!r}")
 
@@ -309,6 +321,7 @@ def serve_command(args: List[str]) -> None:
         batch_window_ms=batch_window_ms,
         max_batch=max_batch,
         budget_aware=budget_aware,
+        access_log=access_log,
     )
     server.serve_forever()
 

@@ -34,6 +34,7 @@ PS_PATH = "/api/ps"  # loaded models (Ollama parity)
 VERSION_PATH = "/api/version"
 LOAD_PATH = "/api/load"  # extension: explicit weight-load outside the window
 HEALTH_PATH = "/healthz"
+METRICS_PATH = "/metrics"  # Prometheus text exposition (obs; 404 when off)
 
 SERVER_VERSION = "0.1.0"
 

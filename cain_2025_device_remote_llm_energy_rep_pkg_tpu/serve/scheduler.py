@@ -25,19 +25,62 @@ from ..engine.backend import (
     GenerationRequest,
     GenerationResult,
 )
+from ..obs.metrics import REGISTRY, ROW_BUCKETS
+from ..obs.trace import TRACER
+
+# Admission/queue telemetry (obs): the scheduler is where a request's
+# wait is DECIDED — queue-wait and window-collect histograms plus the
+# admission-cap distribution make the budget-admission win (docs/PERF.md
+# A/B tables) continuously visible instead of hand-run.
+_QUEUE_WAIT_H = REGISTRY.histogram(
+    "llm_sched_queue_wait_seconds",
+    "Submit-to-dispatch wait of one request in the batching queue",
+)
+_COLLECT_H = REGISTRY.histogram(
+    "llm_sched_window_collect_seconds",
+    "Wall time the batch anchor spent collecting companions",
+)
+_ADMISSION_CAP_H = REGISTRY.histogram(
+    "llm_sched_admission_cap_rows",
+    "Row cap applied to each batch window (static or budget-raised)",
+    buckets=ROW_BUCKETS,
+)
+_BATCH_ROWS_H = REGISTRY.histogram(
+    "llm_sched_batch_rows",
+    "Rows actually admitted into each dispatched batch",
+    buckets=ROW_BUCKETS,
+)
+_REQUESTS_C = REGISTRY.counter(
+    "llm_sched_requests_total", "Requests submitted to the batch scheduler"
+)
+_BATCHES_C = REGISTRY.counter(
+    "llm_sched_batches_total", "Batches dispatched to the backend"
+)
+_BUDGET_ADMISSION_C = REGISTRY.counter(
+    "llm_sched_budget_admission_total",
+    "Admission-cap decisions by outcome: raised (budget estimate beat "
+    "max_batch), static (estimate at/below it or budget admission off), "
+    "error (probe failed; static cap used)",
+    labels=("outcome",),
+)
 
 
 class _Ticket:
     """One submitted request: the caller blocks on ``event`` until the
-    scheduler fills ``result`` or ``error``."""
+    scheduler fills ``result`` or ``error``. ``t_submit``/``span`` carry
+    the submit-side clock and the submitting thread's current span so
+    the scheduler thread can parent queue/backend spans under the HTTP
+    request's root (obs)."""
 
-    __slots__ = ("request", "event", "result", "error")
+    __slots__ = ("request", "event", "result", "error", "t_submit", "span")
 
     def __init__(self, request: GenerationRequest) -> None:
         self.request = request
         self.event = threading.Event()
         self.result: Optional[GenerationResult] = None
         self.error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+        self.span = TRACER.current()
 
 
 class BatchScheduler:
@@ -152,6 +195,7 @@ class BatchScheduler:
     def submit(self, request: GenerationRequest) -> GenerationResult:
         """Enqueue and block until the scheduler served the request."""
         ticket = _Ticket(request)
+        _REQUESTS_C.inc()
         with self._state_lock:
             if not self._running:
                 raise RuntimeError("scheduler is not running")
@@ -174,11 +218,17 @@ class BatchScheduler:
         failure (unknown model, bad prompt) falls back to the static cap
         — admission must never fail a request the backend would serve."""
         if not self.budget_aware:
+            _BUDGET_ADMISSION_C.labels(outcome="static").inc()
             return self.max_batch
         try:
             estimated = self.backend.max_admission_rows(first.request)
         except Exception:  # noqa: BLE001 — estimate only, never fatal
+            _BUDGET_ADMISSION_C.labels(outcome="error").inc()
             return self.max_batch
+        raised = int(estimated) > self.max_batch
+        _BUDGET_ADMISSION_C.labels(
+            outcome="raised" if raised else "static"
+        ).inc()
         return max(self.max_batch, int(estimated))
 
     def _collect(self, first: _Ticket) -> List[_Ticket]:
@@ -187,7 +237,9 @@ class BatchScheduler:
         compatibility class is preserved)."""
         batch = [first]
         leftovers: List[_Ticket] = []
+        t_collect = time.monotonic()
         cap = self._admission_cap(first)
+        _ADMISSION_CAP_H.observe(cap)
         deadline = time.monotonic() + self.window_s
         while len(batch) < cap:
             timeout = deadline - time.monotonic()
@@ -216,6 +268,7 @@ class BatchScheduler:
                 else:
                     ticket.error = RuntimeError("server shutting down")
                     ticket.event.set()
+        _COLLECT_H.observe(time.monotonic() - t_collect)
         return batch
 
     def _loop(self) -> None:
@@ -227,8 +280,22 @@ class BatchScheduler:
             if first is None:
                 break
             batch = self._collect(first)
+            # Queue accounting at dispatch: each ticket's wait (its own
+            # submit clock) plus a "queue" span parented under ITS OWN
+            # request root — the span tree survives the thread hop.
+            t_dispatch = time.monotonic()
+            for ticket in batch:
+                _QUEUE_WAIT_H.observe(t_dispatch - ticket.t_submit)
+                TRACER.add_span(
+                    "queue", ticket.t_submit, t_dispatch,
+                    attrs={"batch_rows": len(batch)}, parent=ticket.span,
+                )
+            _BATCH_ROWS_H.observe(len(batch))
+            _BATCHES_C.inc()
             try:
-                with self._backend_lock:
+                # Backend spans (prefill/decode) emitted on THIS thread
+                # parent under the anchor request's root via attach().
+                with TRACER.attach(batch[0].span), self._backend_lock:
                     if len(batch) == 1:
                         results = [self.backend.generate(batch[0].request)]
                     else:

@@ -16,12 +16,29 @@ from __future__ import annotations
 import itertools
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from ..engine.backend import GenerationBackend
+from ..obs import metrics as obs_metrics
+from ..obs.trace import TRACER
 from ..runner import term
 from . import protocol
+
+# HTTP-surface telemetry (obs): request counts by (method, path, status)
+# and a latency histogram by path. Paths are the fixed API surface
+# (query strings stripped), so label cardinality stays bounded.
+_HTTP_REQUESTS_C = obs_metrics.REGISTRY.counter(
+    "llm_http_requests_total",
+    "HTTP requests served, by method/path/status",
+    labels=("method", "path", "status"),
+)
+_HTTP_SECONDS_H = obs_metrics.REGISTRY.histogram(
+    "llm_http_request_seconds",
+    "Wall time of one HTTP request, by path",
+    labels=("path",),
+)
 
 # Bound on any single streamed-chunk socket write; a consumer slower than
 # this (or one that stopped reading) gets disconnected rather than holding
@@ -52,6 +69,7 @@ class GenerationServer:
         batch_window_ms: float = 0.0,
         max_batch: Optional[int] = None,  # backend-aware (scheduler)
         budget_aware: Optional[bool] = None,  # KV-budget admission
+        access_log: bool = False,  # structured per-request log line
     ) -> None:
         """``batch_window_ms > 0`` enables continuous batching: concurrent
         non-streaming generate requests arriving within the window coalesce
@@ -61,10 +79,15 @@ class GenerationServer:
         for backends exposing ``max_admission_rows``) lets the scheduler
         raise each batch's cap to the widest fleet the backend's KV
         budget admits under its cache layout, so paged/int8-KV serving
-        actually admits the larger fleet its denser cache pays for."""
+        actually admits the larger fleet its denser cache pays for.
+        ``access_log`` (default off — measurement runs stay quiet)
+        emits one structured line per request: method, path, status,
+        duration ms. Telemetry (``/metrics``, spans) is default-on with
+        the obs kill switch (``TPU_LLM_OBS=0`` / ``--no-telemetry``)."""
         self.backend = backend
         self.models = list(models) if models else []
         self.quiet = quiet
+        self.access_log = access_log
         self._generate_lock = threading.Lock()
         self._scheduler = None
         if batch_window_ms > 0:
@@ -94,8 +117,61 @@ class GenerationServer:
             protocol_version = "HTTP/1.1"
 
             def log_message(self, fmt, *args):  # noqa: A003
-                if not server.quiet:
-                    term.log(f"serve: {fmt % args}")
+                # http.server's per-request stderr noise is replaced by
+                # the opt-in structured access log in _observed (below);
+                # measurement runs stay quiet by default.
+                pass
+
+            def send_response(self, code, message=None):
+                self._obs_status = code  # captured for metrics/access log
+                super().send_response(code, message)
+
+            def _observed(self, handler) -> None:
+                """Run one request handler with timing: HTTP metrics
+                always (cheap; no-ops when telemetry is off), plus the
+                opt-in structured access-log line."""
+                path = self.path.split("?", 1)[0]
+                self._obs_status = 0
+                t0 = time.monotonic()
+                try:
+                    handler()
+                finally:
+                    dur_s = time.monotonic() - t0
+                    _HTTP_REQUESTS_C.labels(
+                        method=self.command,
+                        path=path,
+                        status=str(self._obs_status),
+                    ).inc()
+                    _HTTP_SECONDS_H.labels(path=path).observe(dur_s)
+                    if server.access_log:
+                        term.log(
+                            "serve: "
+                            + json.dumps(
+                                {
+                                    "method": self.command,
+                                    "path": path,
+                                    "status": self._obs_status,
+                                    "duration_ms": round(dur_s * 1e3, 3),
+                                }
+                            )
+                        )
+
+            def _send_metrics(self) -> None:
+                """Prometheus text exposition; 404 while telemetry is
+                disabled so scrapers see 'off', not silently-empty."""
+                if not obs_metrics.enabled():
+                    self._send_json(
+                        404, {"error": "telemetry disabled (TPU_LLM_OBS=0)"}
+                    )
+                    return
+                body = obs_metrics.REGISTRY.exposition().encode("utf-8")
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _send_json(self, status: int, payload) -> None:
                 body = json.dumps(payload).encode("utf-8")
@@ -111,7 +187,15 @@ class GenerationServer:
                 return json.loads(raw.decode("utf-8"))
 
             def do_GET(self):  # noqa: N802
-                if self.path == protocol.HEALTH_PATH:
+                self._observed(self._do_get)
+
+            def do_POST(self):  # noqa: N802
+                self._observed(self._do_post)
+
+            def _do_get(self):
+                if self.path == protocol.METRICS_PATH:
+                    self._send_metrics()
+                elif self.path == protocol.HEALTH_PATH:
                     self._send_json(200, {"status": "ok"})
                 elif self.path == protocol.TAGS_PATH:
                     self._send_json(
@@ -137,7 +221,7 @@ class GenerationServer:
                 else:
                     self._send_json(404, {"error": f"unknown path {self.path}"})
 
-            def do_POST(self):  # noqa: N802
+            def _do_post(self):
                 try:
                     body = self._read_json()
                 except (ValueError, json.JSONDecodeError) as exc:
@@ -162,14 +246,21 @@ class GenerationServer:
                     )
                     return
                 if body.get("stream"):
-                    self._handle_generate_stream(request)
+                    with TRACER.span(
+                        "request", model=request.model, stream=True
+                    ):
+                        self._handle_generate_stream(request)
                     return
+                # The request's ROOT span: the scheduler's queue span and
+                # the engine's prefill/decode spans parent under it (the
+                # ticket carries it across the scheduler's thread hop).
                 try:
-                    if server._scheduler is not None:
-                        result = server._scheduler.submit(request)
-                    else:
-                        with server._generate_lock:
-                            result = server.backend.generate(request)
+                    with TRACER.span("request", model=request.model):
+                        if server._scheduler is not None:
+                            result = server._scheduler.submit(request)
+                        else:
+                            with server._generate_lock:
+                                result = server.backend.generate(request)
                 except KeyError as exc:
                     self._send_json(404, {"error": f"model not found: {exc}"})
                 except ValueError as exc:

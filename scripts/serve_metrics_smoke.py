@@ -1,0 +1,93 @@
+"""CI smoke: fake-engine server end-to-end + /metrics scrape + span trace.
+
+Starts a :class:`GenerationServer` over the deterministic fake backend
+with continuous batching on, pushes one request through the full
+HTTP → scheduler → backend path, scrapes ``GET /metrics``, asserts the
+scheduler/HTTP metric families are present, and exports the recorded
+span tree as a Chrome trace (the workflow uploads it as an artifact, so
+every CI run leaves an inspectable serving trace).
+
+Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json]``
+Exit 0 on success; prints one JSON status line either way.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    trace_out = sys.argv[1] if len(sys.argv) > 1 else "serve_trace.json"
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+        FakeBackend,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.trace import TRACER
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server import (
+        GenerationServer,
+    )
+
+    server = GenerationServer(
+        FakeBackend(),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        batch_window_ms=20,
+    )
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(
+            f"{base}/api/generate",
+            data=json.dumps(
+                {
+                    "model": "smoke:1b",
+                    "prompt": "hello",
+                    "options": {"num_predict": 8},
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body.get("done") and body.get("eval_count") == 8, body
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        required = (
+            "llm_http_requests_total",
+            "llm_http_request_seconds",
+            "llm_sched_queue_wait_seconds",
+            "llm_sched_batch_rows",
+        )
+        missing = [f for f in required if f not in text]
+        assert not missing, f"missing metric families: {missing}"
+
+        spans = TRACER.spans()
+        names = {s.name for s in spans}
+        assert {"request", "queue"} <= names, names
+        TRACER.export(trace_out, spans)
+    finally:
+        server.stop()
+
+    print(
+        json.dumps(
+            {
+                "smoke": "serve-metrics",
+                "status": "ok",
+                "metric_families": len(
+                    [l for l in text.splitlines() if l.startswith("# TYPE")]
+                ),
+                "spans": len(spans),
+                "trace": trace_out,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
