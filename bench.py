@@ -31,7 +31,127 @@ BATCH_TIMED_RUNS = 2
 BATCH_STAT = "best"  # max over the timed windows (relay sessions land low)
 
 
+def continuous_batching_bench() -> int:
+    """A/B of the two request schedulers under STAGGERED (Poisson)
+    arrivals: window dispatch (batches run to completion) vs the
+    iteration-level continuous scheduler (admit/step/retire at decode-
+    step granularity — serve/scheduler.py, engine/stepped.py).
+
+    CPU-functional and fake-clock-free: a depth-reduced real JaxEngine
+    decodes real tokens on whatever backend JAX has, and the arrival
+    process sleeps real wall-clock (seeded exponential inter-arrival via
+    scripts/poisson_load.py). The figures that matter are the RELATIVE
+    ones — p50/p95 TTFT, completion latency, aggregate tokens/s at the
+    same arrival trace — recorded in docs/PERF.md "Continuous vs window
+    batching". Prints ONE JSON line.
+    """
+    import dataclasses as _dc
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(
+        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "scripts")
+    )
+    import jax
+    import jax.numpy as jnp
+    from poisson_load import build_workload, run_load, summarize
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        BatchScheduler,
+        ContinuousScheduler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    on_accelerator = jax.default_backend() in ("tpu", "axon")
+    cfg = get_model_config("qwen2:1.5b")
+    if not on_accelerator:
+        # CPU-functional: the tiny architecture decodes real tokens in
+        # ~ms steps, so the latency SHAPES under staggered load are
+        # real while the full-width model's per-shape XLA compiles
+        # (minutes each on CPU) stay out of the bench
+        cfg = cfg.tiny()
+    engine = JaxEngine(
+        registry={cfg.name: cfg},
+        dtype=jnp.bfloat16 if on_accelerator else jnp.float32,
+        decode_attention="auto" if on_accelerator else None,
+    )
+
+    n = int(_os.environ.get("BENCH_CB_REQUESTS", "18"))
+    mean_ms = float(_os.environ.get("BENCH_CB_INTERARRIVAL_MS", "60"))
+    budgets = (8, 16, 96)  # mixed targets: arrivals straddle the long rows
+    # one prompt bucket (all < 32 tokens): the A/B measures scheduling,
+    # not prefill-shape compile churn
+    prompts = ("alpha beta", "gamma delta epsilon", "zeta eta")
+    workload = build_workload(
+        n, mean_ms / 1e3, seed=7, model=cfg.name, budgets=budgets,
+        prompts=prompts,
+        stop_at_eos=False,  # fixed lengths: both schedulers do equal work
+    )
+
+    # Warm every compiled shape OUTSIDE the measured traces (both
+    # schedulers replay the same arrival trace; neither may pay XLA).
+    warm = [req for _, req in workload[:6]]
+    engine.generate_batch(warm)
+    for req in {r.max_new_tokens: r for r in warm}.values():
+        engine.generate(req)
+    sess = engine.decode_open(warm, reserve_rows=2 * len(warm))
+    while sess.active:
+        sess.step()
+    sess.close()
+
+    results = {}
+    for mode, make in (
+        ("window", lambda: BatchScheduler(engine, window_s=0.05)),
+        ("continuous", lambda: ContinuousScheduler(engine)),
+    ):
+        sched = make()
+        sched.start()
+        try:
+            records = run_load(sched.submit, workload)
+        finally:
+            sched.stop()
+        results[mode] = summarize(records)
+
+    line = {
+        "metric": "continuous_batching",
+        "unit": "latency_seconds",
+        "model": cfg.name,
+        "backend": jax.default_backend(),
+        "n_layers": cfg.n_layers,
+        "requests": n,
+        "mean_interarrival_ms": mean_ms,
+        "budgets": list(budgets),
+        "window": results["window"],
+        "continuous": results["continuous"],
+        "ttft_p50_speedup": (
+            round(
+                results["window"]["ttft_p50_s"]
+                / results["continuous"]["ttft_p50_s"],
+                2,
+            )
+            if results["continuous"].get("ttft_p50_s")
+            else None
+        ),
+    }
+    print(json.dumps(line))
+    return 0
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "continuous_batching":
+        return continuous_batching_bench()
     import jax
 
     backend = jax.default_backend()

@@ -89,7 +89,25 @@ class GenerationChunk:
 
 
 class GenerationBackend:
-    """Abstract backend: load models, serve generation requests."""
+    """Abstract backend: load models, serve generation requests.
+
+    Backends MAY additionally speak the optional STEPPED-DECODE protocol
+    (iteration-level continuous batching — serve/scheduler.py's
+    ``ContinuousScheduler`` drives it when present):
+
+    - ``decode_open(requests, reserve_rows=None) -> session`` prefills
+      the rows and returns a resumable session;
+    - ``session.step(max_steps) -> list[GenerationResult]`` runs one
+      bounded decode slice and returns rows that retired during it;
+    - ``session.can_join(request) -> bool`` / ``session.join(request)``
+      admit a compatible queued request into a freed row mid-flight;
+    - ``session.active`` counts live rows; ``session.close()`` releases
+      the session.
+
+    Presence of ``decode_open`` is the capability signal (the base class
+    deliberately does not define it). JaxEngine (engine/stepped.py) and
+    FakeBackend implement it.
+    """
 
     def load_model(self, model: str) -> None:
         """Make ``model`` servable (weights into HBM for the JAX engine)."""

@@ -4,15 +4,88 @@ SURVEY.md §4: the reference has no fakes at all (its "remote" treatment needs
 a real second machine); this backend makes the full experiment — run table,
 hooks, profilers, persistence, analysis — testable with no accelerator and no
 network. Token ids and timings are pure functions of the request.
+
+It also speaks the STEPPED-DECODE protocol (``decode_open`` → session
+``step``/``can_join``/``join``/``close``) the continuous scheduler
+drives, so iteration-level admission/retirement is testable hermetically:
+a session precomputes each row's deterministic token stream and a
+``step(k)`` slice advances every live row's cursor by ``k`` (sleeping
+one shared window of ``k / tokens_per_s`` when ``simulate_delay`` — rows
+decode together, like the real engine's shared batch window), retiring
+rows whose stream is exhausted.
 """
 
 from __future__ import annotations
 
 import hashlib
 import time
-from typing import Dict
+from typing import Dict, List, Optional
 
 from .backend import GenerationBackend, GenerationRequest, GenerationResult
+
+
+class _FakeStepSession:
+    """Stepped-decode session over precomputed deterministic streams."""
+
+    def __init__(
+        self,
+        backend: "FakeBackend",
+        requests: List[GenerationRequest],
+        max_rows: int = 64,
+    ) -> None:
+        self.backend = backend
+        self.max_rows = max_rows
+        self.closed = False
+        self.model = requests[0].model if requests else ""
+        self.top_k = requests[0].top_k if requests else 0
+        self._rows: List[dict] = []
+        for r in requests:
+            self._admit(r)
+
+    def _admit(self, request: GenerationRequest) -> None:
+        self._rows.append(
+            {"request": request, "result": self.backend._result(request), "cursor": 0}
+        )
+
+    @property
+    def active(self) -> int:
+        return len(self._rows)
+
+    def can_join(self, request: GenerationRequest) -> bool:
+        return not self.closed and len(self._rows) < self.max_rows
+
+    def join(self, request: GenerationRequest) -> int:
+        if not self.can_join(request):
+            raise RuntimeError("request cannot join this session")
+        self._admit(request)
+        return len(self._rows) - 1
+
+    def step(self, max_steps: int = 16) -> List[GenerationResult]:
+        if self.closed:
+            raise RuntimeError("session is closed")
+        if self.backend.simulate_delay and self._rows:
+            # one SHARED window per slice, not per row — the semantics of
+            # a real batched decode slice
+            time.sleep(max_steps / self.backend.tokens_per_s)
+        retired, keep = [], []
+        for row in self._rows:
+            row["cursor"] += max_steps
+            if row["cursor"] >= row["result"].generated_tokens:
+                res = row["result"]
+                res.extras = {
+                    **(res.extras or {}),
+                    "retire_reason": "budget",
+                    "stepped": True,
+                }
+                retired.append(res)
+            else:
+                keep.append(row)
+        self._rows = keep
+        return retired
+
+    def close(self) -> None:
+        self.closed = True
+        self._rows = []
 
 
 class FakeBackend(GenerationBackend):
@@ -27,7 +100,10 @@ class FakeBackend(GenerationBackend):
     def loaded_models(self):
         return sorted(self.loaded)
 
-    def generate(self, request: GenerationRequest) -> GenerationResult:
+    def _result(self, request: GenerationRequest) -> GenerationResult:
+        """The deterministic result, with no simulated wall time spent —
+        shared by the blocking path (which sleeps around it) and the
+        stepped sessions (which sleep per slice instead)."""
         if request.model not in self.loaded:
             self.load_model(request.model)
         digest = hashlib.sha256(
@@ -37,8 +113,6 @@ class FakeBackend(GenerationBackend):
         tokens = [digest[i % len(digest)] + 3 for i in range(n)]
         decode_s = n / self.tokens_per_s
         prefill_s = 0.001
-        if self.simulate_delay:
-            time.sleep(decode_s + prefill_s)
         text = "".join(chr(97 + (t % 26)) for t in tokens)
         return GenerationResult(
             request=request,
@@ -50,3 +124,17 @@ class FakeBackend(GenerationBackend):
             decode_s=decode_s,
             total_s=prefill_s + decode_s,
         )
+
+    def generate(self, request: GenerationRequest) -> GenerationResult:
+        result = self._result(request)
+        if self.simulate_delay:
+            time.sleep(result.total_s)
+        return result
+
+    def decode_open(
+        self,
+        requests: List[GenerationRequest],
+        reserve_rows: Optional[int] = None,
+    ) -> _FakeStepSession:
+        """Stepped-decode protocol (see the module docstring)."""
+        return _FakeStepSession(self, requests)

@@ -94,6 +94,14 @@ PAGED_XLA_PARTS_MAX_JMAX = int(
     os.environ.get("PAGED_XLA_PARTS_MAX_JMAX", 8)
 )
 DEFAULT_STREAM_CHUNK = 32  # decode steps per streamed chunk
+# Decode steps per slice of a STEPPED (iteration-level) decode session
+# (engine/stepped.py): the scheduler regains control between slices to
+# retire finished rows (freeing their pages mid-flight) and admit queued
+# requests into the freed rows. Smaller slices = finer admission
+# granularity but more host round-trips per generated token; 8–16 keeps
+# the per-slice host sync under ~5% of slice wall on the measured tiny
+# shapes while bounding a joiner's wait to one slice.
+DECODE_SLICE_STEPS = int(os.environ.get("DECODE_SLICE_STEPS", 16))
 
 # Engine telemetry (obs): the fence-timed prefill/decode windows the
 # engine already measures, published as metric families + spans. The
@@ -2002,6 +2010,275 @@ class JaxEngine(GenerationBackend):
 
         self._decode_cache[key] = decode
         return decode
+
+    # -- stepped (iteration-level) decode --------------------------------------
+    def _batch_decode_step_fn(
+        self,
+        model: str,
+        n_steps: int,
+        top_k: int,
+        use_top_p: bool,
+        use_rp: bool,
+    ) -> Callable:
+        """Stepped twin of :meth:`_batch_decode_fn` for iteration-level
+        scheduling: runs AT MOST ``n_real`` (≤ the compiled ``n_steps``
+        slice) decode steps and returns the FULL loop carry, so the
+        caller (engine/stepped.py) regains control between slices to
+        retire finished rows and admit queued requests into the freed
+        slots. Two deltas vs the monolithic loop, both parity-safe: a
+        per-row ``remaining`` budget folds into the done mask (the
+        tokens it cuts are exactly the post-budget ones the monolithic
+        path samples and then discards at ``take = min(n_row,
+        budget)``), and done rows freeze their offsets (a retired slot
+        must not walk its write position across the cache while it
+        idles; a live row's offsets advance identically)."""
+        key = ("batch-step", model, n_steps, top_k, use_top_p, use_rp)
+        if key in self._decode_cache:
+            return self._decode_cache[key]
+        tf = self._models[model]
+        cfg = tf.cfg
+        decode_attention = self._decode_attention_for_cache(cfg)
+        eos = self._tokenizer_for(model).eos_id
+
+        from ..ops.sampling import sample_token_per_row
+
+        @jax.jit
+        def decode(
+            params,
+            first_tokens,  # [B] — each row's current last token
+            offsets,  # [B]
+            k_cache,
+            v_cache,
+            temperature,  # [B]
+            rngs,  # [B] keys
+            n_real,  # scalar: max steps this slice
+            remaining,  # [B] — per-row token budget left BEFORE this slice
+            top_p,  # [B]
+            repeat_penalty,  # [B]
+            presence,  # [B, vocab]
+            done0,  # [B] — retired/free slots enter (and stay) done
+        ):
+            b = first_tokens.shape[0]
+
+            def cond(carry):
+                _, _, _, _, _, done, i, _, _, _ = carry
+                return (i < n_real) & ~jnp.all(done)
+
+            def body(carry):
+                token, offs, kc, vc, rngs, done, i, out, pres, n_row = carry
+                prev_done = done
+                hidden, kc, vc = forward(
+                    params, cfg, token[:, None], offs, kc, vc, decode_attention
+                )
+                logits = logits_for(params, cfg, hidden[:, 0])
+                split = jax.vmap(jax.random.split)(rngs)
+                rngs, subs = split[:, 0], split[:, 1]
+                nxt = sample_token_per_row(
+                    logits,
+                    subs,
+                    temperature,
+                    top_k,
+                    top_p if use_top_p else None,
+                    pres if use_rp else None,
+                    repeat_penalty if use_rp else None,
+                )
+                nxt = jnp.where(done, jnp.int32(eos), nxt)
+                done = done | (nxt == eos) | (i + 1 >= remaining)
+                if use_rp:
+                    pres = pres.at[jnp.arange(b), nxt].set(True)
+                out = out.at[:, i].set(nxt)
+                n_row = jnp.where(prev_done, n_row, i + 1)
+                offs = jnp.where(done, offs, offs + 1)
+                return (
+                    nxt, offs, kc, vc, rngs, done, i + 1, out, pres, n_row
+                )
+
+            out0 = jnp.full((b, n_steps), eos, dtype=jnp.int32)
+            init = (
+                first_tokens,
+                offsets,
+                k_cache,
+                v_cache,
+                rngs,
+                done0,
+                jnp.int32(0),
+                out0,
+                presence,
+                jnp.zeros((b,), dtype=jnp.int32),
+            )
+            (
+                token, offs, kc, vc, rngs_out, done, _, out_tokens,
+                pres_out, n_row,
+            ) = jax.lax.while_loop(cond, body, init)
+            return (
+                out_tokens, n_row, token, offs, kc, vc, rngs_out,
+                pres_out, done,
+            )
+
+        self._decode_cache[key] = decode
+        return decode
+
+    def _paged_batch_decode_step_fn(
+        self,
+        model: str,
+        n_steps: int,
+        top_k: int,
+        use_top_p: bool,
+        use_rp: bool,
+        stacked: bool,
+        quantized: bool,
+    ) -> Callable:
+        """Stepped twin of :meth:`_paged_batch_decode_fn`. Differences
+        forced by resumability: the pool/table/side-caches arrive as
+        ARGUMENTS instead of closures (a mid-flight join scatters new
+        prefill pages into the pool between slices, so the compiled fn
+        must read the caller's current arrays), ``prompt_lens`` is an
+        explicit input (at slice ≥ 2 the entry offsets are no longer the
+        prompt lengths), and the full carry returns. The per-row
+        ``remaining`` budget replaces the monolithic loop's ``budgets``
+        with the same step arithmetic."""
+        decode_attention = self._paged_decode_attention(
+            self._models[model].cfg
+        )
+        key = (
+            "paged-step", model, n_steps, top_k, use_top_p, use_rp,
+            stacked, quantized,
+        )
+        if key in self._decode_cache:
+            return self._decode_cache[key]
+        tf = self._models[model]
+        cfg = tf.cfg
+        eos = self._tokenizer_for(model).eos_id
+
+        from ..ops.sampling import sample_token_per_row
+
+        @jax.jit
+        def decode(
+            params,
+            first_tokens,  # [B]
+            offsets,  # [B]
+            prompt_lens,  # [B] — static per row between joins
+            pool_k,  # [L, P, Hkv, page, D] — or {"q","s"}
+            pool_v,
+            table,  # [B, Jmax] int32
+            side_k,  # stacked: [L, B, Hkv, Tgen, D] (or {"q","s"}); else 0
+            side_v,
+            temperature,
+            rngs,
+            n_real,  # scalar
+            remaining,  # [B]
+            top_p,
+            repeat_penalty,
+            presence,
+            done0,
+        ):
+            b = first_tokens.shape[0]
+            l = (pool_k["q"] if quantized else pool_k).shape[0]
+            table_c = (
+                table if stacked else jnp.broadcast_to(
+                    table, (l,) + table.shape
+                )
+            )
+
+            def cond(carry):
+                _, _, _, _, _, done, i, _, _, _ = carry
+                return (i < n_real) & ~jnp.all(done)
+
+            def body(carry):
+                token, offs, pk, pv, rngs, done, i, out, pres, n_row = carry
+                prev_done = done
+                if stacked:
+                    kc = {
+                        "pool": pool_k, "table": table_c, "side": pk,
+                        "write_pos": offs - prompt_lens,
+                        "prompt_lens": prompt_lens,
+                    }
+                    vc = {
+                        "pool": pool_v, "table": table_c, "side": pv,
+                        "write_pos": offs - prompt_lens,
+                        "prompt_lens": prompt_lens,
+                    }
+                else:
+                    kc = {"pool": pk, "table": table_c}
+                    vc = {"pool": pv, "table": table_c}
+                hidden, kc, vc = forward(
+                    params, cfg, token[:, None], offs, kc, vc, decode_attention
+                )
+                pk, pv = (
+                    (kc["side"], vc["side"])
+                    if stacked
+                    else (kc["pool"], vc["pool"])
+                )
+                logits = logits_for(params, cfg, hidden[:, 0])
+                split = jax.vmap(jax.random.split)(rngs)
+                rngs, subs = split[:, 0], split[:, 1]
+                nxt = sample_token_per_row(
+                    logits,
+                    subs,
+                    temperature,
+                    top_k,
+                    top_p if use_top_p else None,
+                    pres if use_rp else None,
+                    repeat_penalty if use_rp else None,
+                )
+                nxt = jnp.where(done, jnp.int32(eos), nxt)
+                done = done | (nxt == eos) | (i + 1 >= remaining)
+                if use_rp:
+                    pres = pres.at[jnp.arange(b), nxt].set(True)
+                out = out.at[:, i].set(nxt)
+                n_row = jnp.where(prev_done, n_row, i + 1)
+                offs = jnp.where(done, offs, offs + 1)
+                return (
+                    nxt, offs, pk, pv, rngs, done, i + 1, out, pres, n_row
+                )
+
+            out0 = jnp.full((b, n_steps), eos, dtype=jnp.int32)
+            cache0_k, cache0_v = (
+                (side_k, side_v) if stacked else (pool_k, pool_v)
+            )
+            init = (
+                first_tokens,
+                offsets,
+                cache0_k,
+                cache0_v,
+                rngs,
+                done0,
+                jnp.int32(0),
+                out0,
+                presence,
+                jnp.zeros((b,), dtype=jnp.int32),
+            )
+            (
+                token, offs, ck, cv, rngs_out, done, _, out_tokens,
+                pres_out, n_row,
+            ) = jax.lax.while_loop(cond, body, init)
+            return (
+                out_tokens, n_row, token, offs, ck, cv, rngs_out,
+                pres_out, done,
+            )
+
+        self._decode_cache[key] = decode
+        return decode
+
+    def decode_open(
+        self,
+        requests: "list[GenerationRequest]",
+        reserve_rows: Optional[int] = None,
+    ):
+        """Open an iteration-level decode session over ``requests`` (the
+        stepped-decode protocol the continuous scheduler drives —
+        engine/stepped.py): all rows prefill now, then the caller runs
+        ``session.step(k)`` slices, collecting retired rows' results the
+        moment their done-mask sets and joining queued compatible
+        requests into the freed slots via ``session.join``.
+        ``reserve_rows`` sizes the row bucket above ``len(requests)`` so
+        a session opened by a lone anchor still has free slots for
+        mid-flight joins."""
+        from .stepped import SteppedDecodeSession
+
+        return SteppedDecodeSession.open(
+            self, requests, reserve_rows=reserve_rows
+        )
 
     def _paged_decode_attention(self, cfg: Optional[ModelConfig] = None):
         """The attention impl for paged caches: the Pallas page-table

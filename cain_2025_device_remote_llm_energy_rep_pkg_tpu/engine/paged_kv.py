@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -163,6 +163,14 @@ class PagePool:
         pages, self._free = self._free[:n_pages], self._free[n_pages:]
         _publish_pool_gauges(self._free, self.n_pages)
         return pages
+
+    def try_alloc(self, n_pages: int) -> "Optional[List[int]]":
+        """``alloc`` that returns ``None`` instead of raising when the
+        pool is short — the admission-probe path (a continuous-batching
+        join that doesn't fit should be deferred, not failed)."""
+        if n_pages > len(self._free):
+            return None
+        return self.alloc(n_pages)
 
     def free(self, pages: List[int]) -> None:
         self._free.extend(pages)

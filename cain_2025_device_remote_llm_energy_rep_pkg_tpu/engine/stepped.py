@@ -1,0 +1,672 @@
+"""Iteration-level decode sessions: admit and retire rows at decode-step
+granularity.
+
+The window scheduler dispatches a whole batch to completion: a request
+arriving 10 ms after a window closes waits for the slowest row of the
+previous batch, and the engine keeps stepping EOS-finished rows (writing
+padding EOS tokens) until every row is done. This module is the engine
+half of the fix (Orca's iteration-level scheduling, Yu et al. OSDI '22,
+composed with vLLM-style paged block management, Kwon et al. SOSP '23):
+
+- :meth:`SteppedDecodeSession.open` prefills the initial rows exactly as
+  ``generate_batch`` would (the grouped-prefill machinery via
+  ``_batch_states``) and assembles a resumable batched decode state at a
+  fixed row bucket;
+- :meth:`SteppedDecodeSession.step` runs one bounded slice (8–16 steps,
+  ``DECODE_SLICE_STEPS``) through the stepped decode fns
+  (``_batch_decode_step_fn`` / ``_paged_batch_decode_step_fn``, which
+  return the full loop carry), then RETIRES rows whose done-mask is set
+  — their result returns immediately and, on the paged path, their pages
+  go back to the pool mid-flight;
+- :meth:`SteppedDecodeSession.join` admits a queued compatible request
+  into a freed slot between slices: solo prefill at the session's cache
+  shape, scattered into the slot (contiguous) or into freshly allocated
+  pool pages (paged).
+
+Token parity: every row's stream is bit-identical to its solo
+``generate()`` — the slice loop is the monolithic batch loop with the
+carry threaded across calls (the same argument that makes
+``generate_stream`` identical to ``generate``), per-row rng/knob/done
+machinery is shared with the batch paths, and rows are mathematically
+independent across the batch dimension, so retiring one row or joining
+another never perturbs a companion's tokens. The per-row ``remaining``
+budget folded into the done mask only cuts tokens the monolithic path
+samples and then discards.
+
+Shapes stay static per session: the row bucket, cache length (or page
+pool + table width) and slice width are fixed at open; joins must fit
+them (``can_join``) or they anchor a later session instead — the
+"bucketed prefill-then-join" discipline.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..obs.metrics import enabled as _obs_enabled
+from .backend import GenerationRequest, GenerationResult
+
+
+def _pow2_at_least(n: int, floor: int = 1) -> int:
+    m = floor
+    while m < n:
+        m *= 2
+    return m
+
+
+def _set_row(cache, r: int, row, axis: int = 1):
+    """Write one row of a (possibly ``{"q","s"}``-leafed) batch cache:
+    ``row`` carries a singleton batch dim at ``axis``."""
+    if isinstance(cache, dict):
+        return {
+            k: _set_row(cache[k], r, row[k], axis) for k in cache
+        }
+    idx = [slice(None)] * cache.ndim
+    idx[axis] = r
+    return cache.at[tuple(idx)].set(jnp.take(row, 0, axis=axis))
+
+
+def _zero_row(cache, r: int, axis: int = 1):
+    """Zero one row of a (possibly dict-leafed) batch cache."""
+    if isinstance(cache, dict):
+        return {k: _zero_row(cache[k], r, axis) for k in cache}
+    idx = [slice(None)] * cache.ndim
+    idx[axis] = r
+    return cache.at[tuple(idx)].set(0)
+
+
+class _Row:
+    """Host-side record of one live session row."""
+
+    __slots__ = (
+        "request", "s_real", "generated", "budget", "t0", "t1",
+        "t_decode0", "pages",
+    )
+
+    def __init__(
+        self, request, s_real, first, budget, t0, t1, t_decode0, pages=None
+    ):
+        self.request = request
+        self.s_real = s_real
+        self.generated: List[int] = [first]
+        self.budget = budget  # decode-loop steps (max_new_tokens - 1)
+        self.t0 = t0
+        self.t1 = t1
+        self.t_decode0 = t_decode0
+        self.pages: List[int] = pages or []
+
+
+class SteppedDecodeSession:
+    """One resumable batched decode (see the module docstring).
+
+    The device state mirrors the monolithic batch loops' carries; the
+    host state is one :class:`_Row` per live slot. ``rows[r] is None``
+    marks a free slot (never admitted, or retired) — free slots ride
+    along pre-done, replicating row 0's offsets so their masked
+    attention never softmaxes an empty row, exactly the monolithic
+    paths' padding-row convention.
+    """
+
+    def __init__(self, engine, model: str, top_k: int) -> None:
+        self.engine = engine
+        self.model = model
+        self.top_k = top_k
+        self.closed = False
+        self.paged = bool(engine.paged_kv)
+        self.rows: List[Optional[_Row]] = []
+        self.use_top_p = False
+        self.use_rp = False
+
+    # -- construction ---------------------------------------------------------
+    @classmethod
+    def open(
+        cls,
+        engine,
+        requests: "list[GenerationRequest]",
+        reserve_rows: Optional[int] = None,
+    ) -> "SteppedDecodeSession":
+        from .jax_engine import (
+            BATCH_BUCKETS,
+            DECODE_SLICE_STEPS,
+            GEN_BUCKETS,
+            _bucket,
+        )
+
+        if not requests:
+            raise ValueError("decode_open needs at least one request")
+        models = {r.model for r in requests}
+        if len(models) > 1:
+            raise ValueError(f"one model per session, got {sorted(models)}")
+        top_ks = {r.top_k for r in requests}
+        if len(top_ks) > 1:
+            raise ValueError(f"one top_k per session, got {sorted(top_ks)}")
+        model = requests[0].model
+        engine.load_model(model)
+        self = cls(engine, model, requests[0].top_k)
+        self.cfg = engine._models[model].cfg
+        self.tok = engine._tokenizer_for(model)
+        all_ids = [self.tok.encode(r.prompt) for r in requests]
+        n = len(requests)
+        self.b_bucket = _bucket(
+            max(n, int(reserve_rows or 0)), BATCH_BUCKETS
+        )
+        self.g_bucket = _bucket(
+            max(r.max_new_tokens for r in requests), GEN_BUCKETS
+        )
+        self.slice_bucket = max(1, DECODE_SLICE_STEPS)
+        if self.paged:
+            self._open_paged(requests, all_ids)
+        else:
+            self._open_contiguous(requests, all_ids)
+        return self
+
+    def _open_common(self, requests, states, pad: int) -> None:
+        """Assemble the per-row device arrays shared by both cache
+        layouts (free slots replicate row 0 and enter pre-done)."""
+        rep = [states[0]] * pad
+        self.tokens = jnp.concatenate(
+            [st["first"] for st in states] + [s["first"] for s in rep]
+        )
+        self.rngs = jnp.stack(
+            [st["rng"] for st in states] + [s["rng"] for s in rep]
+        )
+        self.presence = jnp.concatenate(
+            [st["presence"] for st in states]
+            + [s["presence"] for s in rep],
+            axis=0,
+        )
+        offs = [st["s_real"] for st in states] + [
+            states[0]["s_real"]
+        ] * pad
+        self.offsets = jnp.asarray(offs, dtype=jnp.int32)
+        self.prompt_lens = jnp.asarray(offs, dtype=jnp.int32)
+        self.remaining = jnp.asarray(
+            [r.max_new_tokens - 1 for r in requests] + [0] * pad,
+            dtype=jnp.int32,
+        )
+        self.temps = jnp.asarray(
+            [r.temperature for r in requests]
+            + [requests[0].temperature] * pad,
+            dtype=jnp.float32,
+        )
+        self.top_ps = jnp.asarray(
+            [self._row_top_p(r) for r in requests]
+            + [self._row_top_p(requests[0])] * pad,
+            dtype=jnp.float32,
+        )
+        self.rps = jnp.asarray(
+            [r.repeat_penalty for r in requests]
+            + [requests[0].repeat_penalty] * pad,
+            dtype=jnp.float32,
+        )
+        # a max_new_tokens=1 row has no decode steps: it enters done and
+        # retires on the first step call with just its prefill token
+        self.done = jnp.asarray(
+            [r.max_new_tokens <= 1 for r in requests] + [True] * pad
+        )
+        self.use_top_p = any(st["use_top_p"] for st in states)
+        self.use_rp = any(st["use_rp"] for st in states)
+        t_open = time.monotonic()
+        self.rows = [
+            _Row(
+                r,
+                st["s_real"],
+                int(st["first"][0]),
+                r.max_new_tokens - 1,
+                st["t0"],
+                st["t1"],
+                t_open,
+            )
+            for r, st in zip(requests, states)
+        ] + [None] * pad
+
+    @staticmethod
+    def _row_top_p(r: GenerationRequest) -> float:
+        # sentinel 2.0 ≡ filter provably off for that row (the batch
+        # paths' convention — see _generate_batch_chunk)
+        return r.top_p if r.top_p < 1.0 else 2.0
+
+    def _open_contiguous(self, requests, all_ids) -> None:
+        from .jax_engine import _prompt_alloc
+
+        eng = self.engine
+        cfg = self.cfg
+        s_buckets = [_prompt_alloc(max(len(i), 1)) for i in all_ids]
+        self.cache_len = max(s_buckets) + self.g_bucket
+        if self.cache_len > cfg.max_seq_len:
+            raise ValueError(
+                f"{self.model}: session cache {self.cache_len} exceeds "
+                f"max_seq_len {cfg.max_seq_len}"
+            )
+        states = eng._batch_states(
+            requests, all_ids, [self.cache_len] * len(requests)
+        )
+        n = len(states)
+        pad = self.b_bucket - n
+        k_cache = jnp.concatenate(
+            [st["k_cache"] for st in states]
+            + [states[0]["k_cache"]] * pad,
+            axis=1,
+        )
+        v_cache = jnp.concatenate(
+            [st["v_cache"] for st in states]
+            + [states[0]["v_cache"]] * pad,
+            axis=1,
+        )
+        if eng.kv_quantize:
+            k_cache, v_cache = eng._quantize_batch_cache(
+                self.model, k_cache, v_cache
+            )
+        self.k_cache, self.v_cache = k_cache, v_cache
+        self._open_common(requests, states, pad)
+
+    def _open_paged(self, requests, all_ids) -> None:
+        import numpy as np
+
+        from .jax_engine import _prompt_alloc
+        from .paged_kv import (
+            PagePool,
+            _paginate,
+            quantize_chunks,
+            scatter_pages,
+        )
+
+        eng = self.engine
+        cfg = self.cfg
+        page = eng.page_size
+        for r, ids in zip(requests, all_ids):
+            if len(ids) + r.max_new_tokens > cfg.max_seq_len:
+                raise ValueError(
+                    f"{self.model}: prompt {len(ids)} + generation "
+                    f"{r.max_new_tokens} exceeds max_seq_len "
+                    f"{cfg.max_seq_len}"
+                )
+        self.stacked = eng._paged_decode_attention(cfg) is not None
+        self.quantized = bool(eng.kv_quantize)
+        self.page_size = page
+        states = eng._batch_states(
+            requests,
+            all_ids,
+            [_prompt_alloc(max(len(i), 1)) for i in all_ids],
+        )
+        n = len(states)
+        pad = self.b_bucket - n
+        rows_pages = [
+            self._pages_needed(st["s_real"], r.max_new_tokens)
+            for st, r in zip(states, requests)
+        ]
+        # ×2 page and table-width headroom over the initial fleet so
+        # mid-flight joins have pages to allocate and slots to fit —
+        # without it a lone anchor's session could never admit anyone
+        total = sum(rows_pages) + 1  # + the shared parking page
+        n_pages = _pow2_at_least(2 * total, 4)
+        self.jmax = _pow2_at_least(2 * max(rows_pages))
+        self.d_pool = (
+            -(-cfg.d_head // 128) * 128 if self.stacked else cfg.d_head
+        )
+        self.pool = PagePool.create(
+            n_layers=cfg.n_layers,
+            n_pages=n_pages,
+            n_kv_heads=cfg.n_kv_heads,
+            d_head=self.d_pool,
+            page_size=page,
+            dtype=eng.dtype,
+            quantized=self.quantized,
+        )
+        # Retired/free slots park their table rows here: a done row
+        # re-writes one frozen (page, slot) each step (legacy mode), and
+        # that write must never land on pages a live or future row owns.
+        self.parking = self.pool.alloc(1)[0]
+        table_np = np.full(
+            (self.b_bucket, self.jmax), self.parking, dtype=np.int32
+        )
+        chunk_dest: List[int] = []
+        chunks_k, chunks_v = [], []
+        row_pages: List[List[int]] = []
+        for r, (st, need) in enumerate(zip(states, rows_pages)):
+            pages = self.pool.alloc(need)
+            row_pages.append(pages)
+            table_np[r, :need] = pages
+            n_prompt_pages = -(-st["s_real"] // page)
+            chunk_dest.extend(pages[:n_prompt_pages])
+            ck = _paginate(st["k_cache"][:, 0], st["s_real"], page)
+            cv = _paginate(st["v_cache"][:, 0], st["s_real"], page)
+            if self.d_pool != cfg.d_head:
+                padd = [(0, 0)] * (ck.ndim - 1) + [
+                    (0, self.d_pool - cfg.d_head)
+                ]
+                ck, cv = jnp.pad(ck, padd), jnp.pad(cv, padd)
+            chunks_k.append(ck)
+            chunks_v.append(cv)
+        all_k = (
+            chunks_k[0] if len(chunks_k) == 1 else jnp.concatenate(chunks_k)
+        )
+        all_v = (
+            chunks_v[0] if len(chunks_v) == 1 else jnp.concatenate(chunks_v)
+        )
+        if self.quantized:
+            all_k, all_v = quantize_chunks(all_k, all_v)
+        self.pool.k, self.pool.v = scatter_pages(
+            self.pool.k,
+            self.pool.v,
+            jnp.asarray(chunk_dest, jnp.int32),
+            all_k,
+            all_v,
+        )
+        table = jnp.asarray(table_np)
+        self.pool.k, self.pool.v, table = eng._place_pool(
+            cfg, self.pool.k, self.pool.v, table
+        )
+        self.table = table
+        if self.stacked:
+            side_shape = (
+                cfg.n_layers, self.b_bucket, cfg.n_kv_heads,
+                self.g_bucket, cfg.d_head,
+            )
+            if self.quantized:
+                side0 = {
+                    "q": jnp.zeros(side_shape, jnp.int8),
+                    "s": jnp.zeros(side_shape[:-1], jnp.float32),
+                }
+                self.side_k, self.side_v = side0, {
+                    "q": jnp.zeros(side_shape, jnp.int8),
+                    "s": jnp.zeros(side_shape[:-1], jnp.float32),
+                }
+            else:
+                self.side_k = jnp.zeros(side_shape, dtype=eng.dtype)
+                self.side_v = jnp.zeros(side_shape, dtype=eng.dtype)
+        else:
+            self.side_k = self.side_v = jnp.int32(0)
+        self._open_common(requests, states, pad)
+        for row, pages in zip(self.rows, row_pages):
+            row.pages = pages
+
+    def _pages_needed(self, s_real: int, max_new_tokens: int) -> int:
+        """Pages one row pins: prompt-only in stacked mode (generated
+        tokens live in the side caches), prompt + budget in legacy mode
+        — the monolithic paged path's sizing rule."""
+        page = self.page_size
+        if self.stacked:
+            return -(-max(s_real, 1) // page)
+        return -(-(s_real + max_new_tokens) // page)
+
+    # -- introspection --------------------------------------------------------
+    @property
+    def active(self) -> int:
+        return sum(1 for r in self.rows if r is not None)
+
+    @property
+    def free_slots(self) -> int:
+        return sum(1 for r in self.rows if r is None)
+
+    # -- stepping -------------------------------------------------------------
+    def step(self, max_steps: Optional[int] = None) -> List[GenerationResult]:
+        """Run one bounded decode slice; returns the results of every row
+        that retired during it (EOS or budget exhaustion). The caller
+        regains control after at most ``slice_bucket`` steps."""
+        from .jax_engine import _to_host_list
+
+        if self.closed:
+            raise RuntimeError("session is closed")
+        live = [r for r, row in enumerate(self.rows) if row is not None]
+        if not live:
+            return []
+        eng = self.engine
+        params = eng._models[self.model].params
+        n_real = min(max_steps or self.slice_bucket, self.slice_bucket)
+        t1 = time.monotonic()
+        if self.paged:
+            decode = eng._paged_batch_decode_step_fn(
+                self.model, self.slice_bucket, self.top_k,
+                self.use_top_p, self.use_rp, self.stacked, self.quantized,
+            )
+            (
+                out, n_row, tokens, offsets, ck, cv, rngs, presence, done,
+            ) = decode(
+                params,
+                self.tokens,
+                self.offsets,
+                self.prompt_lens,
+                self.pool.k,
+                self.pool.v,
+                self.table,
+                self.side_k,
+                self.side_v,
+                self.temps,
+                self.rngs,
+                jnp.int32(n_real),
+                self.remaining,
+                self.top_ps,
+                self.rps,
+                self.presence,
+                self.done,
+            )
+            if self.stacked:
+                self.side_k, self.side_v = ck, cv
+            else:
+                self.pool.k, self.pool.v = ck, cv
+        else:
+            decode = eng._batch_decode_step_fn(
+                self.model, self.slice_bucket, self.top_k,
+                self.use_top_p, self.use_rp,
+            )
+            (
+                out, n_row, tokens, offsets, ck, cv, rngs, presence, done,
+            ) = decode(
+                params,
+                self.tokens,
+                self.offsets,
+                self.k_cache,
+                self.v_cache,
+                self.temps,
+                self.rngs,
+                jnp.int32(n_real),
+                self.remaining,
+                self.top_ps,
+                self.rps,
+                self.presence,
+                self.done,
+            )
+            self.k_cache, self.v_cache = ck, cv
+        self.tokens, self.offsets = tokens, offsets
+        self.rngs, self.presence = rngs, presence
+        self.remaining = self.remaining - n_row
+        self.done = done
+        out = jax.block_until_ready(out)
+        out_host = _to_host_list(out)
+        n_row_host = _to_host_list(n_row)
+        done_host = _to_host_list(done)
+        t2 = time.monotonic()
+        slice_tokens = 0
+        slice_steps = 0
+        retired: List[GenerationResult] = []
+        for r in live:
+            cnt = int(n_row_host[r])
+            slice_tokens += cnt
+            slice_steps = max(slice_steps, cnt)
+            if cnt:
+                self.rows[r].generated.extend(out_host[r][:cnt])
+            if done_host[r]:
+                retired.append(self._retire(r, t2))
+        if _obs_enabled() and slice_tokens:
+            try:
+                eng._observe_decode_window(
+                    t1, t2, slice_tokens, slice_steps, rows=len(live)
+                )
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        return retired
+
+    def _retire(self, r: int, t2: float) -> GenerationResult:
+        from .jax_engine import _apply_stop
+
+        row = self.rows[r]
+        req = row.request
+        generated = row.generated
+        eos = self.tok.eos_id
+        reason = (
+            "eos" if generated and generated[-1] == eos else "budget"
+        )
+        if req.stop_at_eos and eos in generated:
+            generated = generated[: generated.index(eos)]
+        text = self.tok.decode(generated)
+        if req.stop:
+            generated, text = _apply_stop(generated, text, self.tok, req.stop)
+        result = GenerationResult(
+            request=req,
+            tokens=generated,
+            text=text,
+            prompt_tokens=row.s_real,
+            generated_tokens=len(generated),
+            prefill_s=row.t1 - row.t0,
+            decode_s=t2 - row.t_decode0,
+            total_s=t2 - row.t0,
+            extras={"retire_reason": reason, "stepped": True},
+        )
+        if self.paged:
+            # park the slot's table row FIRST: the dead row's frozen
+            # write slot (legacy mode) must stop aliasing pages we are
+            # about to hand back to the free list
+            self.table = self.table.at[r].set(self.parking)
+            self.pool.free(row.pages)
+            row.pages = []
+        self.rows[r] = None
+        return result
+
+    # -- admission ------------------------------------------------------------
+    def can_join(self, request: GenerationRequest) -> bool:
+        """Whether ``request`` fits this session's static shapes and free
+        capacity RIGHT NOW. Must stay side-effect free — the scheduler
+        probes before paying the prefill."""
+        from .jax_engine import GEN_BUCKETS, _bucket, _prompt_alloc
+
+        if self.closed or self.free_slots == 0:
+            return False
+        if request.model != self.model or request.top_k != self.top_k:
+            return False
+        ids_len = len(self.tok.encode(request.prompt))
+        if ids_len == 0:
+            return False  # would fail prefill; let the solo path 400 it
+        if ids_len + request.max_new_tokens > self.cfg.max_seq_len:
+            return False
+        if not self.paged:
+            return (
+                _prompt_alloc(ids_len)
+                + _bucket(request.max_new_tokens, GEN_BUCKETS)
+                <= self.cache_len
+            )
+        if self.stacked and request.max_new_tokens - 1 > self.g_bucket:
+            return False  # the side caches hold g_bucket columns
+        need = self._pages_needed(ids_len, request.max_new_tokens)
+        return need <= self.jmax and need <= self.pool.free_pages
+
+    def join(self, request: GenerationRequest) -> int:
+        """Admit ``request`` into a free slot (prefill now, decode from
+        the next slice). Returns the slot index. Callers should probe
+        :meth:`can_join` first; a failed prefill raises and leaves the
+        session consistent (the slot stays free)."""
+        import numpy as np
+
+        from .jax_engine import _prompt_alloc
+        from .paged_kv import _paginate, quantize_chunks, scatter_pages
+
+        if not self.can_join(request):
+            raise RuntimeError("request cannot join this session")
+        r = next(i for i, row in enumerate(self.rows) if row is None)
+        eng = self.engine
+        ids = self.tok.encode(request.prompt)
+        pages: List[int] = []
+        if self.paged:
+            st = eng._start(
+                request,
+                cache_len=_prompt_alloc(len(ids)),
+                prompt_ids=ids,
+            )
+            need = self._pages_needed(st["s_real"], request.max_new_tokens)
+            pages = self.pool.alloc(need)
+            n_prompt_pages = -(-st["s_real"] // self.page_size)
+            ck = _paginate(
+                st["k_cache"][:, 0], st["s_real"], self.page_size
+            )
+            cv = _paginate(
+                st["v_cache"][:, 0], st["s_real"], self.page_size
+            )
+            if self.d_pool != self.cfg.d_head:
+                padd = [(0, 0)] * (ck.ndim - 1) + [
+                    (0, self.d_pool - self.cfg.d_head)
+                ]
+                ck, cv = jnp.pad(ck, padd), jnp.pad(cv, padd)
+            if self.quantized:
+                ck, cv = quantize_chunks(ck, cv)
+            self.pool.k, self.pool.v = scatter_pages(
+                self.pool.k,
+                self.pool.v,
+                jnp.asarray(pages[:n_prompt_pages], jnp.int32),
+                ck,
+                cv,
+            )
+            table_row = np.full((self.jmax,), self.parking, dtype=np.int32)
+            table_row[: len(pages)] = pages
+            self.table = self.table.at[r].set(jnp.asarray(table_row))
+            if self.stacked:
+                self.side_k = _zero_row(self.side_k, r)
+                self.side_v = _zero_row(self.side_v, r)
+        else:
+            st = eng._start(
+                request, cache_len=self.cache_len, prompt_ids=ids
+            )
+            kc_row, vc_row = st["k_cache"], st["v_cache"]
+            if eng.kv_quantize:
+                from ..models.quantize import quantize_kv_cache
+
+                kc_row, vc_row = quantize_kv_cache(kc_row, vc_row)
+            self.k_cache = _set_row(self.k_cache, r, kc_row)
+            self.v_cache = _set_row(self.v_cache, r, vc_row)
+        self.tokens = self.tokens.at[r].set(st["first"][0])
+        self.rngs = self.rngs.at[r].set(st["rng"])
+        self.presence = self.presence.at[r].set(st["presence"][0])
+        self.offsets = self.offsets.at[r].set(st["s_real"])
+        self.prompt_lens = self.prompt_lens.at[r].set(st["s_real"])
+        self.remaining = self.remaining.at[r].set(
+            request.max_new_tokens - 1
+        )
+        self.temps = self.temps.at[r].set(request.temperature)
+        self.top_ps = self.top_ps.at[r].set(self._row_top_p(request))
+        self.rps = self.rps.at[r].set(request.repeat_penalty)
+        self.done = self.done.at[r].set(request.max_new_tokens <= 1)
+        # sticky for the session: a sentinel makes the filter an identity
+        # for rows that never asked for it, so turning a knob on for a
+        # joiner cannot perturb a companion's stream
+        self.use_top_p = self.use_top_p or st["use_top_p"]
+        self.use_rp = self.use_rp or st["use_rp"]
+        now = time.monotonic()
+        self.rows[r] = _Row(
+            request,
+            st["s_real"],
+            int(st["first"][0]),
+            request.max_new_tokens - 1,
+            st["t0"],
+            st["t1"],
+            now,
+            pages=pages,
+        )
+        return r
+
+    # -- teardown -------------------------------------------------------------
+    def close(self) -> None:
+        """Release the session (frees any still-allocated pages). Live
+        rows are abandoned — the scheduler fails their tickets; their
+        partial token streams are not returned."""
+        if self.closed:
+            return
+        self.closed = True
+        if self.paged:
+            for row in self.rows:
+                if row is not None and row.pages:
+                    self.pool.free(row.pages)
+                    row.pages = []
+        self.rows = [None] * len(self.rows)

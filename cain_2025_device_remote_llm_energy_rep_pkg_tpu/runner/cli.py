@@ -143,11 +143,20 @@ commands:
   serve [opts]         start the HTTP generation server (the framework-native
                        Ollama-equivalent): --host H --port N (default 11434),
                        --backend jax|jax-tp|fake, --tp N, --models a,b,c,
-                       --batch-window-ms W --max-batch B (continuous batching
-                       of concurrent requests; off by default;
-                       --no-budget-admission pins the cap at --max-batch
-                       instead of raising it to the engine's KV-budget
-                       estimate),
+                       --scheduler window|continuous --window-ms W
+                       --max-batch B (request batching of concurrent
+                       requests; off by default — --scheduler or
+                       --window-ms turns it on. --scheduler defaults to
+                       continuous
+                       for real batched backends: iteration-level
+                       admit/step/retire where rows retire and joiners
+                       admit at decode-step granularity; window = classic
+                       admission-window batches run to completion, with
+                       --window-ms the collect window, default 50.
+                       --batch-window-ms is the deprecated alias of
+                       --window-ms; --no-budget-admission pins the cap
+                       at --max-batch instead of raising it to the
+                       engine's KV-budget estimate),
                        --hf model=/ckpt/dir (serve trained weights + that
                        checkpoint's tokenizer; repeatable),
                        --quantize int8|int4|none or per-model
@@ -177,6 +186,7 @@ def serve_command(args: List[str]) -> None:
     tp = -1
     models: Optional[List[str]] = None
     batch_window_ms = 0.0
+    scheduler = None  # auto: continuous for real batched backends
     max_batch = None  # backend-aware default (serve/scheduler.py)
     budget_aware = None  # auto: KV-budget admission when estimable
     hf_checkpoints = {}
@@ -198,8 +208,16 @@ def serve_command(args: List[str]) -> None:
             tp = int(next(it, "-1"))
         elif arg == "--models":
             models = [m for m in next(it, "").split(",") if m]
-        elif arg == "--batch-window-ms":
+        elif arg in ("--window-ms", "--batch-window-ms"):
+            # --batch-window-ms is the pre-continuous-scheduler spelling,
+            # kept as an alias
             batch_window_ms = float(next(it, "0"))
+        elif arg == "--scheduler":
+            scheduler = next(it, "")
+            if scheduler not in ("window", "continuous"):
+                raise CommandError(
+                    "serve: --scheduler expects 'window' or 'continuous'"
+                )
         elif arg == "--max-batch":
             max_batch = int(next(it, "0")) or None
         elif arg == "--no-budget-admission":
@@ -322,6 +340,7 @@ def serve_command(args: List[str]) -> None:
         max_batch=max_batch,
         budget_aware=budget_aware,
         access_log=access_log,
+        scheduler=scheduler,
     )
     server.serve_forever()
 

@@ -1,16 +1,34 @@
-"""Continuous-batching scheduler for the generation server.
+"""Batching schedulers for the generation server.
 
 The reference's Ollama server handles one request at a time and the
 experiment sends one request per run (experiment/RunnerConfig.py:128-131).
 A TPU serving a fleet of clients would waste most of its HBM bandwidth that
 way: decode is bandwidth-bound, so co-scheduling concurrent requests into
-one batched decode (``JaxEngine.generate_batch``) multiplies tokens/s at
-nearly constant energy/step. This scheduler gives the HTTP server that
-ability without changing the wire protocol: concurrent ``/api/generate``
-POSTs that arrive within a small window are coalesced, compatible ones
-(same model + top_k) decode together, and each caller still gets exactly
-the response it would have gotten alone (the batched engine is
-token-identical per row).
+one batched decode multiplies tokens/s at nearly constant energy/step.
+Two schedulers give the HTTP server that ability without changing the
+wire protocol:
+
+- :class:`BatchScheduler` (WINDOW dispatch): concurrent ``/api/generate``
+  POSTs arriving within a small admission window coalesce into one
+  ``generate_batch`` call that runs to completion. Simple, and the right
+  model when the backend has no resumable decode — but a request arriving
+  just after a window closes waits for the slowest row of the previous
+  batch, and the engine keeps stepping EOS-finished rows until the whole
+  batch drains.
+
+- :class:`ContinuousScheduler` (ITERATION-LEVEL dispatch, Orca-style):
+  drives the backend's stepped-decode protocol (``decode_open`` →
+  ``session.step``/``join`` — engine/stepped.py). The loop runs
+  admit → step → retire phases: each bounded decode slice returns
+  control, rows whose done-mask set RETIRE immediately (their ticket
+  completes and, on the paged engine, their KV pages return to the pool
+  mid-flight), and queued compatible requests JOIN the freed rows with
+  the budget-aware admission cap re-evaluated at each admission. Callers
+  stop waiting for strangers' long tails: time-to-first-token is bounded
+  by one slice + a prefill instead of the previous batch's slowest row.
+
+Both preserve per-request results exactly: the batched/stepped engines
+are token-identical per row to a solo ``generate``.
 """
 
 from __future__ import annotations
@@ -18,7 +36,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..engine.backend import (
     GenerationBackend,
@@ -47,14 +65,15 @@ _ADMISSION_CAP_H = REGISTRY.histogram(
 )
 _BATCH_ROWS_H = REGISTRY.histogram(
     "llm_sched_batch_rows",
-    "Rows actually admitted into each dispatched batch",
+    "Rows actually admitted into each dispatched batch/session open",
     buckets=ROW_BUCKETS,
 )
 _REQUESTS_C = REGISTRY.counter(
     "llm_sched_requests_total", "Requests submitted to the batch scheduler"
 )
 _BATCHES_C = REGISTRY.counter(
-    "llm_sched_batches_total", "Batches dispatched to the backend"
+    "llm_sched_batches_total",
+    "Batches dispatched to the backend (continuous: sessions opened)",
 )
 _BUDGET_ADMISSION_C = REGISTRY.counter(
     "llm_sched_budget_admission_total",
@@ -63,6 +82,40 @@ _BUDGET_ADMISSION_C = REGISTRY.counter(
     "error (probe failed; static cap used)",
     labels=("outcome",),
 )
+_BATCH_FALLBACK_C = REGISTRY.counter(
+    "llm_sched_batch_fallback_total",
+    "Batch-level dispatch failures that fell back to bisected isolation "
+    "(each inc is one failed batch/session call, incl. recursive splits)",
+)
+# Iteration-level (continuous) scheduling telemetry: joins/retirements at
+# decode-step granularity plus the per-request latency split that shows
+# the win over window dispatch on /metrics.
+_ROWS_JOINED_C = REGISTRY.counter(
+    "llm_sched_rows_joined_total",
+    "Requests admitted into an ALREADY-RUNNING continuous decode session "
+    "(mid-flight joins; session-opening rows count in llm_sched_batch_rows)",
+)
+_ROWS_RETIRED_C = REGISTRY.counter(
+    "llm_sched_rows_retired_total",
+    "Continuous-session rows retired, by reason (eos: sampled EOS; "
+    "budget: token budget exhausted; error: failed/salvaged; "
+    "shutdown: scheduler stopped mid-flight)",
+    labels=("reason",),
+)
+_INFLIGHT_G = REGISTRY.gauge(
+    "llm_sched_inflight_rows",
+    "Live rows in the current continuous decode session (0 when idle)",
+)
+_TTFT_H = REGISTRY.histogram(
+    "llm_request_ttft_seconds",
+    "Submit → the request's first generated token exists (continuous: "
+    "measured at admission-prefill completion; window: completion minus "
+    "the shared decode window — the earliest a result could carry it)",
+)
+_COMPLETION_H = REGISTRY.histogram(
+    "llm_request_completion_seconds",
+    "Submit → result handed back to the caller",
+)
 
 
 class _Ticket:
@@ -70,9 +123,12 @@ class _Ticket:
     scheduler fills ``result`` or ``error``. ``t_submit``/``span`` carry
     the submit-side clock and the submitting thread's current span so
     the scheduler thread can parent queue/backend spans under the HTTP
-    request's root (obs)."""
+    request's root (obs); ``t_first`` is stamped when the request's
+    first token exists (continuous admission)."""
 
-    __slots__ = ("request", "event", "result", "error", "t_submit", "span")
+    __slots__ = (
+        "request", "event", "result", "error", "t_submit", "t_first", "span"
+    )
 
     def __init__(self, request: GenerationRequest) -> None:
         self.request = request
@@ -80,34 +136,28 @@ class _Ticket:
         self.result: Optional[GenerationResult] = None
         self.error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
         self.span = TRACER.current()
 
 
-class BatchScheduler:
-    """Coalesce concurrent generate calls into batched backend calls.
+class _SchedulerBase:
+    """Submit/lifecycle machinery shared by the window and continuous
+    schedulers (one queue, one worker thread, shutdown that can never
+    strand a caller on ``event.wait()``).
 
-    ``window_s`` is how long the first request of a batch waits for
-    companions (the classic continuous-batching admission window);
-    ``max_batch`` bounds a single decode's row count. Requests that are
-    mutually incompatible (different model or top_k) run as separate
-    batches in arrival order. The default is BACKEND-AWARE: 32 (the
-    engine's known-safe sub-batch floor) for backends with a real
-    batched decode — wider admission is strictly better there since the
-    round-5 batch work, and ``JaxEngine.generate_batch`` still splits
-    internally if a fleet's KV estimate exceeds the device budget — but
-    8 for backends inheriting the base class's sequential
-    ``generate_batch`` loop (fake backend), where a wider batch only
-    multiplies every caller's wait for the sweep to finish.
+    ``max_batch`` bounds a single decode's row count; the default is
+    BACKEND-AWARE: 32 (the engine's known-safe sub-batch floor) for
+    backends with a real batched decode, 8 for backends inheriting the
+    base class's sequential ``generate_batch`` loop (fake backend),
+    where a wider batch only multiplies every caller's wait.
 
     Admission is additionally BUDGET-AWARE on backends that expose
-    ``max_admission_rows`` (``JaxEngine.max_admission_rows`` — the
-    widest batch bucket whose estimated K+V footprint fits
-    ``BATCH_KV_BUDGET_BYTES`` under the engine's cache layout): each
-    batch's cap is the LARGER of ``max_batch`` and that estimate for the
-    batch's first request. Denser cache layouts therefore admit more
-    concurrent callers into one decode window at the same device budget
-    — paged+int8 serving admits the 2–4× fleet its pages pay for
-    (docs/PERF.md admission A/B) instead of stopping at the static cap.
+    ``max_admission_rows`` (the widest batch bucket whose estimated K+V
+    footprint fits ``BATCH_KV_BUDGET_BYTES`` under the engine's cache
+    layout): each dispatch's cap is the LARGER of ``max_batch`` and that
+    estimate. Denser cache layouts therefore admit more concurrent
+    callers at the same device budget — paged+int8 serving admits the
+    2–4× fleet its pages pay for (docs/PERF.md admission A/B).
     ``budget_aware=False`` opts out (fixed-cap behavior).
     """
 
@@ -168,8 +218,8 @@ class BatchScheduler:
         # their submit() callers on event.wait() forever. The join is
         # bounded (a wedged backend must not hang server shutdown — the
         # worker is a daemon thread); the post-shutdown stranding case is
-        # closed independently by _collect, which fails leftovers instead of
-        # re-queuing them once _running is False.
+        # closed independently by the requeue helper, which fails leftovers
+        # instead of re-queuing them once _running is False.
         deadline = time.monotonic() + timeout_s
         while (
             thread is not None
@@ -191,6 +241,19 @@ class BatchScheduler:
                 ticket.error = RuntimeError("server shutting down")
                 ticket.event.set()
 
+    def _requeue(self, ticket: _Ticket) -> None:
+        """Put an undispatched ticket back. Under the state lock so the
+        re-queue cannot interleave with stop() flipping _running: either
+        the ticket lands in the queue before the flip (stop()'s drains run
+        after and fail it) or it is failed directly here — no window where
+        it is re-queued after the final drain and stranded."""
+        with self._state_lock:
+            if self._running:
+                self._queue.put(ticket)
+            else:
+                ticket.error = RuntimeError("server shutting down")
+                ticket.event.set()
+
     # -- client side ----------------------------------------------------------
     def submit(self, request: GenerationRequest) -> GenerationResult:
         """Enqueue and block until the scheduler served the request."""
@@ -206,17 +269,18 @@ class BatchScheduler:
         assert ticket.result is not None
         return ticket.result
 
-    # -- scheduler loop -------------------------------------------------------
+    # -- shared dispatch helpers ----------------------------------------------
     @staticmethod
     def _compatible(a: GenerationRequest, b: GenerationRequest) -> bool:
         return a.model == b.model and a.top_k == b.top_k
 
     def _admission_cap(self, first: _Ticket) -> int:
-        """Row cap for the batch ``first`` anchors: the static
-        ``max_batch``, raised to the backend's budget-based estimate
-        when it can provide one (see the class docstring). A probe
-        failure (unknown model, bad prompt) falls back to the static cap
-        — admission must never fail a request the backend would serve."""
+        """Row cap for the batch/session ``first`` anchors (or joins): the
+        static ``max_batch``, raised to the backend's budget-based
+        estimate when it can provide one (see the class docstring). A
+        probe failure (unknown model, bad prompt) falls back to the
+        static cap — admission must never fail a request the backend
+        would serve."""
         if not self.budget_aware:
             _BUDGET_ADMISSION_C.labels(outcome="static").inc()
             return self.max_batch
@@ -230,6 +294,89 @@ class BatchScheduler:
             outcome="raised" if raised else "static"
         ).inc()
         return max(self.max_batch, int(estimated))
+
+    def _finish_ticket(
+        self,
+        ticket: _Ticket,
+        result: GenerationResult,
+        now: Optional[float] = None,
+    ) -> None:
+        """Complete one ticket: latency attribution (TTFT + completion
+        histograms, mirrored into ``extras["sched"]`` so bench/load
+        tools read per-request figures off the wire) then unblock the
+        caller."""
+        now = time.monotonic() if now is None else now
+        completion_s = now - ticket.t_submit
+        if ticket.t_first is not None:
+            ttft_s = ticket.t_first - ticket.t_submit
+        else:
+            # window dispatch: the first token existed once the shared
+            # decode window opened — completion minus that window is the
+            # earliest the result could have carried it
+            ttft_s = max(0.0, completion_s - result.decode_s)
+        _TTFT_H.observe(ttft_s)
+        _COMPLETION_H.observe(completion_s)
+        result.extras = {
+            **(result.extras or {}),
+            "sched": {
+                "ttft_s": round(ttft_s, 6),
+                "completion_s": round(completion_s, 6),
+            },
+        }
+        ticket.result = result
+        ticket.event.set()
+
+    def _dispatch_isolated(self, tickets: "List[_Ticket]") -> None:
+        """Salvage a failed batch dispatch by BISECTION instead of a
+        serial per-ticket sweep: each recursive half retries as one
+        batch, so a single pathological request is isolated in O(log n)
+        batch calls and its companions keep batched latency instead of
+        queueing behind a one-by-one retry under the backend lock. Each
+        failed batch call increments ``llm_sched_batch_fallback_total``;
+        per-ticket errors fan out only to their own caller."""
+        if not tickets:
+            return
+        if len(tickets) == 1:
+            ticket = tickets[0]
+            try:
+                with TRACER.attach(ticket.span), self._backend_lock:
+                    result = self.backend.generate(ticket.request)
+            except BaseException as exc:  # noqa: BLE001
+                ticket.error = exc
+                ticket.event.set()
+            else:
+                self._finish_ticket(ticket, result)
+            return
+        try:
+            with TRACER.attach(tickets[0].span), self._backend_lock:
+                results = self.backend.generate_batch(
+                    [t.request for t in tickets]
+                )
+        except BaseException:  # noqa: BLE001
+            _BATCH_FALLBACK_C.inc()
+            mid = len(tickets) // 2
+            self._dispatch_isolated(tickets[:mid])
+            self._dispatch_isolated(tickets[mid:])
+        else:
+            now = time.monotonic()
+            for ticket, result in zip(tickets, results):
+                self._finish_ticket(ticket, result, now)
+
+    def _loop(self) -> None:  # pragma: no cover — subclasses implement
+        raise NotImplementedError
+
+
+class BatchScheduler(_SchedulerBase):
+    """WINDOW dispatch: coalesce concurrent generate calls into batched
+    backend calls run to completion.
+
+    ``window_s`` is how long the first request of a batch waits for
+    companions (the classic admission window — ``serve --window-ms``);
+    requests that are mutually incompatible (different model or top_k)
+    run as separate batches in arrival order. See :class:`_SchedulerBase`
+    for the cap/budget-admission semantics shared with the continuous
+    scheduler.
+    """
 
     def _collect(self, first: _Ticket) -> List[_Ticket]:
         """Admission: wait up to ``window_s`` for companions compatible with
@@ -256,19 +403,13 @@ class BatchScheduler:
                 batch.append(ticket)
             else:
                 leftovers.append(ticket)
-        for ticket in leftovers:
-            # Under the state lock so the re-queue cannot interleave with
-            # stop() flipping _running: either the ticket lands in the queue
-            # before the flip (stop()'s drains run after and fail it) or it
-            # is failed directly here — no window where it is re-queued
-            # after the final drain and stranded.
-            with self._state_lock:
-                if self._running:
-                    self._queue.put(ticket)
-                else:
-                    ticket.error = RuntimeError("server shutting down")
-                    ticket.event.set()
+        # Observe at the collection break, BEFORE the leftover re-queue
+        # loop: each re-queue takes the state lock, and a stop() racing
+        # those acquisitions would inflate the histogram with lock
+        # contention that is not collection time.
         _COLLECT_H.observe(time.monotonic() - t_collect)
+        for ticket in leftovers:
+            self._requeue(ticket)
         return batch
 
     def _loop(self) -> None:
@@ -309,18 +450,222 @@ class BatchScheduler:
                 else:
                     # A batch-level failure (e.g. the combined KV footprint
                     # exceeding max_seq_len) must not 500 callers whose
-                    # requests are individually fine: retry each alone and
-                    # fan out only its own error.
-                    for ticket in batch:
-                        try:
-                            with self._backend_lock:
-                                ticket.result = self.backend.generate(
-                                    ticket.request
-                                )
-                        except BaseException as single_exc:  # noqa: BLE001
-                            ticket.error = single_exc
-                        ticket.event.set()
+                    # requests are individually fine — and must not poison
+                    # every companion's latency with a serial one-by-one
+                    # sweep either: bisect to isolate the failing ticket
+                    # (see _dispatch_isolated).
+                    _BATCH_FALLBACK_C.inc()
+                    mid = len(batch) // 2
+                    self._dispatch_isolated(batch[:mid])
+                    self._dispatch_isolated(batch[mid:])
             else:
+                now = time.monotonic()
                 for ticket, result in zip(batch, results):
-                    ticket.result = result
-                    ticket.event.set()
+                    self._finish_ticket(ticket, result, now)
+
+
+class ContinuousScheduler(_SchedulerBase):
+    """ITERATION-LEVEL dispatch over the backend's stepped-decode
+    protocol (see the module docstring and engine/stepped.py).
+
+    The loop phases per session:
+
+    - **admit**: an anchor ticket opens a session immediately (no
+      admission window — TTFT is the point) together with any compatible
+      tickets already queued, up to the budget-aware cap;
+    - **step**: one bounded decode slice (``slice_steps``) under the
+      backend lock, then control returns here;
+    - **retire**: rows whose done-mask set complete their tickets NOW —
+      not at batch end — and free their rows (and pool pages) for
+      joiners;
+    - **join**: queued compatible requests enter freed rows, with the
+      budget-aware cap re-evaluated at each admission.
+
+    Incompatible arrivals re-queue and anchor their own session once this
+    one drains (same FIFO-per-compatibility-class rule as the window
+    scheduler; under a saturating stream of compatible traffic an
+    incompatible request can wait for the session to drain — the known
+    trade of model-affine continuous batching).
+    """
+
+    def __init__(
+        self,
+        backend: GenerationBackend,
+        max_batch: Optional[int] = None,
+        window_s: float = 0.05,
+        lock: Optional[threading.Lock] = None,
+        budget_aware: Optional[bool] = None,
+        slice_steps: Optional[int] = None,
+    ) -> None:
+        super().__init__(
+            backend,
+            max_batch=max_batch,
+            window_s=window_s,
+            lock=lock,
+            budget_aware=budget_aware,
+        )
+        if not hasattr(backend, "decode_open"):
+            raise ValueError(
+                f"{type(backend).__name__} has no stepped-decode support "
+                "(decode_open); use BatchScheduler"
+            )
+        if slice_steps is None:
+            from ..engine.jax_engine import DECODE_SLICE_STEPS
+
+            slice_steps = DECODE_SLICE_STEPS
+        self.slice_steps = max(1, int(slice_steps))
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                first = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if first is None:
+                break
+            self._run_session(first)
+        _INFLIGHT_G.set(0)
+
+    def _drain_compatible(
+        self, anchor: GenerationRequest, limit: int
+    ) -> List[_Ticket]:
+        """Non-blocking pull of queued tickets compatible with ``anchor``
+        (bounded by the queue's current size so re-queued incompatible
+        tickets cannot spin this loop forever)."""
+        got: List[_Ticket] = []
+        for _ in range(self._queue.qsize()):
+            if len(got) >= limit:
+                break
+            try:
+                ticket = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if ticket is None:
+                self._queue.put(None)
+                break
+            if self._compatible(anchor, ticket.request):
+                got.append(ticket)
+            else:
+                self._requeue(ticket)
+        return got
+
+    def _run_session(self, first: _Ticket) -> None:
+        anchor = first.request
+        cap = self._admission_cap(first)
+        _ADMISSION_CAP_H.observe(cap)
+        batch = [first] + self._drain_compatible(anchor, cap - 1)
+        t_open = time.monotonic()
+        for ticket in batch:
+            _QUEUE_WAIT_H.observe(t_open - ticket.t_submit)
+            TRACER.add_span(
+                "queue", ticket.t_submit, t_open,
+                attrs={"batch_rows": len(batch)}, parent=ticket.span,
+            )
+        _BATCH_ROWS_H.observe(len(batch))
+        _BATCHES_C.inc()
+        try:
+            with TRACER.attach(first.span), self._backend_lock:
+                session = self.backend.decode_open(
+                    [t.request for t in batch],
+                    reserve_rows=min(cap, max(2 * len(batch), 4)),
+                )
+        except BaseException as exc:  # noqa: BLE001
+            # a failed open (one bad prompt poisons the group) salvages
+            # exactly like a failed window batch: bisected isolation
+            if len(batch) == 1:
+                first.error = exc
+                first.event.set()
+            else:
+                _BATCH_FALLBACK_C.inc()
+                mid = len(batch) // 2
+                self._dispatch_isolated(batch[:mid])
+                self._dispatch_isolated(batch[mid:])
+            return
+        live: Dict[int, _Ticket] = {}
+        now = time.monotonic()
+        for ticket in batch:
+            ticket.t_first = now  # admission prefill done: token 1 exists
+            live[id(ticket.request)] = ticket
+        _INFLIGHT_G.set(session.active)
+        try:
+            while self._running and session.active:
+                with self._backend_lock:
+                    retired = session.step(self.slice_steps)
+                now = time.monotonic()
+                for result in retired:
+                    self._complete_row(live, result, now)
+                self._admit_into(session, live, anchor)
+                _INFLIGHT_G.set(session.active)
+        except BaseException:  # noqa: BLE001 — engine died mid-session
+            _BATCH_FALLBACK_C.inc()
+            leftovers = list(live.values())
+            live.clear()
+            for ticket in leftovers:
+                _ROWS_RETIRED_C.labels(reason="error").inc()
+            self._dispatch_isolated(leftovers)
+        finally:
+            try:
+                with self._backend_lock:
+                    session.close()
+            except Exception:  # noqa: BLE001
+                pass
+            for ticket in live.values():
+                # only reachable when stop() interrupted the loop
+                _ROWS_RETIRED_C.labels(reason="shutdown").inc()
+                ticket.error = RuntimeError("server shutting down")
+                ticket.event.set()
+            live.clear()
+            _INFLIGHT_G.set(0)
+
+    def _complete_row(
+        self, live: Dict[int, _Ticket], result: GenerationResult, now: float
+    ) -> None:
+        ticket = live.pop(id(result.request), None)
+        reason = (result.extras or {}).get("retire_reason", "eos")
+        _ROWS_RETIRED_C.labels(reason=reason).inc()
+        if ticket is None:  # defensive: a row the session invented
+            return
+        self._finish_ticket(ticket, result, now)
+
+    def _admit_into(self, session, live: Dict[int, _Ticket], anchor) -> None:
+        """The JOIN phase: move queued compatible tickets into freed rows,
+        re-evaluating the budget-aware cap at each admission. Bounded by
+        the queue's snapshot size; a ticket that cannot join right now
+        (incompatible, cap, no free slot/pages) re-queues for the next
+        slice or its own session."""
+        for _ in range(self._queue.qsize()):
+            try:
+                ticket = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if ticket is None:
+                self._queue.put(None)
+                return
+            request = ticket.request
+            admitted = False
+            if self._compatible(anchor, request):
+                cap = self._admission_cap(ticket)
+                if session.active < cap:
+                    try:
+                        with TRACER.attach(ticket.span), self._backend_lock:
+                            if session.can_join(request):
+                                session.join(request)
+                                admitted = True
+                    except BaseException as exc:  # noqa: BLE001
+                        # the join's prefill failed: this request's own
+                        # fault (bad prompt) — fail only its caller
+                        ticket.error = exc
+                        ticket.event.set()
+                        continue
+            if admitted:
+                now = time.monotonic()
+                ticket.t_first = now
+                _QUEUE_WAIT_H.observe(now - ticket.t_submit)
+                TRACER.add_span(
+                    "queue", ticket.t_submit, now,
+                    attrs={"joined": True}, parent=ticket.span,
+                )
+                live[id(request)] = ticket
+                _ROWS_JOINED_C.inc()
+            else:
+                self._requeue(ticket)

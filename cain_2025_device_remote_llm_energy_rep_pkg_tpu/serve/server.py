@@ -70,36 +70,72 @@ class GenerationServer:
         max_batch: Optional[int] = None,  # backend-aware (scheduler)
         budget_aware: Optional[bool] = None,  # KV-budget admission
         access_log: bool = False,  # structured per-request log line
+        scheduler: Optional[str] = None,  # None(auto)|window|continuous
     ) -> None:
-        """``batch_window_ms > 0`` enables continuous batching: concurrent
-        non-streaming generate requests arriving within the window coalesce
-        into one batched decode (:mod:`.scheduler`). 0 (default) preserves
-        strictly serial one-at-a-time semantics — what the reference's
-        measurement model assumes. ``budget_aware`` (default: auto — on
-        for backends exposing ``max_admission_rows``) lets the scheduler
-        raise each batch's cap to the widest fleet the backend's KV
-        budget admits under its cache layout, so paged/int8-KV serving
-        actually admits the larger fleet its denser cache pays for.
-        ``access_log`` (default off — measurement runs stay quiet)
-        emits one structured line per request: method, path, status,
-        duration ms. Telemetry (``/metrics``, spans) is default-on with
-        the obs kill switch (``TPU_LLM_OBS=0`` / ``--no-telemetry``)."""
+        """``batch_window_ms > 0`` or an explicit ``scheduler`` enables
+        batching: concurrent non-streaming generate requests coalesce
+        into shared decodes (:mod:`.scheduler`). Neither (default)
+        preserves strictly serial one-at-a-time semantics — what the
+        reference's measurement model assumes.
+
+        ``scheduler`` picks the dispatch model: ``"window"`` (classic
+        admission-window batches run to completion), ``"continuous"``
+        (iteration-level admit/step/retire over the backend's
+        stepped-decode protocol), or ``None`` — auto, which DEFAULTS TO
+        CONTINUOUS for real batched backends (those overriding
+        ``generate_batch`` AND speaking ``decode_open``, i.e. the JAX
+        engines) and window otherwise (fake backend). With batching on
+        and no ``batch_window_ms``, the window defaults to 50 ms.
+
+        ``budget_aware`` (default: auto — on for backends exposing
+        ``max_admission_rows``) lets the scheduler raise each batch's
+        cap to the widest fleet the backend's KV budget admits under its
+        cache layout, so paged/int8-KV serving actually admits the
+        larger fleet its denser cache pays for. ``access_log`` (default
+        off — measurement runs stay quiet) emits one structured line per
+        request: method, path, status, duration ms. Telemetry
+        (``/metrics``, spans) is default-on with the obs kill switch
+        (``TPU_LLM_OBS=0`` / ``--no-telemetry``)."""
         self.backend = backend
         self.models = list(models) if models else []
         self.quiet = quiet
         self.access_log = access_log
         self._generate_lock = threading.Lock()
         self._scheduler = None
-        if batch_window_ms > 0:
-            from .scheduler import BatchScheduler
+        if scheduler not in (None, "window", "continuous"):
+            raise ValueError(
+                f"scheduler must be None, 'window' or 'continuous', "
+                f"got {scheduler!r}"
+            )
+        self.scheduler_mode = "off"
+        if batch_window_ms > 0 or scheduler is not None:
+            from .scheduler import BatchScheduler, ContinuousScheduler
 
-            self._scheduler = BatchScheduler(
+            mode = scheduler
+            if mode is None:
+                batched = (
+                    type(backend).generate_batch
+                    is not GenerationBackend.generate_batch
+                )
+                mode = (
+                    "continuous"
+                    if batched and hasattr(backend, "decode_open")
+                    else "window"
+                )
+            window_s = (
+                batch_window_ms if batch_window_ms > 0 else 50.0
+            ) / 1e3
+            cls = (
+                ContinuousScheduler if mode == "continuous" else BatchScheduler
+            )
+            self._scheduler = cls(
                 backend,
                 max_batch=max_batch,
-                window_s=batch_window_ms / 1e3,
+                window_s=window_s,
                 lock=self._generate_lock,
                 budget_aware=budget_aware,
             )
+            self.scheduler_mode = mode
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
         # Set whenever a serve loop is live (threaded start() OR blocking

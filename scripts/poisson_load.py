@@ -1,0 +1,226 @@
+"""Poisson load generator: N clients, exponential inter-arrival, mixed
+prompt/target lengths.
+
+The serving story's claims (continuous vs window batching, budget-aware
+admission) only mean something under STAGGERED arrivals — the pattern a
+fleet of independent users actually produces — not the all-at-once
+thread storms the older tests used. This module is the one source of
+that workload shape:
+
+- :func:`build_workload` — deterministic (seeded) arrival offsets +
+  requests with mixed prompt/budget lengths;
+- :func:`run_load` — drive any ``submit(request) -> result`` callable
+  (a scheduler's ``submit``, a client's ``generate``) with real-clock
+  arrivals on threads, returning per-request latency records;
+- :func:`summarize` — p50/p95 TTFT & completion, aggregate tokens/s.
+
+Used by ``bench.py continuous_batching`` (in-process A/B of the two
+schedulers) and ``scripts/serve_metrics_smoke.py`` (staggered arrivals
+against the fake-engine server in CI); the CLI below drives a LIVE
+server over HTTP::
+
+    python scripts/poisson_load.py --url http://host:11434 \
+        --model qwen2:1.5b -n 32 --mean-interarrival-ms 50
+
+Exit 0 on success; prints one JSON summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (  # noqa: E402
+    GenerationRequest,
+    GenerationResult,
+)
+
+DEFAULT_PROMPTS = (
+    "short prompt",
+    "a somewhat longer prompt with more words in it",
+    "the third prompt variant, medium length",
+)
+DEFAULT_BUDGETS = (8, 16, 48)
+
+
+def build_workload(
+    n: int,
+    mean_interarrival_s: float,
+    seed: int = 0,
+    model: str = "qwen2:1.5b",
+    prompts: Sequence[str] = DEFAULT_PROMPTS,
+    budgets: Sequence[int] = DEFAULT_BUDGETS,
+    stop_at_eos: bool = True,
+) -> List[Tuple[float, GenerationRequest]]:
+    """``[(arrival_offset_s, request), ...]`` — Poisson arrivals (seeded
+    exponential inter-arrival; the first request arrives at t=0) over a
+    deterministic rotation of mixed prompt and budget lengths."""
+    rng = random.Random(seed)
+    out: List[Tuple[float, GenerationRequest]] = []
+    t = 0.0
+    for i in range(n):
+        if i:
+            t += rng.expovariate(1.0 / mean_interarrival_s)
+        out.append(
+            (
+                t,
+                GenerationRequest(
+                    model,
+                    prompts[i % len(prompts)],
+                    max_new_tokens=budgets[i % len(budgets)],
+                    seed=i,
+                    stop_at_eos=stop_at_eos,
+                ),
+            )
+        )
+    return out
+
+
+def run_load(
+    submit: Callable[[GenerationRequest], GenerationResult],
+    workload: List[Tuple[float, GenerationRequest]],
+) -> List[Dict]:
+    """Replay ``workload`` against ``submit`` with real-clock arrival
+    offsets, one thread per request (the N-independent-clients model).
+    Each record carries client-side completion and, when the scheduler
+    attached them (``extras["sched"]``), server-side TTFT/completion."""
+    records: List[Optional[Dict]] = [None] * len(workload)
+    start = time.monotonic()
+
+    def client(i: int, offset: float, request: GenerationRequest) -> None:
+        delay = start + offset - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t_submit = time.monotonic()
+        rec: Dict = {"offset_s": offset, "t_submit": t_submit - start}
+        try:
+            result = submit(request)
+        except BaseException as exc:  # noqa: BLE001
+            rec["error"] = f"{type(exc).__name__}: {exc}"
+        else:
+            t_done = time.monotonic()
+            sched = (result.extras or {}).get("sched", {})
+            rec.update(
+                tokens=result.generated_tokens,
+                completion_s=t_done - t_submit,
+                ttft_s=sched.get("ttft_s"),
+                sched_completion_s=sched.get("completion_s"),
+                t_done=t_done - start,
+            )
+        records[i] = rec
+
+    threads = [
+        threading.Thread(target=client, args=(i, off, req), daemon=True)
+        for i, (off, req) in enumerate(workload)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [r for r in records if r is not None]
+
+
+def percentile(values: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation)."""
+    if not values:
+        return float("nan")
+    ordered = sorted(values)
+    k = min(len(ordered) - 1, max(0, int(round(p / 100.0 * (len(ordered) - 1)))))
+    return ordered[k]
+
+
+def summarize(records: List[Dict]) -> Dict:
+    ok = [r for r in records if "error" not in r]
+    completions = [r["completion_s"] for r in ok]
+    ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
+    tokens = sum(r["tokens"] for r in ok)
+    span = (
+        max(r["t_done"] for r in ok) - min(r["t_submit"] for r in ok)
+        if ok
+        else 0.0
+    )
+    out = {
+        "requests": len(records),
+        "errors": len(records) - len(ok),
+        "tokens": tokens,
+        "agg_tokens_per_s": round(tokens / span, 2) if span > 0 else None,
+        "completion_p50_s": round(percentile(completions, 50), 4),
+        "completion_p95_s": round(percentile(completions, 95), 4),
+    }
+    if ttfts:
+        out["ttft_p50_s"] = round(percentile(ttfts, 50), 4)
+        out["ttft_p95_s"] = round(percentile(ttfts, 95), 4)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--url", help="live server base URL (http://host:port)")
+    ap.add_argument("--model", default="qwen2:1.5b")
+    ap.add_argument("-n", type=int, default=16, help="number of requests")
+    ap.add_argument(
+        "--mean-interarrival-ms", type=float, default=50.0,
+        help="mean of the exponential inter-arrival distribution",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--budgets", default=",".join(map(str, DEFAULT_BUDGETS)),
+        help="comma-separated max_new_tokens rotation",
+    )
+    ap.add_argument(
+        "--fake", action="store_true",
+        help="drive an in-process fake-backend continuous scheduler "
+        "instead of a live server (hermetic demo/CI)",
+    )
+    args = ap.parse_args()
+    budgets = [int(b) for b in args.budgets.split(",") if b]
+    workload = build_workload(
+        args.n,
+        args.mean_interarrival_ms / 1e3,
+        seed=args.seed,
+        model=args.model,
+        budgets=budgets,
+    )
+    if args.fake:
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+            FakeBackend,
+        )
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+            ContinuousScheduler,
+        )
+
+        sched = ContinuousScheduler(
+            FakeBackend(tokens_per_s=500.0, simulate_delay=True)
+        )
+        sched.start()
+        try:
+            records = run_load(sched.submit, workload)
+        finally:
+            sched.stop()
+        target = "fake-continuous"
+    elif args.url:
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
+            RemoteHTTPBackend,
+        )
+
+        client = RemoteHTTPBackend(args.url)
+        records = run_load(client.generate, workload)
+        target = args.url
+    else:
+        ap.error("one of --url or --fake is required")
+        return 2
+    summary = summarize(records)
+    print(json.dumps({"load": "poisson", "target": target, **summary}))
+    return 0 if summary["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,18 @@
 """CI smoke: fake-engine server end-to-end + /metrics scrape + span trace.
 
-Starts a :class:`GenerationServer` over the deterministic fake backend
-with continuous batching on, pushes one request through the full
-HTTP → scheduler → backend path, scrapes ``GET /metrics``, asserts the
-scheduler/HTTP metric families are present, and exports the recorded
-span tree as a Chrome trace (the workflow uploads it as an artifact, so
-every CI run leaves an inspectable serving trace).
+Two phases, both over the deterministic fake backend:
+
+1. WINDOW batching: one request through the full HTTP → scheduler →
+   backend path, scrape ``GET /metrics``, assert the scheduler/HTTP
+   metric families are present, and export the recorded span tree as a
+   Chrome trace (the workflow uploads it as an artifact, so every CI run
+   leaves an inspectable serving trace).
+2. CONTINUOUS (iteration-level) batching under STAGGERED arrivals: a
+   long-budget request anchors a decode session, short requests arrive
+   mid-flight and JOIN it, and the scrape asserts the join/retire
+   counters (``llm_sched_rows_joined_total``,
+   ``llm_sched_rows_retired_total``) and the in-flight gauge family
+   moved — the observability surface of the admit/step/retire loop.
 
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json]``
 Exit 0 on success; prints one JSON status line either way.
@@ -13,10 +20,47 @@ Exit 0 on success; prints one JSON status line either way.
 
 import json
 import os
+import re
 import sys
+import threading
+import time
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _post_generate(base: str, prompt: str, num_predict: int):
+    req = urllib.request.Request(
+        f"{base}/api/generate",
+        data=json.dumps(
+            {
+                "model": "smoke:1b",
+                "prompt": prompt,
+                "options": {"num_predict": num_predict},
+            }
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _scrape(base: str) -> str:
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _metric_value(text: str, name: str) -> float:
+    """Sum of a family's samples (labelled children sum together)."""
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        m = re.match(rf"^{re.escape(name)}(\{{[^}}]*\}})? ([0-9.e+-]+)$", line)
+        if m:
+            total += float(m.group(2))
+            seen = True
+    if not seen:
+        raise AssertionError(f"metric family {name} absent from /metrics")
+    return total
 
 
 def main() -> int:
@@ -30,38 +74,29 @@ def main() -> int:
         GenerationServer,
     )
 
+    # -- phase 1: window batching, span tree, base families -------------------
     server = GenerationServer(
         FakeBackend(),
         host="127.0.0.1",
         port=0,
         quiet=True,
         batch_window_ms=20,
+        scheduler="window",
     )
     server.start()
     try:
         base = f"http://127.0.0.1:{server.port}"
-        req = urllib.request.Request(
-            f"{base}/api/generate",
-            data=json.dumps(
-                {
-                    "model": "smoke:1b",
-                    "prompt": "hello",
-                    "options": {"num_predict": 8},
-                }
-            ).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            body = json.loads(resp.read())
+        body = _post_generate(base, "hello", 8)
         assert body.get("done") and body.get("eval_count") == 8, body
 
-        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
-            text = resp.read().decode()
+        text = _scrape(base)
         required = (
             "llm_http_requests_total",
             "llm_http_request_seconds",
             "llm_sched_queue_wait_seconds",
             "llm_sched_batch_rows",
+            "llm_request_ttft_seconds",
+            "llm_request_completion_seconds",
         )
         missing = [f for f in required if f not in text]
         assert not missing, f"missing metric families: {missing}"
@@ -73,6 +108,52 @@ def main() -> int:
     finally:
         server.stop()
 
+    # -- phase 2: continuous batching under staggered arrivals ----------------
+    # A long row anchors the decode session (64 tokens at 200 tok/s ≈
+    # 0.32 s of slices); two short requests arrive mid-flight and must
+    # JOIN it, retire EARLY, and show up on the join/retire counters.
+    server2 = GenerationServer(
+        FakeBackend(tokens_per_s=200.0, simulate_delay=True),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    server2.start()
+    try:
+        base2 = f"http://127.0.0.1:{server2.port}"
+        done_at = {}
+
+        def client(name, num_predict, delay_s):
+            time.sleep(delay_s)
+            body = _post_generate(base2, name, num_predict)
+            assert body.get("done"), body
+            done_at[name] = time.monotonic()
+
+        threads = [
+            threading.Thread(target=client, args=("anchor", 64, 0.0)),
+            threading.Thread(target=client, args=("join-a", 8, 0.06)),
+            threading.Thread(target=client, args=("join-b", 8, 0.10)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert set(done_at) == {"anchor", "join-a", "join-b"}, done_at
+        # early retirement: the joined short rows completed BEFORE the
+        # anchor's long decode drained
+        assert done_at["join-a"] < done_at["anchor"], done_at
+        assert done_at["join-b"] < done_at["anchor"], done_at
+
+        text2 = _scrape(base2)
+        joined = _metric_value(text2, "llm_sched_rows_joined_total")
+        retired = _metric_value(text2, "llm_sched_rows_retired_total")
+        assert joined >= 2, f"expected >= 2 mid-flight joins, saw {joined}"
+        assert retired >= 3, f"expected >= 3 retirements, saw {retired}"
+        assert "llm_sched_inflight_rows" in text2
+    finally:
+        server2.stop()
+
     print(
         json.dumps(
             {
@@ -83,6 +164,10 @@ def main() -> int:
                 ),
                 "spans": len(spans),
                 "trace": trace_out,
+                "continuous": {
+                    "rows_joined": joined,
+                    "rows_retired": retired,
+                },
             }
         )
     )

@@ -136,6 +136,48 @@ def test_serve_quantize_per_model_spec_parses(monkeypatch):
     assert captured["host"] == "127.0.0.1"
 
 
+def test_serve_scheduler_and_window_flags(monkeypatch):
+    """--scheduler / --window-ms (and the --batch-window-ms alias) reach
+    the server; bad scheduler values fail fast."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    cli.serve_command(
+        [
+            "--backend", "fake", "--port", "0",
+            "--scheduler", "continuous",
+            "--window-ms", "25",
+        ]
+    )
+    assert captured["scheduler"] == "continuous"
+    assert captured["batch_window_ms"] == 25.0
+
+    captured.clear()
+    cli.serve_command(
+        ["--backend", "fake", "--port", "0", "--batch-window-ms", "75"]
+    )
+    assert captured["scheduler"] is None  # auto
+    assert captured["batch_window_ms"] == 75.0
+
+    with pytest.raises(CommandError, match="--scheduler"):
+        serve_command(["--scheduler", "bogus"])
+
+
 def test_prepare_cooldown_promise_matches_consumed_channels(monkeypatch, capsys):
     """prepare's policy line must reflect the channels the study's
     profilers actually WIRE (code-review round-4): a live battery/hwmon

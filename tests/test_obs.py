@@ -260,8 +260,13 @@ def test_request_through_scheduler_yields_span_tree_and_energy(obs_on):
 
     TRACER.clear()
     backend = JaxEngine(registry=_tiny_registry(), dtype=jnp.float32)
+    # scheduler="window" pinned: per-request energy attribution (token
+    # share of ONE shared decode window) is a window/solo-path feature;
+    # continuous sessions retire rows across many slices with varying
+    # companions and attach sched latency extras instead.
     srv = GenerationServer(
-        backend, host="127.0.0.1", port=0, quiet=True, batch_window_ms=20
+        backend, host="127.0.0.1", port=0, quiet=True, batch_window_ms=20,
+        scheduler="window",
     )
     srv.start()
     try:

@@ -132,6 +132,9 @@ def test_stop_unblocks_pending_submits(backend):
 
 
 def test_server_batches_concurrent_http_requests(backend):
+    # scheduler="window" pinned: RecordingBackend overrides generate_batch
+    # AND inherits the fake's stepped API, so auto would pick continuous
+    # and never dispatch through generate_batch (the call log asserted on)
     srv = GenerationServer(
         backend,
         host="127.0.0.1",
@@ -139,6 +142,7 @@ def test_server_batches_concurrent_http_requests(backend):
         quiet=True,
         batch_window_ms=150.0,
         max_batch=8,
+        scheduler="window",
     )
     srv.start()
     try:
@@ -248,6 +252,286 @@ def test_stop_during_inflight_batch_fails_leftovers_after_worker_exit():
         # NOT stranded.
         assert results[0] is not None and errors[0] is None
         assert results[1] is not None or isinstance(errors[1], RuntimeError)
+    finally:
+        sched.stop()
+
+
+def test_batch_failure_fallback_isolates_by_bisection():
+    """One pathological request must not serialise its companions behind
+    a one-by-one retry sweep: the fallback bisects, so good tickets are
+    re-served in BATCHES and only the poisoned one runs (and fails)
+    alone — recorded on llm_sched_batch_fallback_total."""
+
+    class OnePoisonBackend(FakeBackend):
+        def __init__(self):
+            super().__init__()
+            self.batch_calls = []
+
+        def generate(self, request):
+            if request.prompt == "poison":
+                raise ValueError("bad row")
+            return super().generate(request)
+
+        def generate_batch(self, requests):
+            self.batch_calls.append(len(requests))
+            if any(r.prompt == "poison" for r in requests):
+                raise ValueError("bad row in batch")
+            return [super(OnePoisonBackend, self).generate(r) for r in requests]
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        REGISTRY,
+    )
+
+    backend = OnePoisonBackend()
+    sched = BatchScheduler(backend, max_batch=8, window_s=0.2)
+    sched.start()
+    try:
+        before = (
+            REGISTRY.counter("llm_sched_batch_fallback_total")
+            .labels()
+            .value
+        )
+        reqs = [
+            GenerationRequest("m", p, max_new_tokens=4, seed=i)
+            for i, p in enumerate(["a", "b", "poison", "c"])
+        ]
+        results, errors = _submit_concurrently(sched, reqs)
+        # the three good callers are served; only the poisoned one errors
+        for req, res, err in zip(reqs, results, errors):
+            if req.prompt == "poison":
+                assert isinstance(err, ValueError)
+            else:
+                assert err is None
+                assert res.tokens == FakeBackend().generate(req).tokens
+        # bisection really re-batched the survivors: at least one
+        # multi-row batch call succeeded after the poisoned dispatch
+        assert any(
+            n > 1 for n in backend.batch_calls[1:]
+        ), backend.batch_calls
+        after = (
+            REGISTRY.counter("llm_sched_batch_fallback_total")
+            .labels()
+            .value
+        )
+        assert after > before
+    finally:
+        sched.stop()
+
+
+# -- continuous (iteration-level) scheduling ----------------------------------
+
+
+def test_continuous_scheduler_serves_and_matches_fake():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    sched = ContinuousScheduler(FakeBackend(), slice_steps=8)
+    sched.start()
+    try:
+        reqs = [
+            GenerationRequest("m", f"prompt {i}", max_new_tokens=8 + i, seed=i)
+            for i in range(4)
+        ]
+        results, errors = _submit_concurrently(sched, reqs)
+        assert errors == [None] * 4
+        reference = FakeBackend()
+        for req, res in zip(reqs, results):
+            assert res.tokens == reference.generate(req).tokens
+            sched_extras = res.extras["sched"]
+            assert sched_extras["ttft_s"] <= sched_extras["completion_s"]
+    finally:
+        sched.stop()
+
+
+def test_continuous_scheduler_rejects_backend_without_stepped_api():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationBackend,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    with pytest.raises(ValueError, match="decode_open"):
+        ContinuousScheduler(GenerationBackend())
+
+
+def test_continuous_join_completes_before_long_anchor():
+    """A short request arriving mid-decode joins the running session and
+    its caller unblocks BEFORE the anchor's long decode drains — the
+    latency property window dispatch cannot provide."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    sched = ContinuousScheduler(
+        FakeBackend(tokens_per_s=200.0, simulate_delay=True), slice_steps=8
+    )
+    sched.start()
+    try:
+        long_req = GenerationRequest("m", "long", max_new_tokens=64)
+        short_req = GenerationRequest("m", "short", max_new_tokens=8)
+        done_order = []
+
+        def go(name, req):
+            sched.submit(req)
+            done_order.append(name)
+
+        t_long = threading.Thread(target=go, args=("long", long_req))
+        t_long.start()
+        time.sleep(0.08)  # the anchor session is mid-decode now
+        t_short = threading.Thread(target=go, args=("short", short_req))
+        t_short.start()
+        t_short.join(timeout=15)
+        t_long.join(timeout=15)
+        assert done_order[0] == "short", done_order
+    finally:
+        sched.stop()
+
+
+def test_continuous_shutdown_unblocks_queued_and_inflight():
+    """Scheduler shutdown while a continuous decode is IN FLIGHT: queued
+    and mid-flight tickets must all unblock with results or "server
+    shutting down" errors, never strand on event.wait() (the stepped-loop
+    extension of the PR-1 stop()/drain guarantees)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    sched = ContinuousScheduler(
+        FakeBackend(tokens_per_s=50.0, simulate_delay=True), slice_steps=8
+    )
+    sched.start()
+    outcomes = {}
+
+    def worker(i, req):
+        try:
+            outcomes[i] = ("ok", sched.submit(req))
+        except BaseException as exc:  # noqa: BLE001
+            outcomes[i] = ("err", exc)
+
+    # row 0 anchors a ~4 s decode; 1 joins it; 2 queues behind an
+    # incompatible model so it is waiting un-dispatched at shutdown
+    reqs = [
+        GenerationRequest("m", "anchor", max_new_tokens=200),
+        GenerationRequest("m", "joiner", max_new_tokens=200),
+        GenerationRequest("other", "queued", max_new_tokens=200),
+    ]
+    threads = []
+    for i, req in enumerate(reqs):
+        t = threading.Thread(target=worker, args=(i, req))
+        t.start()
+        threads.append(t)
+        time.sleep(0.08)
+    time.sleep(0.2)  # decode well in flight
+    sched.stop()
+    for t in threads:
+        t.join(timeout=15)
+    assert all(not t.is_alive() for t in threads), "caller stranded"
+    assert set(outcomes) == {0, 1, 2}
+    for status, payload in outcomes.values():
+        if status == "err":
+            assert isinstance(payload, RuntimeError)
+            assert "shutting down" in str(payload)
+    # after stop, submits are refused rather than stranded
+    with pytest.raises(RuntimeError, match="not running"):
+        sched.submit(GenerationRequest("m", "late", max_new_tokens=4))
+
+
+def test_server_auto_scheduler_selection():
+    """Auto mode: continuous for real batched backends speaking the
+    stepped protocol (the JAX engines), window otherwise (fake)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    srv = GenerationServer(
+        FakeBackend(), host="127.0.0.1", port=0, quiet=True,
+        batch_window_ms=20,
+    )
+    assert srv.scheduler_mode == "window"
+    srv.stop()
+
+    class SteppedBatched(FakeBackend):
+        def generate_batch(self, requests):  # a real batched path
+            return [self.generate(r) for r in requests]
+
+    srv2 = GenerationServer(
+        SteppedBatched(), host="127.0.0.1", port=0, quiet=True,
+        batch_window_ms=20,
+    )
+    assert srv2.scheduler_mode == "continuous"
+    assert isinstance(srv2._scheduler, ContinuousScheduler)
+    srv2.stop()
+
+    # explicit override wins over auto
+    srv3 = GenerationServer(
+        SteppedBatched(), host="127.0.0.1", port=0, quiet=True,
+        scheduler="window",
+    )
+    assert srv3.scheduler_mode == "window"
+    srv3.stop()
+
+    with pytest.raises(ValueError, match="scheduler"):
+        GenerationServer(
+            FakeBackend(), host="127.0.0.1", port=0, quiet=True,
+            scheduler="bogus",
+        )
+
+
+def test_continuous_scheduler_with_jax_engine_matches_solo():
+    """Scheduler-level token parity on the real engine: staggered
+    concurrent submits through the continuous scheduler (anchors AND
+    mid-flight joins) are bit-identical to solo generate()."""
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    engine = JaxEngine(registry=dict(registry), dtype=jnp.float32)
+    reqs = [
+        GenerationRequest(
+            "tiny", "anchor row runs longest", max_new_tokens=40,
+            stop_at_eos=False,
+        ),
+        GenerationRequest("tiny", "second row", max_new_tokens=8, seed=2),
+        GenerationRequest(
+            "tiny", "third arrives later", max_new_tokens=12, seed=3,
+            temperature=0.8,
+        ),
+    ]
+    solo = [engine.generate(r) for r in reqs]
+    sched = ContinuousScheduler(engine, slice_steps=4)
+    sched.start()
+    try:
+        results = [None] * len(reqs)
+        errors = [None] * len(reqs)
+
+        def go(i):
+            try:
+                results[i] = sched.submit(reqs[i])
+            except BaseException as exc:  # noqa: BLE001
+                errors[i] = exc
+
+        threads = []
+        for i in range(len(reqs)):
+            t = threading.Thread(target=go, args=(i,))
+            t.start()
+            threads.append(t)
+            time.sleep(0.05)  # staggered: later rows join mid-flight
+        for t in threads:
+            t.join(timeout=60)
+        assert errors == [None] * len(reqs)
+        for want, got in zip(solo, results):
+            assert got.tokens == want.tokens
     finally:
         sched.stop()
 

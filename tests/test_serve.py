@@ -226,6 +226,12 @@ def test_concurrent_requests_served_through_grouped_prefill():
         quiet=True,
         batch_window_ms=300,
         max_batch=4,
+        # window pinned: this test asserts the WINDOW path's grouped
+        # prefill (all rows collected before one dispatch); under the
+        # continuous default, companions arriving after the anchor's
+        # session opens join via solo prefill — a different, also
+        # parity-tested path (tests/test_stepped.py)
+        scheduler="window",
     )
     srv.start()
     try:
