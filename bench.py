@@ -149,9 +149,179 @@ def continuous_batching_bench() -> int:
     return 0
 
 
+def chunked_join_bench() -> int:
+    """A/B of the continuous scheduler's JOIN policy under a
+    heavy-tailed (lognormal) prompt-length Poisson trace: synchronous
+    one-shot joins (PR 3 — the whole prompt prefills between two decode
+    slices) vs chunked joins (PR 4 — token-budgeted prefill chunks
+    interleaved with slices, `--prefill-chunk-tokens`).
+
+    Headline figures: the IN-FLIGHT inter-token gap p99 (the wall
+    between two consecutive decode-slice completions that live rows sat
+    through — what a caller mid-decode experiences when a long-prompt
+    joiner streams in; with sync joins one gap swallows the joiner's
+    whole prefill, with chunked joins every gap is bounded by one slice
+    + one chunk) and joiner TTFT p95, at the same seeded arrival trace,
+    plus aggregate tok/s (chunking must not cost throughput) and
+    bit-parity of every stream vs solo generate(). CPU-functional like
+    the continuous_batching bench: tiny real architecture, real tokens,
+    real wall-clock; RELATIVE positions are the result (docs/PERF.md
+    "Chunked join-prefill"). Prints ONE JSON line.
+    """
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(
+        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "scripts")
+    )
+    import jax
+    import jax.numpy as jnp
+    from poisson_load import build_workload, percentile, run_load, summarize
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    on_accelerator = jax.default_backend() in ("tpu", "axon")
+    cfg = get_model_config("qwen2:1.5b")
+    if not on_accelerator:
+        # room for the heavy tail: prompts to ~352 tokens + budgets
+        cfg = cfg.tiny(max_seq_len=1024)
+    engine = JaxEngine(
+        registry={cfg.name: cfg},
+        dtype=jnp.bfloat16 if on_accelerator else jnp.float32,
+        decode_attention="auto" if on_accelerator else None,
+    )
+
+    n = int(_os.environ.get("BENCH_CJ_REQUESTS", "14"))
+    mean_ms = float(_os.environ.get("BENCH_CJ_INTERARRIVAL_MS", "50"))
+    chunk_tokens = int(_os.environ.get("BENCH_CJ_CHUNK_TOKENS", "64"))
+    slice_steps = int(_os.environ.get("BENCH_CJ_SLICE_STEPS", "8"))
+    # request 0 (the session anchor) rotates onto the LONG budget so the
+    # session outlives the arrivals (160 steps of slices spans the whole
+    # trace — heavy-tailed joiners must land MID-FLIGHT, the case under
+    # test); anchor_longest gives it the longest prompt so the session
+    # cache fits every later joiner — the A/B then varies ONLY the join
+    # policy, not capacity feasibility
+    budgets = (160, 12, 24)
+    workload = build_workload(
+        n,
+        mean_ms / 1e3,
+        seed=11,
+        model=cfg.name,
+        budgets=budgets,
+        stop_at_eos=False,  # fixed lengths: both arms do equal work
+        prompt_len_dist="lognormal",
+        prompt_len_median=40.0,
+        prompt_len_sigma=1.1,
+        prompt_len_max=352,
+        anchor_longest=True,
+    )
+    prompt_tokens = [len(req.prompt) + 1 for _, req in workload]
+
+    # solo references: parity oracle AND warm-up of the solo shapes
+    solo = {id(req): engine.generate(req).tokens for _, req in workload}
+
+    def run_mode(chunked: bool):
+        sched = ContinuousScheduler(
+            engine,
+            slice_steps=slice_steps,
+            prefill_chunk_tokens=chunk_tokens,
+            chunked_joins=chunked,
+        )
+        gaps = []
+        sched.slice_gap_sink = lambda gap_s, rows: gaps.append(gap_s)
+        tokens_by_req = {}
+
+        def submit(req):
+            res = sched.submit(req)
+            tokens_by_req[id(req)] = res.tokens
+            return res
+
+        sched.start()
+        try:
+            records = run_load(submit, workload)
+        finally:
+            sched.stop()
+        joiners = [r for r in records if r.get("joined")]
+        joiner_ttfts = [
+            r["ttft_s"] for r in joiners if r.get("ttft_s") is not None
+        ]
+        return {
+            **summarize(records),
+            "inflight_gap_p99_s": (
+                round(percentile(gaps, 99), 4) if gaps else None
+            ),
+            "inflight_gap_max_s": round(max(gaps), 4) if gaps else None,
+            "slice_gaps_observed": len(gaps),
+            "joined": len(joiners),
+            "join_chunks_total": sum(
+                r.get("join_chunks") or 0 for r in records
+            ),
+            "joiner_ttft_p95_s": (
+                round(percentile(joiner_ttfts, 95), 4)
+                if joiner_ttfts
+                else None
+            ),
+            "parity_vs_solo": all(
+                tokens_by_req.get(i) == toks for i, toks in solo.items()
+            ),
+        }
+
+    # warm BOTH arms outside the measured traces (session shapes, chunk
+    # prefill buckets, stepped decode fns — neither arm may pay XLA)
+    run_mode(False)
+    run_mode(True)
+    results = {"sync": run_mode(False), "chunked": run_mode(True)}
+
+    line = {
+        "metric": "chunked_join",
+        "unit": "latency_seconds",
+        "model": cfg.name,
+        "backend": jax.default_backend(),
+        "n_layers": cfg.n_layers,
+        "requests": n,
+        "mean_interarrival_ms": mean_ms,
+        "budgets": list(budgets),
+        "prompt_len": {
+            "dist": "lognormal", "median": 40.0, "sigma": 1.1,
+            "max": 352, "anchor_longest": True,
+            "drawn_min": min(prompt_tokens),
+            "drawn_max": max(prompt_tokens),
+        },
+        "prefill_chunk_tokens": chunk_tokens,
+        "decode_slice_steps": slice_steps,
+        **results,
+        "inflight_gap_p99_ratio": (
+            round(
+                results["sync"]["inflight_gap_p99_s"]
+                / results["chunked"]["inflight_gap_p99_s"],
+                2,
+            )
+            if results["sync"]["inflight_gap_p99_s"]
+            and results["chunked"]["inflight_gap_p99_s"]
+            else None
+        ),
+    }
+    print(json.dumps(line))
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "continuous_batching":
         return continuous_batching_bench()
+    if len(sys.argv) > 1 and sys.argv[1] == "chunked_join":
+        return chunked_join_bench()
     import jax
 
     backend = jax.default_backend()

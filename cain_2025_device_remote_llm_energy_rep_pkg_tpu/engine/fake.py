@@ -6,8 +6,10 @@ hooks, profilers, persistence, analysis — testable with no accelerator and no
 network. Token ids and timings are pure functions of the request.
 
 It also speaks the STEPPED-DECODE protocol (``decode_open`` → session
-``step``/``can_join``/``join``/``close``) the continuous scheduler
-drives, so iteration-level admission/retirement is testable hermetically:
+``step``/``can_join``/``join``/``close``, plus the resumable chunked
+join ``join_begin``/``join_step``/``join_commit``/``join_abort``) the
+continuous scheduler drives, so iteration-level admission/retirement —
+including chunked join-prefill interleaving — is testable hermetically:
 a session precomputes each row's deterministic token stream and a
 ``step(k)`` slice advances every live row's cursor by ``k`` (sleeping
 one shared window of ``k / tokens_per_s`` when ``simulate_delay`` — rows
@@ -39,6 +41,7 @@ class _FakeStepSession:
         self.model = requests[0].model if requests else ""
         self.top_k = requests[0].top_k if requests else 0
         self._rows: List[dict] = []
+        self._pending: List[dict] = []  # chunked joiners mid-prefill
         for r in requests:
             self._admit(r)
 
@@ -52,13 +55,61 @@ class _FakeStepSession:
         return len(self._rows)
 
     def can_join(self, request: GenerationRequest) -> bool:
-        return not self.closed and len(self._rows) < self.max_rows
+        return (
+            not self.closed
+            and len(self._rows) + len(self._pending) < self.max_rows
+        )
 
     def join(self, request: GenerationRequest) -> int:
         if not self.can_join(request):
             raise RuntimeError("request cannot join this session")
         self._admit(request)
         return len(self._rows) - 1
+
+    # -- resumable (chunked) join, the real engine's protocol ------------------
+    def join_begin(
+        self, request: GenerationRequest, chunk_tokens: "Optional[int]" = None
+    ) -> dict:
+        """Reserve a slot and split the prompt into token-budgeted
+        prefill chunks (1 byte ≈ 1 prompt token, like the byte
+        tokenizer), mirroring ``SteppedDecodeSession.join_begin`` so the
+        continuous scheduler's interleave policy is testable
+        hermetically."""
+        if not self.can_join(request):
+            raise RuntimeError("request cannot join this session")
+        chunk = max(1, int(chunk_tokens or 256))
+        n_prompt = len(request.prompt.encode("utf-8")) + 1
+        pending = {
+            "request": request,
+            "chunk_tokens": chunk,
+            "tokens_left": n_prompt,
+        }
+        self._pending.append(pending)
+        return pending
+
+    def join_step(self, pending: dict) -> bool:
+        """One prefill chunk; prefill streams ~8 tokens per decode-token
+        wall (it is parallel over positions) when simulating delay."""
+        tokens = min(pending["chunk_tokens"], pending["tokens_left"])
+        if self.backend.simulate_delay:
+            time.sleep(max(1, tokens) / (self.backend.tokens_per_s * 8.0))
+        pending["tokens_left"] -= tokens
+        return pending["tokens_left"] <= 0
+
+    def join_commit(self, pending: dict) -> int:
+        if pending["tokens_left"] > 0:
+            raise RuntimeError("join not fully prefilled")
+        self._pending.remove(pending)
+        self._admit(pending["request"])
+        return len(self._rows) - 1
+
+    def join_abort(self, pending: dict) -> None:
+        if pending in self._pending:
+            self._pending.remove(pending)
+
+    @property
+    def pending_joins(self) -> int:
+        return len(self._pending)
 
     def step(self, max_steps: int = 16) -> List[GenerationResult]:
         if self.closed:
@@ -86,6 +137,7 @@ class _FakeStepSession:
     def close(self) -> None:
         self.closed = True
         self._rows = []
+        self._pending = []
 
 
 class FakeBackend(GenerationBackend):
@@ -135,6 +187,9 @@ class FakeBackend(GenerationBackend):
         self,
         requests: List[GenerationRequest],
         reserve_rows: Optional[int] = None,
+        slice_steps: Optional[int] = None,
     ) -> _FakeStepSession:
-        """Stepped-decode protocol (see the module docstring)."""
+        """Stepped-decode protocol (see the module docstring);
+        ``slice_steps`` is accepted for signature parity with the real
+        engine (the fake session's step takes the width per call)."""
         return _FakeStepSession(self, requests)

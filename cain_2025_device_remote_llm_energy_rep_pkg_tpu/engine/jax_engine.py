@@ -153,16 +153,44 @@ def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
 # (the flash-prefill kernel supports offset > 0 against a partially-filled
 # cache), so max prompt length is bounded by max_seq_len, not the bucket.
 PREFILL_CHUNK = PROMPT_BUCKETS[-1]
+# Token budget for ONE chunk of a mid-flight join's prefill
+# (engine/stepped.py join_begin/join_step): the continuous scheduler
+# interleaves join-prefill chunks with decode slices, so in-flight rows'
+# stall per slice is bounded by this many prompt tokens instead of the
+# joiner's whole prompt length. 0 = auto (256: the chunk forward stays
+# in the same ballpark as a 16-step decode slice on the measured shapes
+# while reusing an existing compiled prompt bucket). CLI twin:
+# `serve --prefill-chunk-tokens`.
+JOIN_PREFILL_CHUNK_TOKENS = (
+    int(os.environ.get("PREFILL_CHUNK_TOKENS", 0)) or 256
+)
 
 
-def _prompt_chunks(s_real: int) -> "list[tuple[int, int]]":
+def _floor_bucket(n: int, buckets: Tuple[int, ...]) -> int:
+    """Largest bucket <= n (the smallest bucket when n undershoots all)
+    — chunk-budget rounding must round DOWN so a stall budget is a cap,
+    where _bucket's round-up would exceed it."""
+    best = buckets[0]
+    for b in buckets:
+        if b <= n:
+            best = b
+    return best
+
+
+def _prompt_chunks(
+    s_real: int, chunk: Optional[int] = None
+) -> "list[tuple[int, int]]":
     """Cover ``s_real`` prompt tokens as [(start, bucket), ...]: full
-    PREFILL_CHUNK chunks, then one bucket-rounded tail."""
+    ``chunk``-sized chunks (default PREFILL_CHUNK), then one
+    bucket-rounded tail. ``chunk`` must be a PROMPT_BUCKETS width so
+    every chunk reuses an existing compiled prefill shape."""
+    if chunk is None:
+        chunk = PREFILL_CHUNK
     chunks = []
     start = 0
-    while s_real - start > PREFILL_CHUNK:
-        chunks.append((start, PREFILL_CHUNK))
-        start += PREFILL_CHUNK
+    while s_real - start > chunk:
+        chunks.append((start, chunk))
+        start += chunk
     chunks.append((start, _bucket(s_real - start, PROMPT_BUCKETS)))
     return chunks
 
@@ -2264,20 +2292,25 @@ class JaxEngine(GenerationBackend):
         self,
         requests: "list[GenerationRequest]",
         reserve_rows: Optional[int] = None,
+        slice_steps: Optional[int] = None,
     ):
         """Open an iteration-level decode session over ``requests`` (the
         stepped-decode protocol the continuous scheduler drives —
         engine/stepped.py): all rows prefill now, then the caller runs
         ``session.step(k)`` slices, collecting retired rows' results the
         moment their done-mask sets and joining queued compatible
-        requests into the freed slots via ``session.join``.
-        ``reserve_rows`` sizes the row bucket above ``len(requests)`` so
-        a session opened by a lone anchor still has free slots for
-        mid-flight joins."""
+        requests into the freed slots via ``session.join`` (or the
+        resumable ``join_begin``/``join_step``/``join_commit`` chunked
+        variant). ``reserve_rows`` sizes the row bucket above
+        ``len(requests)`` so a session opened by a lone anchor still has
+        free slots for mid-flight joins; ``slice_steps`` overrides the
+        compiled slice width (default DECODE_SLICE_STEPS — the
+        ``serve --decode-slice-steps`` knob lands here)."""
         from .stepped import SteppedDecodeSession
 
         return SteppedDecodeSession.open(
-            self, requests, reserve_rows=reserve_rows
+            self, requests, reserve_rows=reserve_rows,
+            slice_steps=slice_steps,
         )
 
     def _paged_decode_attention(self, cfg: Optional[ModelConfig] = None):

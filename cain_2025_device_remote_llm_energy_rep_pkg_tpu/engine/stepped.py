@@ -21,7 +21,18 @@ composed with vLLM-style paged block management, Kwon et al. SOSP '23):
 - :meth:`SteppedDecodeSession.join` admits a queued compatible request
   into a freed slot between slices: solo prefill at the session's cache
   shape, scattered into the slot (contiguous) or into freshly allocated
-  pool pages (paged).
+  pool pages (paged);
+- the CHUNKED variant — :meth:`SteppedDecodeSession.join_begin` /
+  :meth:`join_step` / :meth:`join_commit` — splits that prefill into
+  token-budgeted chunks (the engine's offset>0 chunked-prefill path,
+  ``_prompt_chunks``) so the scheduler can interleave one chunk per
+  decode slice: in-flight rows' stall per slice is bounded by the chunk
+  budget (``--prefill-chunk-tokens``) instead of the joiner's prompt
+  length (Sarathi-Serve's chunked-prefill argument, Agrawal et al.
+  OSDI '24, applied to mid-flight admission). The pending joiner's KV
+  accumulates in a private solo cache across chunks; the row enters the
+  session's done-mask bookkeeping only at commit, which samples the
+  first token and scatters the cache exactly as the one-shot join.
 
 Token parity: every row's stream is bit-identical to its solo
 ``generate()`` — the slice loop is the monolithic batch loop with the
@@ -79,6 +90,42 @@ def _zero_row(cache, r: int, axis: int = 1):
     return cache.at[tuple(idx)].set(0)
 
 
+class _PendingJoin:
+    """One joiner mid-chunked-prefill: the reserved slot, the private
+    solo cache the chunks accumulate into, and the cursor over the
+    token-budgeted chunk list. Holds its paged pages from ``join_begin``
+    (reserved against concurrent joiners) until commit installs them or
+    abort frees them."""
+
+    __slots__ = (
+        "request", "slot", "ids", "chunks", "next_chunk", "cache_len",
+        "k_cache", "v_cache", "presence", "logits", "pages",
+        "prefill_s", "t0",
+    )
+
+    def __init__(
+        self, request, slot, ids, chunks, cache_len,
+        k_cache, v_cache, presence, pages,
+    ):
+        self.request = request
+        self.slot = slot
+        self.ids: List[int] = ids
+        self.chunks: List[tuple] = chunks
+        self.next_chunk = 0
+        self.cache_len = cache_len
+        self.k_cache = k_cache
+        self.v_cache = v_cache
+        self.presence = presence
+        self.logits = None
+        self.pages: List[int] = pages
+        self.prefill_s = 0.0  # sum of chunk walls (not the interleaved span)
+        self.t0 = time.monotonic()
+
+    @property
+    def total_chunks(self) -> int:
+        return len(self.chunks)
+
+
 class _Row:
     """Host-side record of one live session row."""
 
@@ -118,6 +165,10 @@ class SteppedDecodeSession:
         self.closed = False
         self.paged = bool(engine.paged_kv)
         self.rows: List[Optional[_Row]] = []
+        # slot -> _PendingJoin: chunked joiners mid-prefill. A reserved
+        # slot is not free (free_slots/can_join account for it) and not
+        # live (the decode loop's done-mask still marks it done).
+        self._pending: Dict[int, _PendingJoin] = {}
         self.use_top_p = False
         self.use_rp = False
 
@@ -128,6 +179,7 @@ class SteppedDecodeSession:
         engine,
         requests: "list[GenerationRequest]",
         reserve_rows: Optional[int] = None,
+        slice_steps: Optional[int] = None,
     ) -> "SteppedDecodeSession":
         from .jax_engine import (
             BATCH_BUCKETS,
@@ -157,7 +209,7 @@ class SteppedDecodeSession:
         self.g_bucket = _bucket(
             max(r.max_new_tokens for r in requests), GEN_BUCKETS
         )
-        self.slice_bucket = max(1, DECODE_SLICE_STEPS)
+        self.slice_bucket = max(1, int(slice_steps or DECODE_SLICE_STEPS))
         if self.paged:
             self._open_paged(requests, all_ids)
         else:
@@ -401,7 +453,17 @@ class SteppedDecodeSession:
 
     @property
     def free_slots(self) -> int:
-        return sum(1 for r in self.rows if r is None)
+        """Slots open to a new joiner: not live AND not reserved by a
+        pending chunked join."""
+        return sum(
+            1
+            for r, row in enumerate(self.rows)
+            if row is None and r not in self._pending
+        )
+
+    @property
+    def pending_joins(self) -> int:
+        return len(self._pending)
 
     # -- stepping -------------------------------------------------------------
     def step(self, max_steps: Optional[int] = None) -> List[GenerationResult]:
@@ -565,36 +627,221 @@ class SteppedDecodeSession:
         return need <= self.jmax and need <= self.pool.free_pages
 
     def join(self, request: GenerationRequest) -> int:
-        """Admit ``request`` into a free slot (prefill now, decode from
-        the next slice). Returns the slot index. Callers should probe
-        :meth:`can_join` first; a failed prefill raises and leaves the
-        session consistent (the slot stays free)."""
-        import numpy as np
+        """Admit ``request`` into a free slot, paying the WHOLE prompt
+        prefill now (decode from the next slice) — the synchronous
+        one-shot join, kept for callers that don't interleave (and as
+        the `--no-chunked-joins`-style baseline the chunked_join bench
+        A/Bs against). Implemented over the resumable protocol below so
+        the two paths cannot drift. Returns the slot index. Callers
+        should probe :meth:`can_join` first; a failed prefill raises and
+        leaves the session consistent (the slot stays free)."""
+        from .jax_engine import PREFILL_CHUNK
 
-        from .jax_engine import _prompt_alloc
-        from .paged_kv import _paginate, quantize_chunks, scatter_pages
+        pending = self.join_begin(request, chunk_tokens=PREFILL_CHUNK)
+        try:
+            while not self.join_step(pending):
+                pass
+            return self.join_commit(pending)
+        except BaseException:
+            self.join_abort(pending)
+            raise
+
+    def join_begin(
+        self,
+        request: GenerationRequest,
+        chunk_tokens: Optional[int] = None,
+    ) -> _PendingJoin:
+        """Start a RESUMABLE join: reserve a free slot (and, paged, the
+        row's pages — so concurrent admissions can't oversubscribe the
+        pool while this prefill streams in), build the private solo
+        cache, and split the prompt into token-budgeted chunks
+        (``chunk_tokens``, default JOIN_PREFILL_CHUNK_TOKENS; floored to
+        a compiled prompt-bucket width). No device compute happens here
+        — the first :meth:`join_step` runs the first chunk. The budget-
+        aware admission cap is the caller's to re-evaluate before this
+        call (serve/scheduler.py does, per joiner)."""
+        from .jax_engine import (
+            JOIN_PREFILL_CHUNK_TOKENS,
+            PROMPT_BUCKETS,
+            _floor_bucket,
+            _prompt_chunks,
+        )
 
         if not self.can_join(request):
             raise RuntimeError("request cannot join this session")
-        r = next(i for i, row in enumerate(self.rows) if row is None)
+        r = next(
+            i
+            for i, row in enumerate(self.rows)
+            if row is None and i not in self._pending
+        )
         eng = self.engine
         ids = self.tok.encode(request.prompt)
+        chunk = _floor_bucket(
+            int(chunk_tokens or JOIN_PREFILL_CHUNK_TOKENS), PROMPT_BUCKETS
+        )
+        chunks = _prompt_chunks(len(ids), chunk)
+        alloc = chunks[-1][0] + chunks[-1][1]
+        if self.paged:
+            # private cache covers just the prompt; commit scatters whole
+            # pages (the generation region lives in the pool/side caches)
+            cache_len = alloc
+        else:
+            cache_len = self.cache_len
+            if alloc > cache_len:
+                # the budgeted chunking's bucket rounding overshot the
+                # session cache; the standard chunking fits by can_join's
+                # _prompt_alloc check
+                chunks = _prompt_chunks(len(ids))
         pages: List[int] = []
         if self.paged:
-            st = eng._start(
-                request,
-                cache_len=_prompt_alloc(len(ids)),
-                prompt_ids=ids,
+            pages = self.pool.alloc(
+                self._pages_needed(len(ids), request.max_new_tokens)
             )
-            need = self._pages_needed(st["s_real"], request.max_new_tokens)
-            pages = self.pool.alloc(need)
-            n_prompt_pages = -(-st["s_real"] // self.page_size)
-            ck = _paginate(
-                st["k_cache"][:, 0], st["s_real"], self.page_size
+        tf = eng._models[self.model]
+        k_cache, v_cache = tf.init_cache(1, cache_len, dtype=eng.dtype)
+        k_cache, v_cache = eng._place_cache(k_cache, v_cache, self.cfg)
+        presence = jnp.zeros((1, self.cfg.vocab_size), dtype=bool)
+        if request.repeat_penalty != 1.0:
+            presence = presence.at[0, jnp.asarray(ids)].set(True)
+        pending = _PendingJoin(
+            request, r, ids, chunks, cache_len, k_cache, v_cache,
+            presence, pages,
+        )
+        self._pending[r] = pending
+        return pending
+
+    def join_step(self, pending: _PendingJoin) -> bool:
+        """Run ONE prefill chunk of a pending join (offset>0 against the
+        private cache — the engine's chunked-prefill path). Returns True
+        once the whole prompt is prefilled (commit next). Fenced, so the
+        caller's wall-clock around this call IS the in-flight rows'
+        stall for this chunk."""
+        if pending.next_chunk >= len(pending.chunks):
+            return True
+        eng = self.engine
+        tf = eng._models[self.model]
+        t0 = time.monotonic()
+        start, bucket = pending.chunks[pending.next_chunk]
+        ids = pending.ids[start : start + bucket]
+        real = len(ids)
+        tokens = jnp.asarray(
+            [ids + [self.tok.pad_id] * (bucket - real)], dtype=jnp.int32
+        )
+        prefill = eng._prefill_fn(self.model, bucket, pending.cache_len)
+        logits, pending.k_cache, pending.v_cache = prefill(
+            tf.params,
+            tokens,
+            jnp.int32(start),
+            jnp.asarray([real - 1]),
+            pending.k_cache,
+            pending.v_cache,
+        )
+        jax.block_until_ready(logits)
+        pending.logits = logits
+        pending.next_chunk += 1
+        pending.prefill_s += time.monotonic() - t0
+        return pending.next_chunk >= len(pending.chunks)
+
+    def join_commit(self, pending: _PendingJoin) -> int:
+        """Finish a fully-prefilled pending join: sample the first token
+        (exactly as the solo path's ``_start`` — same rng derivation,
+        same sampler call — so the joiner's stream stays bit-identical
+        to its solo ``generate()``) and install the row into the
+        session. Only now does the row enter the decode done-mask
+        bookkeeping. Returns the slot index."""
+        from ..ops.sampling import sample_token
+
+        if pending.next_chunk < len(pending.chunks):
+            raise RuntimeError(
+                f"join not fully prefilled: chunk {pending.next_chunk} of "
+                f"{len(pending.chunks)}"
             )
-            cv = _paginate(
-                st["v_cache"][:, 0], st["s_real"], self.page_size
-            )
+        request = pending.request
+        use_top_p = request.top_p < 1.0
+        use_rp = request.repeat_penalty != 1.0
+        t0 = time.monotonic()
+        rng = jax.random.PRNGKey(request.seed)
+        rng, sub = jax.random.split(rng)
+        presence = pending.presence
+        first = sample_token(
+            pending.logits,
+            sub,
+            jnp.float32(request.temperature),
+            request.top_k,
+            jnp.float32(request.top_p) if use_top_p else None,
+            presence if use_rp else None,
+            jnp.float32(request.repeat_penalty) if use_rp else None,
+        )
+        if use_rp:
+            presence = presence.at[jnp.arange(1), first].set(True)
+        jax.block_until_ready(first)
+        pending.prefill_s += time.monotonic() - t0
+        if _obs_enabled():
+            try:
+                from .jax_engine import _PREFILL_H
+
+                # the sum of chunk walls, not the interleaved span — the
+                # decode slices between chunks are not prefill time
+                _PREFILL_H.observe(pending.prefill_s)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        r = pending.slot
+        del self._pending[r]
+        self._install_row(
+            request,
+            r,
+            s_real=len(pending.ids),
+            first=first,
+            rng=rng,
+            presence=presence,
+            k_cache=pending.k_cache,
+            v_cache=pending.v_cache,
+            use_top_p=use_top_p,
+            use_rp=use_rp,
+            pages=pending.pages,
+            t0=pending.t0,
+            prefill_s=pending.prefill_s,
+        )
+        return r
+
+    def join_abort(self, pending: _PendingJoin) -> None:
+        """Drop a pending join (failed chunk, scheduler shutdown): the
+        slot reservation lifts and its pages return to the pool. The
+        private cache is garbage-collected with the object."""
+        self._pending.pop(pending.slot, None)
+        if self.paged and pending.pages:
+            self.pool.free(pending.pages)
+            pending.pages = []
+
+    def _install_row(
+        self,
+        request: GenerationRequest,
+        r: int,
+        *,
+        s_real: int,
+        first,
+        rng,
+        presence,
+        k_cache,
+        v_cache,
+        use_top_p: bool,
+        use_rp: bool,
+        pages: "List[int]",
+        t0: float,
+        prefill_s: float,
+    ) -> None:
+        """Scatter a prefilled solo cache into slot ``r`` and set every
+        per-row device/host field — the shared tail of the one-shot and
+        chunked joins."""
+        import numpy as np
+
+        from .paged_kv import _paginate, quantize_chunks, scatter_pages
+
+        eng = self.engine
+        if self.paged:
+            n_prompt_pages = -(-s_real // self.page_size)
+            ck = _paginate(k_cache[:, 0], s_real, self.page_size)
+            cv = _paginate(v_cache[:, 0], s_real, self.page_size)
             if self.d_pool != self.cfg.d_head:
                 padd = [(0, 0)] * (ck.ndim - 1) + [
                     (0, self.d_pool - self.cfg.d_head)
@@ -616,21 +863,18 @@ class SteppedDecodeSession:
                 self.side_k = _zero_row(self.side_k, r)
                 self.side_v = _zero_row(self.side_v, r)
         else:
-            st = eng._start(
-                request, cache_len=self.cache_len, prompt_ids=ids
-            )
-            kc_row, vc_row = st["k_cache"], st["v_cache"]
+            kc_row, vc_row = k_cache, v_cache
             if eng.kv_quantize:
                 from ..models.quantize import quantize_kv_cache
 
                 kc_row, vc_row = quantize_kv_cache(kc_row, vc_row)
             self.k_cache = _set_row(self.k_cache, r, kc_row)
             self.v_cache = _set_row(self.v_cache, r, vc_row)
-        self.tokens = self.tokens.at[r].set(st["first"][0])
-        self.rngs = self.rngs.at[r].set(st["rng"])
-        self.presence = self.presence.at[r].set(st["presence"][0])
-        self.offsets = self.offsets.at[r].set(st["s_real"])
-        self.prompt_lens = self.prompt_lens.at[r].set(st["s_real"])
+        self.tokens = self.tokens.at[r].set(first[0])
+        self.rngs = self.rngs.at[r].set(rng)
+        self.presence = self.presence.at[r].set(presence[0])
+        self.offsets = self.offsets.at[r].set(s_real)
+        self.prompt_lens = self.prompt_lens.at[r].set(s_real)
         self.remaining = self.remaining.at[r].set(
             request.max_new_tokens - 1
         )
@@ -641,20 +885,19 @@ class SteppedDecodeSession:
         # sticky for the session: a sentinel makes the filter an identity
         # for rows that never asked for it, so turning a knob on for a
         # joiner cannot perturb a companion's stream
-        self.use_top_p = self.use_top_p or st["use_top_p"]
-        self.use_rp = self.use_rp or st["use_rp"]
+        self.use_top_p = self.use_top_p or use_top_p
+        self.use_rp = self.use_rp or use_rp
         now = time.monotonic()
         self.rows[r] = _Row(
             request,
-            st["s_real"],
-            int(st["first"][0]),
+            s_real,
+            int(first[0]),
             request.max_new_tokens - 1,
-            st["t0"],
-            st["t1"],
+            t0,
+            t0 + prefill_s,
             now,
             pages=pages,
         )
-        return r
 
     # -- teardown -------------------------------------------------------------
     def close(self) -> None:
@@ -669,4 +912,9 @@ class SteppedDecodeSession:
                 if row is not None and row.pages:
                     self.pool.free(row.pages)
                     row.pages = []
+            for pending in self._pending.values():
+                if pending.pages:
+                    self.pool.free(pending.pages)
+                    pending.pages = []
+        self._pending.clear()
         self.rows = [None] * len(self.rows)

@@ -156,7 +156,15 @@ commands:
                        --batch-window-ms is the deprecated alias of
                        --window-ms; --no-budget-admission pins the cap
                        at --max-batch instead of raising it to the
-                       engine's KV-budget estimate),
+                       engine's KV-budget estimate;
+                       --decode-slice-steps N sets the continuous
+                       scheduler's bounded decode-slice width (default
+                       16, env DECODE_SLICE_STEPS) and
+                       --prefill-chunk-tokens N the token budget of one
+                       chunk of a mid-flight joiner's prefill (default
+                       auto/256, env PREFILL_CHUNK_TOKENS) — together
+                       they bound in-flight rows' stall per scheduler
+                       iteration),
                        --hf model=/ckpt/dir (serve trained weights + that
                        checkpoint's tokenizer; repeatable),
                        --quantize int8|int4|none or per-model
@@ -189,6 +197,8 @@ def serve_command(args: List[str]) -> None:
     scheduler = None  # auto: continuous for real batched backends
     max_batch = None  # backend-aware default (serve/scheduler.py)
     budget_aware = None  # auto: KV-budget admission when estimable
+    slice_steps = None  # continuous: engine DECODE_SLICE_STEPS default
+    prefill_chunk_tokens = None  # continuous: engine auto default
     hf_checkpoints = {}
     quantize = None
     kv_quantize = None
@@ -222,6 +232,18 @@ def serve_command(args: List[str]) -> None:
             max_batch = int(next(it, "0")) or None
         elif arg == "--no-budget-admission":
             budget_aware = False
+        elif arg == "--decode-slice-steps":
+            slice_steps = int(next(it, "0")) or None
+            if slice_steps is not None and slice_steps < 1:
+                raise CommandError(
+                    "serve: --decode-slice-steps expects a positive integer"
+                )
+        elif arg == "--prefill-chunk-tokens":
+            prefill_chunk_tokens = int(next(it, "0")) or None
+            if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+                raise CommandError(
+                    "serve: --prefill-chunk-tokens expects a positive integer"
+                )
         elif arg == "--hf":
             # --hf model=/path/to/checkpoint (repeatable): serve the model
             # from a local HF checkpoint (trained weights + its tokenizer)
@@ -341,6 +363,8 @@ def serve_command(args: List[str]) -> None:
         budget_aware=budget_aware,
         access_log=access_log,
         scheduler=scheduler,
+        slice_steps=slice_steps,
+        prefill_chunk_tokens=prefill_chunk_tokens,
     )
     server.serve_forever()
 

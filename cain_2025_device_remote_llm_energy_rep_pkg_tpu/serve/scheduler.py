@@ -23,9 +23,15 @@ wire protocol:
   control, rows whose done-mask set RETIRE immediately (their ticket
   completes and, on the paged engine, their KV pages return to the pool
   mid-flight), and queued compatible requests JOIN the freed rows with
-  the budget-aware admission cap re-evaluated at each admission. Callers
-  stop waiting for strangers' long tails: time-to-first-token is bounded
-  by one slice + a prefill instead of the previous batch's slowest row.
+  the budget-aware admission cap re-evaluated at each admission. Joins
+  are CHUNKED by default: a joiner's prompt prefill streams in as
+  token-budgeted chunks interleaved with decode slices (at most one
+  chunk between two slices, pending joiners round-robin), so one
+  long-prompt joiner can no longer stall every in-flight row for its
+  whole prefill. Callers stop waiting for strangers' long tails:
+  time-to-first-token is bounded by one slice + a prefill instead of
+  the previous batch's slowest row, and in-flight inter-token latency
+  is bounded by one slice + one prefill chunk.
 
 Both preserve per-request results exactly: the batched/stepped engines
 are token-identical per row to a solo ``generate``.
@@ -36,6 +42,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from ..engine.backend import (
@@ -109,12 +116,36 @@ _INFLIGHT_G = REGISTRY.gauge(
 _TTFT_H = REGISTRY.histogram(
     "llm_request_ttft_seconds",
     "Submit → the request's first generated token exists (continuous: "
-    "measured at admission-prefill completion; window: completion minus "
-    "the shared decode window — the earliest a result could carry it)",
+    "measured at admission-prefill completion — a chunked joiner's "
+    "spans all its prefill chunks; window: estimated as completion "
+    "minus the shared decode window minus the recorded queue wait, "
+    "which llm_sched_queue_wait_seconds reports separately)",
 )
 _COMPLETION_H = REGISTRY.histogram(
     "llm_request_completion_seconds",
     "Submit → result handed back to the caller",
+)
+# Chunked join-prefill (continuous scheduler): a joiner's prompt prefill
+# is split into token-budgeted chunks interleaved with decode slices, so
+# in-flight rows' stall per slice is bounded by the chunk budget instead
+# of the joiner's prompt length. These three families make that policy's
+# cost continuously visible: per-chunk wall, the stall decode actually
+# paid, and chunk volume.
+_JOIN_PREFILL_H = REGISTRY.histogram(
+    "llm_sched_join_prefill_seconds",
+    "Wall time of ONE join-prefill chunk (chunked joins; the final "
+    "chunk includes the commit's first-token sample + row scatter)",
+)
+_DECODE_STALL_H = REGISTRY.histogram(
+    "llm_sched_decode_stall_seconds",
+    "Time in-flight decode rows waited on join-prefill work between two "
+    "decode slices (observed only when live rows were actually waiting)",
+)
+_JOIN_CHUNKS_C = REGISTRY.counter(
+    "llm_sched_join_chunks_total",
+    "Join-prefill chunks executed by the continuous scheduler "
+    "(a synchronous join executes its whole prompt as one admit call "
+    "and does not count here)",
 )
 
 
@@ -124,10 +155,14 @@ class _Ticket:
     the submit-side clock and the submitting thread's current span so
     the scheduler thread can parent queue/backend spans under the HTTP
     request's root (obs); ``t_first`` is stamped when the request's
-    first token exists (continuous admission)."""
+    first token exists (continuous admission). ``queue_wait_s`` is the
+    recorded submit→dispatch wait (the TTFT fallback subtracts it);
+    ``joined``/``join_chunks`` mark mid-flight admissions and how many
+    prefill chunks the join took (0 = synchronous)."""
 
     __slots__ = (
-        "request", "event", "result", "error", "t_submit", "t_first", "span"
+        "request", "event", "result", "error", "t_submit", "t_first",
+        "span", "queue_wait_s", "joined", "join_chunks",
     )
 
     def __init__(self, request: GenerationRequest) -> None:
@@ -138,6 +173,9 @@ class _Ticket:
         self.t_submit = time.monotonic()
         self.t_first: Optional[float] = None
         self.span = TRACER.current()
+        self.queue_wait_s: Optional[float] = None
+        self.joined = False
+        self.join_chunks = 0
 
 
 class _SchedulerBase:
@@ -312,16 +350,32 @@ class _SchedulerBase:
         else:
             # window dispatch: the first token existed once the shared
             # decode window opened — completion minus that window is the
-            # earliest the result could have carried it
-            ttft_s = max(0.0, completion_s - result.decode_s)
+            # earliest the result could have carried it. The recorded
+            # queue wait is subtracted too: it previously folded into
+            # this estimate (ISSUE 4 satellite), skewing the window
+            # histogram against the continuous one on the same scrape;
+            # the queue component stays visible on its own family
+            # (llm_sched_queue_wait_seconds).
+            ttft_s = max(
+                0.0,
+                completion_s
+                - result.decode_s
+                - (ticket.queue_wait_s or 0.0),
+            )
         _TTFT_H.observe(ttft_s)
         _COMPLETION_H.observe(completion_s)
+        sched_extras = {
+            "ttft_s": round(ttft_s, 6),
+            "completion_s": round(completion_s, 6),
+        }
+        if ticket.joined:
+            # mid-flight admission attribution: the TTFT above spans the
+            # whole chunked prefill (queue → last chunk → first token)
+            sched_extras["joined"] = True
+            sched_extras["join_chunks"] = ticket.join_chunks
         result.extras = {
             **(result.extras or {}),
-            "sched": {
-                "ttft_s": round(ttft_s, 6),
-                "completion_s": round(completion_s, 6),
-            },
+            "sched": sched_extras,
         }
         ticket.result = result
         ticket.event.set()
@@ -426,7 +480,8 @@ class BatchScheduler(_SchedulerBase):
             # request root — the span tree survives the thread hop.
             t_dispatch = time.monotonic()
             for ticket in batch:
-                _QUEUE_WAIT_H.observe(t_dispatch - ticket.t_submit)
+                ticket.queue_wait_s = t_dispatch - ticket.t_submit
+                _QUEUE_WAIT_H.observe(ticket.queue_wait_s)
                 TRACER.add_span(
                     "queue", ticket.t_submit, t_dispatch,
                     attrs={"batch_rows": len(batch)}, parent=ticket.span,
@@ -479,7 +534,20 @@ class ContinuousScheduler(_SchedulerBase):
       not at batch end — and free their rows (and pool pages) for
       joiners;
     - **join**: queued compatible requests enter freed rows, with the
-      budget-aware cap re-evaluated at each admission.
+      budget-aware cap re-evaluated at each admission. By default joins
+      are CHUNKED (``chunked_joins``): admission reserves the slot
+      (``session.join_begin``) and the joiner's prompt prefill then
+      streams in as token-budgeted chunks — AT MOST ONE chunk (at most
+      ``prefill_chunk_tokens`` prompt tokens) between two decode slices,
+      multiple pending joiners progressed round-robin — so in-flight
+      rows' stall per slice is bounded by the chunk budget instead of
+      the joiner's prompt length (the Sarathi-Serve chunked-prefill
+      argument applied to mid-flight admission). The joiner's row only
+      enters decode at ``join_commit`` (first token sampled there; TTFT
+      spans all its chunks). ``chunked_joins=False`` restores the
+      synchronous one-shot join (the whole prompt prefills between two
+      slices — the pre-ISSUE-4 behavior the chunked_join bench A/Bs
+      against).
 
     Incompatible arrivals re-queue and anchor their own session once this
     one drains (same FIFO-per-compatibility-class rule as the window
@@ -496,6 +564,8 @@ class ContinuousScheduler(_SchedulerBase):
         lock: Optional[threading.Lock] = None,
         budget_aware: Optional[bool] = None,
         slice_steps: Optional[int] = None,
+        prefill_chunk_tokens: Optional[int] = None,
+        chunked_joins: bool = True,
     ) -> None:
         super().__init__(
             backend,
@@ -514,6 +584,23 @@ class ContinuousScheduler(_SchedulerBase):
 
             slice_steps = DECODE_SLICE_STEPS
         self.slice_steps = max(1, int(slice_steps))
+        # None = the backend's auto default (engine:
+        # JOIN_PREFILL_CHUNK_TOKENS, env PREFILL_CHUNK_TOKENS); the
+        # serve CLI's --prefill-chunk-tokens lands here.
+        self.prefill_chunk_tokens = (
+            max(1, int(prefill_chunk_tokens))
+            if prefill_chunk_tokens
+            else None
+        )
+        self.chunked_joins = bool(chunked_joins)
+        # Optional fine-grained probe for benches: called with
+        # (gap_seconds, live_rows) for every gap between two consecutive
+        # decode-slice completions that live rows sat through — the
+        # inter-token arrival gap an in-flight caller experiences,
+        # including any join work the scheduler did in between. The
+        # /metrics twin is llm_sched_decode_stall_seconds (join work
+        # only, bucketed).
+        self.slice_gap_sink = None
 
     def _loop(self) -> None:
         while self._running:
@@ -556,7 +643,8 @@ class ContinuousScheduler(_SchedulerBase):
         batch = [first] + self._drain_compatible(anchor, cap - 1)
         t_open = time.monotonic()
         for ticket in batch:
-            _QUEUE_WAIT_H.observe(t_open - ticket.t_submit)
+            ticket.queue_wait_s = t_open - ticket.t_submit
+            _QUEUE_WAIT_H.observe(ticket.queue_wait_s)
             TRACER.add_span(
                 "queue", ticket.t_submit, t_open,
                 attrs={"batch_rows": len(batch)}, parent=ticket.span,
@@ -568,6 +656,7 @@ class ContinuousScheduler(_SchedulerBase):
                 session = self.backend.decode_open(
                     [t.request for t in batch],
                     reserve_rows=min(cap, max(2 * len(batch), 4)),
+                    slice_steps=self.slice_steps,
                 )
         except BaseException as exc:  # noqa: BLE001
             # a failed open (one bad prompt poisons the group) salvages
@@ -586,29 +675,60 @@ class ContinuousScheduler(_SchedulerBase):
         for ticket in batch:
             ticket.t_first = now  # admission prefill done: token 1 exists
             live[id(ticket.request)] = ticket
+        # chunked joiners mid-prefill: (ticket, pending_join) in
+        # round-robin order — _progress_joins advances the head one
+        # chunk per loop iteration
+        pending: "deque[tuple[_Ticket, object]]" = deque()
         _INFLIGHT_G.set(session.active)
         try:
-            while self._running and session.active:
-                with self._backend_lock:
-                    retired = session.step(self.slice_steps)
-                now = time.monotonic()
-                for result in retired:
-                    self._complete_row(live, result, now)
-                self._admit_into(session, live, anchor)
-                _INFLIGHT_G.set(session.active)
+            prev_slice_end: Optional[float] = None
+            while self._running and (session.active or pending):
+                rows_before = session.active
+                if rows_before:
+                    with self._backend_lock:
+                        retired = session.step(self.slice_steps)
+                    t_slice_end = time.monotonic()
+                    if (
+                        prev_slice_end is not None
+                        and self.slice_gap_sink is not None
+                    ):
+                        try:
+                            self.slice_gap_sink(
+                                t_slice_end - prev_slice_end, rows_before
+                            )
+                        except Exception:  # noqa: BLE001 — probe only
+                            pass
+                    prev_slice_end = t_slice_end
+                    for result in retired:
+                        self._complete_row(live, result, t_slice_end)
+                else:
+                    # every live row retired while joiners are still
+                    # prefilling: no decode to slice, chunks run
+                    # back-to-back until one commits
+                    prev_slice_end = None
+                self._progress_joins(session, live, pending)
+                self._admit_into(session, live, anchor, pending)
+                _INFLIGHT_G.set(session.active + len(pending))
         except BaseException:  # noqa: BLE001 — engine died mid-session
             _BATCH_FALLBACK_C.inc()
-            leftovers = list(live.values())
+            leftovers = list(live.values()) + [t for t, _ in pending]
             live.clear()
+            pending.clear()
             for ticket in leftovers:
                 _ROWS_RETIRED_C.labels(reason="error").inc()
             self._dispatch_isolated(leftovers)
         finally:
             try:
                 with self._backend_lock:
-                    session.close()
+                    session.close()  # aborts pending joins, frees pages
             except Exception:  # noqa: BLE001
                 pass
+            for ticket, _pj in pending:
+                # only reachable when stop() interrupted the loop
+                _ROWS_RETIRED_C.labels(reason="shutdown").inc()
+                ticket.error = RuntimeError("server shutting down")
+                ticket.event.set()
+            pending.clear()
             for ticket in live.values():
                 # only reachable when stop() interrupted the loop
                 _ROWS_RETIRED_C.labels(reason="shutdown").inc()
@@ -616,6 +736,52 @@ class ContinuousScheduler(_SchedulerBase):
                 ticket.event.set()
             live.clear()
             _INFLIGHT_G.set(0)
+
+    def _progress_joins(
+        self,
+        session,
+        live: Dict[int, _Ticket],
+        pending: "deque",
+    ) -> None:
+        """The INTERLEAVE policy: run AT MOST ONE prefill chunk of AT
+        MOST ONE pending joiner between two decode slices (round-robin
+        across joiners), so in-flight rows' stall per slice is bounded
+        by the chunk budget. A chunk failure is the joiner's own fault:
+        its reservation is aborted and only its caller fails."""
+        if not pending:
+            return
+        ticket, pj = pending.popleft()
+        stalled_rows = session.active  # rows that wait on this chunk
+        t0 = time.monotonic()
+        committed = False
+        try:
+            with TRACER.attach(ticket.span), self._backend_lock:
+                if session.join_step(pj):
+                    session.join_commit(pj)
+                    committed = True
+        except BaseException as exc:  # noqa: BLE001
+            try:
+                with self._backend_lock:
+                    session.join_abort(pj)
+            except Exception:  # noqa: BLE001
+                pass
+            ticket.error = exc
+            ticket.event.set()
+            return
+        dt = time.monotonic() - t0
+        ticket.join_chunks += 1
+        _JOIN_CHUNKS_C.inc()
+        _JOIN_PREFILL_H.observe(dt)
+        if stalled_rows:
+            _DECODE_STALL_H.observe(dt)
+        if committed:
+            now = time.monotonic()
+            ticket.t_first = now  # first token sampled at commit
+            ticket.joined = True
+            live[id(ticket.request)] = ticket
+            _ROWS_JOINED_C.inc()
+        else:
+            pending.append((ticket, pj))  # round-robin: back of the line
 
     def _complete_row(
         self, live: Dict[int, _Ticket], result: GenerationResult, now: float
@@ -627,12 +793,24 @@ class ContinuousScheduler(_SchedulerBase):
             return
         self._finish_ticket(ticket, result, now)
 
-    def _admit_into(self, session, live: Dict[int, _Ticket], anchor) -> None:
-        """The JOIN phase: move queued compatible tickets into freed rows,
-        re-evaluating the budget-aware cap at each admission. Bounded by
-        the queue's snapshot size; a ticket that cannot join right now
-        (incompatible, cap, no free slot/pages) re-queues for the next
-        slice or its own session."""
+    def _admit_into(
+        self,
+        session,
+        live: Dict[int, _Ticket],
+        anchor,
+        pending: "deque",
+    ) -> None:
+        """The JOIN phase: move queued compatible tickets into freed
+        rows, re-evaluating the budget-aware cap at each admission
+        (pending chunked joiners count against it — they hold slots and
+        pages). With ``chunked_joins`` and a resumable backend, admission
+        only RESERVES (``join_begin``: slot + pages, no device compute);
+        the prefill then streams in one chunk per iteration via
+        :meth:`_progress_joins`. Otherwise the whole prompt prefills here
+        (synchronous ``join``). Bounded by the queue's snapshot size; a
+        ticket that cannot join right now (incompatible, cap, no free
+        slot/pages) re-queues for the next slice or its own session."""
+        chunked = self.chunked_joins and hasattr(session, "join_begin")
         for _ in range(self._queue.qsize()):
             try:
                 ticket = self._queue.get_nowait()
@@ -643,13 +821,19 @@ class ContinuousScheduler(_SchedulerBase):
                 return
             request = ticket.request
             admitted = False
+            pj = None
             if self._compatible(anchor, request):
                 cap = self._admission_cap(ticket)
-                if session.active < cap:
+                if session.active + len(pending) < cap:
                     try:
                         with TRACER.attach(ticket.span), self._backend_lock:
                             if session.can_join(request):
-                                session.join(request)
+                                if chunked:
+                                    pj = session.join_begin(
+                                        request, self.prefill_chunk_tokens
+                                    )
+                                else:
+                                    session.join(request)
                                 admitted = True
                     except BaseException as exc:  # noqa: BLE001
                         # the join's prefill failed: this request's own
@@ -659,13 +843,18 @@ class ContinuousScheduler(_SchedulerBase):
                         continue
             if admitted:
                 now = time.monotonic()
-                ticket.t_first = now
-                _QUEUE_WAIT_H.observe(now - ticket.t_submit)
+                ticket.queue_wait_s = now - ticket.t_submit
+                _QUEUE_WAIT_H.observe(ticket.queue_wait_s)
                 TRACER.add_span(
                     "queue", ticket.t_submit, now,
                     attrs={"joined": True}, parent=ticket.span,
                 )
-                live[id(request)] = ticket
-                _ROWS_JOINED_C.inc()
+                if chunked:
+                    pending.append((ticket, pj))
+                else:
+                    ticket.t_first = now
+                    ticket.joined = True
+                    live[id(request)] = ticket
+                    _ROWS_JOINED_C.inc()
             else:
                 self._requeue(ticket)

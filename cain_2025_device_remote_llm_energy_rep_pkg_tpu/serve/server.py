@@ -71,6 +71,8 @@ class GenerationServer:
         budget_aware: Optional[bool] = None,  # KV-budget admission
         access_log: bool = False,  # structured per-request log line
         scheduler: Optional[str] = None,  # None(auto)|window|continuous
+        slice_steps: Optional[int] = None,  # continuous: decode-slice width
+        prefill_chunk_tokens: Optional[int] = None,  # continuous: join chunk
     ) -> None:
         """``batch_window_ms > 0`` or an explicit ``scheduler`` enables
         batching: concurrent non-streaming generate requests coalesce
@@ -95,7 +97,15 @@ class GenerationServer:
         off — measurement runs stay quiet) emits one structured line per
         request: method, path, status, duration ms. Telemetry
         (``/metrics``, spans) is default-on with the obs kill switch
-        (``TPU_LLM_OBS=0`` / ``--no-telemetry``)."""
+        (``TPU_LLM_OBS=0`` / ``--no-telemetry``).
+
+        Continuous-only tuning (ignored under window dispatch):
+        ``slice_steps`` is the bounded decode-slice width (default: the
+        engine's DECODE_SLICE_STEPS, env ``DECODE_SLICE_STEPS``) and
+        ``prefill_chunk_tokens`` the token budget of ONE chunk of a
+        mid-flight joiner's prefill (default: the engine's auto, env
+        ``PREFILL_CHUNK_TOKENS``) — together they bound how long
+        in-flight rows stall per scheduler iteration."""
         self.backend = backend
         self.models = list(models) if models else []
         self.quiet = quiet
@@ -125,16 +135,24 @@ class GenerationServer:
             window_s = (
                 batch_window_ms if batch_window_ms > 0 else 50.0
             ) / 1e3
-            cls = (
-                ContinuousScheduler if mode == "continuous" else BatchScheduler
-            )
-            self._scheduler = cls(
-                backend,
-                max_batch=max_batch,
-                window_s=window_s,
-                lock=self._generate_lock,
-                budget_aware=budget_aware,
-            )
+            if mode == "continuous":
+                self._scheduler = ContinuousScheduler(
+                    backend,
+                    max_batch=max_batch,
+                    window_s=window_s,
+                    lock=self._generate_lock,
+                    budget_aware=budget_aware,
+                    slice_steps=slice_steps,
+                    prefill_chunk_tokens=prefill_chunk_tokens,
+                )
+            else:
+                self._scheduler = BatchScheduler(
+                    backend,
+                    max_batch=max_batch,
+                    window_s=window_s,
+                    lock=self._generate_lock,
+                    budget_aware=budget_aware,
+                )
             self.scheduler_mode = mode
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None

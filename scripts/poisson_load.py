@@ -8,7 +8,10 @@ thread storms the older tests used. This module is the one source of
 that workload shape:
 
 - :func:`build_workload` — deterministic (seeded) arrival offsets +
-  requests with mixed prompt/budget lengths;
+  requests with mixed prompt/budget lengths; optionally HEAVY-TAILED
+  (lognormal) prompt lengths — real prompt-length distributions are
+  long-tailed, and the tail is exactly what stresses mid-flight
+  admission (one long-prompt joiner vs everyone's inter-token latency);
 - :func:`run_load` — drive any ``submit(request) -> result`` callable
   (a scheduler's ``submit``, a client's ``generate``) with real-clock
   arrivals on threads, returning per-request latency records;
@@ -29,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import random
 import sys
@@ -51,6 +55,33 @@ DEFAULT_PROMPTS = (
 DEFAULT_BUDGETS = (8, 16, 48)
 
 
+def lognormal_prompt_tokens(
+    n: int,
+    median: float = 48.0,
+    sigma: float = 1.0,
+    max_tokens: int = 1024,
+    seed: int = 0,
+) -> List[int]:
+    """``n`` seeded HEAVY-TAILED prompt lengths, in tokens: lognormal
+    with the given median (= exp(mu)) and shape ``sigma``, clamped to
+    [1, max_tokens]. Deterministic for a (n, params, seed) tuple — the
+    same trace replays across A/B arms. The generator is independent of
+    the arrival-time stream (its own derived seed), so adding length
+    draws does not perturb previously-seeded arrival offsets."""
+    rng = random.Random((seed << 16) ^ 0x10C0)
+    mu = math.log(max(median, 1.0))
+    return [
+        max(1, min(int(max_tokens), int(round(rng.lognormvariate(mu, sigma)))))
+        for _ in range(n)
+    ]
+
+
+def synth_prompt(n_tokens: int) -> str:
+    """A prompt that byte-tokenizes to ``n_tokens`` ids (BOS + one id
+    per ASCII byte — models/tokenizer.ByteTokenizer)."""
+    return "p" * max(1, n_tokens - 1)
+
+
 def build_workload(
     n: int,
     mean_interarrival_s: float,
@@ -59,11 +90,42 @@ def build_workload(
     prompts: Sequence[str] = DEFAULT_PROMPTS,
     budgets: Sequence[int] = DEFAULT_BUDGETS,
     stop_at_eos: bool = True,
+    prompt_len_dist: Optional[str] = None,  # None/"fixed" | "lognormal"
+    prompt_len_median: float = 48.0,
+    prompt_len_sigma: float = 1.0,
+    prompt_len_max: int = 1024,
+    anchor_longest: bool = False,
 ) -> List[Tuple[float, GenerationRequest]]:
     """``[(arrival_offset_s, request), ...]`` — Poisson arrivals (seeded
     exponential inter-arrival; the first request arrives at t=0) over a
-    deterministic rotation of mixed prompt and budget lengths."""
+    deterministic rotation of mixed prompt and budget lengths.
+
+    ``prompt_len_dist="lognormal"`` replaces the prompt rotation with
+    per-request synthetic prompts whose TOKEN lengths draw from a seeded
+    heavy-tailed lognormal (:func:`lognormal_prompt_tokens`).
+    ``anchor_longest`` swaps the longest draw to request 0: the first
+    arrival anchors a continuous decode session and its prompt bucket
+    sizes the session's cache, so capacity-feasibility of later joins is
+    held constant while the JOIN policy under test varies."""
     rng = random.Random(seed)
+    prompt_list: Optional[List[str]] = None
+    if prompt_len_dist == "lognormal":
+        lens = lognormal_prompt_tokens(
+            n,
+            median=prompt_len_median,
+            sigma=prompt_len_sigma,
+            max_tokens=prompt_len_max,
+            seed=seed,
+        )
+        if anchor_longest and lens:
+            i_max = lens.index(max(lens))
+            lens[0], lens[i_max] = lens[i_max], lens[0]
+        prompt_list = [synth_prompt(t) for t in lens]
+    elif prompt_len_dist not in (None, "fixed"):
+        raise ValueError(
+            f"unknown prompt_len_dist {prompt_len_dist!r} "
+            "(expected None, 'fixed' or 'lognormal')"
+        )
     out: List[Tuple[float, GenerationRequest]] = []
     t = 0.0
     for i in range(n):
@@ -74,7 +136,11 @@ def build_workload(
                 t,
                 GenerationRequest(
                     model,
-                    prompts[i % len(prompts)],
+                    (
+                        prompt_list[i]
+                        if prompt_list is not None
+                        else prompts[i % len(prompts)]
+                    ),
                     max_new_tokens=budgets[i % len(budgets)],
                     seed=i,
                     stop_at_eos=stop_at_eos,
@@ -113,6 +179,8 @@ def run_load(
                 completion_s=t_done - t_submit,
                 ttft_s=sched.get("ttft_s"),
                 sched_completion_s=sched.get("completion_s"),
+                joined=sched.get("joined"),
+                join_chunks=sched.get("join_chunks"),
                 t_done=t_done - start,
             )
         records[i] = rec
@@ -176,6 +244,23 @@ def main() -> int:
         help="comma-separated max_new_tokens rotation",
     )
     ap.add_argument(
+        "--prompt-len-dist", choices=["fixed", "lognormal"], default="fixed",
+        help="prompt lengths: fixed rotation (default) or seeded "
+        "heavy-tailed lognormal synthetic prompts",
+    )
+    ap.add_argument(
+        "--prompt-len-median", type=float, default=48.0,
+        help="lognormal: median prompt length in tokens",
+    )
+    ap.add_argument(
+        "--prompt-len-sigma", type=float, default=1.0,
+        help="lognormal: shape (bigger = heavier tail)",
+    )
+    ap.add_argument(
+        "--prompt-len-max", type=int, default=1024,
+        help="lognormal: clamp for drawn lengths",
+    )
+    ap.add_argument(
         "--fake", action="store_true",
         help="drive an in-process fake-backend continuous scheduler "
         "instead of a live server (hermetic demo/CI)",
@@ -188,6 +273,12 @@ def main() -> int:
         seed=args.seed,
         model=args.model,
         budgets=budgets,
+        prompt_len_dist=(
+            None if args.prompt_len_dist == "fixed" else args.prompt_len_dist
+        ),
+        prompt_len_median=args.prompt_len_median,
+        prompt_len_sigma=args.prompt_len_sigma,
+        prompt_len_max=args.prompt_len_max,
     )
     if args.fake:
         from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (

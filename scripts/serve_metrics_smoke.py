@@ -1,6 +1,6 @@
 """CI smoke: fake-engine server end-to-end + /metrics scrape + span trace.
 
-Two phases, both over the deterministic fake backend:
+Three phases, all over the deterministic fake backend:
 
 1. WINDOW batching: one request through the full HTTP → scheduler →
    backend path, scrape ``GET /metrics``, assert the scheduler/HTTP
@@ -13,6 +13,14 @@ Two phases, both over the deterministic fake backend:
    counters (``llm_sched_rows_joined_total``,
    ``llm_sched_rows_retired_total``) and the in-flight gauge family
    moved — the observability surface of the admit/step/retire loop.
+3. CHUNKED JOIN-PREFILL: a LONG-PROMPT request joins a running session
+   and its prefill streams in as token-budgeted chunks interleaved with
+   the anchor's decode slices (``--prefill-chunk-tokens``); the scrape
+   asserts the chunk counters moved (``llm_sched_join_chunks_total`` by
+   several chunks, ``llm_sched_join_prefill_seconds`` per chunk,
+   ``llm_sched_decode_stall_seconds`` — the bounded stall the in-flight
+   anchor actually paid) and the joiner's wire result attributes its
+   TTFT across the chunks (``extras.sched.join_chunks``).
 
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json]``
 Exit 0 on success; prints one JSON status line either way.
@@ -154,6 +162,66 @@ def main() -> int:
     finally:
         server2.stop()
 
+    # -- phase 3: chunked join-prefill of a long-prompt joiner -----------------
+    # The anchor decodes 128 tokens (~0.32 s of slices at 400 tok/s); a
+    # ~300-token-prompt request arrives mid-flight and must join in
+    # MULTIPLE 64-token prefill chunks, each interleaved between decode
+    # slices. Counters are process-global and monotonic, so phase-3
+    # assertions are on DELTAS over the pre-phase scrape.
+    chunks_before = _metric_value(text2, "llm_sched_join_chunks_total")
+    joined_before = _metric_value(text2, "llm_sched_rows_joined_total")
+    server3 = GenerationServer(
+        FakeBackend(tokens_per_s=400.0, simulate_delay=True),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+        prefill_chunk_tokens=64,
+    )
+    server3.start()
+    try:
+        base3 = f"http://127.0.0.1:{server3.port}"
+        bodies = {}
+
+        def client3(name, prompt, num_predict, delay_s):
+            time.sleep(delay_s)
+            bodies[name] = _post_generate(base3, prompt, num_predict)
+
+        threads = [
+            threading.Thread(target=client3, args=("anchor", "anchor", 128, 0.0)),
+            threading.Thread(
+                target=client3, args=("long-join", "j" * 300, 8, 0.05)
+            ),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert set(bodies) == {"anchor", "long-join"}, bodies
+        assert all(b.get("done") for b in bodies.values()), bodies
+        sched3 = (bodies["long-join"].get("x_extras") or {}).get("sched", {})
+
+        text3 = _scrape(base3)
+        join_chunks = (
+            _metric_value(text3, "llm_sched_join_chunks_total") - chunks_before
+        )
+        joined3 = (
+            _metric_value(text3, "llm_sched_rows_joined_total") - joined_before
+        )
+        # 301 prompt tokens at a 64-token chunk budget = 5 chunks
+        assert joined3 >= 1, f"expected a mid-flight join, saw {joined3}"
+        assert join_chunks >= 3, (
+            f"expected a multi-chunk join prefill, saw {join_chunks} chunks"
+        )
+        assert "llm_sched_join_prefill_seconds" in text3
+        assert "llm_sched_decode_stall_seconds" in text3
+        # TTFT attribution across chunks rides the wire per request
+        assert sched3.get("joined") is True, sched3
+        assert sched3.get("join_chunks", 0) >= 3, sched3
+        assert sched3.get("ttft_s", 0) > 0, sched3
+    finally:
+        server3.stop()
+
     print(
         json.dumps(
             {
@@ -167,6 +235,10 @@ def main() -> int:
                 "continuous": {
                     "rows_joined": joined,
                     "rows_retired": retired,
+                },
+                "chunked_join": {
+                    "rows_joined": joined3,
+                    "join_chunks": join_chunks,
                 },
             }
         )

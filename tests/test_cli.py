@@ -178,6 +178,52 @@ def test_serve_scheduler_and_window_flags(monkeypatch):
         serve_command(["--scheduler", "bogus"])
 
 
+def test_serve_slice_and_chunk_knobs(monkeypatch):
+    """--decode-slice-steps / --prefill-chunk-tokens reach the server
+    (ISSUE 4: DECODE_SLICE_STEPS stops being env-only); zero means
+    'auto' and negatives fail fast."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    cli.serve_command(
+        [
+            "--backend", "fake", "--port", "0",
+            "--scheduler", "continuous",
+            "--decode-slice-steps", "4",
+            "--prefill-chunk-tokens", "128",
+        ]
+    )
+    assert captured["slice_steps"] == 4
+    assert captured["prefill_chunk_tokens"] == 128
+
+    captured.clear()
+    cli.serve_command(
+        ["--backend", "fake", "--port", "0", "--decode-slice-steps", "0"]
+    )
+    assert captured["slice_steps"] is None  # 0 = auto (engine default)
+    assert captured["prefill_chunk_tokens"] is None
+
+    with pytest.raises(CommandError, match="decode-slice-steps"):
+        serve_command(["--decode-slice-steps", "-2"])
+    with pytest.raises(CommandError, match="prefill-chunk-tokens"):
+        serve_command(["--prefill-chunk-tokens", "-8"])
+
+
 def test_prepare_cooldown_promise_matches_consumed_channels(monkeypatch, capsys):
     """prepare's policy line must reflect the channels the study's
     profilers actually WIRE (code-review round-4): a live battery/hwmon

@@ -536,6 +536,201 @@ def test_continuous_scheduler_with_jax_engine_matches_solo():
         sched.stop()
 
 
+def test_server_plumbs_slice_and_chunk_knobs():
+    """GenerationServer hands --decode-slice-steps / --prefill-chunk-
+    tokens through to the continuous scheduler (and the engine default
+    applies when unset)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        DECODE_SLICE_STEPS,
+    )
+
+    srv = GenerationServer(
+        FakeBackend(), host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous", slice_steps=5, prefill_chunk_tokens=64,
+    )
+    assert srv._scheduler.slice_steps == 5
+    assert srv._scheduler.prefill_chunk_tokens == 64
+    srv.stop()
+
+    srv2 = GenerationServer(
+        FakeBackend(), host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous",
+    )
+    assert srv2._scheduler.slice_steps == DECODE_SLICE_STEPS
+    assert srv2._scheduler.prefill_chunk_tokens is None  # backend auto
+    srv2.stop()
+
+
+def test_continuous_chunked_join_progresses_round_robin():
+    """A long-prompt joiner is admitted in MULTIPLE token-budgeted
+    prefill chunks interleaved with the anchor's decode slices — its
+    result carries the chunk count, and both callers complete."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    sched = ContinuousScheduler(
+        FakeBackend(tokens_per_s=400.0, simulate_delay=True),
+        slice_steps=8,
+        prefill_chunk_tokens=32,
+    )
+    assert sched.chunked_joins
+    sched.start()
+    try:
+        results = {}
+
+        def go(name, req):
+            results[name] = sched.submit(req)
+
+        anchor = GenerationRequest("m", "anchor", max_new_tokens=96)
+        joiner = GenerationRequest("m", "J" * 200, max_new_tokens=8)
+        t_a = threading.Thread(target=go, args=("anchor", anchor))
+        t_a.start()
+        time.sleep(0.05)  # the anchor session is mid-decode
+        t_j = threading.Thread(target=go, args=("joiner", joiner))
+        t_j.start()
+        t_a.join(timeout=15)
+        t_j.join(timeout=15)
+        assert set(results) == {"anchor", "joiner"}
+        sched_extras = results["joiner"].extras["sched"]
+        assert sched_extras["joined"] is True
+        # 201 prompt tokens at a 32-token budget: several chunks, each
+        # run between decode slices
+        assert sched_extras["join_chunks"] >= 3
+        assert "joined" not in results["anchor"].extras["sched"]
+    finally:
+        sched.stop()
+
+
+def test_continuous_sync_join_mode_still_available():
+    """chunked_joins=False restores the one-shot join (the ISSUE-3
+    baseline the chunked_join bench A/Bs against): joins still work,
+    with no chunk accounting."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    sched = ContinuousScheduler(
+        FakeBackend(tokens_per_s=400.0, simulate_delay=True),
+        slice_steps=8,
+        chunked_joins=False,
+    )
+    sched.start()
+    try:
+        results = {}
+
+        def go(name, req):
+            results[name] = sched.submit(req)
+
+        t_a = threading.Thread(
+            target=go,
+            args=("anchor", GenerationRequest("m", "a", max_new_tokens=64)),
+        )
+        t_a.start()
+        time.sleep(0.05)
+        t_j = threading.Thread(
+            target=go,
+            args=("joiner", GenerationRequest("m", "J" * 200, max_new_tokens=8)),
+        )
+        t_j.start()
+        t_a.join(timeout=15)
+        t_j.join(timeout=15)
+        sched_extras = results["joiner"].extras["sched"]
+        assert sched_extras["joined"] is True
+        assert sched_extras["join_chunks"] == 0  # one-shot, no chunks
+    finally:
+        sched.stop()
+
+
+def test_continuous_chunked_join_with_jax_engine_matches_solo():
+    """End-to-end chunked-join parity on the REAL engine through the
+    scheduler: a long-prompt joiner whose prefill streams in across
+    slices, and the anchor decoding through it, both match solo."""
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    engine = JaxEngine(registry=dict(registry), dtype=jnp.float32)
+    anchor = GenerationRequest(
+        "tiny", "a" * 120, max_new_tokens=48, stop_at_eos=False, seed=1
+    )
+    joiner = GenerationRequest("tiny", "j" * 100, max_new_tokens=8, seed=3)
+    solo = {id(r): engine.generate(r).tokens for r in (anchor, joiner)}
+    sched = ContinuousScheduler(
+        engine, slice_steps=4, prefill_chunk_tokens=32
+    )
+    sched.start()
+    try:
+        results = {}
+
+        def go(req):
+            results[id(req)] = sched.submit(req)
+
+        t_a = threading.Thread(target=go, args=(anchor,))
+        t_a.start()
+        time.sleep(0.2)  # anchor mid-decode (tiny CPU steps are ~ms)
+        t_j = threading.Thread(target=go, args=(joiner,))
+        t_j.start()
+        t_a.join(timeout=60)
+        t_j.join(timeout=60)
+        assert results[id(anchor)].tokens == solo[id(anchor)]
+        assert results[id(joiner)].tokens == solo[id(joiner)]
+        j_extras = results[id(joiner)].extras["sched"]
+        if j_extras.get("joined"):  # arrival raced the anchor's drain
+            assert j_extras["join_chunks"] >= 3
+    finally:
+        sched.stop()
+
+
+def test_window_ttft_fallback_excludes_queue_wait():
+    """The window-path TTFT estimate no longer folds queue wait in
+    (ISSUE-4 satellite): a request queued behind another model's long
+    batch reports a TTFT near its own prefill, not its queue wait —
+    comparable with the continuous histogram; the wait itself stays on
+    llm_sched_queue_wait_seconds."""
+    sched = BatchScheduler(
+        FakeBackend(tokens_per_s=100.0, simulate_delay=True), window_s=0.02
+    )
+    sched.start()
+    try:
+        results = {}
+
+        def go(name, req):
+            results[name] = sched.submit(req)
+
+        # ~0.64 s batch the second request must queue behind (different
+        # model → its own later batch)
+        t_a = threading.Thread(
+            target=go,
+            args=("first", GenerationRequest("m1", "x", max_new_tokens=64)),
+        )
+        t_a.start()
+        time.sleep(0.05)
+        t_b = threading.Thread(
+            target=go,
+            args=("second", GenerationRequest("m2", "y", max_new_tokens=8)),
+        )
+        t_b.start()
+        t_a.join(timeout=15)
+        t_b.join(timeout=15)
+        sched_extras = results["second"].extras["sched"]
+        # completion includes ~0.6 s of queue wait; the TTFT estimate
+        # must not
+        assert sched_extras["completion_s"] > 0.4
+        assert sched_extras["ttft_s"] < 0.3
+    finally:
+        sched.stop()
+
+
 def test_max_batch_default_is_backend_aware():
     """32 for backends with a real batched decode; 8 for backends on the
     base class's sequential generate_batch loop, where wider admission

@@ -237,6 +237,131 @@ def test_stepped_close_frees_pages(registry):
         sess.step()
 
 
+def _layout_engine(registry, paged, kv):
+    return JaxEngine(
+        registry=dict(registry),
+        dtype=jnp.float32,
+        paged_kv=paged,
+        kv_quantize=kv,
+    )
+
+
+@pytest.mark.parametrize(
+    "paged,kv",
+    [(False, None), (False, "int8"), (True, None), (True, "int8")],
+    ids=["contig-bf16", "contig-int8", "paged-bf16", "paged-int8"],
+)
+def test_chunked_join_parity_all_layouts(registry, paged, kv):
+    """The ISSUE-4 tentpole invariant: a joiner whose prefill streams in
+    as MULTIPLE token-budgeted chunks — interleaved with decode slices
+    the companion keeps generating through — produces a stream
+    bit-identical to its solo generate(), and so does the companion that
+    decoded across the whole chunked join. All four cache layouts."""
+    eng = _layout_engine(registry, paged, kv)
+    anchor = GenerationRequest(
+        "tiny", "a" * 120, max_new_tokens=40, stop_at_eos=False, seed=1
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    sess.step(4)  # the anchor is mid-flight
+    joiner = GenerationRequest(
+        "tiny", "j" * 100, max_new_tokens=12, seed=3
+    )
+    assert sess.can_join(joiner)
+    pj = sess.join_begin(joiner, chunk_tokens=32)
+    assert pj.total_chunks >= 3  # 101 prompt ids at 32-token chunks
+    assert sess.free_slots == sess.b_bucket - 2  # slot reserved
+    done = False
+    while not done:
+        done = sess.join_step(pj)
+        if not done:
+            # the companion keeps decoding BETWEEN prefill chunks —
+            # exactly the scheduler's interleave
+            sess.step(2)
+    assert sess.active == 1  # joiner not live until commit
+    sess.join_commit(pj)
+    assert sess.active == 2
+    results = {id(r.request): r for r in _drain(sess)}
+    assert results[id(anchor)].tokens == eng.generate(anchor).tokens
+    assert results[id(joiner)].tokens == eng.generate(joiner).tokens
+
+
+def test_chunked_join_single_chunk_matches_sync_join(engine):
+    """A short-prompt joiner through the chunked protocol is the
+    one-shot join (the sync path is implemented over it)."""
+    anchor = GenerationRequest(
+        "tiny", "anchor stays", max_new_tokens=32, stop_at_eos=False
+    )
+    sess = engine.decode_open([anchor], reserve_rows=4)
+    sess.step(4)
+    joiner = GenerationRequest("tiny", "quick", max_new_tokens=8, seed=5)
+    pj = sess.join_begin(joiner)
+    assert pj.total_chunks == 1
+    assert sess.join_step(pj)
+    sess.join_commit(pj)
+    results = {id(r.request): r for r in _drain(sess)}
+    assert results[id(joiner)].tokens == engine.generate(joiner).tokens
+
+
+def test_join_abort_releases_slot_and_pages(registry):
+    paged = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, paged_kv=True
+    )
+    anchor = GenerationRequest(
+        "tiny", "anchor", max_new_tokens=40, stop_at_eos=False
+    )
+    sess = paged.decode_open([anchor], reserve_rows=4)
+    free0 = sess.pool.free_pages
+    slots0 = sess.free_slots
+    pj = sess.join_begin(
+        GenerationRequest("tiny", "j" * 80, max_new_tokens=8), chunk_tokens=32
+    )
+    assert sess.pool.free_pages < free0  # pages reserved at begin
+    assert sess.free_slots == slots0 - 1
+    sess.join_step(pj)
+    sess.join_abort(pj)
+    assert sess.pool.free_pages == free0
+    assert sess.free_slots == slots0
+    sess.close()
+
+
+def test_can_join_rejects_prompt_over_session_bucket(engine):
+    """A prompt whose bucketed alloc + generation bucket exceeds the
+    session's cache must be refused BEFORE any prefill is paid (it would
+    overflow the contiguous row cache)."""
+    sess = engine.decode_open(
+        [GenerationRequest("tiny", "tiny anchor", max_new_tokens=16)],
+        reserve_rows=4,
+    )
+    # session cache: prompt bucket 32 + gen bucket 16 = 48 slots
+    assert sess.cache_len == 48
+    long_prompt = GenerationRequest("tiny", "x" * 100, max_new_tokens=8)
+    assert not sess.can_join(long_prompt)
+    with pytest.raises(RuntimeError, match="cannot join"):
+        sess.join_begin(long_prompt)
+    _drain(sess)
+
+
+def test_can_join_rejects_when_pool_drained(registry):
+    """Paged admission probe: a joiner whose pages don't fit the pool's
+    free list right now is deferred, not failed."""
+    paged = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, paged_kv=True
+    )
+    sess = paged.decode_open(
+        [GenerationRequest(
+            "tiny", "anchor", max_new_tokens=24, stop_at_eos=False
+        )],
+        reserve_rows=4,
+    )
+    joiner = GenerationRequest("tiny", "late", max_new_tokens=8)
+    assert sess.can_join(joiner)
+    hog = sess.pool.alloc(sess.pool.free_pages)  # drain the free list
+    assert not sess.can_join(joiner)
+    sess.pool.free(hog)
+    assert sess.can_join(joiner)
+    sess.close()
+
+
 def test_stepped_validates_inputs(engine):
     with pytest.raises(ValueError, match="one model"):
         engine.decode_open(
