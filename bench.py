@@ -31,6 +31,31 @@ BATCH_TIMED_RUNS = 2
 BATCH_STAT = "best"  # max over the timed windows (relay sessions land low)
 
 
+def _attach_obs(line: dict) -> None:
+    """Attach the obs registry snapshot (`obs_metrics`) and the flight-
+    recorder summary (`obs_flight`: event counts by type + drop count)
+    to a bench JSON line — EVERY bench entry carries both, so a
+    BENCH_*.json row records not just the figures but the scheduler/
+    engine decisions (slices, joins, retirements, fallbacks) behind
+    them. Guarded: the perf line must never die on telemetry."""
+    try:
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.flight import (
+            FLIGHT,
+        )
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+            REGISTRY,
+        )
+
+        snap = REGISTRY.snapshot()
+        if snap:
+            line["obs_metrics"] = snap
+        flight = FLIGHT.summary()
+        if flight.get("events_total"):
+            line["obs_flight"] = flight
+    except Exception:
+        pass
+
+
 def continuous_batching_bench() -> int:
     """A/B of the two request schedulers under STAGGERED (Poisson)
     arrivals: window dispatch (batches run to completion) vs the
@@ -145,6 +170,7 @@ def continuous_batching_bench() -> int:
             else None
         ),
     }
+    _attach_obs(line)
     print(json.dumps(line))
     return 0
 
@@ -313,6 +339,7 @@ def chunked_join_bench() -> int:
             else None
         ),
     }
+    _attach_obs(line)
     print(json.dumps(line))
     return 0
 
@@ -566,22 +593,13 @@ def main() -> int:
                 batch_tokens_per_s / BASELINE_TOKENS_PER_S, 3
             ),
         )
-    # Metrics-registry snapshot (obs): the engines above recorded their
-    # prefill/decode windows, step counts per attention path, pool
-    # occupancy and modelled J/token into the shared registry as they
-    # ran — attach it so BENCH_*.json rows carry the distributions, not
-    # just the aggregate figures. Guarded like the energy extra: the
-    # perf line must never die on telemetry.
-    try:
-        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
-            REGISTRY as _obs_registry,
-        )
-
-        snap = _obs_registry.snapshot()
-        if snap:
-            line["obs_metrics"] = snap
-    except Exception:
-        pass
+    # Obs attachments: the engines above recorded their prefill/decode
+    # windows, step counts per attention path, pool occupancy and
+    # modelled J/token into the shared registry — and their decisions
+    # into the flight recorder — as they ran; attach both so
+    # BENCH_*.json rows carry the distributions and the event counts,
+    # not just the aggregate figures.
+    _attach_obs(line)
     print(json.dumps(line))
     return 0
 

@@ -23,6 +23,7 @@ import hashlib
 import time
 from typing import Dict, List, Optional
 
+from ..obs.detect import observe_retired_tokens, observe_slice_tokens
 from .backend import GenerationBackend, GenerationRequest, GenerationResult
 
 
@@ -111,6 +112,34 @@ class _FakeStepSession:
     def pending_joins(self) -> int:
         return len(self._pending)
 
+    def debug_state(self) -> dict:
+        """JSON-able session snapshot — the fake twin of
+        ``SteppedDecodeSession.debug_state`` so ``GET /debug/state`` is
+        testable hermetically."""
+        return {
+            "model": self.model,
+            "closed": self.closed,
+            "paged": False,
+            "b_bucket": self.max_rows,
+            "active": self.active,
+            "free_slots": self.max_rows - len(self._rows) - len(self._pending),
+            "pending_joins": len(self._pending),
+            "rows": [
+                {
+                    "slot": i,
+                    "prompt_tokens": row["result"].prompt_tokens,
+                    "generated_tokens": min(
+                        row["cursor"], row["result"].generated_tokens
+                    ),
+                    "budget": row["result"].generated_tokens,
+                }
+                for i, row in enumerate(self._rows)
+            ],
+            "pending": [
+                {"tokens_left": pj["tokens_left"]} for pj in self._pending
+            ],
+        }
+
     def step(self, max_steps: int = 16) -> List[GenerationResult]:
         if self.closed:
             raise RuntimeError("session is closed")
@@ -131,6 +160,12 @@ class _FakeStepSession:
                 retired.append(res)
             else:
                 keep.append(row)
+        # goodput accounting, same convention as the real stepped path
+        # (obs/detect.py): every row steps the whole slice; completed
+        # rows credit their generated tokens
+        observe_slice_tokens(max_steps, len(self._rows))
+        for res in retired:
+            observe_retired_tokens(res.generated_tokens)
         self._rows = keep
         return retired
 

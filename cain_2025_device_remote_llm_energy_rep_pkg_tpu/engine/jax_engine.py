@@ -1463,7 +1463,8 @@ class JaxEngine(GenerationBackend):
         self, t1: float, t2: float, tokens: int, steps: int, rows: int = 1
     ) -> None:
         """One decode window into the registry + a span (parented under
-        the serving request's root when the scheduler attached one)."""
+        the serving request's root when the scheduler attached one) + a
+        flight-recorder event linking back to the request's span tree."""
         labels = self._obs_labels()
         _DECODE_H.observe(t2 - t1)
         _TOKENS_C.labels(**labels).inc(tokens)
@@ -1473,6 +1474,17 @@ class JaxEngine(GenerationBackend):
         _TRACER.add_span(
             "decode", t1, t2,
             attrs={"tokens": tokens, "rows": rows, **labels},
+        )
+        from ..obs.flight import EV_DECODE_WINDOW, FLIGHT, trace_of
+
+        FLIGHT.emit(
+            EV_DECODE_WINDOW,
+            trace=trace_of(_TRACER.current()),
+            tokens=tokens,
+            steps=steps,
+            rows=rows,
+            dur_s=round(t2 - t1, 6),
+            **labels,
         )
 
     def _observe_result(self, result: GenerationResult, st: Dict[str, Any], t2: float) -> None:

@@ -30,6 +30,7 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.flight import EV_POOL_EXHAUSTED, FLIGHT
 from ..obs.metrics import REGISTRY, enabled as _obs_enabled
 
 DEFAULT_PAGE_SIZE = 128
@@ -58,21 +59,27 @@ _POOL_EXHAUSTED = REGISTRY.counter(
 )
 
 
+def _fragmentation(free: List[int]) -> float:
+    """1 - (largest contiguous free run / free pages); 0 when free space
+    is one run or the pool is full. ONE definition — the gauges and the
+    /debug/state snapshot must agree."""
+    if not free:
+        return 0.0
+    ordered = sorted(free)
+    longest = run = 1
+    for a, b in zip(ordered, ordered[1:]):
+        run = run + 1 if b == a + 1 else 1
+        longest = max(longest, run)
+    return 1.0 - longest / len(free)
+
+
 def _publish_pool_gauges(free: List[int], total: int) -> None:
     if not _obs_enabled():
         return
     _POOL_PAGES.set(total)
     _POOL_FREE.set(len(free))
     _POOL_OCCUPANCY.set(1.0 - len(free) / total if total else 0.0)
-    if not free:
-        _POOL_FRAGMENTATION.set(0.0)
-        return
-    ordered = sorted(free)
-    longest = run = 1
-    for a, b in zip(ordered, ordered[1:]):
-        run = run + 1 if b == a + 1 else 1
-        longest = max(longest, run)
-    _POOL_FRAGMENTATION.set(1.0 - longest / len(free))
+    _POOL_FRAGMENTATION.set(_fragmentation(free))
 
 
 def _codes(leaf):
@@ -153,9 +160,30 @@ class PagePool:
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 
+    def debug_state(self) -> dict:
+        """JSON-able pool snapshot for ``GET /debug/state`` (same
+        definitions as the gauges — see :func:`_fragmentation`)."""
+        total = self.n_pages
+        return {
+            "pages": total,
+            "free_pages": len(self._free),
+            "page_size": self.page_size,
+            "quantized": self.quantized,
+            "occupancy": round(
+                1.0 - len(self._free) / total if total else 0.0, 4
+            ),
+            "fragmentation": round(_fragmentation(self._free), 4),
+        }
+
     def alloc(self, n_pages: int) -> List[int]:
         if n_pages > len(self._free):
             _POOL_EXHAUSTED.inc()
+            FLIGHT.emit(
+                EV_POOL_EXHAUSTED,
+                needed=n_pages,
+                free=len(self._free),
+                total=self.n_pages,
+            )
             raise PagePoolExhausted(
                 f"need {n_pages} pages, {len(self._free)} free of "
                 f"{self.n_pages} — evict a finished request or grow the pool"

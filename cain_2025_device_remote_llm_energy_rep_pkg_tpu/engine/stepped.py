@@ -58,6 +58,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from ..obs.detect import observe_retired_tokens, observe_slice_tokens
 from ..obs.metrics import enabled as _obs_enabled
 from .backend import GenerationRequest, GenerationResult
 
@@ -465,6 +466,50 @@ class SteppedDecodeSession:
     def pending_joins(self) -> int:
         return len(self._pending)
 
+    def debug_state(self) -> Dict[str, Any]:
+        """Live JSON-able snapshot for ``GET /debug/state``: per-slot row
+        state (ages, token counts, budgets, page holdings), pending
+        joiners' chunk progress, and (paged) pool occupancy. Read-only
+        and lock-free — a racing slice costs a stale field, nothing
+        more."""
+        now = time.monotonic()
+        state: Dict[str, Any] = {
+            "model": self.model,
+            "closed": self.closed,
+            "paged": self.paged,
+            "b_bucket": len(self.rows),
+            "slice_steps": self.slice_bucket,
+            "active": self.active,
+            "free_slots": self.free_slots,
+            "pending_joins": self.pending_joins,
+            "rows": [
+                {
+                    "slot": r,
+                    "prompt_tokens": row.s_real,
+                    "generated_tokens": len(row.generated),
+                    "budget": row.budget,
+                    "age_s": round(now - row.t0, 4),
+                    "pages": len(row.pages),
+                }
+                for r, row in enumerate(self.rows)
+                if row is not None
+            ],
+            "pending": [
+                {
+                    "slot": pj.slot,
+                    "prompt_tokens": len(pj.ids),
+                    "chunks_done": pj.next_chunk,
+                    "total_chunks": pj.total_chunks,
+                    "age_s": round(now - pj.t0, 4),
+                    "pages": len(pj.pages),
+                }
+                for pj in self._pending.values()
+            ],
+        }
+        if self.paged:
+            state["pool"] = self.pool.debug_state()
+        return state
+
     # -- stepping -------------------------------------------------------------
     def step(self, max_steps: Optional[int] = None) -> List[GenerationResult]:
         """Run one bounded decode slice; returns the results of every row
@@ -554,6 +599,12 @@ class SteppedDecodeSession:
                 self.rows[r].generated.extend(out_host[r][:cnt])
             if done_host[r]:
                 retired.append(self._retire(r, t2))
+        # Goodput accounting (obs/detect.py): the compiled slice steps
+        # EVERY bucket row — live, finished-mid-slice, and padding rows
+        # alike — so the device executed ~slice_steps × b_bucket row-
+        # steps while only the live rows' sampled tokens were useful.
+        # Completed rows credit the numerator at retirement (_retire).
+        observe_slice_tokens(slice_steps, len(self.rows))
         if _obs_enabled() and slice_tokens:
             try:
                 eng._observe_decode_window(
@@ -589,6 +640,11 @@ class SteppedDecodeSession:
             total_s=t2 - row.t0,
             extras={"retire_reason": reason, "stepped": True},
         )
+        # the row COMPLETED (eos/budget): its DECODE-LOOP tokens were
+        # useful device work — the goodput numerator (the first token
+        # came from prefill, outside the stepped denominator; rows
+        # abandoned at close() never credit — wasted by definition)
+        observe_retired_tokens(max(0, len(row.generated) - 1))
         if self.paged:
             # park the slot's table row FIRST: the dead row's frozen
             # write slot (legacy mode) must stop aliasing pages we are

@@ -755,6 +755,30 @@ class LlmEnergyConfig(ExperimentConfig):
             )
         except OSError:
             pass
+        # Streaming per-cell CV (obs/detect.py): fold this run's modelled
+        # J and wall time into the (model, length, location) cell's
+        # Welford tracker, so ROADMAP #1's <=5% CV target is observable
+        # MID-STUDY (llm_run_cell_cv gauges; a breaching cell fires an
+        # anomaly flight event) instead of post-hoc. Telemetry only —
+        # must never fail a run.
+        try:
+            from ..obs.detect import CELL_CV
+            from ..obs.energy import estimate_from_stats
+
+            location = context.factor("location")
+            est = estimate_from_stats(
+                context.scratch.get("generation_stats") or {},
+                n_chips=self._n_chips_by_location.get(location, 1),
+            )
+            CELL_CV.observe_run(
+                model=context.factor("model"),
+                length=context.factor("length"),
+                location=location,
+                energy_J=est["J"] if est else None,
+                wall_s=result.total_s,
+            )
+        except Exception:  # noqa: BLE001
+            pass
         return {
             "topic": context.scratch["topic"],
             "backend": self.describe_backend(context.factor("location")),

@@ -17,6 +17,14 @@ and default-on with a shared kill switch (env ``TPU_LLM_OBS=0`` or
   documented coefficient box) folded into live per-request J and
   J/token estimates, surfaced in ``/metrics`` and in each result's
   ``extras["energy_model"]``.
+- :mod:`.flight` — a bounded ring of schema'd structured events (the
+  decisions the scheduler/engine actually made: admissions, join
+  chunks, slice boundaries, retirements, fallbacks, pool exhaustion),
+  served at ``GET /debug/flight`` with crash dumps on batch/session
+  failure. One process-wide ``FLIGHT``.
+- :mod:`.detect` — streaming anomaly detection (per-cell run CV against
+  ROADMAP #1's <=5% target, rolling-median step-time spikes) and
+  goodput accounting for the stepped decode path.
 
 Instrumented layers: ``serve/server.py`` (HTTP timings, request root
 spans, ``/metrics``), ``serve/scheduler.py`` (queue wait, window
@@ -25,6 +33,7 @@ collect, admission caps, batch composition), ``engine/jax_engine.py``
 attribution), ``engine/paged_kv.py`` (pool occupancy / fragmentation).
 """
 
+from .flight import FLIGHT, FlightRecorder
 from .metrics import REGISTRY, MetricsRegistry, disable, enable, enabled
 from .trace import TRACER, Span, SpanTracer
 
@@ -34,6 +43,8 @@ __all__ = [
     "TRACER",
     "Span",
     "SpanTracer",
+    "FLIGHT",
+    "FlightRecorder",
     "enabled",
     "enable",
     "disable",

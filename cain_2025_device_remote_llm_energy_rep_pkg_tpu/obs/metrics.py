@@ -219,25 +219,47 @@ class MetricsRegistry:
 
     # -- export ---------------------------------------------------------------
     @staticmethod
-    def _label_str(names: Tuple[str, ...], values: Tuple[str, ...], extra: str = "") -> str:
-        pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+    def _escape_label(value: str) -> str:
+        """Label-value escaping per the text-format spec: backslash,
+        double-quote and newline (in that order — escaping the escape
+        character first keeps the result unambiguous)."""
+        return (
+            value.replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    @classmethod
+    def _label_str(cls, names: Tuple[str, ...], values: Tuple[str, ...], extra: str = "") -> str:
+        pairs = [
+            f'{n}="{cls._escape_label(v)}"' for n, v in zip(names, values)
+        ]
         if extra:
             pairs.append(extra)
         return "{" + ",".join(pairs) + "}" if pairs else ""
 
     def exposition(self) -> str:
-        """Prometheus text format v0.0.4. Empty string when disabled."""
+        """Prometheus text format v0.0.4. Empty string when disabled.
+
+        Format guarantees (pinned by the golden-output test): families
+        sorted by name, children in STABLE sorted label order (not the
+        racy first-touch insertion order), label values escaped per the
+        spec, HELP text with backslash/newline escaped, histogram
+        buckets cumulative ending in ``+Inf`` == ``_count``.
+        """
         if not _enabled:
             return ""
         lines = []
         with self._lock:
             families = list(self._families.values())
         for fam in sorted(families, key=lambda f: f.name):
-            children = list(fam._children.items())
+            with fam._lock:
+                children = sorted(fam._children.items())
             if not children:
                 continue
             if fam.help:
-                lines.append(f"# HELP {fam.name} {fam.help}")
+                help_ = fam.help.replace("\\", "\\\\").replace("\n", "\\n")
+                lines.append(f"# HELP {fam.name} {help_}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
             for values, child in children:
                 ls = self._label_str(fam.label_names, values)

@@ -177,8 +177,10 @@ commands:
                        row's padding),
                        --access-log (structured per-request log line:
                        method/path/status/duration; default off),
-                       --no-telemetry (kill switch for /metrics, spans
-                       and per-request energy attribution — default on;
+                       --no-telemetry (kill switch for /metrics, the
+                       /debug/state + /debug/flight introspection
+                       endpoints, spans, the flight recorder and
+                       per-request energy attribution — default on;
                        env twin: TPU_LLM_OBS=0)
   help                 show this message
 """

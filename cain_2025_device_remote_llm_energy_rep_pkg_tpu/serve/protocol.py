@@ -35,6 +35,9 @@ VERSION_PATH = "/api/version"
 LOAD_PATH = "/api/load"  # extension: explicit weight-load outside the window
 HEALTH_PATH = "/healthz"
 METRICS_PATH = "/metrics"  # Prometheus text exposition (obs; 404 when off)
+# Debug introspection (obs; both 404 when telemetry is off):
+DEBUG_STATE_PATH = "/debug/state"  # live scheduler/session/pool snapshot
+DEBUG_FLIGHT_PATH = "/debug/flight"  # flight-recorder events (?n=, ?type=)
 
 SERVER_VERSION = "0.1.0"
 

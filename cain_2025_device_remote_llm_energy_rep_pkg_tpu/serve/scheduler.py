@@ -50,7 +50,17 @@ from ..engine.backend import (
     GenerationRequest,
     GenerationResult,
 )
-from ..obs.metrics import REGISTRY, ROW_BUCKETS
+from ..obs.detect import SLICE_SPIKES
+from ..obs.flight import (
+    EV_BATCH_FALLBACK,
+    EV_JOIN_CHUNK,
+    EV_REQUEST_ADMITTED,
+    EV_ROW_RETIRED,
+    EV_SLICE,
+    FLIGHT,
+    trace_of,
+)
+from ..obs.metrics import REGISTRY, ROW_BUCKETS, enabled as _obs_enabled
 from ..obs.trace import TRACER
 
 # Admission/queue telemetry (obs): the scheduler is where a request's
@@ -307,6 +317,21 @@ class _SchedulerBase:
         assert ticket.result is not None
         return ticket.result
 
+    # -- introspection --------------------------------------------------------
+    def debug_state(self) -> Dict[str, object]:
+        """Live snapshot for ``GET /debug/state``: what the scheduler is
+        doing RIGHT NOW. Best-effort — it races the worker loop by
+        design (forensic reads must not take the dispatch locks) — and
+        every field is plain data, safe to JSON-serialise."""
+        return {
+            "mode": "window",
+            "running": self._running,
+            "queue_depth": self._queue.qsize(),
+            "max_batch": self.max_batch,
+            "budget_aware": self.budget_aware,
+            "window_s": self.window_s,
+        }
+
     # -- shared dispatch helpers ----------------------------------------------
     @staticmethod
     def _compatible(a: GenerationRequest, b: GenerationRequest) -> bool:
@@ -408,6 +433,12 @@ class _SchedulerBase:
                 )
         except BaseException:  # noqa: BLE001
             _BATCH_FALLBACK_C.inc()
+            FLIGHT.emit(
+                EV_BATCH_FALLBACK,
+                trace=trace_of(tickets[0].span),
+                rows=len(tickets),
+                stage="bisect",
+            )
             mid = len(tickets) // 2
             self._dispatch_isolated(tickets[:mid])
             self._dispatch_isolated(tickets[mid:])
@@ -488,6 +519,15 @@ class BatchScheduler(_SchedulerBase):
                 )
             _BATCH_ROWS_H.observe(len(batch))
             _BATCHES_C.inc()
+            if _obs_enabled():
+                for ticket in batch:
+                    FLIGHT.emit(
+                        EV_REQUEST_ADMITTED,
+                        trace=trace_of(ticket.span),
+                        mode="window",
+                        rows=len(batch),
+                        model=ticket.request.model,
+                    )
             try:
                 # Backend spans (prefill/decode) emitted on THIS thread
                 # parent under the anchor request's root via attach().
@@ -510,6 +550,21 @@ class BatchScheduler(_SchedulerBase):
                     # sweep either: bisect to isolate the failing ticket
                     # (see _dispatch_isolated).
                     _BATCH_FALLBACK_C.inc()
+                    FLIGHT.emit(
+                        EV_BATCH_FALLBACK,
+                        trace=trace_of(batch[0].span),
+                        rows=len(batch),
+                        stage="batch",
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
+                    # forensics BEFORE the salvage mutates anything: the
+                    # last events + live scheduler state, next to the
+                    # span trace (TPU_LLM_CRASH_DIR)
+                    FLIGHT.crash_dump(
+                        f"window batch dispatch failed: "
+                        f"{type(exc).__name__}: {exc}",
+                        state=self.debug_state(),
+                    )
                     mid = len(batch) // 2
                     self._dispatch_isolated(batch[:mid])
                     self._dispatch_isolated(batch[mid:])
@@ -601,6 +656,53 @@ class ContinuousScheduler(_SchedulerBase):
         # /metrics twin is llm_sched_decode_stall_seconds (join work
         # only, bucketed).
         self.slice_gap_sink = None
+        # Live-session reference for debug_state(): (session, live,
+        # pending) while a session runs, None when idle. Read
+        # best-effort by the /debug/state endpoint — never locked.
+        self._dbg = None
+
+    def debug_state(self) -> Dict[str, object]:
+        """The window snapshot plus the live continuous session: in-
+        flight rows with ages/token counts, pending joiners with chunk
+        progress, and (paged) pool occupancy — the "which decisions is
+        the scheduler making RIGHT NOW" surface. Racing the loop is
+        fine; a torn read costs a stale field, never an exception that
+        escapes (the endpoint guards)."""
+        state = super().debug_state()
+        state["mode"] = "continuous"
+        state["slice_steps"] = self.slice_steps
+        state["chunked_joins"] = self.chunked_joins
+        state["prefill_chunk_tokens"] = self.prefill_chunk_tokens
+        dbg = self._dbg
+        if dbg is None:
+            state["session"] = None
+            return state
+        session, live, pending = dbg
+        now = time.monotonic()
+        try:
+            state["session"] = session.debug_state()
+        except Exception:  # noqa: BLE001 — snapshot raced close()
+            state["session"] = None
+        state["inflight"] = [
+            {
+                "model": t.request.model,
+                "age_s": round(now - t.t_submit, 4),
+                "max_new_tokens": t.request.max_new_tokens,
+                "joined": t.joined,
+                "trace": trace_of(t.span),
+            }
+            for t in list(live.values())
+        ]
+        state["pending_joins"] = [
+            {
+                "model": t.request.model,
+                "age_s": round(now - t.t_submit, 4),
+                "join_chunks_done": t.join_chunks,
+                "trace": trace_of(t.span),
+            }
+            for t, _pj in list(pending)
+        ]
+        return state
 
     def _loop(self) -> None:
         while self._running:
@@ -675,19 +777,44 @@ class ContinuousScheduler(_SchedulerBase):
         for ticket in batch:
             ticket.t_first = now  # admission prefill done: token 1 exists
             live[id(ticket.request)] = ticket
+            FLIGHT.emit(
+                EV_REQUEST_ADMITTED,
+                trace=trace_of(ticket.span),
+                mode="continuous",
+                rows=len(batch),
+                model=ticket.request.model,
+            )
         # chunked joiners mid-prefill: (ticket, pending_join) in
         # round-robin order — _progress_joins advances the head one
         # chunk per loop iteration
         pending: "deque[tuple[_Ticket, object]]" = deque()
+        self._dbg = (session, live, pending)
         _INFLIGHT_G.set(session.active)
         try:
             prev_slice_end: Optional[float] = None
             while self._running and (session.active or pending):
                 rows_before = session.active
                 if rows_before:
+                    t_slice0 = time.monotonic()
                     with self._backend_lock:
                         retired = session.step(self.slice_steps)
                     t_slice_end = time.monotonic()
+                    if _obs_enabled():
+                        FLIGHT.emit(
+                            EV_SLICE,
+                            trace=trace_of(first.span),
+                            rows=rows_before,
+                            retired=len(retired),
+                            dur_s=round(t_slice_end - t_slice0, 6),
+                        )
+                        # spike detection over the slice wall itself:
+                        # a slice at a rolling-median multiple fires an
+                        # anomaly event carrying the recorder's recent
+                        # context as the exemplar
+                        SLICE_SPIKES.observe(
+                            t_slice_end - t_slice0,
+                            trace=trace_of(first.span),
+                        )
                     if (
                         prev_slice_end is not None
                         and self.slice_gap_sink is not None
@@ -709,15 +836,32 @@ class ContinuousScheduler(_SchedulerBase):
                 self._progress_joins(session, live, pending)
                 self._admit_into(session, live, anchor, pending)
                 _INFLIGHT_G.set(session.active + len(pending))
-        except BaseException:  # noqa: BLE001 — engine died mid-session
+        except BaseException as exc:  # noqa: BLE001 — engine died mid-session
             _BATCH_FALLBACK_C.inc()
+            FLIGHT.emit(
+                EV_BATCH_FALLBACK,
+                trace=trace_of(first.span),
+                rows=session.active,
+                stage="session",
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            FLIGHT.crash_dump(
+                f"continuous session died: {type(exc).__name__}: {exc}",
+                state=self.debug_state(),
+            )
             leftovers = list(live.values()) + [t for t, _ in pending]
             live.clear()
             pending.clear()
             for ticket in leftovers:
                 _ROWS_RETIRED_C.labels(reason="error").inc()
+                FLIGHT.emit(
+                    EV_ROW_RETIRED,
+                    trace=trace_of(ticket.span),
+                    reason="error",
+                )
             self._dispatch_isolated(leftovers)
         finally:
+            self._dbg = None
             try:
                 with self._backend_lock:
                     session.close()  # aborts pending joins, frees pages
@@ -726,12 +870,22 @@ class ContinuousScheduler(_SchedulerBase):
             for ticket, _pj in pending:
                 # only reachable when stop() interrupted the loop
                 _ROWS_RETIRED_C.labels(reason="shutdown").inc()
+                FLIGHT.emit(
+                    EV_ROW_RETIRED,
+                    trace=trace_of(ticket.span),
+                    reason="shutdown",
+                )
                 ticket.error = RuntimeError("server shutting down")
                 ticket.event.set()
             pending.clear()
             for ticket in live.values():
                 # only reachable when stop() interrupted the loop
                 _ROWS_RETIRED_C.labels(reason="shutdown").inc()
+                FLIGHT.emit(
+                    EV_ROW_RETIRED,
+                    trace=trace_of(ticket.span),
+                    reason="shutdown",
+                )
                 ticket.error = RuntimeError("server shutting down")
                 ticket.event.set()
             live.clear()
@@ -765,6 +919,12 @@ class ContinuousScheduler(_SchedulerBase):
                     session.join_abort(pj)
             except Exception:  # noqa: BLE001
                 pass
+            FLIGHT.emit(
+                EV_ROW_RETIRED,
+                trace=trace_of(ticket.span),
+                reason="error",
+                join_aborted=True,
+            )
             ticket.error = exc
             ticket.event.set()
             return
@@ -772,6 +932,15 @@ class ContinuousScheduler(_SchedulerBase):
         ticket.join_chunks += 1
         _JOIN_CHUNKS_C.inc()
         _JOIN_PREFILL_H.observe(dt)
+        if _obs_enabled():
+            FLIGHT.emit(
+                EV_JOIN_CHUNK,
+                trace=trace_of(ticket.span),
+                chunk=ticket.join_chunks,
+                committed=committed,
+                stalled_rows=stalled_rows,
+                dur_s=round(dt, 6),
+            )
         if stalled_rows:
             _DECODE_STALL_H.observe(dt)
         if committed:
@@ -789,6 +958,12 @@ class ContinuousScheduler(_SchedulerBase):
         ticket = live.pop(id(result.request), None)
         reason = (result.extras or {}).get("retire_reason", "eos")
         _ROWS_RETIRED_C.labels(reason=reason).inc()
+        FLIGHT.emit(
+            EV_ROW_RETIRED,
+            trace=trace_of(ticket.span) if ticket is not None else None,
+            reason=reason,
+            generated_tokens=result.generated_tokens,
+        )
         if ticket is None:  # defensive: a row the session invented
             return
         self._finish_ticket(ticket, result, now)
@@ -848,6 +1023,14 @@ class ContinuousScheduler(_SchedulerBase):
                 TRACER.add_span(
                     "queue", ticket.t_submit, now,
                     attrs={"joined": True}, parent=ticket.span,
+                )
+                FLIGHT.emit(
+                    EV_REQUEST_ADMITTED,
+                    trace=trace_of(ticket.span),
+                    mode="continuous",
+                    joined=True,
+                    chunked=chunked,
+                    model=request.model,
                 )
                 if chunked:
                     pending.append((ticket, pj))

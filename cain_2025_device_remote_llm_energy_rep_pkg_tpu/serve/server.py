@@ -19,9 +19,11 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
+from urllib.parse import parse_qs
 
 from ..engine.backend import GenerationBackend
 from ..obs import metrics as obs_metrics
+from ..obs.flight import FLIGHT
 from ..obs.trace import TRACER
 from ..runner import term
 from . import protocol
@@ -227,6 +229,55 @@ class GenerationServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_debug_state(self) -> None:
+                """Live scheduler/session/pool snapshot (forensics; 404
+                while telemetry is off — same contract as /metrics).
+                Best-effort: the snapshot races the scheduler loop by
+                design and must never 500 a probe."""
+                if not obs_metrics.enabled():
+                    self._send_json(
+                        404, {"error": "telemetry disabled (TPU_LLM_OBS=0)"}
+                    )
+                    return
+                state = {
+                    "t_s": round(time.monotonic(), 6),
+                    "backend": type(server.backend).__name__,
+                    "scheduler_mode": server.scheduler_mode,
+                    "flight": FLIGHT.summary(),
+                }
+                try:
+                    if server._scheduler is not None:
+                        state["scheduler"] = server._scheduler.debug_state()
+                except Exception as exc:  # noqa: BLE001 — probe only
+                    state["scheduler_error"] = f"{type(exc).__name__}: {exc}"
+                self._send_json(200, state)
+
+            def _send_debug_flight(self) -> None:
+                """Flight-recorder tail: ``?n=`` bounds the event count
+                (default 200), ``?type=`` filters by event type. 404
+                while telemetry is off."""
+                if not obs_metrics.enabled():
+                    self._send_json(
+                        404, {"error": "telemetry disabled (TPU_LLM_OBS=0)"}
+                    )
+                    return
+                query = parse_qs(
+                    self.path.partition("?")[2], keep_blank_values=False
+                )
+                try:
+                    n = int(query.get("n", ["200"])[0])
+                except ValueError:
+                    self._send_json(400, {"error": "n must be an integer"})
+                    return
+                type_ = query.get("type", [None])[0]
+                self._send_json(
+                    200,
+                    {
+                        "summary": FLIGHT.summary(),
+                        "events": FLIGHT.events(n=n, type_=type_),
+                    },
+                )
+
             def _send_json(self, status: int, payload) -> None:
                 body = json.dumps(payload).encode("utf-8")
                 self.send_response(status)
@@ -249,6 +300,10 @@ class GenerationServer:
             def _do_get(self):
                 if self.path == protocol.METRICS_PATH:
                     self._send_metrics()
+                elif self.path.split("?", 1)[0] == protocol.DEBUG_STATE_PATH:
+                    self._send_debug_state()
+                elif self.path.split("?", 1)[0] == protocol.DEBUG_FLIGHT_PATH:
+                    self._send_debug_flight()
                 elif self.path == protocol.HEALTH_PATH:
                     self._send_json(200, {"status": "ok"})
                 elif self.path == protocol.TAGS_PATH:

@@ -21,8 +21,15 @@ Three phases, all over the deterministic fake backend:
    ``llm_sched_decode_stall_seconds`` — the bounded stall the in-flight
    anchor actually paid) and the joiner's wire result attributes its
    TTFT across the chunks (``extras.sched.join_chunks``).
+4. DEBUG INTROSPECTION + FLIGHT RECORDER: drive the continuous fake
+   server again, scrape ``GET /debug/state`` mid-flight (live session
+   rows / queue depth / flight summary) and ``GET /debug/flight`` after,
+   and assert the structured event log tells the request's story in
+   ORDER — admitted → slice(s) → retired — with trace ids matching the
+   joined ticket's admitted/join-chunk/retired events; the flight dump
+   is written next to the span trace (the workflow uploads both).
 
-Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json]``
+Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
 """
 
@@ -71,8 +78,14 @@ def _metric_value(text: str, name: str) -> float:
     return total
 
 
+def _get_json(base: str, path: str):
+    with urllib.request.urlopen(f"{base}{path}", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
 def main() -> int:
     trace_out = sys.argv[1] if len(sys.argv) > 1 else "serve_trace.json"
+    flight_out = sys.argv[2] if len(sys.argv) > 2 else "serve_flight.json"
 
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
         FakeBackend,
@@ -222,6 +235,98 @@ def main() -> int:
     finally:
         server3.stop()
 
+    # -- phase 4: debug introspection + flight recorder ------------------------
+    # Drive the continuous scheduler once more; scrape /debug/state
+    # MID-FLIGHT (a live session must show in-flight rows) and
+    # /debug/flight after, asserting the event log is ordered and its
+    # trace ids link the joined ticket's admitted → retired story.
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.flight import (
+        FLIGHT,
+    )
+
+    server4 = GenerationServer(
+        FakeBackend(tokens_per_s=200.0, simulate_delay=True),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    server4.start()
+    try:
+        base4 = f"http://127.0.0.1:{server4.port}"
+        mid_state = {}
+
+        def probe_state():
+            time.sleep(0.12)  # mid-decode of the anchor's ~0.35 s session
+            mid_state.update(_get_json(base4, "/debug/state"))
+
+        threads = [
+            threading.Thread(
+                target=lambda: _post_generate(base4, "dbg-anchor", 64)
+            ),
+            threading.Thread(
+                target=lambda: (
+                    time.sleep(0.06), _post_generate(base4, "dbg-join", 8)
+                )
+            ),
+            threading.Thread(target=probe_state),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+
+        # live snapshot: scheduler mode, a running session with rows,
+        # and the flight summary rode along
+        assert mid_state.get("scheduler_mode") == "continuous", mid_state
+        sched_state = mid_state.get("scheduler") or {}
+        assert sched_state.get("mode") == "continuous", sched_state
+        session_state = sched_state.get("session") or {}
+        assert session_state.get("active", 0) >= 1, sched_state
+        assert mid_state.get("flight", {}).get("events_total", 0) > 0
+
+        flight = _get_json(base4, "/debug/flight?n=500")
+        events = flight["events"]
+        assert events == sorted(events, key=lambda e: e["seq"]), (
+            "flight events not seq-ordered"
+        )
+        by_type = {}
+        for e in events:
+            by_type.setdefault(e["type"], []).append(e)
+        for needed in ("request_admitted", "slice", "row_retired"):
+            assert by_type.get(needed), f"no {needed} events in {flight}"
+
+        # trace linkage: the joined ticket's admitted and retired events
+        # carry ONE trace id, and its admission precedes its retirement
+        joined_admits = [
+            e for e in by_type["request_admitted"] if e.get("joined")
+        ]
+        assert joined_admits, by_type["request_admitted"]
+        ja = joined_admits[-1]
+        retire = [
+            e
+            for e in by_type["row_retired"]
+            if e.get("trace") == ja.get("trace")
+        ]
+        assert ja.get("trace") is not None and retire, (ja, by_type)
+        assert ja["seq"] < retire[0]["seq"], (ja, retire)
+        # slice events belong to the anchor's trace and bracket the join
+        anchor_slices = [
+            e for e in by_type["slice"] if e.get("trace") is not None
+        ]
+        assert anchor_slices, by_type["slice"]
+
+        # the flight dump artifact: last events + live state, the same
+        # shape the scheduler writes on a batch/session failure
+        dump_path = FLIGHT.crash_dump(
+            "smoke: exported flight dump artifact",
+            state=_get_json(base4, "/debug/state"),
+            path=flight_out,
+        )
+        assert dump_path, "flight dump failed to write"
+    finally:
+        server4.stop()
+
     print(
         json.dumps(
             {
@@ -239,6 +344,11 @@ def main() -> int:
                 "chunked_join": {
                     "rows_joined": joined3,
                     "join_chunks": join_chunks,
+                },
+                "flight": {
+                    "events": len(events),
+                    "dump": flight_out,
+                    "summary": flight["summary"],
                 },
             }
         )

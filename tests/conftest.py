@@ -6,6 +6,14 @@ slices; the accelerator-free kernel tests don't touch JAX at all.
 """
 
 import os
+import tempfile
+
+# Flight-recorder crash dumps (obs/flight.py) default to the working
+# directory; the suite's deliberate poison-batch tests must not litter
+# the repo root (tests that assert on dumps monkeypatch their own dir).
+os.environ.setdefault(
+    "TPU_LLM_CRASH_DIR", tempfile.mkdtemp(prefix="flight_crash_test_")
+)
 
 # Force (not setdefault): this environment globally sets JAX_PLATFORMS=axon
 # (the real-TPU tunnel); tests must run on virtual CPU devices.

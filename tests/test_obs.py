@@ -105,6 +105,50 @@ def test_snapshot_shape(obs_on):
     assert snap["t_h"]["_"]["sum"] == 0.5
 
 
+def test_exposition_golden_output(obs_on):
+    """Pin the FULL text exposition against the v0.0.4 format spec:
+    family sort, stable (sorted) child label order independent of
+    first-touch order, label-value escaping (backslash, quote, newline),
+    HELP escaping, cumulative buckets ending in +Inf == _count, and
+    _count/_sum consistency. Any formatting drift breaks this test."""
+    reg = MetricsRegistry()
+    c = reg.counter("g_req_total", "requests", labels=("path", "code"))
+    # touch children OUT of sorted order: exposition must sort them
+    c.labels(path="/z", code="500").inc(2)
+    c.labels(path="/a", code="200").inc(1)
+    c.labels(path='/esc"\\x\n', code="200").inc(3)
+    g = reg.gauge("g_rows", "live rows")
+    g.set(4)
+    h = reg.histogram("g_lat_seconds", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    golden = (
+        "# HELP g_lat_seconds latency\n"
+        "# TYPE g_lat_seconds histogram\n"
+        'g_lat_seconds_bucket{le="0.1"} 1\n'
+        'g_lat_seconds_bucket{le="1.0"} 2\n'
+        'g_lat_seconds_bucket{le="+Inf"} 3\n'
+        "g_lat_seconds_sum 2.55\n"
+        "g_lat_seconds_count 3\n"
+        "# HELP g_req_total requests\n"
+        "# TYPE g_req_total counter\n"
+        'g_req_total{path="/a",code="200"} 1.0\n'
+        'g_req_total{path="/esc\\"\\\\x\\n",code="200"} 3.0\n'
+        'g_req_total{path="/z",code="500"} 2.0\n'
+        "# HELP g_rows live rows\n"
+        "# TYPE g_rows gauge\n"
+        "g_rows 4.0\n"
+    )
+    assert reg.exposition() == golden
+
+
+def test_exposition_help_escaping(obs_on):
+    reg = MetricsRegistry()
+    reg.counter("g_c", "line one\nline two \\ slash").inc()
+    text = reg.exposition()
+    assert "# HELP g_c line one\\nline two \\\\ slash\n" in text
+
+
 def test_kill_switch_silences_metrics_and_spans(obs_off):
     reg = MetricsRegistry()
     reg.counter("t_dead").inc(5)
@@ -241,6 +285,36 @@ def test_metrics_endpoint_404_when_disabled(obs_off):
                 f"http://127.0.0.1:{srv.port}/metrics", timeout=10
             )
         assert exc_info.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_kill_switch_covers_flight_and_debug_surface(obs_off):
+    """Kill-switch completeness (ISSUE 5): with telemetry off the NEW
+    surface is off too — flight emits are no-ops, the detectors stay
+    silent, and both debug endpoints 404 (deep coverage incl. the
+    concurrency/ordering cases lives in tests/test_flight.py)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.detect import (
+        SpikeDetector,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.flight import (
+        FlightRecorder,
+    )
+
+    rec = FlightRecorder(capacity=4)
+    assert rec.emit("dead") is None and rec.events() == []
+    det = SpikeDetector("s", min_samples=1)
+    det.observe(0.001)
+    assert det.observe(999.0) is False
+    srv = GenerationServer(FakeBackend(), host="127.0.0.1", port=0, quiet=True)
+    srv.start()
+    try:
+        for path in ("/debug/state", "/debug/flight"):
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}", timeout=10
+                )
+            assert exc_info.value.code == 404, path
     finally:
         srv.stop()
 
