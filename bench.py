@@ -344,11 +344,262 @@ def chunked_join_bench() -> int:
     return 0
 
 
+def streaming_cancellation_bench() -> int:
+    """A/B of streaming delivery + mid-stream cancellation (ISSUE 6)
+    under the same seeded Poisson trace, three arms on one tiny PAGED
+    JaxEngine through the continuous scheduler:
+
+    - **buffered**: blocking submits — the pre-streaming baseline; a
+      25%-cancellation INTENT is recorded but cannot take effect, so
+      every abandoned row decodes to its full budget;
+    - **streaming**: every request consumes its per-slice egress
+      channel, nobody cancels — the tok/s-regression guard (streamed
+      delivery must not cost aggregate throughput on the uncancelled
+      subset);
+    - **streaming_cancel**: the same trace with the 25% of clients
+      actually hanging up after their drawn token count — rows retire
+      mid-flight (reason="cancelled") and their pages recycle.
+
+    Headline figures: TTFT-at-first-chunk percentiles, the paged pool's
+    HIGH-WATER page occupancy (cancellation keeps it lower), and the
+    GOODPUT RATIO — tokens a client actually wanted, over row-steps the
+    device executed (llm_engine_stepped_tokens_total deltas). Cancelled
+    rows stop consuming steps, so the ratio must improve vs the
+    buffered arm, which keeps decoding for nobody. CPU-functional,
+    seeded, relative positions are the result (docs/PERF.md "Streaming
+    delivery + cancellation"). Prints ONE JSON line.
+    """
+    import os as _os
+    import sys as _sys
+    import threading as _threading
+    import time as _time
+
+    _sys.path.insert(
+        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "scripts")
+    )
+    import jax
+    import jax.numpy as jnp
+    from poisson_load import (
+        build_cancellations,
+        build_workload,
+        channel_chunks,
+        percentile,
+        run_load,
+        summarize,
+    )
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.detect import (
+        STEPPED_C,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    on_accelerator = jax.default_backend() in ("tpu", "axon")
+    cfg = get_model_config("qwen2:1.5b")
+    if not on_accelerator:
+        cfg = cfg.tiny()
+    engine = JaxEngine(
+        registry={cfg.name: cfg},
+        dtype=jnp.bfloat16 if on_accelerator else jnp.float32,
+        decode_attention="auto" if on_accelerator else None,
+        paged_kv=True,  # the pool high-water figure is a paged-pool story
+    )
+
+    n = int(_os.environ.get("BENCH_SC_REQUESTS", "16"))
+    mean_ms = float(_os.environ.get("BENCH_SC_INTERARRIVAL_MS", "50"))
+    slice_steps = int(_os.environ.get("BENCH_SC_SLICE_STEPS", "8"))
+    # every 4th request draws the LONG budget — and that quarter is the
+    # cancellation target: the realistic abandonment case (a client who
+    # has read enough of a long generation hangs up) and the only one
+    # where reclaiming matters — a cancelled short row's session is
+    # still bounded by its longest companion, so cancelling short rows
+    # saves no bucket-steps by construction
+    budgets = (128, 12, 24, 48)
+    prompts = ("alpha beta", "gamma delta epsilon", "zeta eta")
+    workload = build_workload(
+        n, mean_ms / 1e3, seed=13, model=cfg.name, budgets=budgets,
+        prompts=prompts, stop_at_eos=False,
+    )
+    # seeded per-request hang-up points, applied to the long-budget
+    # quarter: entry i = tokens delivered before client i disconnects
+    # (None = runs to completion). Same plan in every arm.
+    draws = build_cancellations(n, 1.0, after_tokens=(4, 24), seed=13)
+    cancellations = [
+        d if req.max_new_tokens == max(budgets) else None
+        for d, (_, req) in zip(draws, workload)
+    ]
+    cancel_frac = sum(1 for c in cancellations if c is not None) / n
+    # tokens each client actually WANTS under the cancellation intent —
+    # the goodput numerator for every arm (a buffered arm still decodes
+    # the full budget; the excess is the waste streaming reclaims)
+    useful = [
+        min(c, req.max_new_tokens) if c is not None else req.max_new_tokens
+        for c, (_, req) in zip(cancellations, workload)
+    ]
+
+    # solo warm-up: every compiled shape + the parity oracle
+    solo = {id(req): engine.generate(req).tokens for _, req in workload}
+    warm_sess = engine.decode_open(
+        [req for _, req in workload[:4]], reserve_rows=8
+    )
+    while warm_sess.active:
+        warm_sess.step(slice_steps)
+    warm_sess.close()
+
+    # run_load streams exactly the requests with a cancel-after plan, so
+    # the all-streaming arms give no-cancel requests an unreachable
+    # cancel point (every token streams, the stream runs to completion)
+    NEVER = 1 << 30
+    stream_all_plan = [c if c is not None else NEVER for c in cancellations]
+
+    def run_arm(cancel_plan):
+        sched = ContinuousScheduler(engine, slice_steps=slice_steps)
+        # paged-pool high-water sampler: peak pages in use across the
+        # arm (the scheduler's live debug handle; /debug/state's twin)
+        high_water = [0]
+        stop_probe = _threading.Event()
+
+        def probe():
+            while not stop_probe.is_set():
+                dbg = sched._dbg
+                if dbg is not None:
+                    try:
+                        pool = dbg[0].pool
+                        in_use = pool.n_pages - pool.free_pages
+                        high_water[0] = max(high_water[0], in_use)
+                    except Exception:  # noqa: BLE001 — racing close()
+                        pass
+                _time.sleep(0.004)
+
+        tokens_by_req = {}
+
+        def submit(req):
+            res = sched.submit(req)
+            tokens_by_req[id(req)] = res.tokens
+            return res
+
+        def stream_submit(req):
+            def recording():
+                inner = channel_chunks(sched.submit_stream(req))
+                try:
+                    for chunk in inner:
+                        if chunk.done and chunk.result is not None:
+                            tokens_by_req[id(req)] = chunk.result.tokens
+                        yield chunk
+                finally:
+                    inner.close()  # early close propagates the cancel
+
+            return recording()
+
+        stepped0 = STEPPED_C.labels().value
+        sched.start()
+        prober = _threading.Thread(target=probe, daemon=True)
+        prober.start()
+        try:
+            records = run_load(
+                submit,
+                workload,
+                stream_submit=(
+                    stream_submit if cancel_plan is not None else None
+                ),
+                cancellations=cancel_plan,
+            )
+        finally:
+            stop_probe.set()
+            sched.stop()
+            prober.join(timeout=2)
+        stepped = STEPPED_C.labels().value - stepped0
+        ttfts = [r["ttft_s"] for r in records if r.get("ttft_s") is not None]
+        uncancelled = [
+            r for r in records
+            if "error" not in r and not r.get("cancelled")
+        ]
+        return {
+            **summarize(records),
+            "ttft_first_chunk_p50_s": (
+                round(percentile(ttfts, 50), 4) if ttfts else None
+            ),
+            "ttft_first_chunk_p95_s": (
+                round(percentile(ttfts, 95), 4) if ttfts else None
+            ),
+            "pool_high_water_pages": high_water[0],
+            "stepped_row_steps": int(stepped),
+            "goodput_ratio": (
+                round(sum(useful) / stepped, 3) if stepped else None
+            ),
+            "uncancelled_tokens": sum(r["tokens"] for r in uncancelled),
+            "parity_vs_solo": all(
+                tokens_by_req.get(i) == toks
+                for i, toks in solo.items()
+                if i in tokens_by_req
+            ),
+        }
+
+    # warm the arm machinery itself (join shapes, stream plumbing)
+    run_arm([NEVER] * n)
+    results = {
+        "buffered": run_arm(None),
+        "streaming": run_arm([NEVER] * n),
+        "streaming_cancel": run_arm(stream_all_plan),
+    }
+
+    line = {
+        "metric": "streaming_cancellation",
+        "unit": "latency_seconds",
+        "model": cfg.name,
+        "backend": jax.default_backend(),
+        "n_layers": cfg.n_layers,
+        "requests": n,
+        "mean_interarrival_ms": mean_ms,
+        "budgets": list(budgets),
+        "cancel_frac": cancel_frac,
+        "planned_cancellations": sum(
+            1 for c in cancellations if c is not None
+        ),
+        "decode_slice_steps": slice_steps,
+        **results,
+        "streaming_vs_buffered_tok_s": (
+            round(
+                results["streaming"]["agg_tokens_per_s"]
+                / results["buffered"]["agg_tokens_per_s"],
+                3,
+            )
+            if results["buffered"]["agg_tokens_per_s"]
+            else None
+        ),
+        "goodput_ratio_gain": (
+            round(
+                results["streaming_cancel"]["goodput_ratio"]
+                / results["buffered"]["goodput_ratio"],
+                2,
+            )
+            if results["buffered"]["goodput_ratio"]
+            else None
+        ),
+    }
+    _attach_obs(line)
+    print(json.dumps(line))
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "continuous_batching":
         return continuous_batching_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "chunked_join":
         return chunked_join_bench()
+    if len(sys.argv) > 1 and sys.argv[1] == "streaming_cancellation":
+        return streaming_cancellation_bench()
     import jax
 
     backend = jax.default_backend()

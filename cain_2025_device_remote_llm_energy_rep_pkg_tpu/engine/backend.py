@@ -28,6 +28,11 @@ class GenerationRequest:
     # Ollama's options.stop: generation output is cut before the first
     # occurrence of any of these strings.
     stop: "tuple[str, ...]" = ()
+    # Wall-clock budget for the WHOLE request, submit to completion
+    # (wire: x_deadline_ms). None = no deadline. Schedulers enforce it:
+    # queued past the deadline rejects before admission, in-flight past
+    # it retires the row (reason="deadline") and fails the caller.
+    deadline_ms: Optional[float] = None
 
     def __post_init__(self) -> None:
         # Degenerate knobs would silently corrupt sampling (top_p<=0 masks
@@ -50,6 +55,10 @@ class GenerationRequest:
             raise ValueError(
                 "stop strings must be non-empty (an empty string matches at "
                 "position 0 and would blank every result)"
+            )
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {self.deadline_ms}"
             )
 
 
@@ -102,7 +111,15 @@ class GenerationBackend:
     - ``session.can_join(request) -> bool`` / ``session.join(request)``
       admit a compatible queued request into a freed row mid-flight;
     - ``session.active`` counts live rows; ``session.close()`` releases
-      the session.
+      the session;
+    - ``session.cancel(request) -> bool`` retires a live row NOW without
+      completing it (client disconnect / deadline — the row's pages
+      return to the pool, its partial stream is discarded);
+    - ``session.stream_deltas() -> list[(request, tokens, text)]``
+      returns each row's tokens generated since the previous call
+      (honoured only while ``session.stream_tokens`` is set by the
+      scheduler) — the producer side of serve/stream.py's egress
+      channels.
 
     Presence of ``decode_open`` is the capability signal (the base class
     deliberately does not define it). JaxEngine (engine/stepped.py) and

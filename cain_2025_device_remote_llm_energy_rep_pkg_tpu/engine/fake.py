@@ -43,12 +43,23 @@ class _FakeStepSession:
         self.top_k = requests[0].top_k if requests else 0
         self._rows: List[dict] = []
         self._pending: List[dict] = []  # chunked joiners mid-prefill
+        # streaming egress twins of SteppedDecodeSession's: the scheduler
+        # flips stream_tokens on while any live ticket streams, and
+        # retired rows buffer their unstreamed tails for the next
+        # stream_deltas() drain
+        self.stream_tokens = False
+        self._stream_tail: List[tuple] = []
         for r in requests:
             self._admit(r)
 
     def _admit(self, request: GenerationRequest) -> None:
         self._rows.append(
-            {"request": request, "result": self.backend._result(request), "cursor": 0}
+            {
+                "request": request,
+                "result": self.backend._result(request),
+                "cursor": 0,
+                "streamed": 0,
+            }
         )
 
     @property
@@ -157,6 +168,11 @@ class _FakeStepSession:
                     "retire_reason": "budget",
                     "stepped": True,
                 }
+                if self.stream_tokens and row["streamed"] < len(res.tokens):
+                    tail = res.tokens[row["streamed"] :]
+                    self._stream_tail.append(
+                        (res.request, tail, res.text[row["streamed"] :])
+                    )
                 retired.append(res)
             else:
                 keep.append(row)
@@ -169,10 +185,38 @@ class _FakeStepSession:
         self._rows = keep
         return retired
 
+    def stream_deltas(self) -> List[tuple]:
+        """``(request, tokens, text)`` per row since the previous call —
+        the fake twin of ``SteppedDecodeSession.stream_deltas`` (1 token
+        ≙ 1 text char here, so text deltas are exact slices)."""
+        out: List[tuple] = list(self._stream_tail)
+        self._stream_tail.clear()
+        for row in self._rows:
+            res = row["result"]
+            avail = min(row["cursor"], res.generated_tokens)
+            if avail <= row["streamed"]:
+                continue
+            tokens = res.tokens[row["streamed"] : avail]
+            text = res.text[row["streamed"] : avail]
+            row["streamed"] = avail
+            out.append((res.request, tokens, text))
+        return out
+
+    def cancel(self, request: GenerationRequest) -> bool:
+        """Retire a live row without completing it (fake twin of
+        ``SteppedDecodeSession.cancel``): the slot frees immediately and
+        the partial stream is discarded."""
+        for row in self._rows:
+            if row["request"] is request:
+                self._rows.remove(row)
+                return True
+        return False
+
     def close(self) -> None:
         self.closed = True
         self._rows = []
         self._pending = []
+        self._stream_tail = []
 
 
 class FakeBackend(GenerationBackend):

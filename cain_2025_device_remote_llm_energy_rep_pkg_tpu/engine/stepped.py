@@ -132,7 +132,7 @@ class _Row:
 
     __slots__ = (
         "request", "s_real", "generated", "budget", "t0", "t1",
-        "t_decode0", "pages",
+        "t_decode0", "pages", "streamed",
     )
 
     def __init__(
@@ -146,6 +146,8 @@ class _Row:
         self.t1 = t1
         self.t_decode0 = t_decode0
         self.pages: List[int] = pages or []
+        # egress cursor: tokens already handed out via stream_deltas()
+        self.streamed = 0
 
 
 class SteppedDecodeSession:
@@ -172,6 +174,12 @@ class SteppedDecodeSession:
         self._pending: Dict[int, _PendingJoin] = {}
         self.use_top_p = False
         self.use_rp = False
+        # Streaming egress (serve/stream.py): the scheduler flips
+        # stream_tokens on while any live ticket streams; only then do
+        # retirements buffer their tail deltas for the next
+        # stream_deltas() drain (bounded by the session's rows).
+        self.stream_tokens = False
+        self._stream_tail: List[tuple] = []
 
     # -- construction ---------------------------------------------------------
     @classmethod
@@ -645,6 +653,12 @@ class SteppedDecodeSession:
         # came from prefill, outside the stepped denominator; rows
         # abandoned at close() never credit — wasted by definition)
         observe_retired_tokens(max(0, len(row.generated) - 1))
+        if self.stream_tokens and row.streamed < len(generated):
+            # buffer the retiring row's unstreamed tail (post-cut, so
+            # concatenated deltas equal the final token list) for the
+            # next stream_deltas() drain — the row record dies here
+            tail = generated[row.streamed :]
+            self._stream_tail.append((req, tail, self.tok.decode(tail)))
         if self.paged:
             # park the slot's table row FIRST: the dead row's frozen
             # write slot (legacy mode) must stop aliasing pages we are
@@ -654,6 +668,57 @@ class SteppedDecodeSession:
             row.pages = []
         self.rows[r] = None
         return result
+
+    # -- streaming egress ------------------------------------------------------
+    def stream_deltas(self) -> List[tuple]:
+        """Each row's tokens generated since the previous call, as
+        ``(request, tokens, text)`` triples — the producer feed of the
+        per-request egress channels (serve/stream.py). Rows that retired
+        since the last call contribute their buffered post-cut tail, so
+        a fully-drained stream's concatenated deltas equal the final
+        token list (stop-STRING cuts are the documented exception: they
+        cut retroactively, and the final event's text is authoritative).
+        EOS is clipped from live-row deltas when the row asked
+        ``stop_at_eos`` — an EOS the result will not contain must not be
+        streamed."""
+        out: List[tuple] = list(self._stream_tail)
+        self._stream_tail.clear()
+        eos = self.tok.eos_id
+        for row in self.rows:
+            if row is None or len(row.generated) <= row.streamed:
+                continue
+            new = row.generated[row.streamed :]
+            row.streamed = len(row.generated)
+            if row.request.stop_at_eos and eos in new:
+                new = new[: new.index(eos)]
+            if new:
+                out.append((row.request, new, self.tok.decode(new)))
+        return out
+
+    def cancel(self, request: GenerationRequest) -> bool:
+        """Retire a live row NOW without completing it (client
+        disconnect / deadline): the row leaves the done-mask bookkeeping
+        as if it had finished — parked table row, pages back to the pool
+        free-list mid-flight — but its partial stream is DISCARDED and
+        its tokens never credit goodput (abandoned work is wasted by
+        definition, same rule as close()). Returns False when the
+        request has no live row (already retired — the race is benign).
+        """
+        for r, row in enumerate(self.rows):
+            if row is None or row.request is not request:
+                continue
+            # same ordering discipline as _retire: mark the row done on
+            # device (it rides along as a padding row from the next
+            # slice), park its table row FIRST, then free its pages
+            self.done = self.done.at[r].set(True)
+            self.remaining = self.remaining.at[r].set(0)
+            if self.paged:
+                self.table = self.table.at[r].set(self.parking)
+                self.pool.free(row.pages)
+                row.pages = []
+            self.rows[r] = None
+            return True
+        return False
 
     # -- admission ------------------------------------------------------------
     def can_join(self, request: GenerationRequest) -> bool:
@@ -973,4 +1038,5 @@ class SteppedDecodeSession:
                     self.pool.free(pending.pages)
                     pending.pages = []
         self._pending.clear()
+        self._stream_tail.clear()
         self.rows = [None] * len(self.rows)

@@ -49,7 +49,10 @@ from .metrics import REGISTRY, enabled
 EV_REQUEST_ADMITTED = "request_admitted"  # ticket entered a batch/session
 EV_JOIN_CHUNK = "join_chunk"  # one token-budgeted join-prefill chunk ran
 EV_SLICE = "slice"  # one bounded decode slice completed
-EV_ROW_RETIRED = "row_retired"  # a row left the session {eos|budget|error|shutdown}
+EV_ROW_RETIRED = "row_retired"  # a row left the session
+#   {eos|budget|error|shutdown|cancelled|deadline}
+EV_REQUEST_REJECTED = "request_rejected"  # queued ticket refused pre-admission
+#   (deadline already passed / TTFT SLO unmeetable)
 EV_BATCH_FALLBACK = "batch_fallback"  # batch/session dispatch failed → bisection
 EV_POOL_EXHAUSTED = "pool_exhausted"  # PagePool refused an allocation
 EV_DECODE_WINDOW = "decode_window"  # engine fence-timed decode window

@@ -164,7 +164,16 @@ commands:
                        chunk of a mid-flight joiner's prefill (default
                        auto/256, env PREFILL_CHUNK_TOKENS) — together
                        they bound in-flight rows' stall per scheduler
-                       iteration),
+                       iteration;
+                       --ttft-slo-ms N rejects queued requests whose
+                       wait alone already exceeds the TTFT SLO (HTTP
+                       504, before any prefill is paid; off by
+                       default). Streaming: "stream": true serves SSE
+                       through the continuous scheduler's per-slice
+                       egress — a client hanging up retires its row
+                       mid-flight and recycles its KV pages; requests
+                       may carry x_deadline_ms, enforced pre-admission
+                       AND mid-flight),
                        --hf model=/ckpt/dir (serve trained weights + that
                        checkpoint's tokenizer; repeatable),
                        --quantize int8|int4|none or per-model
@@ -201,6 +210,7 @@ def serve_command(args: List[str]) -> None:
     budget_aware = None  # auto: KV-budget admission when estimable
     slice_steps = None  # continuous: engine DECODE_SLICE_STEPS default
     prefill_chunk_tokens = None  # continuous: engine auto default
+    ttft_slo_ms = None  # no TTFT SLO: late requests serve late
     hf_checkpoints = {}
     quantize = None
     kv_quantize = None
@@ -245,6 +255,12 @@ def serve_command(args: List[str]) -> None:
             if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
                 raise CommandError(
                     "serve: --prefill-chunk-tokens expects a positive integer"
+                )
+        elif arg == "--ttft-slo-ms":
+            ttft_slo_ms = float(next(it, "0")) or None
+            if ttft_slo_ms is not None and ttft_slo_ms <= 0:
+                raise CommandError(
+                    "serve: --ttft-slo-ms expects a positive number"
                 )
         elif arg == "--hf":
             # --hf model=/path/to/checkpoint (repeatable): serve the model
@@ -367,6 +383,7 @@ def serve_command(args: List[str]) -> None:
         scheduler=scheduler,
         slice_steps=slice_steps,
         prefill_chunk_tokens=prefill_chunk_tokens,
+        ttft_slo_ms=ttft_slo_ms,
     )
     server.serve_forever()
 

@@ -137,11 +137,22 @@ class RemoteHTTPBackend(GenerationBackend):
     def generate_stream(
         self, request: GenerationRequest
     ) -> Iterator[GenerationChunk]:
-        """Stream over the wire: POST with ``stream: true`` and re-yield the
-        server's NDJSON records as :class:`GenerationChunk`s. The final
-        record rebuilds the full :class:`GenerationResult` (its text is the
-        concatenation of the streamed deltas; the server sends the final
-        ``response`` empty, Ollama-style)."""
+        """Stream over the wire: POST with ``stream: true`` and re-yield
+        the server's records as :class:`GenerationChunk`s. Our server
+        speaks SSE (``text/event-stream``, ``data: <json>`` events —
+        detected by Content-Type); plain Ollama servers speak NDJSON
+        line records — both parse to the same chunk stream. The final
+        record rebuilds the full :class:`GenerationResult` (its text is
+        the server's authoritative ``x_text``, falling back to the
+        concatenated deltas).
+
+        EARLY CLOSE = SERVER-SIDE CANCELLATION: closing this generator
+        (``gen.close()``, breaking out of the loop, or ``with
+        contextlib.closing(...)``) closes the HTTP connection; the
+        server's next SSE write fails and the continuous scheduler
+        retires the row mid-flight (``reason="cancelled"``, pages back
+        to the pool) — the wire path tests and the load generator's
+        ``--cancel-frac`` exercise exactly this."""
         t0 = time.monotonic()
         body = json.dumps(
             protocol.request_to_wire(request, stream=True)
@@ -155,14 +166,20 @@ class RemoteHTTPBackend(GenerationBackend):
         text_parts = []
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                for raw in resp:  # urllib un-chunks; records are lines
-                    line = raw.decode("utf-8").strip()
-                    if not line:
-                        continue
-                    record = json.loads(line)
+                content_type = resp.headers.get("Content-Type", "")
+                lines = (raw.decode("utf-8") for raw in resp)
+                if content_type.startswith(protocol.STREAM_CONTENT_TYPE):
+                    records = protocol.sse_records(lines)
+                else:  # plain-Ollama NDJSON fallback
+                    records = (
+                        json.loads(line)
+                        for line in (ln.strip() for ln in lines)
+                        if line
+                    )
+                for record in records:
                     if "error" in record:
                         # Mid-stream backend failure, surfaced by the server
-                        # as a terminal NDJSON error record.
+                        # as a terminal error record.
                         raise RemoteServerError(500, str(record["error"]))
                     if record.get("done"):
                         result = protocol.result_from_wire(record, request)

@@ -11,7 +11,8 @@ server works against ours unchanged; our extensions ride under ``x_*`` keys.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import json
+from typing import Any, Dict, Iterable, Iterator
 
 from ..engine.backend import GenerationRequest, GenerationResult
 
@@ -41,6 +42,41 @@ DEBUG_FLIGHT_PATH = "/debug/flight"  # flight-recorder events (?n=, ?type=)
 
 SERVER_VERSION = "0.1.0"
 
+# Streaming wire format (ISSUE 6): Server-Sent Events over chunked
+# transfer. Each record is one ``data: <json>`` line followed by a blank
+# line (the SSE event separator); the final event's JSON carries the
+# full result (``done: true`` + extras/energy payload). The client
+# detects the format by Content-Type, falling back to NDJSON line
+# records for plain-Ollama servers.
+STREAM_CONTENT_TYPE = "text/event-stream"
+
+
+def sse_event(payload: Dict[str, Any]) -> bytes:
+    """Frame one JSON payload as an SSE event. The exact byte shape
+    (``data: `` prefix, compact JSON, double newline terminator) is
+    pinned by the framing golden test — clients depend on it."""
+    return b"data: " + json.dumps(payload, separators=(",", ":")).encode(
+        "utf-8"
+    ) + b"\n\n"
+
+
+def sse_records(lines: Iterable[str]) -> Iterator[Dict[str, Any]]:
+    """Parse decoded SSE lines back into JSON records (the inverse of
+    :func:`sse_event`, tolerant of multi-``data:``-line events and
+    ``:`` comment lines per the SSE spec)."""
+    buf: list = []
+    for line in lines:
+        line = line.rstrip("\r\n")
+        if not line:
+            if buf:
+                yield json.loads("\n".join(buf))
+                buf = []
+            continue
+        if line.startswith("data:"):
+            buf.append(line[5:].lstrip())
+    if buf:
+        yield json.loads("\n".join(buf))
+
 
 def request_to_wire(
     request: GenerationRequest, stream: bool = False
@@ -59,6 +95,11 @@ def request_to_wire(
             **({"stop": list(request.stop)} if request.stop else {}),
         },
         "x_stop_at_eos": request.stop_at_eos,
+        **(
+            {"x_deadline_ms": request.deadline_ms}
+            if request.deadline_ms is not None
+            else {}
+        ),
     }
 
 
@@ -85,6 +126,11 @@ def request_from_wire(body: Dict[str, Any]) -> GenerationRequest:
         seed=int(options.get("seed", 0)),
         stop_at_eos=bool(body.get("x_stop_at_eos", True)),
         stop=_stop_from_wire(options.get("stop")),
+        deadline_ms=(
+            float(body["x_deadline_ms"])
+            if body.get("x_deadline_ms") is not None
+            else None
+        ),
     )
 
 

@@ -55,6 +55,7 @@ from ..obs.flight import (
     EV_BATCH_FALLBACK,
     EV_JOIN_CHUNK,
     EV_REQUEST_ADMITTED,
+    EV_REQUEST_REJECTED,
     EV_ROW_RETIRED,
     EV_SLICE,
     FLIGHT,
@@ -62,6 +63,12 @@ from ..obs.flight import (
 )
 from ..obs.metrics import REGISTRY, ROW_BUCKETS, enabled as _obs_enabled
 from ..obs.trace import TRACER
+from .stream import (
+    DeadlineExceeded,
+    StreamCancelled,
+    TokenStream,
+    open_stream,
+)
 
 # Admission/queue telemetry (obs): the scheduler is where a request's
 # wait is DECIDED — queue-wait and window-collect histograms plus the
@@ -116,7 +123,21 @@ _ROWS_RETIRED_C = REGISTRY.counter(
     "llm_sched_rows_retired_total",
     "Continuous-session rows retired, by reason (eos: sampled EOS; "
     "budget: token budget exhausted; error: failed/salvaged; "
-    "shutdown: scheduler stopped mid-flight)",
+    "shutdown: scheduler stopped mid-flight; cancelled: the streaming "
+    "client disconnected or cancelled; deadline: the request's "
+    "deadline_ms passed mid-flight)",
+    labels=("reason",),
+)
+# Deadline SLOs (ISSUE 6): rejections at the admission EDGE — a queued
+# ticket whose own deadline already passed, or whose queue wait alone
+# exceeds the server-wide --ttft-slo-ms, fails before any prefill is
+# paid. Mid-flight deadline retirements count on
+# llm_sched_rows_retired_total{reason="deadline"} instead.
+_DEADLINE_REJECTED_C = REGISTRY.counter(
+    "llm_sched_deadline_rejected_total",
+    "Queued tickets rejected pre-admission, by reason (deadline: the "
+    "request's deadline_ms already passed; ttft_slo: queue wait alone "
+    "exceeded the server TTFT SLO, so the SLO is unmeetable)",
     labels=("reason",),
 )
 _INFLIGHT_G = REGISTRY.gauge(
@@ -168,11 +189,16 @@ class _Ticket:
     first token exists (continuous admission). ``queue_wait_s`` is the
     recorded submit→dispatch wait (the TTFT fallback subtracts it);
     ``joined``/``join_chunks`` mark mid-flight admissions and how many
-    prefill chunks the join took (0 = synchronous)."""
+    prefill chunks the join took (0 = synchronous). ``stream`` is the
+    per-request egress channel for streaming submissions (None =
+    buffered): deltas are pushed per decode slice, the terminal event
+    ends the channel, and the consumer cancelling it retires the row —
+    for streamed tickets ``t_first`` is stamped at the FIRST PUSHED
+    CHUNK, so llm_request_ttft_seconds records TTFT-at-first-chunk."""
 
     __slots__ = (
         "request", "event", "result", "error", "t_submit", "t_first",
-        "span", "queue_wait_s", "joined", "join_chunks",
+        "span", "queue_wait_s", "joined", "join_chunks", "stream",
     )
 
     def __init__(self, request: GenerationRequest) -> None:
@@ -186,6 +212,7 @@ class _Ticket:
         self.queue_wait_s: Optional[float] = None
         self.joined = False
         self.join_chunks = 0
+        self.stream: Optional[TokenStream] = None
 
 
 class _SchedulerBase:
@@ -216,8 +243,14 @@ class _SchedulerBase:
         window_s: float = 0.05,
         lock: Optional[threading.Lock] = None,
         budget_aware: Optional[bool] = None,
+        ttft_slo_ms: Optional[float] = None,
     ) -> None:
         self.backend = backend
+        # Server-wide TTFT SLO (`serve --ttft-slo-ms`): a queued ticket
+        # whose wait alone already exceeds it is rejected before
+        # admission — enforcing the SLO instead of merely histogramming
+        # its violations. None = no SLO.
+        self.ttft_slo_ms = ttft_slo_ms
         if max_batch is None:
             batched = (
                 type(backend).generate_batch
@@ -278,6 +311,15 @@ class _SchedulerBase:
             self._fail_queued()
         self._fail_queued()
 
+    @staticmethod
+    def _fail_ticket(ticket: _Ticket, exc: BaseException) -> None:
+        """Fail one ticket: the blocking caller unblocks with the error
+        and a streaming consumer receives it as the terminal event."""
+        ticket.error = exc
+        if ticket.stream is not None:
+            ticket.stream.fail(exc)
+        ticket.event.set()
+
     def _fail_queued(self) -> None:
         """Fail every queued ticket so its caller unblocks (shutdown only)."""
         while True:
@@ -286,8 +328,7 @@ class _SchedulerBase:
             except queue.Empty:
                 return
             if ticket is not None:
-                ticket.error = RuntimeError("server shutting down")
-                ticket.event.set()
+                self._fail_ticket(ticket, RuntimeError("server shutting down"))
 
     def _requeue(self, ticket: _Ticket) -> None:
         """Put an undispatched ticket back. Under the state lock so the
@@ -299,8 +340,7 @@ class _SchedulerBase:
             if self._running:
                 self._queue.put(ticket)
             else:
-                ticket.error = RuntimeError("server shutting down")
-                ticket.event.set()
+                self._fail_ticket(ticket, RuntimeError("server shutting down"))
 
     # -- client side ----------------------------------------------------------
     def submit(self, request: GenerationRequest) -> GenerationResult:
@@ -317,6 +357,26 @@ class _SchedulerBase:
         assert ticket.result is not None
         return ticket.result
 
+    def submit_stream(self, request: GenerationRequest) -> TokenStream:
+        """Enqueue a STREAMING request and return its egress channel
+        immediately (non-blocking — the consumer iterates
+        ``channel.events()``). Under continuous dispatch the scheduler
+        pushes each decode slice's new tokens as delta events; under
+        window dispatch the stream degenerates to the single final
+        event. The final event carries the full result, extras riding
+        along; every failure path ends the channel with a terminal
+        error. ``channel.cancel()`` — explicit, or by the server on an
+        SSE write failure — retires the row within one decode slice
+        (``reason="cancelled"``, pages back to the pool)."""
+        ticket = _Ticket(request)
+        ticket.stream = open_stream()
+        _REQUESTS_C.inc()
+        with self._state_lock:
+            if not self._running:
+                raise RuntimeError("scheduler is not running")
+            self._queue.put(ticket)
+        return ticket.stream
+
     # -- introspection --------------------------------------------------------
     def debug_state(self) -> Dict[str, object]:
         """Live snapshot for ``GET /debug/state``: what the scheduler is
@@ -330,6 +390,7 @@ class _SchedulerBase:
             "max_batch": self.max_batch,
             "budget_aware": self.budget_aware,
             "window_s": self.window_s,
+            "ttft_slo_ms": self.ttft_slo_ms,
         }
 
     # -- shared dispatch helpers ----------------------------------------------
@@ -357,6 +418,46 @@ class _SchedulerBase:
             outcome="raised" if raised else "static"
         ).inc()
         return max(self.max_batch, int(estimated))
+
+    def _preadmit_reject(
+        self, ticket: _Ticket, now: Optional[float] = None
+    ) -> bool:
+        """The deadline/SLO gate at the ADMISSION EDGE: a queued ticket
+        whose own ``deadline_ms`` already passed — or whose queue wait
+        alone exceeds the server-wide TTFT SLO — fails cleanly before
+        any prefill is paid (the cheapest possible place to shed load a
+        caller has already given up on). Returns True when the ticket
+        was rejected (and its caller already failed)."""
+        request = ticket.request
+        if request.deadline_ms is None and self.ttft_slo_ms is None:
+            return False
+        now = time.monotonic() if now is None else now
+        wait = now - ticket.t_submit
+        if (
+            request.deadline_ms is not None
+            and wait > request.deadline_ms / 1e3
+        ):
+            reason, bound_ms = "deadline", request.deadline_ms
+        elif self.ttft_slo_ms is not None and wait > self.ttft_slo_ms / 1e3:
+            reason, bound_ms = "ttft_slo", self.ttft_slo_ms
+        else:
+            return False
+        _DEADLINE_REJECTED_C.labels(reason=reason).inc()
+        FLIGHT.emit(
+            EV_REQUEST_REJECTED,
+            trace=trace_of(ticket.span),
+            reason=reason,
+            wait_s=round(wait, 4),
+        )
+        self._fail_ticket(
+            ticket,
+            DeadlineExceeded(
+                f"queued {wait * 1e3:.0f} ms, past the "
+                f"{'request deadline_ms' if reason == 'deadline' else 'server TTFT SLO'}"
+                f" of {bound_ms:g} ms"
+            ),
+        )
+        return True
 
     def _finish_ticket(
         self,
@@ -403,6 +504,10 @@ class _SchedulerBase:
             "sched": sched_extras,
         }
         ticket.result = result
+        if ticket.stream is not None:
+            # the final egress event carries the COMPLETE wire result —
+            # extras (sched attribution, energy payload) included
+            ticket.stream.finish(result)
         ticket.event.set()
 
     def _dispatch_isolated(self, tickets: "List[_Ticket]") -> None:
@@ -421,8 +526,7 @@ class _SchedulerBase:
                 with TRACER.attach(ticket.span), self._backend_lock:
                     result = self.backend.generate(ticket.request)
             except BaseException as exc:  # noqa: BLE001
-                ticket.error = exc
-                ticket.event.set()
+                self._fail_ticket(ticket, exc)
             else:
                 self._finish_ticket(ticket, result)
             return
@@ -506,6 +610,12 @@ class BatchScheduler(_SchedulerBase):
             if first is None:
                 break
             batch = self._collect(first)
+            # Deadline/SLO gate at the dispatch edge: tickets that can
+            # no longer meet their bound fail here instead of burning a
+            # shared decode on work the caller has abandoned.
+            batch = [t for t in batch if not self._preadmit_reject(t)]
+            if not batch:
+                continue
             # Queue accounting at dispatch: each ticket's wait (its own
             # submit clock) plus a "queue" span parented under ITS OWN
             # request root — the span tree survives the thread hop.
@@ -540,8 +650,7 @@ class BatchScheduler(_SchedulerBase):
                         )
             except BaseException as exc:  # noqa: BLE001
                 if len(batch) == 1:
-                    batch[0].error = exc
-                    batch[0].event.set()
+                    self._fail_ticket(batch[0], exc)
                 else:
                     # A batch-level failure (e.g. the combined KV footprint
                     # exceeding max_seq_len) must not 500 callers whose
@@ -604,6 +713,20 @@ class ContinuousScheduler(_SchedulerBase):
       slices — the pre-ISSUE-4 behavior the chunked_join bench A/Bs
       against).
 
+    Two more phases ride the same loop (ISSUE 6):
+
+    - **egress**: after every slice, each STREAMING row's new tokens
+      push into its per-request channel (serve/stream.py) — the
+      producer side of SSE delivery; a retiring row's tail deltas
+      precede its final event;
+    - **reap**: between every two slices, rows whose stream was
+      cancelled (client disconnect / explicit / backpressure) or whose
+      ``deadline_ms`` passed retire NOW via ``session.cancel`` — pages
+      recycled mid-flight, ticket failed cleanly
+      (``retired{reason=cancelled|deadline}``). Queued tickets past
+      their deadline — or past the server-wide ``ttft_slo_ms`` — are
+      rejected BEFORE admission instead.
+
     Incompatible arrivals re-queue and anchor their own session once this
     one drains (same FIFO-per-compatibility-class rule as the window
     scheduler; under a saturating stream of compatible traffic an
@@ -621,6 +744,7 @@ class ContinuousScheduler(_SchedulerBase):
         slice_steps: Optional[int] = None,
         prefill_chunk_tokens: Optional[int] = None,
         chunked_joins: bool = True,
+        ttft_slo_ms: Optional[float] = None,
     ) -> None:
         super().__init__(
             backend,
@@ -628,6 +752,7 @@ class ContinuousScheduler(_SchedulerBase):
             window_s=window_s,
             lock=lock,
             budget_aware=budget_aware,
+            ttft_slo_ms=ttft_slo_ms,
         )
         if not hasattr(backend, "decode_open"):
             raise ValueError(
@@ -689,6 +814,11 @@ class ContinuousScheduler(_SchedulerBase):
                 "age_s": round(now - t.t_submit, 4),
                 "max_new_tokens": t.request.max_new_tokens,
                 "joined": t.joined,
+                "streaming": t.stream is not None,
+                "tokens_streamed": (
+                    t.stream.tokens_pushed if t.stream is not None else 0
+                ),
+                "deadline_ms": t.request.deadline_ms,
                 "trace": trace_of(t.span),
             }
             for t in list(live.values())
@@ -712,6 +842,8 @@ class ContinuousScheduler(_SchedulerBase):
                 continue
             if first is None:
                 break
+            if self._preadmit_reject(first):
+                continue
             self._run_session(first)
         _INFLIGHT_G.set(0)
 
@@ -720,7 +852,8 @@ class ContinuousScheduler(_SchedulerBase):
     ) -> List[_Ticket]:
         """Non-blocking pull of queued tickets compatible with ``anchor``
         (bounded by the queue's current size so re-queued incompatible
-        tickets cannot spin this loop forever)."""
+        tickets cannot spin this loop forever). Expired tickets
+        (deadline/TTFT-SLO) fail here instead of entering the session."""
         got: List[_Ticket] = []
         for _ in range(self._queue.qsize()):
             if len(got) >= limit:
@@ -732,6 +865,8 @@ class ContinuousScheduler(_SchedulerBase):
             if ticket is None:
                 self._queue.put(None)
                 break
+            if self._preadmit_reject(ticket):
+                continue
             if self._compatible(anchor, ticket.request):
                 got.append(ticket)
             else:
@@ -764,8 +899,7 @@ class ContinuousScheduler(_SchedulerBase):
             # a failed open (one bad prompt poisons the group) salvages
             # exactly like a failed window batch: bisected isolation
             if len(batch) == 1:
-                first.error = exc
-                first.event.set()
+                self._fail_ticket(first, exc)
             else:
                 _BATCH_FALLBACK_C.inc()
                 mid = len(batch) // 2
@@ -775,7 +909,11 @@ class ContinuousScheduler(_SchedulerBase):
         live: Dict[int, _Ticket] = {}
         now = time.monotonic()
         for ticket in batch:
-            ticket.t_first = now  # admission prefill done: token 1 exists
+            if ticket.stream is None:
+                # admission prefill done: token 1 exists. Streamed
+                # tickets stamp t_first at their FIRST PUSHED CHUNK
+                # instead (TTFT-at-first-chunk).
+                ticket.t_first = now
             live[id(ticket.request)] = ticket
             FLIGHT.emit(
                 EV_REQUEST_ADMITTED,
@@ -792,7 +930,14 @@ class ContinuousScheduler(_SchedulerBase):
         _INFLIGHT_G.set(session.active)
         try:
             prev_slice_end: Optional[float] = None
+            # prefill tokens egress immediately: a streamed anchor's
+            # first chunk exists before any decode slice ran
+            self._push_deltas(session, live)
             while self._running and (session.active or pending):
+                # cancellation/deadline sweep BETWEEN slices: a client
+                # that hung up (or a deadline that passed) retires its
+                # row within one decode slice
+                self._reap_expired(session, live, pending)
                 rows_before = session.active
                 if rows_before:
                     t_slice0 = time.monotonic()
@@ -826,6 +971,9 @@ class ContinuousScheduler(_SchedulerBase):
                         except Exception:  # noqa: BLE001 — probe only
                             pass
                     prev_slice_end = t_slice_end
+                    # token egress BEFORE ticket completion: a retiring
+                    # row's tail deltas precede its final event
+                    self._push_deltas(session, live)
                     for result in retired:
                         self._complete_row(live, result, t_slice_end)
                 else:
@@ -835,6 +983,10 @@ class ContinuousScheduler(_SchedulerBase):
                     prev_slice_end = None
                 self._progress_joins(session, live, pending)
                 self._admit_into(session, live, anchor, pending)
+                # newly committed/admitted streaming rows egress their
+                # prefill token now, and the session's stream_tokens
+                # flag is refreshed before the next slice
+                self._push_deltas(session, live)
                 _INFLIGHT_G.set(session.active + len(pending))
         except BaseException as exc:  # noqa: BLE001 — engine died mid-session
             _BATCH_FALLBACK_C.inc()
@@ -875,8 +1027,9 @@ class ContinuousScheduler(_SchedulerBase):
                     trace=trace_of(ticket.span),
                     reason="shutdown",
                 )
-                ticket.error = RuntimeError("server shutting down")
-                ticket.event.set()
+                self._fail_ticket(
+                    ticket, RuntimeError("server shutting down")
+                )
             pending.clear()
             for ticket in live.values():
                 # only reachable when stop() interrupted the loop
@@ -886,10 +1039,105 @@ class ContinuousScheduler(_SchedulerBase):
                     trace=trace_of(ticket.span),
                     reason="shutdown",
                 )
-                ticket.error = RuntimeError("server shutting down")
-                ticket.event.set()
+                self._fail_ticket(
+                    ticket, RuntimeError("server shutting down")
+                )
             live.clear()
             _INFLIGHT_G.set(0)
+
+    def _push_deltas(self, session, live: Dict[int, _Ticket]) -> None:
+        """The EGRESS phase: hand each streaming row's new tokens to its
+        per-request channel (serve/stream.py). Also maintains the
+        session's ``stream_tokens`` flag so retiring rows buffer their
+        tails only while someone is listening. A failed push means the
+        consumer is gone — the next reap sweep retires the row."""
+        streaming = any(t.stream is not None for t in live.values())
+        if hasattr(session, "stream_tokens"):
+            session.stream_tokens = streaming
+        if not streaming or not hasattr(session, "stream_deltas"):
+            return
+        for request, tokens, text in session.stream_deltas():
+            ticket = live.get(id(request))
+            if ticket is None or ticket.stream is None:
+                continue
+            if ticket.stream.push(text, tokens) and ticket.t_first is None:
+                # TTFT-at-first-chunk: the stream's own first-push clock
+                ticket.t_first = ticket.stream.t_first_chunk
+
+    def _reap_expired(self, session, live, pending) -> None:
+        """The CANCELLATION/DEADLINE sweep, run between two decode
+        slices: live rows whose stream was cancelled (disconnect,
+        explicit cancel, or backpressure) or whose ``deadline_ms``
+        passed retire NOW through ``session.cancel`` — done-mask set,
+        pages back to the pool free-list, ticket failed cleanly — and
+        pending chunked joiners abort their reservation the same way."""
+        if not live and not pending:
+            return
+        now = time.monotonic()
+        for ticket in list(live.values()):
+            reason = self._reap_reason(ticket, now)
+            if reason is None:
+                continue
+            try:
+                with self._backend_lock:
+                    session.cancel(ticket.request)
+            except Exception:  # noqa: BLE001 — row may have just retired
+                pass
+            live.pop(id(ticket.request), None)
+            self._fail_reaped(ticket, reason)
+        for entry in list(pending):
+            ticket, pj = entry
+            reason = self._reap_reason(ticket, now)
+            if reason is None:
+                continue
+            try:
+                with self._backend_lock:
+                    session.join_abort(pj)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                pending.remove(entry)
+            except ValueError:
+                pass
+            self._fail_reaped(ticket, reason)
+
+    @staticmethod
+    def _reap_reason(ticket: _Ticket, now: float) -> Optional[str]:
+        if ticket.stream is not None and ticket.stream.cancelled:
+            return "cancelled"
+        deadline_ms = ticket.request.deadline_ms
+        if deadline_ms is not None and now - ticket.t_submit > deadline_ms / 1e3:
+            return "deadline"
+        return None
+
+    def _fail_reaped(self, ticket: _Ticket, reason: str) -> None:
+        _ROWS_RETIRED_C.labels(reason=reason).inc()
+        FLIGHT.emit(
+            EV_ROW_RETIRED,
+            trace=trace_of(ticket.span),
+            reason=reason,
+            generated_tokens=(
+                ticket.stream.tokens_pushed
+                if ticket.stream is not None
+                else None
+            ),
+        )
+        if reason == "cancelled":
+            self._fail_ticket(
+                ticket,
+                StreamCancelled(
+                    "stream cancelled "
+                    f"({ticket.stream.cancel_cause or 'disconnect'})"
+                ),
+            )
+        else:
+            self._fail_ticket(
+                ticket,
+                DeadlineExceeded(
+                    f"deadline_ms={ticket.request.deadline_ms:g} passed "
+                    "mid-flight; row retired"
+                ),
+            )
 
     def _progress_joins(
         self,
@@ -925,8 +1173,7 @@ class ContinuousScheduler(_SchedulerBase):
                 reason="error",
                 join_aborted=True,
             )
-            ticket.error = exc
-            ticket.event.set()
+            self._fail_ticket(ticket, exc)
             return
         dt = time.monotonic() - t0
         ticket.join_chunks += 1
@@ -945,7 +1192,10 @@ class ContinuousScheduler(_SchedulerBase):
             _DECODE_STALL_H.observe(dt)
         if committed:
             now = time.monotonic()
-            ticket.t_first = now  # first token sampled at commit
+            if ticket.stream is None:
+                # first token sampled at commit; streamed joiners stamp
+                # t_first at their first pushed chunk instead
+                ticket.t_first = now
             ticket.joined = True
             live[id(ticket.request)] = ticket
             _ROWS_JOINED_C.inc()
@@ -994,6 +1244,8 @@ class ContinuousScheduler(_SchedulerBase):
             if ticket is None:
                 self._queue.put(None)
                 return
+            if self._preadmit_reject(ticket):
+                continue
             request = ticket.request
             admitted = False
             pj = None
@@ -1013,8 +1265,7 @@ class ContinuousScheduler(_SchedulerBase):
                     except BaseException as exc:  # noqa: BLE001
                         # the join's prefill failed: this request's own
                         # fault (bad prompt) — fail only its caller
-                        ticket.error = exc
-                        ticket.event.set()
+                        self._fail_ticket(ticket, exc)
                         continue
             if admitted:
                 now = time.monotonic()
@@ -1035,7 +1286,8 @@ class ContinuousScheduler(_SchedulerBase):
                 if chunked:
                     pending.append((ticket, pj))
                 else:
-                    ticket.t_first = now
+                    if ticket.stream is None:
+                        ticket.t_first = now
                     ticket.joined = True
                     live[id(request)] = ticket
                     _ROWS_JOINED_C.inc()

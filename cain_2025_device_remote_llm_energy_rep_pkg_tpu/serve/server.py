@@ -27,6 +27,7 @@ from ..obs.flight import FLIGHT
 from ..obs.trace import TRACER
 from ..runner import term
 from . import protocol
+from .stream import DeadlineExceeded, StreamCancelled
 
 # HTTP-surface telemetry (obs): request counts by (method, path, status)
 # and a latency histogram by path. Paths are the fixed API surface
@@ -75,6 +76,7 @@ class GenerationServer:
         scheduler: Optional[str] = None,  # None(auto)|window|continuous
         slice_steps: Optional[int] = None,  # continuous: decode-slice width
         prefill_chunk_tokens: Optional[int] = None,  # continuous: join chunk
+        ttft_slo_ms: Optional[float] = None,  # queued-past-SLO rejection
     ) -> None:
         """``batch_window_ms > 0`` or an explicit ``scheduler`` enables
         batching: concurrent non-streaming generate requests coalesce
@@ -107,7 +109,15 @@ class GenerationServer:
         ``prefill_chunk_tokens`` the token budget of ONE chunk of a
         mid-flight joiner's prefill (default: the engine's auto, env
         ``PREFILL_CHUNK_TOKENS``) — together they bound how long
-        in-flight rows stall per scheduler iteration."""
+        in-flight rows stall per scheduler iteration.
+
+        ``ttft_slo_ms`` (CLI ``--ttft-slo-ms``) is the server-wide TTFT
+        SLO: a queued request whose wait alone already exceeds it is
+        rejected (HTTP 504) before admission instead of being served
+        late — load shedding at the cheapest possible point. Requests
+        can additionally carry their own ``x_deadline_ms``, enforced
+        both pre-admission and mid-flight (the row retires,
+        ``reason="deadline"``)."""
         self.backend = backend
         self.models = list(models) if models else []
         self.quiet = quiet
@@ -146,6 +156,7 @@ class GenerationServer:
                     budget_aware=budget_aware,
                     slice_steps=slice_steps,
                     prefill_chunk_tokens=prefill_chunk_tokens,
+                    ttft_slo_ms=ttft_slo_ms,
                 )
             else:
                 self._scheduler = BatchScheduler(
@@ -154,6 +165,7 @@ class GenerationServer:
                     window_s=window_s,
                     lock=self._generate_lock,
                     budget_aware=budget_aware,
+                    ttft_slo_ms=ttft_slo_ms,
                 )
             self.scheduler_mode = mode
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
@@ -376,23 +388,148 @@ class GenerationServer:
                     # Engine-side request validation (empty-encoding prompt,
                     # budget over max_seq_len, …) is the client's fault.
                     self._send_json(400, {"error": str(exc)})
+                except DeadlineExceeded as exc:
+                    # queued past x_deadline_ms / --ttft-slo-ms, or the
+                    # deadline passed mid-flight: the scheduler shed it
+                    self._send_json(504, {"error": str(exc)})
                 except Exception as exc:  # noqa: BLE001 — server must not die
                     self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
                 else:
                     self._send_json(200, protocol.result_to_wire(result))
 
-            def _write_ndjson_chunk(self, payload) -> None:
-                data = (json.dumps(payload) + "\n").encode("utf-8")
+            def _write_sse_chunk(self, payload) -> None:
+                """One SSE event as one HTTP/1.1 chunk (protocol.sse_event
+                pins the framing; the golden test pins those bytes)."""
+                data = protocol.sse_event(payload)
                 self.wfile.write(f"{len(data):X}\r\n".encode("ascii"))
                 self.wfile.write(data + b"\r\n")
                 self.wfile.flush()
 
+            def _start_sse(self) -> None:
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", protocol.STREAM_CONTENT_TYPE
+                )
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                # A consumer that stops reading would otherwise block
+                # flush() forever — bound every socket write so one
+                # stalled client can't wedge its handler (or, on the
+                # serial path, the generate lock).
+                self.connection.settimeout(STREAM_WRITE_TIMEOUT_S)
+
+            def _end_sse(self) -> None:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    self.close_connection = True
+
+            def _final_record(self, result) -> dict:
+                final = protocol.result_to_wire(result)
+                # Ollama-style: the final record's response is empty
+                # (text was streamed); the authoritative full text
+                # (per-chunk deltas can split multi-byte chars, and stop
+                # strings cut retroactively) rides in x_text.
+                final["response"] = ""
+                final["x_text"] = result.text
+                return final
+
             def _handle_generate_stream(self, request) -> None:
-                """Ollama's ``stream: true`` shape: chunked NDJSON records of
-                incremental ``response`` text ending with a ``done: true``
-                record carrying the aggregate stats. The first record is only
-                sent once generation has begun, so backend errors surface as
-                a clean HTTP error status rather than a broken stream."""
+                """``stream: true``: Server-Sent Events of incremental
+                ``response`` deltas ending with a ``done: true`` event
+                carrying the aggregate stats + extras (energy payload
+                included). Routed through the continuous scheduler's
+                per-request egress channel when one is running — tokens
+                leave per decode slice, and a dead socket CANCELS the
+                row mid-flight — else served from the backend's own
+                generate_stream under the serial lock."""
+                if (
+                    server._scheduler is not None
+                    and server.scheduler_mode == "continuous"
+                ):
+                    self._stream_via_scheduler(request)
+                else:
+                    self._stream_serial(request)
+
+            def _stream_via_scheduler(self, request) -> None:
+                """Streaming delivery (ISSUE 6): the scheduler's slice
+                loop produces into the bounded egress channel; this
+                handler drains it onto the SSE socket. A failed socket
+                write cancels the channel — the scheduler retires the
+                row within one decode slice (``reason="cancelled"``) and
+                its pages return to the pool."""
+                try:
+                    channel = server._scheduler.submit_stream(request)
+                except RuntimeError as exc:
+                    self._send_json(503, {"error": str(exc)})
+                    return
+                events = channel.events()
+                # Headers wait for the first event, so pre-admission
+                # failures (bad prompt, unknown model, deadline shed)
+                # surface as clean HTTP statuses, not broken streams.
+                first = next(events)
+                if first.kind == "error":
+                    self._send_stream_open_error(first.error)
+                    return
+                self._start_sse()
+                try:
+                    for event in itertools.chain([first], events):
+                        if event.kind == "delta":
+                            self._write_sse_chunk(
+                                protocol.stream_chunk_to_wire(
+                                    request.model, event.text, event.tokens
+                                )
+                            )
+                        elif event.kind == "done":
+                            self._write_sse_chunk(
+                                self._final_record(event.result)
+                            )
+                        else:
+                            # mid-stream failure (engine death, deadline
+                            # passed in flight): a terminal error event
+                            # so the client sees a clean end
+                            self._write_sse_chunk(
+                                {
+                                    "error": (
+                                        f"{type(event.error).__name__}: "
+                                        f"{event.error}"
+                                    ),
+                                    "done": True,
+                                }
+                            )
+                except OSError:
+                    # Socket gone (client hung up / write timed out):
+                    # cancel the channel — the scheduler notices between
+                    # slices and retires the row, recycling its pages.
+                    channel.cancel(cause="disconnect")
+                    self.close_connection = True
+                    return
+                self._end_sse()
+
+            def _send_stream_open_error(self, exc) -> None:
+                if isinstance(exc, DeadlineExceeded):
+                    self._send_json(504, {"error": str(exc)})
+                elif isinstance(exc, StreamCancelled):
+                    # consumer cancelled before the first token; nothing
+                    # useful to send — close quietly
+                    self.close_connection = True
+                elif isinstance(exc, KeyError):
+                    self._send_json(
+                        404, {"error": f"model not found: {exc}"}
+                    )
+                elif isinstance(exc, ValueError):
+                    self._send_json(400, {"error": str(exc)})
+                else:
+                    self._send_json(
+                        500, {"error": f"{type(exc).__name__}: {exc}"}
+                    )
+
+            def _stream_serial(self, request) -> None:
+                """The pre-scheduler streaming path (serial lock, the
+                backend's own chunked generate_stream), now SSE-framed
+                like the scheduler path so clients speak one format."""
                 with server._generate_lock:
                     stream = server.backend.generate_stream(request)
                     try:
@@ -415,28 +552,15 @@ class GenerationServer:
                             500, {"error": f"{type(exc).__name__}: {exc}"}
                         )
                         return
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/x-ndjson")
-                    self.send_header("Transfer-Encoding", "chunked")
-                    self.end_headers()
-                    # A consumer that stops reading would otherwise block
-                    # flush() forever *while holding the generate lock* —
-                    # bound every socket write so one stalled client can't
-                    # wedge the whole server.
-                    self.connection.settimeout(STREAM_WRITE_TIMEOUT_S)
+                    self._start_sse()
                     try:
                         for chunk in itertools.chain([first], stream):
                             if chunk.done:
-                                final = protocol.result_to_wire(chunk.result)
-                                # Ollama-style: the final record's response
-                                # is empty (text was streamed); the
-                                # authoritative full text (per-chunk deltas
-                                # can split multi-byte chars) rides in x_text.
-                                final["response"] = ""
-                                final["x_text"] = chunk.result.text
-                                self._write_ndjson_chunk(final)
+                                self._write_sse_chunk(
+                                    self._final_record(chunk.result)
+                                )
                             else:
-                                self._write_ndjson_chunk(
+                                self._write_sse_chunk(
                                     protocol.stream_chunk_to_wire(
                                         request.model, chunk.text, chunk.tokens
                                     )
@@ -448,10 +572,10 @@ class GenerationServer:
                         return
                     except Exception as exc:  # noqa: BLE001 — backend died
                         # Headers are out; surface the failure as a final
-                        # NDJSON error record so the client sees a clean,
+                        # SSE error event so the client sees a clean,
                         # terminated stream instead of an IncompleteRead.
                         try:
-                            self._write_ndjson_chunk(
+                            self._write_sse_chunk(
                                 {
                                     "error": f"{type(exc).__name__}: {exc}",
                                     "done": True,
@@ -460,11 +584,7 @@ class GenerationServer:
                         except OSError:
                             self.close_connection = True
                             return
-                    try:
-                        self.wfile.write(b"0\r\n\r\n")
-                        self.wfile.flush()
-                    except OSError:
-                        self.close_connection = True
+                    self._end_sse()
 
             def _handle_load(self, body) -> None:
                 model = body.get("model")

@@ -15,7 +15,13 @@ that workload shape:
 - :func:`run_load` — drive any ``submit(request) -> result`` callable
   (a scheduler's ``submit``, a client's ``generate``) with real-clock
   arrivals on threads, returning per-request latency records;
-- :func:`summarize` — p50/p95 TTFT & completion, aggregate tokens/s.
+- :func:`build_cancellations` + ``run_load(stream_submit=...)`` —
+  seeded MID-STREAM CANCELLATION injection (ISSUE 6): a chosen fraction
+  of requests stream and hang up after a drawn token count, exercising
+  the server's disconnect-driven retirement; per-request deadlines
+  (``deadline_ms``) ride the workload the same seeded way;
+- :func:`summarize` — p50/p95 TTFT & completion, aggregate tokens/s,
+  plus cancelled / deadline-exceeded counts next to the percentiles.
 
 Used by ``bench.py continuous_batching`` (in-process A/B of the two
 schedulers) and ``scripts/serve_metrics_smoke.py`` (staggered arrivals
@@ -76,6 +82,26 @@ def lognormal_prompt_tokens(
     ]
 
 
+def build_cancellations(
+    n: int,
+    cancel_frac: float,
+    after_tokens: Tuple[int, int] = (4, 32),
+    seed: int = 0,
+) -> List[Optional[int]]:
+    """Per-request cancellation plan: entry ``i`` is the token count
+    after which client ``i`` hangs up mid-stream, or None (runs to
+    completion). Seeded and independent of the arrival/length streams
+    (its own derived seed), so turning cancellation on replays the SAME
+    arrivals — the A/B the streaming_cancellation bench depends on.
+    ``after_tokens`` is an inclusive uniform range."""
+    rng = random.Random((seed << 16) ^ 0xCA7CE1)
+    lo, hi = after_tokens
+    return [
+        rng.randint(int(lo), int(hi)) if rng.random() < cancel_frac else None
+        for _ in range(n)
+    ]
+
+
 def synth_prompt(n_tokens: int) -> str:
     """A prompt that byte-tokenizes to ``n_tokens`` ids (BOS + one id
     per ASCII byte — models/tokenizer.ByteTokenizer)."""
@@ -95,6 +121,7 @@ def build_workload(
     prompt_len_sigma: float = 1.0,
     prompt_len_max: int = 1024,
     anchor_longest: bool = False,
+    deadline_ms: Optional[float] = None,
 ) -> List[Tuple[float, GenerationRequest]]:
     """``[(arrival_offset_s, request), ...]`` — Poisson arrivals (seeded
     exponential inter-arrival; the first request arrives at t=0) over a
@@ -106,7 +133,10 @@ def build_workload(
     ``anchor_longest`` swaps the longest draw to request 0: the first
     arrival anchors a continuous decode session and its prompt bucket
     sizes the session's cache, so capacity-feasibility of later joins is
-    held constant while the JOIN policy under test varies."""
+    held constant while the JOIN policy under test varies.
+    ``deadline_ms`` stamps every request with that per-request deadline
+    (scheduler-enforced: pre-admission rejection + mid-flight
+    retirement)."""
     rng = random.Random(seed)
     prompt_list: Optional[List[str]] = None
     if prompt_len_dist == "lognormal":
@@ -144,6 +174,7 @@ def build_workload(
                     max_new_tokens=budgets[i % len(budgets)],
                     seed=i,
                     stop_at_eos=stop_at_eos,
+                    deadline_ms=deadline_ms,
                 ),
             )
         )
@@ -153,11 +184,22 @@ def build_workload(
 def run_load(
     submit: Callable[[GenerationRequest], GenerationResult],
     workload: List[Tuple[float, GenerationRequest]],
+    stream_submit: Optional[Callable] = None,
+    cancellations: Optional[List[Optional[int]]] = None,
 ) -> List[Dict]:
     """Replay ``workload`` against ``submit`` with real-clock arrival
     offsets, one thread per request (the N-independent-clients model).
     Each record carries client-side completion and, when the scheduler
-    attached them (``extras["sched"]``), server-side TTFT/completion."""
+    attached them (``extras["sched"]``), server-side TTFT/completion.
+
+    With ``stream_submit`` (a callable returning an iterator of
+    chunk-like objects with ``tokens``/``done``/``result`` — a client's
+    ``generate_stream``, or :func:`channel_chunks` over a scheduler's
+    ``submit_stream``) and a :func:`build_cancellations` plan, planned
+    requests STREAM and close the iterator after their drawn token
+    count — the wire-level disconnect that triggers server-side
+    retirement. Their records carry ``cancelled=True``, the tokens
+    actually delivered, and a client-side TTFT-at-first-chunk."""
     records: List[Optional[Dict]] = [None] * len(workload)
     start = time.monotonic()
 
@@ -167,22 +209,34 @@ def run_load(
             time.sleep(delay)
         t_submit = time.monotonic()
         rec: Dict = {"offset_s": offset, "t_submit": t_submit - start}
+        cancel_after = cancellations[i] if cancellations else None
         try:
-            result = submit(request)
+            if cancel_after is not None and stream_submit is not None:
+                self_cancelled, tokens, t_first, result = _consume_stream(
+                    stream_submit(request), cancel_after
+                )
+                t_done = time.monotonic()
+                if self_cancelled:
+                    rec.update(
+                        cancelled=True,
+                        tokens=tokens,
+                        ttft_s=(
+                            t_first - t_submit if t_first is not None else None
+                        ),
+                        completion_s=t_done - t_submit,
+                        t_done=t_done - start,
+                    )
+                    records[i] = rec
+                    return
+                # finished before the cancel point: a normal completion
+                _record_result(rec, result, t_submit, t_done, start)
+            else:
+                result = submit(request)
+                _record_result(
+                    rec, result, t_submit, time.monotonic(), start
+                )
         except BaseException as exc:  # noqa: BLE001
             rec["error"] = f"{type(exc).__name__}: {exc}"
-        else:
-            t_done = time.monotonic()
-            sched = (result.extras or {}).get("sched", {})
-            rec.update(
-                tokens=result.generated_tokens,
-                completion_s=t_done - t_submit,
-                ttft_s=sched.get("ttft_s"),
-                sched_completion_s=sched.get("completion_s"),
-                joined=sched.get("joined"),
-                join_chunks=sched.get("join_chunks"),
-                t_done=t_done - start,
-            )
         records[i] = rec
 
     threads = [
@@ -196,6 +250,75 @@ def run_load(
     return [r for r in records if r is not None]
 
 
+def _record_result(rec, result, t_submit, t_done, start) -> None:
+    sched = (result.extras or {}).get("sched", {})
+    rec.update(
+        tokens=result.generated_tokens,
+        completion_s=t_done - t_submit,
+        ttft_s=sched.get("ttft_s"),
+        sched_completion_s=sched.get("completion_s"),
+        joined=sched.get("joined"),
+        join_chunks=sched.get("join_chunks"),
+        t_done=t_done - start,
+    )
+
+
+def _consume_stream(chunks, cancel_after: int):
+    """Drain a chunk iterator until ``cancel_after`` tokens arrived,
+    then close it (the disconnect). Returns (cancelled, tokens_seen,
+    t_first_chunk, result-or-None)."""
+    tokens = 0
+    t_first = None
+    result = None
+    try:
+        for chunk in chunks:
+            if getattr(chunk, "done", False):
+                result = chunk.result
+                return False, tokens, t_first, result
+            if chunk.tokens:
+                if t_first is None:
+                    t_first = time.monotonic()
+                tokens += len(chunk.tokens)
+            if tokens >= cancel_after:
+                return True, tokens, t_first, None
+    finally:
+        close = getattr(chunks, "close", None)
+        if close is not None:
+            close()
+    # stream ended without a done record (server already saw the cancel)
+    return True, tokens, t_first, None
+
+
+def channel_chunks(channel):
+    """Adapt a scheduler egress channel (serve/stream.py TokenStream)
+    to the chunk-iterator protocol ``run_load``'s cancellation path
+    drives: closing the generator cancels the channel, mirroring an
+    HTTP client hanging up."""
+    import types
+
+    def gen():
+        finished = False
+        try:
+            for event in channel.events():
+                if event.kind == "delta":
+                    yield types.SimpleNamespace(
+                        tokens=event.tokens, done=False, result=None
+                    )
+                elif event.kind == "done":
+                    finished = True
+                    yield types.SimpleNamespace(
+                        tokens=[], done=True, result=event.result
+                    )
+                else:
+                    finished = True
+                    raise event.error
+        finally:
+            if not finished:
+                channel.cancel()
+
+    return gen()
+
+
 def percentile(values: Sequence[float], p: float) -> float:
     """Nearest-rank percentile (deterministic, no interpolation)."""
     if not values:
@@ -207,9 +330,18 @@ def percentile(values: Sequence[float], p: float) -> float:
 
 def summarize(records: List[Dict]) -> Dict:
     ok = [r for r in records if "error" not in r]
-    completions = [r["completion_s"] for r in ok]
+    completed = [r for r in ok if not r.get("cancelled")]
+    cancelled = [r for r in ok if r.get("cancelled")]
+    errors = [r for r in records if "error" in r]
+    # a shed deadline is an OUTCOME of the workload, not a failure of
+    # the harness: count it on its own next to the percentiles
+    deadline_exceeded = [
+        r for r in errors
+        if "DeadlineExceeded" in r["error"] or "504" in r["error"]
+    ]
+    completions = [r["completion_s"] for r in completed]
     ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
-    tokens = sum(r["tokens"] for r in ok)
+    tokens = sum(r["tokens"] for r in ok)  # delivered incl. partial streams
     span = (
         max(r["t_done"] for r in ok) - min(r["t_submit"] for r in ok)
         if ok
@@ -217,7 +349,9 @@ def summarize(records: List[Dict]) -> Dict:
     )
     out = {
         "requests": len(records),
-        "errors": len(records) - len(ok),
+        "errors": len(errors) - len(deadline_exceeded),
+        "cancelled": len(cancelled),
+        "deadline_exceeded": len(deadline_exceeded),
         "tokens": tokens,
         "agg_tokens_per_s": round(tokens / span, 2) if span > 0 else None,
         "completion_p50_s": round(percentile(completions, 50), 4),
@@ -265,6 +399,21 @@ def main() -> int:
         help="drive an in-process fake-backend continuous scheduler "
         "instead of a live server (hermetic demo/CI)",
     )
+    ap.add_argument(
+        "--cancel-frac", type=float, default=0.0,
+        help="fraction of requests that stream and hang up mid-flight "
+        "(seeded; exercises disconnect-driven retirement)",
+    )
+    ap.add_argument(
+        "--cancel-after-tokens-dist", default="4,32",
+        help="inclusive uniform range 'lo,hi' of delivered tokens after "
+        "which a cancelling client hangs up",
+    )
+    ap.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="per-request deadline stamped on every request "
+        "(x_deadline_ms; scheduler-enforced pre-admission + mid-flight)",
+    )
     args = ap.parse_args()
     budgets = [int(b) for b in args.budgets.split(",") if b]
     workload = build_workload(
@@ -279,7 +428,17 @@ def main() -> int:
         prompt_len_median=args.prompt_len_median,
         prompt_len_sigma=args.prompt_len_sigma,
         prompt_len_max=args.prompt_len_max,
+        deadline_ms=args.deadline_ms,
     )
+    cancellations = None
+    if args.cancel_frac > 0:
+        lo, _, hi = args.cancel_after_tokens_dist.partition(",")
+        cancellations = build_cancellations(
+            args.n,
+            args.cancel_frac,
+            after_tokens=(int(lo), int(hi or lo)),
+            seed=args.seed,
+        )
     if args.fake:
         from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
             FakeBackend,
@@ -293,7 +452,14 @@ def main() -> int:
         )
         sched.start()
         try:
-            records = run_load(sched.submit, workload)
+            records = run_load(
+                sched.submit,
+                workload,
+                stream_submit=lambda req: channel_chunks(
+                    sched.submit_stream(req)
+                ),
+                cancellations=cancellations,
+            )
         finally:
             sched.stop()
         target = "fake-continuous"
@@ -303,7 +469,12 @@ def main() -> int:
         )
 
         client = RemoteHTTPBackend(args.url)
-        records = run_load(client.generate, workload)
+        records = run_load(
+            client.generate,
+            workload,
+            stream_submit=client.generate_stream,
+            cancellations=cancellations,
+        )
         target = args.url
     else:
         ap.error("one of --url or --fake is required")

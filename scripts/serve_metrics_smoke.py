@@ -28,6 +28,15 @@ Three phases, all over the deterministic fake backend:
    ORDER — admitted → slice(s) → retired — with trace ids matching the
    joined ticket's admitted/join-chunk/retired events; the flight dump
    is written next to the span trace (the workflow uploads both).
+5. STREAMING DELIVERY + CANCELLATION (ISSUE 6): stream a long request
+   over SSE from the continuous fake server, KILL the client after a
+   few delta events, and assert the server retired the row — the
+   ``row_retired{reason="cancelled"}`` flight event fired,
+   ``llm_sched_rows_retired_total{reason="cancelled"}`` moved on
+   ``/metrics``, the stream counters
+   (``llm_stream_requests_total``/``llm_stream_chunks_total``/
+   ``llm_stream_cancelled_total``) are live, and ``/debug/state`` shows
+   the session's slots recycled (no in-flight rows left behind).
 
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
@@ -327,6 +336,94 @@ def main() -> int:
     finally:
         server4.stop()
 
+    # -- phase 5: streaming delivery + mid-stream client disconnect ------------
+    # A 600-token request streams over SSE; the client hangs up after a
+    # handful of delta events. The scheduler must notice within a slice,
+    # retire the row (reason="cancelled"), and leave the session clean.
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
+        RemoteHTTPBackend,
+    )
+
+    server5 = GenerationServer(
+        FakeBackend(tokens_per_s=300.0, simulate_delay=True),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    server5.start()
+    try:
+        base5 = f"http://127.0.0.1:{server5.port}"
+        cancelled_before = 0
+        try:
+            cancelled_before = _metric_value(
+                _scrape(base5), "llm_sched_rows_retired_total"
+            )
+        except AssertionError:
+            pass
+        client5 = RemoteHTTPBackend(base5)
+        stream = client5.generate_stream(
+            GenerationRequest("smoke:1b", "s" * 40, max_new_tokens=600)
+        )
+        delivered = 0
+        for chunk in stream:
+            delivered += len(chunk.tokens)
+            if delivered >= 8:
+                break
+        stream.close()  # the disconnect under test
+
+        # the retirement lands within a slice or two; poll briefly
+        deadline = time.monotonic() + 10.0
+        cancelled_seen = 0.0
+        while time.monotonic() < deadline:
+            text5 = _scrape(base5)
+            cancelled_lines = [
+                ln
+                for ln in text5.splitlines()
+                if ln.startswith("llm_sched_rows_retired_total")
+                and 'reason="cancelled"' in ln
+            ]
+            if cancelled_lines:
+                cancelled_seen = float(cancelled_lines[0].rsplit(" ", 1)[1])
+                if cancelled_seen >= 1:
+                    break
+            time.sleep(0.05)
+        assert cancelled_seen >= 1, (
+            f"no cancelled retirement on /metrics "
+            f"(before={cancelled_before}): {text5[:2000]}"
+        )
+        # streaming egress counters are live
+        assert _metric_value(text5, "llm_stream_requests_total") >= 1
+        assert _metric_value(text5, "llm_stream_chunks_total") >= 1
+        assert _metric_value(text5, "llm_stream_cancelled_total") >= 1
+
+        # the cancellation flight event fired, linked to a trace
+        flight5 = _get_json(
+            base5, "/debug/flight?n=500&type=row_retired"
+        )
+        cancelled_events = [
+            e for e in flight5["events"] if e.get("reason") == "cancelled"
+        ]
+        assert cancelled_events, flight5["events"][-10:]
+
+        # the session recycled the row: /debug/state shows no in-flight
+        # rows left behind (slots free for the next joiner)
+        state5 = _get_json(base5, "/debug/state")
+        sched5 = state5.get("scheduler") or {}
+        inflight5 = sched5.get("inflight") or []
+        assert not inflight5, sched5
+        session5 = sched5.get("session")
+        if session5:  # session may have drained and closed entirely
+            assert session5.get("active", 0) == 0, session5
+            assert session5.get("free_slots") == session5.get("b_bucket"), (
+                session5
+            )
+    finally:
+        server5.stop()
+
     print(
         json.dumps(
             {
@@ -349,6 +446,10 @@ def main() -> int:
                     "events": len(events),
                     "dump": flight_out,
                     "summary": flight["summary"],
+                },
+                "streaming_cancellation": {
+                    "delivered_before_disconnect": delivered,
+                    "rows_cancelled": cancelled_seen,
                 },
             }
         )

@@ -224,6 +224,42 @@ def test_serve_slice_and_chunk_knobs(monkeypatch):
         serve_command(["--prefill-chunk-tokens", "-8"])
 
 
+def test_serve_ttft_slo_knob(monkeypatch):
+    """--ttft-slo-ms reaches the server (ISSUE 6: the TTFT SLO becomes
+    enforceable at admission); 0 means off and negatives fail fast."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    cli.serve_command(
+        ["--backend", "fake", "--port", "0", "--ttft-slo-ms", "250"]
+    )
+    assert captured["ttft_slo_ms"] == 250.0
+
+    captured.clear()
+    cli.serve_command(
+        ["--backend", "fake", "--port", "0", "--ttft-slo-ms", "0"]
+    )
+    assert captured["ttft_slo_ms"] is None  # 0 = no SLO
+
+    with pytest.raises(CommandError, match="ttft-slo-ms"):
+        serve_command(["--ttft-slo-ms", "-5"])
+
+
 def test_prepare_cooldown_promise_matches_consumed_channels(monkeypatch, capsys):
     """prepare's policy line must reflect the channels the study's
     profilers actually WIRE (code-review round-4): a live battery/hwmon

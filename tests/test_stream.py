@@ -1,0 +1,525 @@
+"""Streaming delivery + cancellation (ISSUE 6): per-slice token egress,
+SSE framing, disconnect-driven retirement with exact page accounting,
+and deadline SLOs — scheduler-level (fake + real engine, all four cache
+layouts) and over the real HTTP wire."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import FakeBackend
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import REGISTRY
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import protocol
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
+    RemoteHTTPBackend,
+    RemoteServerError,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+    ContinuousScheduler,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server import (
+    GenerationServer,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.stream import (
+    DeadlineExceeded,
+    StreamCancelled,
+    TokenStream,
+)
+
+
+def _retired(reason: str) -> float:
+    return (
+        REGISTRY.counter("llm_sched_rows_retired_total", labels=("reason",))
+        .labels(reason=reason)
+        .value
+    )
+
+
+def _drain_stream(channel, timeout_s: float = 30.0):
+    """Consume a channel fully; returns (delta_tokens, delta_text, result)."""
+    tokens, text, result = [], [], None
+    for event in channel.events(timeout_s=timeout_s):
+        if event.kind == "delta":
+            tokens.extend(event.tokens)
+            text.append(event.text)
+        elif event.kind == "done":
+            result = event.result
+        else:
+            raise event.error
+    return tokens, "".join(text), result
+
+
+# -- SSE framing ---------------------------------------------------------------
+
+
+def test_sse_framing_golden():
+    """The exact wire bytes of one SSE event are a contract clients
+    parse byte-by-byte — pin them."""
+    assert protocol.sse_event({"response": "hi", "done": False}) == (
+        b'data: {"response":"hi","done":false}\n\n'
+    )
+    assert protocol.sse_event({}) == b"data: {}\n\n"
+
+
+def test_sse_records_round_trip():
+    payloads = [{"a": 1}, {"response": "x", "x_tokens": [7, 8]}, {"done": True}]
+    wire = b"".join(protocol.sse_event(p) for p in payloads)
+    lines = [ln + "\n" for ln in wire.decode().split("\n")]
+    assert list(protocol.sse_records(lines)) == payloads
+
+
+def test_sse_records_tolerates_comments_and_crlf():
+    lines = [": keepalive\r\n", 'data: {"v": 1}\r\n', "\r\n"]
+    assert list(protocol.sse_records(lines)) == [{"v": 1}]
+
+
+def test_deadline_ms_round_trips_on_wire():
+    req = GenerationRequest("m", "x", max_new_tokens=4, deadline_ms=1500)
+    assert protocol.request_from_wire(protocol.request_to_wire(req)) == req
+    # absent on the wire -> None, and never emitted when unset
+    plain = GenerationRequest("m", "x", max_new_tokens=4)
+    wire = protocol.request_to_wire(plain)
+    assert "x_deadline_ms" not in wire
+    assert protocol.request_from_wire(wire).deadline_ms is None
+    with pytest.raises(ValueError, match="deadline_ms"):
+        GenerationRequest("m", "x", max_new_tokens=4, deadline_ms=0)
+
+
+# -- the egress channel --------------------------------------------------------
+
+
+def test_token_stream_orders_deltas_before_final():
+    chan = TokenStream()
+    assert chan.push("ab", [1, 2])
+    assert chan.push("c", [3])
+    result = FakeBackend().generate(
+        GenerationRequest("m", "x", max_new_tokens=3)
+    )
+    chan.finish(result)
+    tokens, text, final = _drain_stream(chan, timeout_s=2.0)
+    assert tokens == [1, 2, 3] and text == "abc"
+    assert final is result
+
+
+def test_token_stream_cancel_unblocks_producer_and_refuses_pushes():
+    chan = TokenStream(maxsize=2)
+    assert chan.push("a", [1])
+    chan.cancel()
+    assert chan.cancelled and chan.cancel_cause == "explicit"
+    assert not chan.push("b", [2])  # consumer gone
+
+
+def test_token_stream_full_queue_is_backpressure_cancellation(monkeypatch):
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import stream
+
+    monkeypatch.setattr(stream, "PUSH_TIMEOUT_S", 0.05)
+    chan = TokenStream(maxsize=1)
+    assert chan.push("a", [1])
+    assert not chan.push("b", [2])  # nobody draining -> backpressure
+    assert chan.cancelled and chan.cancel_cause == "backpressure"
+
+
+def test_token_stream_terminal_survives_full_queue():
+    chan = TokenStream(maxsize=1)
+    assert chan.push("a", [1])
+    chan.fail(RuntimeError("boom"))  # must not block; supersedes the delta
+    events = list(chan.events(timeout_s=2.0))
+    assert events[-1].kind == "error"
+
+
+# -- scheduler-level streaming -------------------------------------------------
+
+
+def test_stream_matches_buffered_on_fake_backend():
+    sched = ContinuousScheduler(FakeBackend(), slice_steps=8)
+    sched.start()
+    try:
+        req = GenerationRequest("m", "parity", max_new_tokens=24, seed=9)
+        tokens, _, result = _drain_stream(sched.submit_stream(req))
+        buffered = sched.submit(req)
+        assert result.tokens == buffered.tokens
+        assert tokens == buffered.tokens  # concatenated deltas, exactly
+        # TTFT-at-first-chunk rides the usual sched extras
+        assert result.extras["sched"]["ttft_s"] >= 0
+    finally:
+        sched.stop()
+
+
+def test_window_scheduler_stream_degenerates_to_final_event():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        BatchScheduler,
+    )
+
+    sched = BatchScheduler(FakeBackend(), window_s=0.02)
+    sched.start()
+    try:
+        req = GenerationRequest("m", "w", max_new_tokens=8, seed=2)
+        tokens, _, result = _drain_stream(sched.submit_stream(req))
+        assert tokens == []  # no per-slice producer under window dispatch
+        assert result.tokens == FakeBackend().generate(req).tokens
+    finally:
+        sched.stop()
+
+
+def test_cancel_mid_stream_retires_row_and_frees_slot():
+    backend = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    sched = ContinuousScheduler(backend, slice_steps=8)
+    sched.start()
+    try:
+        before = _retired("cancelled")
+        req = GenerationRequest("m", "long", max_new_tokens=400)
+        chan = sched.submit_stream(req)
+        events = chan.events(timeout_s=10.0)
+        got = 0
+        for event in events:
+            assert event.kind == "delta"
+            got += len(event.tokens)
+            if got >= 8:
+                break
+        chan.cancel()
+        # the reap runs between slices: the terminal error arrives and
+        # the cancelled-retirement counter moves within a slice or two
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if _retired("cancelled") > before:
+                break
+            time.sleep(0.02)
+        assert _retired("cancelled") > before
+    finally:
+        sched.stop()
+
+
+def test_deadline_rejects_queued_ticket_before_admission():
+    backend = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    sched = ContinuousScheduler(backend, slice_steps=8)
+    sched.start()
+    try:
+        done = {}
+
+        def anchor():
+            done["a"] = sched.submit(
+                GenerationRequest("m", "anchor", max_new_tokens=300)
+            )
+
+        t = threading.Thread(target=anchor)
+        t.start()
+        time.sleep(0.1)  # the anchor session is mid-decode
+        # incompatible model -> must wait for the session to drain; its
+        # deadline passes IN THE QUEUE and it is shed pre-admission
+        with pytest.raises(DeadlineExceeded, match="queued"):
+            sched.submit(
+                GenerationRequest(
+                    "other", "q", max_new_tokens=4, deadline_ms=200
+                )
+            )
+        t.join(timeout=20)
+        assert done["a"].generated_tokens == 300
+    finally:
+        sched.stop()
+
+
+def test_deadline_retires_in_flight_row():
+    backend = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    sched = ContinuousScheduler(backend, slice_steps=8)
+    sched.start()
+    try:
+        before = _retired("deadline")
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded, match="mid-flight"):
+            sched.submit(
+                GenerationRequest(
+                    "m", "slow", max_new_tokens=1000, deadline_ms=250
+                )
+            )
+        # enforced within ~one slice of the deadline, not at drain
+        assert time.monotonic() - t0 < 2.0
+        assert _retired("deadline") > before
+    finally:
+        sched.stop()
+
+
+def test_ttft_slo_rejects_stale_queued_ticket():
+    backend = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    sched = ContinuousScheduler(backend, slice_steps=8, ttft_slo_ms=150)
+    sched.start()
+    try:
+        def anchor():
+            sched.submit(GenerationRequest("m", "anchor", max_new_tokens=300))
+
+        t = threading.Thread(target=anchor)
+        t.start()
+        time.sleep(0.1)
+        with pytest.raises(DeadlineExceeded, match="TTFT SLO"):
+            sched.submit(GenerationRequest("other", "q", max_new_tokens=4))
+        t.join(timeout=20)
+    finally:
+        sched.stop()
+
+
+# -- real engine: cancellation page accounting + 4-layout parity ---------------
+
+
+@pytest.fixture(scope="module")
+def registry():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+
+    return {"tiny": get_model_config("qwen2:1.5b").tiny()}
+
+
+def test_disconnect_returns_pages_to_pool_exactly(registry):
+    """The acceptance invariant: a cancelled streaming row's pages are
+    recycled and the pool's free count returns EXACTLY to its
+    pre-admission level, within one decode slice of the cancel."""
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+
+    eng = JaxEngine(registry=dict(registry), dtype=jnp.float32, paged_kv=True)
+    anchor = GenerationRequest(
+        "tiny", "anchor", max_new_tokens=60, stop_at_eos=False
+    )
+    victim = GenerationRequest(
+        "tiny", "victim row to cancel", max_new_tokens=60,
+        stop_at_eos=False, seed=3,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    free_before_join = sess.pool.free_pages
+    sess.step(4)
+    sess.join(victim)
+    victim_pages = next(
+        row.pages for row in sess.rows
+        if row is not None and row.request is victim
+    )
+    assert sess.pool.free_pages == free_before_join - len(victim_pages)
+    sess.step(4)
+    assert sess.cancel(victim)
+    # exact restoration: every page the victim held is back on the free
+    # list; the anchor's holdings are untouched
+    assert sess.pool.free_pages == free_before_join
+    assert sess.active == 1
+    # and the anchor decodes on, unperturbed, to its solo stream
+    results = []
+    while sess.active:
+        results.extend(sess.step(8))
+    assert results[0].tokens == eng.generate(anchor).tokens
+    sess.close()
+
+
+def test_cancelled_rows_never_credit_goodput(registry):
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+
+    goodput = REGISTRY.counter("llm_engine_goodput_tokens_total").labels()
+    eng = JaxEngine(registry=dict(registry), dtype=jnp.float32)
+    req = GenerationRequest("tiny", "wasted", max_new_tokens=40,
+                            stop_at_eos=False)
+    sess = eng.decode_open([req], reserve_rows=2)
+    sess.step(4)
+    before = goodput.value
+    assert sess.cancel(req)
+    assert goodput.value == before  # abandoned work is waste, not goodput
+    sess.close()
+
+
+@pytest.mark.parametrize(
+    "paged,kv",
+    [(False, None), (False, "int8"), (True, None), (True, "int8")],
+    ids=["contig-bf16", "contig-int8", "paged-bf16", "paged-int8"],
+)
+def test_stream_matches_buffered_all_layouts(registry, paged, kv):
+    """Stream-vs-buffered token parity on every cache layout: the
+    streamed final result AND the concatenated per-slice deltas equal
+    the buffered (solo) stream."""
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+
+    eng = JaxEngine(
+        registry=dict(registry),
+        dtype=jnp.float32,
+        paged_kv=paged,
+        kv_quantize=kv,
+    )
+    req = GenerationRequest(
+        "tiny", "stream parity row", max_new_tokens=18,
+        stop_at_eos=False, seed=4,
+    )
+    solo = eng.generate(req)
+    sched = ContinuousScheduler(eng, slice_steps=4)
+    sched.start()
+    try:
+        tokens, _, result = _drain_stream(
+            sched.submit_stream(req), timeout_s=120.0
+        )
+    finally:
+        sched.stop()
+    assert result.tokens == solo.tokens
+    assert tokens == solo.tokens
+
+
+# -- the real HTTP wire --------------------------------------------------------
+
+
+@pytest.fixture()
+def sse_server():
+    srv = GenerationServer(
+        FakeBackend(tokens_per_s=300.0, simulate_delay=True),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_http_stream_is_sse_and_token_identical(sse_server):
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{sse_server.port}")
+    req = GenerationRequest("m", "wire parity", max_new_tokens=24, seed=7)
+    chunks = list(client.generate_stream(req))
+    assert chunks[-1].done
+    final = chunks[-1].result
+    buffered = FakeBackend().generate(req)
+    assert final.tokens == buffered.tokens
+    assert final.text == buffered.text
+    assert [t for c in chunks[:-1] for t in c.tokens] == buffered.tokens
+    # extras (sched attribution) ride the final SSE event
+    assert "sched" in (final.extras or {})
+
+
+def test_http_stream_content_type_is_event_stream(sse_server):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{sse_server.port}/api/generate",
+        data=json.dumps(
+            {
+                "model": "m",
+                "prompt": "ct",
+                "stream": True,
+                "options": {"num_predict": 4},
+            }
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers.get("Content-Type") == protocol.STREAM_CONTENT_TYPE
+        resp.read()
+
+
+def test_http_disconnect_mid_stream_cancels_server_side(sse_server):
+    """Kill the socket mid-stream: the server's next SSE write fails,
+    the channel cancels, and the scheduler retires the row
+    (reason="cancelled") — observable on /metrics and in free slots."""
+    before = _retired("cancelled")
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{sse_server.port}")
+    req = GenerationRequest("m", "to be cancelled", max_new_tokens=600)
+    gen = client.generate_stream(req)
+    got = 0
+    for chunk in gen:
+        got += len(chunk.tokens)
+        if got >= 8:
+            break
+    gen.close()  # early close = the documented cancellation trigger
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if _retired("cancelled") > before:
+            break
+        time.sleep(0.05)
+    assert _retired("cancelled") > before
+
+
+def test_http_stream_unknown_model_is_clean_404(sse_server):
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{sse_server.port}")
+    sse_server.models.extend(["m"])  # allowlist excludes "nope"
+    with pytest.raises(RemoteServerError) as exc_info:
+        list(client.generate_stream(GenerationRequest("nope", "x", 4)))
+    assert exc_info.value.status == 404
+
+
+def test_http_deadline_maps_to_504():
+    srv = GenerationServer(
+        FakeBackend(tokens_per_s=150.0, simulate_delay=True),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    srv.start()
+    try:
+        client = RemoteHTTPBackend(f"http://127.0.0.1:{srv.port}")
+        with pytest.raises(RemoteServerError) as exc_info:
+            client.generate(
+                GenerationRequest(
+                    "m", "slow", max_new_tokens=1000, deadline_ms=200
+                )
+            )
+        assert exc_info.value.status == 504
+    finally:
+        srv.stop()
+
+
+def test_server_plumbs_ttft_slo_knob():
+    srv = GenerationServer(
+        FakeBackend(), host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous", ttft_slo_ms=250.0,
+    )
+    assert srv._scheduler.ttft_slo_ms == 250.0
+    assert srv._scheduler.debug_state()["ttft_slo_ms"] == 250.0
+    srv.stop()
+
+
+def test_streamed_ticket_failure_ends_channel():
+    """Every scheduler failure path must terminate the egress channel —
+    a consumer can never be stranded (here: shutdown mid-stream)."""
+    sched = ContinuousScheduler(
+        FakeBackend(tokens_per_s=100.0, simulate_delay=True), slice_steps=8
+    )
+    sched.start()
+    chan = sched.submit_stream(
+        GenerationRequest("m", "orphaned", max_new_tokens=500)
+    )
+    events = chan.events(timeout_s=10.0)
+    next(events)  # stream is live
+    sched.stop()
+    terminal = list(events)[-1]
+    assert terminal.kind == "error"
+    assert "shutting down" in str(terminal.error)
+
+
+def test_stream_cancelled_exception_type():
+    """The explicit cancel path surfaces as StreamCancelled on the
+    ticket (the server closes quietly; in-process callers can match)."""
+    backend = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    sched = ContinuousScheduler(backend, slice_steps=8)
+    sched.start()
+    try:
+        chan = sched.submit_stream(
+            GenerationRequest("m", "x", max_new_tokens=400)
+        )
+        events = chan.events(timeout_s=10.0)
+        next(events)
+        chan.cancel()
+        terminal = list(events)
+        # cancel() drained the queue; the terminal error may be the only
+        # event left — and it must be the cancellation
+        assert terminal and terminal[-1].kind == "error"
+        assert isinstance(terminal[-1].error, StreamCancelled)
+    finally:
+        sched.stop()
