@@ -593,6 +593,276 @@ def streaming_cancellation_bench() -> int:
     return 0
 
 
+def shared_prefix_bench() -> int:
+    """A/B of shared-prefix copy-on-write paging (ISSUE 7) on a
+    high-share Poisson trace: the chunked-join baseline (every joiner
+    prefills its whole prompt) vs `prefix_share=True` (joiners map the
+    anchor's refcounted read-only prefix pages and chunk-prefill only
+    the divergent tail).
+
+    Headline figures at the same seeded trace: joiner TTFT p50/p95,
+    prefill tokens actually COMPUTED (prompt tokens minus
+    llm_prefix_hit_tokens_total's delta), pool high-water (peak pages
+    in use — shared pages billed once shrink it), aggregate tok/s
+    (sharing must not cost throughput), and bit-parity of every stream
+    vs solo generate() in BOTH arms. A second part drives sessions
+    directly on the bf16 AND int8 paged pools: N sharers admitted then
+    all retired (incl. a mid-flight cancellation) must restore the
+    pool free-count EXACTLY, and close() must restore it fully.
+    CPU-functional like the chunked_join bench; RELATIVE positions are
+    the result (docs/PERF.md "Shared-prefix CoW paging"). Prints ONE
+    JSON line.
+    """
+    import os as _os
+    import sys as _sys
+    import threading as _threading
+
+    _sys.path.insert(
+        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "scripts")
+    )
+    import jax
+    import jax.numpy as jnp
+    from poisson_load import build_workload, percentile, run_load, summarize
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.paged_kv import (
+        _POOL_FREE,
+        _POOL_PAGES,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.prefix import (
+        PREFIX_COW_COPIES_C,
+        PREFIX_HIT_TOKENS_C,
+        PREFIX_SHARED_PAGES_G,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    on_accelerator = jax.default_backend() in ("tpu", "axon")
+    cfg = get_model_config("qwen2:1.5b")
+    if not on_accelerator:
+        # room for the 192-token shared prefix + tails + budgets
+        cfg = cfg.tiny(max_seq_len=1024)
+    dtype = jnp.bfloat16 if on_accelerator else jnp.float32
+
+    # arrivals dense enough that admission prefill CONTENDS with decode
+    # (the regime sharing exists for: under a sparse trace both arms
+    # idle between joiners and the A/B only moves TTFT)
+    n = int(_os.environ.get("BENCH_SP_REQUESTS", "16"))
+    mean_ms = float(_os.environ.get("BENCH_SP_INTERARRIVAL_MS", "25"))
+    chunk_tokens = int(_os.environ.get("BENCH_SP_CHUNK_TOKENS", "64"))
+    slice_steps = int(_os.environ.get("BENCH_SP_SLICE_STEPS", "8"))
+    share_frac = float(_os.environ.get("BENCH_SP_SHARE_FRAC", "0.75"))
+    prefix_tokens = int(_os.environ.get("BENCH_SP_PREFIX_TOKENS", "192"))
+    # anchor rotates onto the LONG budget so the session outlives the
+    # arrivals and carries the page-backed shared prefix (see
+    # anchor_shared_prefix in scripts/poisson_load.py)
+    budgets = (192, 10, 16)
+    workload = build_workload(
+        n,
+        mean_ms / 1e3,
+        seed=7,
+        model=cfg.name,
+        budgets=budgets,
+        stop_at_eos=False,  # fixed lengths: both arms do equal work
+        shared_prefix_frac=share_frac,
+        prefix_pool=1,
+        shared_prefix_tokens=prefix_tokens,
+        anchor_shared_prefix=True,
+    )
+    prompt_tokens = [len(req.prompt) + 1 for _, req in workload]
+    shared_requests = sum(
+        1 for _, req in workload if req.prompt.startswith("<sys0>")
+    )
+
+    def make_engine(share: bool) -> JaxEngine:
+        return JaxEngine(
+            registry={cfg.name: cfg},
+            dtype=dtype,
+            decode_attention="auto" if on_accelerator else None,
+            paged_kv=True,
+            prefix_share=share,
+        )
+
+    engines = {False: make_engine(False), True: make_engine(True)}
+    # solo references: parity oracle AND warm-up of the solo shapes
+    solo = {
+        id(req): engines[False].generate(req).tokens for _, req in workload
+    }
+
+    def run_arm(share: bool):
+        engine = engines[share]
+        sched = ContinuousScheduler(
+            engine,
+            slice_steps=slice_steps,
+            prefill_chunk_tokens=chunk_tokens,
+            chunked_joins=True,
+        )
+        hits0 = PREFIX_HIT_TOKENS_C.labels().value
+        cow0 = PREFIX_COW_COPIES_C.labels().value
+        tokens_by_req = {}
+        high_water = {"pages": 0.0, "shared": 0.0}
+        stop_probe = _threading.Event()
+
+        def probe():
+            while not stop_probe.wait(0.01):
+                total = _POOL_PAGES.labels().value
+                free = _POOL_FREE.labels().value
+                high_water["pages"] = max(
+                    high_water["pages"], total - free
+                )
+                high_water["shared"] = max(
+                    high_water["shared"], PREFIX_SHARED_PAGES_G.labels().value
+                )
+
+        def submit(req):
+            res = sched.submit(req)
+            tokens_by_req[id(req)] = res.tokens
+            return res
+
+        sched.start()
+        prober = _threading.Thread(target=probe, daemon=True)
+        prober.start()
+        try:
+            records = run_load(submit, workload)
+        finally:
+            sched.stop()
+            stop_probe.set()
+            prober.join(timeout=2)
+        joiners = [r for r in records if r.get("joined")]
+        joiner_ttfts = [
+            r["ttft_s"] for r in joiners if r.get("ttft_s") is not None
+        ]
+        hit_tokens = PREFIX_HIT_TOKENS_C.labels().value - hits0
+        return {
+            **summarize(records),
+            "joined": len(joiners),
+            "joiner_ttft_p50_s": (
+                round(percentile(joiner_ttfts, 50), 4)
+                if joiner_ttfts
+                else None
+            ),
+            "joiner_ttft_p95_s": (
+                round(percentile(joiner_ttfts, 95), 4)
+                if joiner_ttfts
+                else None
+            ),
+            "prefill_tokens_total": sum(prompt_tokens),
+            "prefix_hit_tokens": int(hit_tokens),
+            "prefill_tokens_computed": int(sum(prompt_tokens) - hit_tokens),
+            "cow_copies": int(PREFIX_COW_COPIES_C.labels().value - cow0),
+            "pool_high_water_pages": int(high_water["pages"]),
+            "shared_pages_high_water": int(high_water["shared"]),
+            "parity_vs_solo": all(
+                tokens_by_req.get(i) == toks for i, toks in solo.items()
+            ),
+        }
+
+    # warm BOTH arms outside the measured traces (session shapes, chunk
+    # prefill buckets, stepped decode fns — neither arm may pay XLA)
+    run_arm(False)
+    run_arm(True)
+    results = {"baseline": run_arm(False), "prefix_share": run_arm(True)}
+
+    # part 2: exact pool accounting on both quantizations — N sharers
+    # admitted then all retired (eos/budget AND a mid-flight cancel)
+    # restore the free-count exactly; close() restores the pool fully
+    accounting = {}
+    shared_sys = "<sys0>" + "s" * (prefix_tokens - 7)
+    for kv in (None, "int8"):
+        eng = JaxEngine(
+            registry={cfg.name: cfg},
+            dtype=dtype,
+            decode_attention="auto" if on_accelerator else None,
+            paged_kv=True,
+            kv_quantize=kv,
+            prefix_share=True,
+        )
+        anchor = GenerationRequest(
+            cfg.name, shared_sys + " anchor", max_new_tokens=160,
+            stop_at_eos=False, seed=1,
+        )
+        sess = eng.decode_open([anchor], reserve_rows=8)
+        sess.step(4)
+        free_before = sess.pool.free_pages
+        sharers = [
+            GenerationRequest(
+                cfg.name, shared_sys + f" q{k}", max_new_tokens=8,
+                stop_at_eos=False, seed=k + 2,
+            )
+            for k in range(3)
+        ]
+        for req in sharers[:2]:
+            sess.join(req)
+        sess.join(sharers[2])
+        sess.cancel(sharers[2])  # the cancellation path frees shared refs too
+        done = 0
+        while done < 2:
+            done += len(sess.step(8))
+        restored = sess.pool.free_pages == free_before
+        total = sess.pool.n_pages
+        sess.close()
+        accounting["int8" if kv else "bf16"] = {
+            "free_restored_after_sharers": bool(restored),
+            "close_restores_pool": sess.pool.free_pages == total - 1,
+        }
+
+    line = {
+        "metric": "shared_prefix",
+        "unit": "latency_seconds",
+        "model": cfg.name,
+        "backend": jax.default_backend(),
+        "n_layers": cfg.n_layers,
+        "requests": n,
+        "mean_interarrival_ms": mean_ms,
+        "budgets": list(budgets),
+        "shared_prefix": {
+            "frac": share_frac,
+            "tokens": prefix_tokens,
+            "pool": 1,
+            "shared_requests": shared_requests,
+        },
+        "prefill_chunk_tokens": chunk_tokens,
+        "decode_slice_steps": slice_steps,
+        **results,
+        "joiner_ttft_p50_ratio": (
+            round(
+                results["baseline"]["joiner_ttft_p50_s"]
+                / results["prefix_share"]["joiner_ttft_p50_s"],
+                2,
+            )
+            if results["baseline"]["joiner_ttft_p50_s"]
+            and results["prefix_share"]["joiner_ttft_p50_s"]
+            else None
+        ),
+        "computed_prefill_ratio": (
+            round(
+                results["prefix_share"]["prefill_tokens_computed"]
+                / results["baseline"]["prefill_tokens_computed"],
+                3,
+            )
+            if results["baseline"]["prefill_tokens_computed"]
+            else None
+        ),
+        "pool_accounting": accounting,
+    }
+    _attach_obs(line)
+    print(json.dumps(line))
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "continuous_batching":
         return continuous_batching_bench()
@@ -600,6 +870,8 @@ def main() -> int:
         return chunked_join_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "streaming_cancellation":
         return streaming_cancellation_bench()
+    if len(sys.argv) > 1 and sys.argv[1] == "shared_prefix":
+        return shared_prefix_bench()
     import jax
 
     backend = jax.default_backend()

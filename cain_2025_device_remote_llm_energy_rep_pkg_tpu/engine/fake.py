@@ -26,6 +26,10 @@ from typing import Dict, List, Optional
 from ..obs.detect import observe_retired_tokens, observe_slice_tokens
 from .backend import GenerationBackend, GenerationRequest, GenerationResult
 
+# Fake "page" granularity for the shared-prefix simulation: small enough
+# that smoke-test prompts span several pages (1 byte ≈ 1 prompt token).
+FAKE_PREFIX_PAGE = 16
+
 
 class _FakeStepSession:
     """Stepped-decode session over precomputed deterministic streams."""
@@ -49,8 +53,49 @@ class _FakeStepSession:
         # stream_deltas() drain
         self.stream_tokens = False
         self._stream_tail: List[tuple] = []
+        # shared-prefix simulation (backend.prefix_share — the fake twin
+        # of engine/prefix.py so the CI smoke can assert the
+        # llm_prefix_* families hermetically): published prompt byte
+        # streams + the count of shared pages live rows currently map
+        self._prefix_pub: List[bytes] = []
+        self._shared_live = 0
         for r in requests:
             self._admit(r)
+
+    def _prefix_probe(self, request: GenerationRequest) -> dict:
+        """Longest published common prefix for this prompt, page-floored
+        — mirrors SteppedDecodeSession._prefix_hit + observe_hit."""
+        prompt = request.prompt.encode("utf-8")
+        out = {"hit_tokens": 0, "shared_pages": 0}
+        if not self.backend.prefix_share:
+            return out
+        best = 0
+        for pub in self._prefix_pub:
+            n = min(len(pub), len(prompt), len(prompt) - 1)
+            common = 0
+            while common < n and pub[common] == prompt[common]:
+                common += 1
+            best = max(best, common)
+        if best > 0:
+            from .prefix import PREFIX_SHARED_PAGES_G, observe_hit
+
+            shared = best // FAKE_PREFIX_PAGE
+            out = {"hit_tokens": best, "shared_pages": shared}
+            observe_hit(
+                best, shared, cow=best > shared * FAKE_PREFIX_PAGE
+            )
+            self._shared_live += shared
+            PREFIX_SHARED_PAGES_G.set(self._shared_live)
+        self._prefix_pub.append(prompt)
+        return out
+
+    def _prefix_release(self, row: dict) -> None:
+        shared = row.get("shared_pages", 0)
+        if shared:
+            from .prefix import PREFIX_SHARED_PAGES_G
+
+            self._shared_live = max(0, self._shared_live - shared)
+            PREFIX_SHARED_PAGES_G.set(self._shared_live)
 
     def _admit(self, request: GenerationRequest) -> None:
         self._rows.append(
@@ -59,6 +104,7 @@ class _FakeStepSession:
                 "result": self.backend._result(request),
                 "cursor": 0,
                 "streamed": 0,
+                **self._prefix_probe(request),
             }
         )
 
@@ -173,6 +219,7 @@ class _FakeStepSession:
                     self._stream_tail.append(
                         (res.request, tail, res.text[row["streamed"] :])
                     )
+                self._prefix_release(row)
                 retired.append(res)
             else:
                 keep.append(row)
@@ -208,21 +255,34 @@ class _FakeStepSession:
         the partial stream is discarded."""
         for row in self._rows:
             if row["request"] is request:
+                self._prefix_release(row)
                 self._rows.remove(row)
                 return True
         return False
 
     def close(self) -> None:
         self.closed = True
+        for row in self._rows:
+            self._prefix_release(row)
         self._rows = []
         self._pending = []
         self._stream_tail = []
+        self._prefix_pub = []
 
 
 class FakeBackend(GenerationBackend):
-    def __init__(self, tokens_per_s: float = 1000.0, simulate_delay: bool = False):
+    def __init__(
+        self,
+        tokens_per_s: float = 1000.0,
+        simulate_delay: bool = False,
+        prefix_share: bool = False,
+    ):
         self.tokens_per_s = tokens_per_s
         self.simulate_delay = simulate_delay
+        # the fake twin of JaxEngine(prefix_share=True): stepped sessions
+        # simulate shared-prefix hits so llm_prefix_* telemetry is
+        # CI-testable with no accelerator (see _FakeStepSession)
+        self.prefix_share = prefix_share
         self.loaded: Dict[str, bool] = {}
 
     def load_model(self, model: str) -> None:

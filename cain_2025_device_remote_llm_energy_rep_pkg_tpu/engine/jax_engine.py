@@ -281,6 +281,8 @@ class JaxEngine(GenerationBackend):
         kv_quantize: Optional[str] = None,  # None | "int8" (decode path)
         paged_kv: bool = False,  # batched decode over a paged pool
         page_size: int = 128,
+        prefix_share: bool = False,  # session shared-prefix CoW paging
+        prefix_index_entries: int = 16,  # per-session prefix-index cap
     ) -> None:
         # quantize: one mode for every model (None | "int8" | "int4"), or a
         # per-model dict {model: mode} with an optional "default" key — a
@@ -309,17 +311,20 @@ class JaxEngine(GenerationBackend):
         # quantized once before decoding). Halves the cache stream — the
         # dominant per-step bytes for many-KV-head models at long context
         # (phi3: ~0.8 GB/step at 2k). Composes with generate/stream/batch,
-        # the TP engine, and paged_kv (int8 page pool); still incompatible
-        # with speculative decoding and prefix caching (both thread bf16
-        # caches across calls).
+        # the TP engine, paged_kv (int8 page pool) AND the prefix caches:
+        # both the solo LRU (_store_prefix keeps the PRE-quantization bf16
+        # cache, _find_prefix seeds the next bf16 cache before its
+        # post-prefill quantization) and the session prefix index
+        # (engine/prefix.py seed slabs are pre-quantization by
+        # construction) — the former int8×prefix exclusion is retired
+        # (ISSUE 7). Still incompatible with speculative decoding (the
+        # draft/verify loops thread bf16 caches ACROSS decode calls).
         if kv_quantize not in (None, "int8"):
             raise ValueError(f"unsupported kv_quantize mode: {kv_quantize!r}")
-        if kv_quantize and (
-            speculative or prefix_cache_size or prefix_cache_bytes is not None
-        ):
+        if kv_quantize and speculative:
             raise ValueError(
-                "kv_quantize is incompatible with speculative decoding and "
-                "prefix caching (both thread bf16 caches)"
+                "kv_quantize is incompatible with speculative decoding "
+                "(draft-verify threads bf16 caches across decode calls)"
             )
         # paged_kv=True: generate_batch decodes over a shared page pool
         # (engine/paged_kv.py) instead of one max-shape contiguous cache —
@@ -342,6 +347,19 @@ class JaxEngine(GenerationBackend):
         self.paged_kv = paged_kv
         self.page_size = page_size
         self.kv_quantize = kv_quantize
+        # prefix_share=True: stepped decode sessions keep a session-scoped
+        # shared-prefix index (engine/prefix.py) — joiners whose prompt
+        # shares a published prefix map its refcounted read-only pool
+        # pages and chunk-prefill only the divergent tail (CoW on the
+        # boundary page). Works on all four cache layouts; page sharing
+        # engages on the paged pools, seed-only reuse on contiguous.
+        # CLI twin: `serve --prefix-share` (+ --prefix-index-entries).
+        self.prefix_share = bool(prefix_share)
+        if prefix_index_entries < 1:
+            raise ValueError(
+                f"prefix_index_entries must be >= 1, got {prefix_index_entries}"
+            )
+        self.prefix_index_entries = int(prefix_index_entries)
         self.quantize = quantize
         # target model → (draft model, k): greedy requests for the target
         # route through speculative decoding (engine/speculative.py).
@@ -2770,7 +2788,6 @@ class JaxEngine(GenerationBackend):
         g_bucket = _bucket(
             max(r.max_new_tokens for r in requests), GEN_BUCKETS
         )
-        max_rows = BATCH_MIN_SPLIT_ROWS
         if self.paged_kv:
             page = self.page_size
             stacked = self._paged_decode_attention(cfg) is not None
@@ -2783,32 +2800,49 @@ class JaxEngine(GenerationBackend):
                 else -(-(len(ids) + r.max_new_tokens) // page)
                 for r, ids in zip(requests, all_prompt_ids)
             ]
-            for b in BATCH_BUCKETS:
-                if b <= max_rows:
-                    continue
-                chunks = [
-                    rows_pages[i : i + b]
-                    for i in range(0, len(rows_pages), b)
-                ]
-                if all(
-                    self._paged_chunk_bytes(
-                        cfg,
-                        chunk,
-                        _bucket(len(chunk), BATCH_BUCKETS),
-                        g_bucket,
-                        stacked,
-                    )
-                    <= BATCH_KV_BUDGET_BYTES
-                    for chunk in chunks
-                ):
-                    max_rows = b
-            return max_rows
+            return self._paged_rows_cap(cfg, rows_pages, g_bucket, stacked)
+        max_rows = BATCH_MIN_SPLIT_ROWS
         s_bucket = max(
             _prompt_alloc(len(ids)) for ids in all_prompt_ids
         )
         bytes_per_row = self._contiguous_row_bytes(cfg, s_bucket, g_bucket)
         for b in BATCH_BUCKETS:
             if b > max_rows and b * bytes_per_row <= BATCH_KV_BUDGET_BYTES:
+                max_rows = b
+        return max_rows
+
+    def _paged_rows_cap(
+        self,
+        cfg: ModelConfig,
+        rows_pages: "list[int]",
+        g_bucket: int,
+        stacked: bool,
+    ) -> int:
+        """Widest batch bucket whose paged pool+side bytes fit the
+        budget for the given PER-ROW page bill — factored out so the
+        admission estimator can bill shared-prefix sharers their OWN
+        pages only (:meth:`max_admission_rows`) while the batch
+        splitter keeps billing full allocation (the one-shot batch path
+        does not share pages)."""
+        max_rows = BATCH_MIN_SPLIT_ROWS
+        for b in BATCH_BUCKETS:
+            if b <= max_rows:
+                continue
+            chunks = [
+                rows_pages[i : i + b]
+                for i in range(0, len(rows_pages), b)
+            ]
+            if all(
+                self._paged_chunk_bytes(
+                    cfg,
+                    chunk,
+                    _bucket(len(chunk), BATCH_BUCKETS),
+                    g_bucket,
+                    stacked,
+                )
+                <= BATCH_KV_BUDGET_BYTES
+                for chunk in chunks
+            ):
                 max_rows = b
         return max_rows
 
@@ -2830,6 +2864,26 @@ class JaxEngine(GenerationBackend):
         )
         ids = self._tokenizer_for(model).encode(request.prompt)
         width = max(BATCH_BUCKETS)
+        if self.paged_kv and self.prefix_share and ids:
+            # Shared-prefix billing (ISSUE 7): under prefix sharing a
+            # fleet anchored by this request shares the prompt's full
+            # page-aligned pages — the FIRST row pays them, every later
+            # sharer is billed only its divergent-tail pages (here: the
+            # boundary CoW page + generation pages). The session-level
+            # pool accounting enforces the same rule exactly
+            # (can_join/join_begin); this estimate just stops the row
+            # cap from under-admitting the fleet the pool can hold.
+            page = self.page_size
+            stacked = self._paged_decode_attention(cfg) is not None
+            need = (
+                -(-max(len(ids), 1) // page)
+                if stacked
+                else -(-(len(ids) + request.max_new_tokens) // page)
+            )
+            shared = min((len(ids) - 1) // page, need - 1)
+            rows_pages = [need] + [need - shared] * (width - 1)
+            g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
+            return self._paged_rows_cap(cfg, rows_pages, g_bucket, stacked)
         return self._max_batch_rows(cfg, [request] * width, [ids] * width)
 
     def generate_batch(

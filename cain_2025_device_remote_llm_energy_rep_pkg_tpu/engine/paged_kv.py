@@ -25,13 +25,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..obs.flight import EV_POOL_EXHAUSTED, FLIGHT
 from ..obs.metrics import REGISTRY, enabled as _obs_enabled
+from .prefix import PREFIX_SHARED_PAGES_G
 
 DEFAULT_PAGE_SIZE = 128
 
@@ -73,13 +74,16 @@ def _fragmentation(free: List[int]) -> float:
     return 1.0 - longest / len(free)
 
 
-def _publish_pool_gauges(free: List[int], total: int) -> None:
+def _publish_pool_gauges(
+    free: List[int], total: int, shared: int = 0
+) -> None:
     if not _obs_enabled():
         return
     _POOL_PAGES.set(total)
     _POOL_FREE.set(len(free))
     _POOL_OCCUPANCY.set(1.0 - len(free) / total if total else 0.0)
     _POOL_FRAGMENTATION.set(_fragmentation(free))
+    PREFIX_SHARED_PAGES_G.set(shared)
 
 
 def _codes(leaf):
@@ -99,6 +103,15 @@ class PagePool:
     The arrays are functional (every write returns new arrays); the
     allocator is host state owned by whoever schedules requests.
 
+    Allocation is REFCOUNTED (ISSUE 7 shared-prefix paging): ``alloc``
+    hands out pages at one reference, :meth:`share` adds a reader (a
+    prefix-index entry, a joiner mapping read-only prefix pages into
+    its table row), and :meth:`free` drops one reference — a page
+    returns to the free list only when its LAST reader lets go. Every
+    pre-existing call site (row retirement, cancellation, join abort,
+    session close) therefore keeps its exact-free-count contract
+    unchanged whether or not its pages are shared.
+
     ``quantized=True`` makes each pool leaf an int8 ``{"q": codes
     [L, P, Hkv, page, D], "s": f32 scales [L, P, Hkv, page]}`` dict —
     one symmetric scale per (layer, page, head, position) vector, the
@@ -114,6 +127,8 @@ class PagePool:
     v: "jnp.ndarray | dict"
     page_size: int
     _free: List[int] = dataclasses.field(default_factory=list)
+    # page index -> live reference count; absent = on the free list
+    _refs: Dict[int, int] = dataclasses.field(default_factory=dict)
 
     @classmethod
     def create(
@@ -157,6 +172,15 @@ class PagePool:
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def shared_pages(self) -> int:
+        """Pages currently held by MORE than one reader — the
+        ``llm_prefix_shared_pages`` gauge's definition."""
+        return sum(1 for c in self._refs.values() if c >= 2)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 
@@ -173,6 +197,7 @@ class PagePool:
                 1.0 - len(self._free) / total if total else 0.0, 4
             ),
             "fragmentation": round(_fragmentation(self._free), 4),
+            "shared_pages": self.shared_pages,
         }
 
     def alloc(self, n_pages: int) -> List[int]:
@@ -189,7 +214,9 @@ class PagePool:
                 f"{self.n_pages} — evict a finished request or grow the pool"
             )
         pages, self._free = self._free[:n_pages], self._free[n_pages:]
-        _publish_pool_gauges(self._free, self.n_pages)
+        for p in pages:
+            self._refs[p] = 1
+        _publish_pool_gauges(self._free, self.n_pages, self.shared_pages)
         return pages
 
     def try_alloc(self, n_pages: int) -> "Optional[List[int]]":
@@ -200,9 +227,31 @@ class PagePool:
             return None
         return self.alloc(n_pages)
 
+    def share(self, pages: List[int]) -> None:
+        """Add one reader to each page (shared-prefix mapping): the page
+        now recycles only after every holder calls :meth:`free` once."""
+        for p in pages:
+            if p not in self._refs:
+                raise ValueError(
+                    f"page {p} is not allocated — cannot share a free page"
+                )
+            self._refs[p] += 1
+        _publish_pool_gauges(self._free, self.n_pages, self.shared_pages)
+
     def free(self, pages: List[int]) -> None:
-        self._free.extend(pages)
-        _publish_pool_gauges(self._free, self.n_pages)
+        """Drop one reference per page; pages whose last reader left
+        return to the free list. Double-free (a page already free) is a
+        bookkeeping bug and raises rather than corrupting the pool."""
+        for p in pages:
+            refs = self._refs.get(p)
+            if refs is None:
+                raise ValueError(f"page {p} is already free (double free)")
+            if refs > 1:
+                self._refs[p] = refs - 1
+            else:
+                del self._refs[p]
+                self._free.append(p)
+        _publish_pool_gauges(self._free, self.n_pages, self.shared_pages)
 
 
 def page_slot(table, lengths, page_size: int):

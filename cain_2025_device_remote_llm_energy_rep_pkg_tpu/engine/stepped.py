@@ -96,17 +96,23 @@ class _PendingJoin:
     solo cache the chunks accumulate into, and the cursor over the
     token-budgeted chunk list. Holds its paged pages from ``join_begin``
     (reserved against concurrent joiners) until commit installs them or
-    abort frees them."""
+    abort frees them. With a shared-prefix hit, ``hit_tokens`` leading
+    positions were SEEDED instead of computed (the chunk list starts at
+    the divergence) and the first ``shared_pages`` entries of ``pages``
+    are read-only mappings of the index entry's pool pages (one
+    ``pool.share`` reference each — ``pool.free`` on abort/retire drops
+    exactly that reference)."""
 
     __slots__ = (
         "request", "slot", "ids", "chunks", "next_chunk", "cache_len",
         "k_cache", "v_cache", "presence", "logits", "pages",
-        "prefill_s", "t0",
+        "prefill_s", "t0", "hit_tokens", "shared_pages",
     )
 
     def __init__(
         self, request, slot, ids, chunks, cache_len,
         k_cache, v_cache, presence, pages,
+        hit_tokens=0, shared_pages=0,
     ):
         self.request = request
         self.slot = slot
@@ -121,6 +127,8 @@ class _PendingJoin:
         self.pages: List[int] = pages
         self.prefill_s = 0.0  # sum of chunk walls (not the interleaved span)
         self.t0 = time.monotonic()
+        self.hit_tokens = hit_tokens
+        self.shared_pages = shared_pages
 
     @property
     def total_chunks(self) -> int:
@@ -174,6 +182,18 @@ class SteppedDecodeSession:
         self._pending: Dict[int, _PendingJoin] = {}
         self.use_top_p = False
         self.use_rp = False
+        # Shared-prefix index (ISSUE 7, engine/prefix.py): session-scoped
+        # longest-match map of published prompt prefixes. None when
+        # engine.prefix_share is off — every prefix code path below
+        # guards on it, so the off configuration is bit-for-bit the
+        # pre-ISSUE-7 session.
+        self.prefix = None
+        if getattr(engine, "prefix_share", False):
+            from .prefix import PrefixIndex
+
+            self.prefix = PrefixIndex(
+                getattr(engine, "prefix_index_entries", 16)
+            )
         # Streaming egress (serve/stream.py): the scheduler flips
         # stream_tokens on while any live ticket streams; only then do
         # retirements buffer their tail deltas for the next
@@ -324,6 +344,11 @@ class SteppedDecodeSession:
             )
         self.k_cache, self.v_cache = k_cache, v_cache
         self._open_common(requests, states, pad)
+        if self.prefix is not None:
+            for ids, st, row in zip(all_ids, states, self.rows):
+                self._publish_prefix(
+                    ids, st["k_cache"], st["v_cache"], row.pages
+                )
 
     def _open_paged(self, requests, all_ids) -> None:
         import numpy as np
@@ -445,6 +470,11 @@ class SteppedDecodeSession:
         self._open_common(requests, states, pad)
         for row, pages in zip(self.rows, row_pages):
             row.pages = pages
+        if self.prefix is not None:
+            for ids, st, row in zip(all_ids, states, self.rows):
+                self._publish_prefix(
+                    ids, st["k_cache"], st["v_cache"], row.pages
+                )
 
     def _pages_needed(self, s_real: int, max_new_tokens: int) -> int:
         """Pages one row pins: prompt-only in stacked mode (generated
@@ -454,6 +484,60 @@ class SteppedDecodeSession:
         if self.stacked:
             return -(-max(s_real, 1) // page)
         return -(-(s_real + max_new_tokens) // page)
+
+    # -- shared-prefix index (engine/prefix.py, ISSUE 7) -----------------------
+    def _publish_prefix(
+        self, ids, k_cache, v_cache, pages, page_cap: Optional[int] = None
+    ) -> None:
+        """Index a completed prompt prefill: full page-aligned prompt
+        pages (safe to share — prefill wrote them and neither layout
+        writes a FULL prompt page again: decode appends land at
+        positions >= s_real) plus the bf16 seed slab the divergent-tail
+        prefill of a future sharer attends through. ``k_cache`` is the
+        row's PRE-QUANTIZATION private cache ``[L, 1, Hkv, S, D]``.
+
+        ``page_cap`` bounds how many leading pages the entry references:
+        a JOINER's publish is capped at the pages it itself mapped from
+        the index (already index-held), so a sharer's own tail pages are
+        never pinned past its retirement — that is what keeps the exact
+        free-count restoration invariant ("N sharers admitted then all
+        retired restores the pool") while its seed slab still covers the
+        full prompt for future compute reuse. Anchors (session open)
+        publish uncapped — their prompt pages outliving them is the
+        feature."""
+        s_real = len(ids)
+        if self.prefix is None or s_real < 2:
+            return
+        k_seed = k_cache[:, 0, :, :s_real]
+        v_seed = v_cache[:, 0, :, :s_real]
+        if self.paged:
+            full = s_real // self.page_size
+            if page_cap is not None:
+                full = min(full, page_cap)
+            self.prefix.publish(
+                ids, pages[:full], k_seed, v_seed, self.pool
+            )
+        else:
+            self.prefix.publish(ids, [], k_seed, v_seed, None)
+
+    def _prefix_hit(self, ids: "List[int]"):
+        """Longest usable index hit for ``ids``: ``(entry, common,
+        shared_full_pages)`` with ``common`` capped so at least one tail
+        token is still computed (prefill must produce last-position
+        logits), or None. Side-effect free — ``can_join`` probes it."""
+        if self.prefix is None:
+            return None
+        m = self.prefix.match(ids)
+        if m is None:
+            return None
+        entry, common = m
+        common = min(common, len(ids) - 1)
+        if common <= 0:
+            return None
+        shared = 0
+        if self.paged:
+            shared = min(common // self.page_size, len(entry.pages))
+        return entry, common, shared
 
     # -- introspection --------------------------------------------------------
     @property
@@ -516,6 +600,8 @@ class SteppedDecodeSession:
         }
         if self.paged:
             state["pool"] = self.pool.debug_state()
+        if self.prefix is not None:
+            state["prefix"] = self.prefix.debug_state()
         return state
 
     # -- stepping -------------------------------------------------------------
@@ -731,7 +817,8 @@ class SteppedDecodeSession:
             return False
         if request.model != self.model or request.top_k != self.top_k:
             return False
-        ids_len = len(self.tok.encode(request.prompt))
+        ids = self.tok.encode(request.prompt)
+        ids_len = len(ids)
         if ids_len == 0:
             return False  # would fail prefill; let the solo path 400 it
         if ids_len + request.max_new_tokens > self.cfg.max_seq_len:
@@ -745,7 +832,13 @@ class SteppedDecodeSession:
         if self.stacked and request.max_new_tokens - 1 > self.g_bucket:
             return False  # the side caches hold g_bucket columns
         need = self._pages_needed(ids_len, request.max_new_tokens)
-        return need <= self.jmax and need <= self.pool.free_pages
+        # Shared-prefix billing: pages mapped from the index are billed
+        # ONCE (the publisher/index already hold them) — only the
+        # divergent tail's pages come off the free list. The table row
+        # still holds every page, so the jmax bound uses the full need.
+        hit = self._prefix_hit(ids)
+        own = need - (hit[2] if hit is not None else 0)
+        return need <= self.jmax and own <= self.pool.free_pages
 
     def join(self, request: GenerationRequest) -> int:
         """Admit ``request`` into a free slot, paying the WHOLE prompt
@@ -800,7 +893,21 @@ class SteppedDecodeSession:
         chunk = _floor_bucket(
             int(chunk_tokens or JOIN_PREFILL_CHUNK_TOKENS), PROMPT_BUCKETS
         )
-        chunks = _prompt_chunks(len(ids), chunk)
+        # Shared-prefix hit (engine/prefix.py): the leading `common`
+        # positions are SEEDED from the index entry's slab instead of
+        # recomputed — the chunk list covers only the divergent tail,
+        # at absolute offsets (join_step's prefill already takes any
+        # start offset against the partially-filled private cache).
+        hit = self._prefix_hit(ids)
+        entry, common, shared = hit if hit is not None else (None, 0, 0)
+
+        def _tail_chunks(common_, chunk_):
+            return [
+                (common_ + s, b)
+                for s, b in _prompt_chunks(len(ids) - common_, chunk_)
+            ]
+
+        chunks = _tail_chunks(common, chunk)
         alloc = chunks[-1][0] + chunks[-1][1]
         if self.paged:
             # private cache covers just the prompt; commit scatters whole
@@ -810,23 +917,61 @@ class SteppedDecodeSession:
             cache_len = self.cache_len
             if alloc > cache_len:
                 # the budgeted chunking's bucket rounding overshot the
-                # session cache; the standard chunking fits by can_join's
-                # _prompt_alloc check
-                chunks = _prompt_chunks(len(ids))
+                # session cache; fall back to the standard chunk width,
+                # then use LESS of the hit until the tail's bucketed end
+                # fits (can_join's _prompt_alloc check guarantees the
+                # common=0 chunking fits)
+                chunks = _tail_chunks(common, None)
+                while common > 0 and chunks[-1][0] + chunks[-1][1] > cache_len:
+                    common -= 1
+                    chunks = _tail_chunks(common, None)
+                if common == 0:
+                    entry, shared = None, 0
         pages: List[int] = []
         if self.paged:
-            pages = self.pool.alloc(
-                self._pages_needed(len(ids), request.max_new_tokens)
-            )
+            need = self._pages_needed(len(ids), request.max_new_tokens)
+            pages = self.pool.alloc(need - shared)
+            if shared:
+                # map the read-only prefix pages into this row: one
+                # reference per sharer — recycled only when the LAST
+                # reader (rows, index entry) frees them
+                self.pool.share(entry.pages[:shared])
+                pages = list(entry.pages[:shared]) + pages
         tf = eng._models[self.model]
         k_cache, v_cache = tf.init_cache(1, cache_len, dtype=eng.dtype)
         k_cache, v_cache = eng._place_cache(k_cache, v_cache, self.cfg)
+        if common:
+            # seed the private prefill cache with the entry's exact
+            # pre-quantization K/V: the tail prefill attends to the
+            # prefix at solo precision (token parity, incl. int8 pools)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache,
+                entry.k_seed[:, None, :, :common, :].astype(k_cache.dtype),
+                (0, 0, 0, 0, 0),
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache,
+                entry.v_seed[:, None, :, :common, :].astype(v_cache.dtype),
+                (0, 0, 0, 0, 0),
+            )
+            self.prefix.touch(entry)
+            from .prefix import observe_hit
+
+            # CoW: seeded positions past the last SHARED page boundary
+            # are copied into the joiner's own first partial page at
+            # commit (paged) / live only in its private cache (contig)
+            observe_hit(
+                common,
+                shared,
+                cow=self.paged and common > shared * self.page_size,
+            )
         presence = jnp.zeros((1, self.cfg.vocab_size), dtype=bool)
         if request.repeat_penalty != 1.0:
             presence = presence.at[0, jnp.asarray(ids)].set(True)
         pending = _PendingJoin(
             request, r, ids, chunks, cache_len, k_cache, v_cache,
             presence, pages,
+            hit_tokens=common, shared_pages=shared,
         )
         self._pending[r] = pending
         return pending
@@ -922,7 +1067,17 @@ class SteppedDecodeSession:
             pages=pending.pages,
             t0=pending.t0,
             prefill_s=pending.prefill_s,
+            shared_pages=pending.shared_pages,
         )
+        if self.prefix is not None:
+            # publish at join-commit: the next sharer can seed from THIS
+            # prompt's slab (the seeded prefix region is in the private
+            # cache too, so the slab is complete). Page references are
+            # capped at the already-shared region — see _publish_prefix.
+            self._publish_prefix(
+                pending.ids, pending.k_cache, pending.v_cache,
+                pending.pages, page_cap=pending.shared_pages,
+            )
         return r
 
     def join_abort(self, pending: _PendingJoin) -> None:
@@ -950,10 +1105,16 @@ class SteppedDecodeSession:
         pages: "List[int]",
         t0: float,
         prefill_s: float,
+        shared_pages: int = 0,
     ) -> None:
         """Scatter a prefilled solo cache into slot ``r`` and set every
         per-row device/host field — the shared tail of the one-shot and
-        chunked joins."""
+        chunked joins. The first ``shared_pages`` page entries are
+        READ-ONLY mappings of index-held prefix pages: they are skipped
+        by the scatter (their content is the publisher's — writing them
+        would be a write to shared state) and the private cache's
+        positions past that boundary — the copy-on-write partial page
+        plus the computed tail — scatter into the row's OWN pages."""
         import numpy as np
 
         from .paged_kv import _paginate, quantize_chunks, scatter_pages
@@ -961,8 +1122,14 @@ class SteppedDecodeSession:
         eng = self.engine
         if self.paged:
             n_prompt_pages = -(-s_real // self.page_size)
-            ck = _paginate(k_cache[:, 0], s_real, self.page_size)
-            cv = _paginate(v_cache[:, 0], s_real, self.page_size)
+            base = min(shared_pages, n_prompt_pages)
+            start = base * self.page_size
+            ck = _paginate(
+                k_cache[:, 0][:, :, start:], s_real - start, self.page_size
+            )
+            cv = _paginate(
+                v_cache[:, 0][:, :, start:], s_real - start, self.page_size
+            )
             if self.d_pool != self.cfg.d_head:
                 padd = [(0, 0)] * (ck.ndim - 1) + [
                     (0, self.d_pool - self.cfg.d_head)
@@ -973,7 +1140,7 @@ class SteppedDecodeSession:
             self.pool.k, self.pool.v = scatter_pages(
                 self.pool.k,
                 self.pool.v,
-                jnp.asarray(pages[:n_prompt_pages], jnp.int32),
+                jnp.asarray(pages[base:n_prompt_pages], jnp.int32),
                 ck,
                 cv,
             )
@@ -1037,6 +1204,10 @@ class SteppedDecodeSession:
                 if pending.pages:
                     self.pool.free(pending.pages)
                     pending.pages = []
+        if self.prefix is not None:
+            # the index's own page references return LAST so the pool
+            # free-count is exactly restored (refcounts hit zero here)
+            self.prefix.release_all(self.pool if self.paged else None)
         self._pending.clear()
         self._stream_tail.clear()
         self.rows = [None] * len(self.rows)

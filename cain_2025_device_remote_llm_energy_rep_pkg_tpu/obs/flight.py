@@ -55,6 +55,8 @@ EV_REQUEST_REJECTED = "request_rejected"  # queued ticket refused pre-admission
 #   (deadline already passed / TTFT SLO unmeetable)
 EV_BATCH_FALLBACK = "batch_fallback"  # batch/session dispatch failed → bisection
 EV_POOL_EXHAUSTED = "pool_exhausted"  # PagePool refused an allocation
+EV_PREFIX_HIT = "prefix_hit"  # a joiner reused cached shared-prefix KV
+EV_PREFIX_EVICT = "prefix_evict"  # a prefix-index entry was evicted (LRU)
 EV_DECODE_WINDOW = "decode_window"  # engine fence-timed decode window
 EV_ANOMALY = "anomaly"  # detector fired (obs/detect.py)
 EV_CRASH_DUMP = "crash_dump"  # a crash dump was written

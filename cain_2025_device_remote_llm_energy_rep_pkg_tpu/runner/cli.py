@@ -184,6 +184,14 @@ commands:
                        LRU), --paged-kv (batched decode over a paged KV
                        pool: mixed-length batches stop paying the widest
                        row's padding),
+                       --prefix-share (shared-prefix CoW paging:
+                       continuous-session joiners whose prompt shares a
+                       published prefix map its refcounted read-only
+                       pool pages and chunk-prefill only the divergent
+                       tail; works with --paged-kv and --kv-quantize
+                       int8, seed-only reuse on contiguous caches) with
+                       --prefix-index-entries N the per-session index
+                       capacity (default 16, LRU),
                        --access-log (structured per-request log line:
                        method/path/status/duration; default off),
                        --no-telemetry (kill switch for /metrics, the
@@ -217,6 +225,8 @@ def serve_command(args: List[str]) -> None:
     paged_kv = False
     speculative = {}
     prefix_cache = 0
+    prefix_share = False
+    prefix_index_entries = None
     access_log = False
     it = iter(args)
     for arg in it:
@@ -311,6 +321,14 @@ def serve_command(args: List[str]) -> None:
             speculative[name] = (draft, k)
         elif arg == "--prefix-cache":
             prefix_cache = int(next(it, "4"))
+        elif arg == "--prefix-share":
+            prefix_share = True
+        elif arg == "--prefix-index-entries":
+            prefix_index_entries = int(next(it, "16"))
+            if prefix_index_entries < 1:
+                raise CommandError(
+                    "serve: --prefix-index-entries expects a positive integer"
+                )
         elif arg == "--kv-quantize":
             kv_quantize = next(it, "int8")
             if kv_quantize == "none":
@@ -351,6 +369,12 @@ def serve_command(args: List[str]) -> None:
             paged_kv=paged_kv,
             speculative=speculative or None,
             prefix_cache_size=prefix_cache,
+            prefix_share=prefix_share,
+            **(
+                {"prefix_index_entries": prefix_index_entries}
+                if prefix_index_entries is not None
+                else {}
+            ),
         )
     elif backend_kind == "jax":
         from ..engine.jax_engine import JaxEngine
@@ -363,6 +387,12 @@ def serve_command(args: List[str]) -> None:
             paged_kv=paged_kv,
             speculative=speculative or None,
             prefix_cache_size=prefix_cache,
+            prefix_share=prefix_share,
+            **(
+                {"prefix_index_entries": prefix_index_entries}
+                if prefix_index_entries is not None
+                else {}
+            ),
         )
     else:
         raise CommandError(f"serve: unknown backend {backend_kind!r}")

@@ -60,6 +60,15 @@ def sse_event(payload: Dict[str, Any]) -> bytes:
     ) + b"\n\n"
 
 
+# Keep-alive comment (SSE spec: a line starting with ``:`` is ignored by
+# clients): written during idle prefill gaps — a long chunked join
+# produces no deltas for its whole interleaved prefill, and proxies/
+# clients with idle timeouts would otherwise drop the stream.
+# sse_records() and serve/client.py already skip comment lines, and the
+# byte shape is pinned by the framing golden test.
+SSE_KEEPALIVE = b": keep-alive\n\n"
+
+
 def sse_records(lines: Iterable[str]) -> Iterator[Dict[str, Any]]:
     """Parse decoded SSE lines back into JSON records (the inverse of
     :func:`sse_event`, tolerant of multi-``data:``-line events and

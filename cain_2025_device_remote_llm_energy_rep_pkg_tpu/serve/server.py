@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -47,6 +48,13 @@ _HTTP_SECONDS_H = obs_metrics.REGISTRY.histogram(
 # this (or one that stopped reading) gets disconnected rather than holding
 # the generation lock indefinitely.
 STREAM_WRITE_TIMEOUT_S = 60.0
+
+# SSE keep-alive cadence: after this much producer silence the handler
+# writes a ``: keep-alive`` comment (protocol.SSE_KEEPALIVE) so clients
+# and proxies with idle timeouts survive a long chunked join-prefill —
+# a joiner's first delta can be many decode slices away while its
+# prompt streams in one chunk at a time (ISSUE 6 follow-on).
+STREAM_KEEPALIVE_S = float(os.environ.get("STREAM_KEEPALIVE_S", 5.0))
 
 
 class GenerationServer:
@@ -405,6 +413,16 @@ class GenerationServer:
                 self.wfile.write(data + b"\r\n")
                 self.wfile.flush()
 
+            def _write_sse_keepalive(self) -> None:
+                """One ``: keep-alive`` SSE comment as one HTTP/1.1
+                chunk — ignored by every SSE parser (incl. our own
+                sse_records), but bytes on the wire reset client/proxy
+                idle timers during long prefill gaps."""
+                data = protocol.SSE_KEEPALIVE
+                self.wfile.write(f"{len(data):X}\r\n".encode("ascii"))
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
             def _start_sse(self) -> None:
                 self.send_response(200)
                 self.send_header(
@@ -465,17 +483,29 @@ class GenerationServer:
                 except RuntimeError as exc:
                     self._send_json(503, {"error": str(exc)})
                     return
-                events = channel.events()
-                # Headers wait for the first event, so pre-admission
-                # failures (bad prompt, unknown model, deadline shed)
-                # surface as clean HTTP statuses, not broken streams.
-                first = next(events)
-                if first.kind == "error":
-                    self._send_stream_open_error(first.error)
-                    return
-                self._start_sse()
+                events = channel.events(keepalive_s=STREAM_KEEPALIVE_S)
+                # Headers wait for the first REAL event, so fast
+                # pre-admission failures (bad prompt, unknown model,
+                # deadline shed) surface as clean HTTP statuses, not
+                # broken streams. If the producer is silent past the
+                # keep-alive cadence (a long chunked join-prefill), the
+                # stream opens anyway and comments flow — a late
+                # failure then ends it as a terminal SSE error event.
+                started = False
                 try:
-                    for event in itertools.chain([first], events):
+                    for event in events:
+                        if event.kind == "keepalive":
+                            if not started:
+                                self._start_sse()
+                                started = True
+                            self._write_sse_keepalive()
+                            continue
+                        if not started:
+                            if event.kind == "error":
+                                self._send_stream_open_error(event.error)
+                                return
+                            self._start_sse()
+                            started = True
                         if event.kind == "delta":
                             self._write_sse_chunk(
                                 protocol.stream_chunk_to_wire(

@@ -86,7 +86,11 @@ class DeadlineExceeded(RuntimeError):
 
 class StreamEvent:
     """One channel event: a token ``delta``, the terminal ``done`` (with
-    the full :class:`GenerationResult`), or a terminal ``error``."""
+    the full :class:`GenerationResult`), a terminal ``error``, or a
+    non-terminal ``keepalive`` synthesised by :meth:`TokenStream.events`
+    when the producer has been silent for ``keepalive_s`` (a long
+    chunked join-prefill produces no deltas — the consumer writes an
+    SSE comment so the client's idle timeout never fires)."""
 
     __slots__ = ("kind", "text", "tokens", "result", "error")
 
@@ -98,7 +102,7 @@ class StreamEvent:
         result: Optional[GenerationResult] = None,
         error: Optional[BaseException] = None,
     ) -> None:
-        self.kind = kind  # "delta" | "done" | "error"
+        self.kind = kind  # "delta" | "done" | "error" | "keepalive"
         self.text = text
         self.tokens = tokens or []
         self.result = result
@@ -144,14 +148,35 @@ class TokenStream:
         except queue.Empty:
             pass
 
-    def events(self, timeout_s: float = EVENT_TIMEOUT_S) -> Iterator[StreamEvent]:
+    def events(
+        self,
+        timeout_s: float = EVENT_TIMEOUT_S,
+        keepalive_s: Optional[float] = None,
+    ) -> Iterator[StreamEvent]:
         """Yield events until a terminal one (``done``/``error``). A
         producer silent past ``timeout_s`` yields a terminal error —
-        the consumer must never be stranded."""
+        the consumer must never be stranded.
+
+        With ``keepalive_s``, every ``keepalive_s`` of producer silence
+        yields a NON-terminal ``keepalive`` event instead of blocking
+        through the gap — the SSE handler turns it into a comment line
+        so a client behind a long chunked join-prefill (or an idle
+        proxy) sees bytes while no tokens exist yet. The overall
+        ``timeout_s`` bound still applies to the total silent span."""
+        silent = 0.0
+        wait = (
+            min(keepalive_s, timeout_s)
+            if keepalive_s is not None
+            else timeout_s
+        )
         while True:
             try:
-                event = self._q.get(timeout=timeout_s)
+                event = self._q.get(timeout=wait)
             except queue.Empty:
+                silent += wait
+                if silent < timeout_s:
+                    yield StreamEvent("keepalive")
+                    continue
                 yield StreamEvent(
                     "error",
                     error=RuntimeError(
@@ -159,6 +184,7 @@ class TokenStream:
                     ),
                 )
                 return
+            silent = 0.0
             yield event
             if event.kind in ("done", "error"):
                 return

@@ -108,6 +108,20 @@ def synth_prompt(n_tokens: int) -> str:
     return "p" * max(1, n_tokens - 1)
 
 
+def shared_prefix_texts(pool: int, prefix_tokens: int) -> List[str]:
+    """``pool`` distinct system-prompt texts, each byte-tokenizing to
+    ``prefix_tokens`` ids (BOS + one id per char). Members differ in
+    their first bytes (``<sysK>``), so prompts drawn from different
+    pool members never share a usable prefix — the trace models a
+    server fronting ``pool`` distinct applications."""
+    out = []
+    for k in range(pool):
+        head = f"<sys{k}>"
+        body = max(0, prefix_tokens - 1 - len(head))
+        out.append(head + "s" * body)
+    return out
+
+
 def build_workload(
     n: int,
     mean_interarrival_s: float,
@@ -122,6 +136,10 @@ def build_workload(
     prompt_len_max: int = 1024,
     anchor_longest: bool = False,
     deadline_ms: Optional[float] = None,
+    shared_prefix_frac: float = 0.0,
+    prefix_pool: int = 1,
+    shared_prefix_tokens: int = 192,
+    anchor_shared_prefix: bool = False,
 ) -> List[Tuple[float, GenerationRequest]]:
     """``[(arrival_offset_s, request), ...]`` — Poisson arrivals (seeded
     exponential inter-arrival; the first request arrives at t=0) over a
@@ -136,8 +154,22 @@ def build_workload(
     held constant while the JOIN policy under test varies.
     ``deadline_ms`` stamps every request with that per-request deadline
     (scheduler-enforced: pre-admission rejection + mid-flight
-    retirement)."""
+    retirement).
+
+    ``shared_prefix_frac`` models the paper's many-clients-one-server
+    shape (ISSUE 7): that fraction of requests (seeded, independent of
+    the arrival/length streams) carries one of ``prefix_pool`` distinct
+    ``shared_prefix_tokens``-token system prompts in front of its own
+    (always-unique) tail — the workload shared-prefix CoW paging is
+    built for. A/B arms replay the SAME trace because the share draws
+    use their own derived seed."""
     rng = random.Random(seed)
+    share_rng = random.Random((seed << 16) ^ 0x5F1C)
+    prefixes = (
+        shared_prefix_texts(max(1, prefix_pool), shared_prefix_tokens)
+        if shared_prefix_frac > 0
+        else []
+    )
     prompt_list: Optional[List[str]] = None
     if prompt_len_dist == "lognormal":
         lens = lognormal_prompt_tokens(
@@ -161,16 +193,32 @@ def build_workload(
     for i in range(n):
         if i:
             t += rng.expovariate(1.0 / mean_interarrival_s)
+        prompt = (
+            prompt_list[i]
+            if prompt_list is not None
+            else prompts[i % len(prompts)]
+        )
+        shares = prefixes and share_rng.random() < shared_prefix_frac
+        if prefixes and i == 0 and anchor_shared_prefix:
+            # request 0 anchors the continuous session, and a session
+            # anchor's prompt pages are what later sharers MAP — pin it
+            # to pool member 0 so the hot prefix is always page-backed
+            # (the share_rng draw above is still consumed, keeping the
+            # rest of the trace identical either way)
+            prompt = prefixes[0] + f" q{i} " + prompt
+        elif shares:
+            # unique per-request marker after the shared prefix so two
+            # sharers always DIVERGE (the CoW boundary under test)
+            prompt = (
+                prefixes[share_rng.randrange(len(prefixes))]
+                + f" q{i} " + prompt
+            )
         out.append(
             (
                 t,
                 GenerationRequest(
                     model,
-                    (
-                        prompt_list[i]
-                        if prompt_list is not None
-                        else prompts[i % len(prompts)]
-                    ),
+                    prompt,
                     max_new_tokens=budgets[i % len(budgets)],
                     seed=i,
                     stop_at_eos=stop_at_eos,
@@ -395,6 +443,20 @@ def main() -> int:
         help="lognormal: clamp for drawn lengths",
     )
     ap.add_argument(
+        "--shared-prefix-frac", type=float, default=0.0,
+        help="fraction of requests carrying a shared system-prompt "
+        "prefix (seeded; models the many-clients-one-server workload "
+        "shared-prefix CoW paging targets)",
+    )
+    ap.add_argument(
+        "--prefix-pool", type=int, default=1,
+        help="number of DISTINCT shared prefixes to draw from",
+    )
+    ap.add_argument(
+        "--shared-prefix-tokens", type=int, default=192,
+        help="token length of each shared prefix",
+    )
+    ap.add_argument(
         "--fake", action="store_true",
         help="drive an in-process fake-backend continuous scheduler "
         "instead of a live server (hermetic demo/CI)",
@@ -429,6 +491,9 @@ def main() -> int:
         prompt_len_sigma=args.prompt_len_sigma,
         prompt_len_max=args.prompt_len_max,
         deadline_ms=args.deadline_ms,
+        shared_prefix_frac=args.shared_prefix_frac,
+        prefix_pool=args.prefix_pool,
+        shared_prefix_tokens=args.shared_prefix_tokens,
     )
     cancellations = None
     if args.cancel_frac > 0:

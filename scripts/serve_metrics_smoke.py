@@ -37,6 +37,14 @@ Three phases, all over the deterministic fake backend:
    (``llm_stream_requests_total``/``llm_stream_chunks_total``/
    ``llm_stream_cancelled_total``) are live, and ``/debug/state`` shows
    the session's slots recycled (no in-flight rows left behind).
+6. SHARED-PREFIX PAGING (ISSUE 7): two staggered requests sharing a
+   system-prompt prefix through the continuous fake server
+   (``FakeBackend(prefix_share=True)``, the hermetic twin of
+   ``JaxEngine(prefix_share=True)``); assert
+   ``llm_prefix_hit_tokens_total`` moved, the shared-page gauge
+   (``llm_prefix_shared_pages``) ROSE mid-flight and returned to zero
+   after both rows retired, and the ``prefix_hit`` flight event fired
+   linked to the joined ticket's trace.
 
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
@@ -139,9 +147,11 @@ def main() -> int:
         server.stop()
 
     # -- phase 2: continuous batching under staggered arrivals ----------------
-    # A long row anchors the decode session (64 tokens at 200 tok/s ≈
-    # 0.32 s of slices); two short requests arrive mid-flight and must
-    # JOIN it, retire EARLY, and show up on the join/retire counters.
+    # A long row anchors the decode session (128 tokens at 200 tok/s ≈
+    # 0.64 s of slices — wide enough that a joiner whose admission slips
+    # a slice still retires strictly before it); two short requests
+    # arrive mid-flight and must JOIN it, retire EARLY, and show up on
+    # the join/retire counters.
     server2 = GenerationServer(
         FakeBackend(tokens_per_s=200.0, simulate_delay=True),
         host="127.0.0.1",
@@ -161,7 +171,7 @@ def main() -> int:
             done_at[name] = time.monotonic()
 
         threads = [
-            threading.Thread(target=client, args=("anchor", 64, 0.0)),
+            threading.Thread(target=client, args=("anchor", 128, 0.0)),
             threading.Thread(target=client, args=("join-a", 8, 0.06)),
             threading.Thread(target=client, args=("join-b", 8, 0.10)),
         ]
@@ -424,6 +434,92 @@ def main() -> int:
     finally:
         server5.stop()
 
+    # -- phase 6: shared-prefix paging through the continuous scheduler --------
+    # Two staggered requests share a system-prompt prefix; the joiner's
+    # admission must register a prefix HIT (tokens counter + flight
+    # event linked to its trace), and the shared-page gauge must rise
+    # while the sharers are live and return to ZERO once both retired.
+    server6 = GenerationServer(
+        FakeBackend(tokens_per_s=200.0, simulate_delay=True, prefix_share=True),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    server6.start()
+    try:
+        base6 = f"http://127.0.0.1:{server6.port}"
+        try:
+            hits_before = _metric_value(
+                _scrape(base6), "llm_prefix_hit_tokens_total"
+            )
+        except AssertionError:
+            hits_before = 0.0
+        sys_prefix = "you are a helpful assistant; answer briefly. "
+        mid6 = {"shared_peak": 0.0}
+
+        def probe6():
+            # poll the shared-page gauge across the whole flight: it
+            # rises when the joiner commits (the exact moment races the
+            # decode slices, so a single snapshot would be flaky)
+            deadline6 = time.monotonic() + 5.0
+            while time.monotonic() < deadline6:
+                try:
+                    mid6["shared_peak"] = max(
+                        mid6["shared_peak"],
+                        _metric_value(
+                            _scrape(base6), "llm_prefix_shared_pages"
+                        ),
+                    )
+                except AssertionError:
+                    pass  # gauge not touched yet
+                time.sleep(0.02)
+
+        threads6 = [
+            threading.Thread(
+                target=lambda: _post_generate(base6, sys_prefix + "anchor", 64)
+            ),
+            threading.Thread(
+                target=lambda: (
+                    time.sleep(0.06),
+                    _post_generate(base6, sys_prefix + "join me", 48),
+                )
+            ),
+            threading.Thread(target=probe6),
+        ]
+        for t in threads6:
+            t.start()
+        for t in threads6:
+            t.join(timeout=30)
+
+        text6 = _scrape(base6)
+        hit_tokens = (
+            _metric_value(text6, "llm_prefix_hit_tokens_total") - hits_before
+        )
+        assert hit_tokens > 0, f"no prefix hit tokens: {text6[:1500]}"
+        shared_mid = mid6["shared_peak"]
+        assert shared_mid > 0, "shared-page gauge never rose mid-flight"
+        shared_after = _metric_value(text6, "llm_prefix_shared_pages")
+        assert shared_after == 0, (
+            f"shared-page gauge stuck at {shared_after} after retirement"
+        )
+
+        flight6 = _get_json(base6, "/debug/flight?n=500&type=prefix_hit")
+        prefix_hits = flight6["events"]
+        assert prefix_hits, "no prefix_hit flight event"
+        # trace linkage: the hit belongs to the JOINED ticket's story
+        admits6 = _get_json(
+            base6, "/debug/flight?n=500&type=request_admitted"
+        )["events"]
+        joined_traces = {
+            e.get("trace") for e in admits6 if e.get("joined")
+        }
+        assert any(
+            e.get("trace") in joined_traces for e in prefix_hits
+        ), (prefix_hits, admits6)
+    finally:
+        server6.stop()
+
     print(
         json.dumps(
             {
@@ -450,6 +546,11 @@ def main() -> int:
                 "streaming_cancellation": {
                     "delivered_before_disconnect": delivered,
                     "rows_cancelled": cancelled_seen,
+                },
+                "shared_prefix": {
+                    "hit_tokens": hit_tokens,
+                    "shared_pages_mid_flight": shared_mid,
+                    "prefix_hit_events": len(prefix_hits),
                 },
             }
         )

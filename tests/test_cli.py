@@ -260,6 +260,49 @@ def test_serve_ttft_slo_knob(monkeypatch):
         serve_command(["--ttft-slo-ms", "-5"])
 
 
+def test_serve_prefix_share_knobs(monkeypatch):
+    """--prefix-share / --prefix-index-entries reach the ENGINE (ISSUE
+    7: shared-prefix CoW paging is a backend capability, not a
+    scheduler one); bad capacities fail fast."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured["backend"] = backend
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    cli.serve_command(
+        [
+            "--backend", "jax", "--port", "0",
+            "--prefix-share", "--prefix-index-entries", "4",
+            "--paged-kv", "--kv-quantize", "int8",
+        ]
+    )
+    backend = captured["backend"]
+    assert backend.prefix_share is True
+    assert backend.prefix_index_entries == 4
+    # the retired exclusion: int8 KV + prefix features co-exist
+    assert backend.kv_quantize == "int8" and backend.paged_kv
+
+    captured.clear()
+    cli.serve_command(["--backend", "jax", "--port", "0"])
+    assert captured["backend"].prefix_share is False  # off by default
+
+    with pytest.raises(CommandError, match="prefix-index-entries"):
+        serve_command(["--prefix-index-entries", "0"])
+
+
 def test_prepare_cooldown_promise_matches_consumed_channels(monkeypatch, capsys):
     """prepare's policy line must reflect the channels the study's
     profilers actually WIRE (code-review round-4): a live battery/hwmon

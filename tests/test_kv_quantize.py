@@ -118,13 +118,43 @@ def test_kv_quantize_guards():
     with pytest.raises(ValueError, match="kv_quantize"):
         JaxEngine(registry=registry, kv_quantize="int4")
     with pytest.raises(ValueError, match="incompatible"):
-        JaxEngine(registry=registry, kv_quantize="int8", prefix_cache_size=2)
-    with pytest.raises(ValueError, match="incompatible"):
         JaxEngine(
             registry=registry,
             kv_quantize="int8",
             speculative={"a": ("b", 4)},
         )
+
+
+def test_kv_quantize_composes_with_prefix_cache():
+    """ISSUE 7 retires the int8×prefix exclusion: the solo prefix cache
+    stores the PRE-quantization bf16 prompt KV and seeds the next
+    request's cache before its post-prefill quantization, so a hit is
+    token-identical to the cold path under kv_quantize="int8"."""
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    eng = JaxEngine(
+        registry=registry,
+        dtype=jnp.float32,
+        kv_quantize="int8",
+        prefix_cache_size=4,
+    )
+    shared = "system prompt shared by both requests. "
+    cold = eng.generate(
+        GenerationRequest("tiny", shared + "tail one", max_new_tokens=10)
+    )
+    assert eng._prefix_cache["tiny"]  # the cold run populated the LRU
+    warm = eng.generate(
+        GenerationRequest("tiny", shared + "tail one", max_new_tokens=10)
+    )
+    assert warm.tokens == cold.tokens  # exact-hit parity
+    partial = eng.generate(
+        GenerationRequest("tiny", shared + "tail two", max_new_tokens=10)
+    )
+    fresh = JaxEngine(
+        registry=registry, dtype=jnp.float32, kv_quantize="int8"
+    ).generate(
+        GenerationRequest("tiny", shared + "tail two", max_new_tokens=10)
+    )
+    assert partial.tokens == fresh.tokens  # partial-hit parity
 
 
 def test_kv_quantize_batch_matches_single(engines):

@@ -1,0 +1,413 @@
+"""Shared-prefix copy-on-write paging (ISSUE 7): PagePool refcounts,
+the session-scoped PrefixIndex, and stepped-session integration.
+
+The contracts under test:
+
+- refcounted pages: a page is recycled only when its LAST reader frees
+  it; every pre-existing free site (retire/cancel/abort/close) keeps
+  its exact-free-count behavior whether or not pages are shared;
+- joiners whose prompt shares a published prefix map its read-only
+  pages (billed ONCE), seed the boundary positions (CoW), chunk-prefill
+  only the divergent tail — and stay TOKEN-IDENTICAL to their solo
+  ``generate()`` on all four cache layouts;
+- N sharers admitted then all retired (eos / budget / cancelled)
+  restore the pool free-count EXACTLY; close() restores it fully
+  (index references released last).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+    JaxEngine,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.paged_kv import (
+    PagePool,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.prefix import (
+    PREFIX_COW_COPIES_C,
+    PREFIX_HIT_TOKENS_C,
+    PREFIX_SHARED_PAGES_G,
+    PrefixIndex,
+    common_prefix_len,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+
+# 140 's' chars -> 141 ids (BOS + bytes): one FULL 128-token page plus a
+# 13-token partial — every sharer exercises both the page mapping and
+# the copy-on-write boundary.
+SHARED = "s" * 140
+
+
+@pytest.fixture(scope="module")
+def registry():
+    return {"tiny": get_model_config("qwen2:1.5b").tiny(max_seq_len=512)}
+
+
+def _engine(registry, paged=True, kv=None, share=True, **kw):
+    return JaxEngine(
+        registry=dict(registry),
+        dtype=jnp.float32,
+        paged_kv=paged,
+        kv_quantize=kv,
+        prefix_share=share,
+        **kw,
+    )
+
+
+def _drain(session, max_steps=8, limit=400):
+    out = []
+    for _ in range(limit):
+        if not session.active:
+            break
+        out.extend(session.step(max_steps))
+    assert not session.active, "session did not drain"
+    return out
+
+
+# -- PagePool refcounts --------------------------------------------------------
+
+
+def _tiny_pool(n_pages=8):
+    return PagePool.create(
+        n_layers=1, n_pages=n_pages, n_kv_heads=1, d_head=4, page_size=128
+    )
+
+
+def test_pool_share_defers_recycling_to_last_reader():
+    pool = _tiny_pool()
+    pages = pool.alloc(2)
+    free0 = pool.free_pages
+    pool.share(pages)  # second reader
+    assert pool.refcount(pages[0]) == 2
+    assert pool.shared_pages == 2
+    pool.free(pages)  # first reader leaves — pages stay allocated
+    assert pool.free_pages == free0
+    assert pool.shared_pages == 0
+    pool.free(pages)  # last reader leaves — NOW they recycle
+    assert pool.free_pages == free0 + 2
+    assert pool.refcount(pages[0]) == 0
+
+
+def test_pool_double_free_and_share_free_raise():
+    pool = _tiny_pool()
+    pages = pool.alloc(1)
+    pool.free(pages)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(pages)
+    with pytest.raises(ValueError, match="share a free page"):
+        pool.share(pages)
+
+
+# -- PrefixIndex ---------------------------------------------------------------
+
+
+def test_index_longest_match_and_partial_common():
+    idx = PrefixIndex(capacity=4)
+    idx.publish([1, 2, 3, 4], [], None, None)
+    idx.publish([1, 2, 9], [], None, None)
+    entry, common = idx.match([1, 2, 3, 5, 6])
+    assert entry.ids == [1, 2, 3, 4] and common == 3
+    assert idx.match([7, 8]) is None
+    assert common_prefix_len([1, 2], [1, 2, 3]) == 2
+
+
+def test_index_capacity_evicts_lru_and_releases_pages():
+    pool = _tiny_pool(n_pages=8)
+    idx = PrefixIndex(capacity=2)
+    a, b, c = pool.alloc(1), pool.alloc(1), pool.alloc(1)
+    free0 = pool.free_pages
+    idx.publish([1, 1], a, None, None, pool)
+    idx.publish([2, 2], b, None, None, pool)
+    # touch [1,1] so [2,2] is the LRU victim when [3,3] lands
+    entry, _ = idx.match([1, 1, 5])
+    idx.touch(entry)
+    idx.publish([3, 3], c, None, None, pool)
+    assert len(idx) == 2
+    assert {tuple(e.ids) for e in idx._entries} == {(1, 1), (3, 3)}
+    # the victim's index reference released; owner still holds b
+    assert pool.refcount(b[0]) == 1
+    assert pool.free_pages == free0
+    idx.release_all(pool)
+    for pages in (a, b, c):
+        pool.free(pages)
+    assert pool.free_pages == 8
+
+
+def test_index_publish_supersedes_covered_entries():
+    idx = PrefixIndex(capacity=8)
+    idx.publish([1, 2], [], None, None)
+    idx.publish([1, 2, 3, 4], [], None, None)  # covers [1,2] — supersedes
+    assert len(idx) == 1 and idx._entries[0].ids == [1, 2, 3, 4]
+    # re-publishing a covered prefix refreshes the covering entry instead
+    assert idx.publish([1, 2, 3], [], None, None) is False
+    assert len(idx) == 1
+
+
+# -- session integration: sharing, parity, exact accounting --------------------
+
+
+@pytest.mark.parametrize("kv", [None, "int8"], ids=["bf16", "int8"])
+def test_sharers_map_pages_and_match_solo_exactly(registry, kv):
+    """The tentpole invariant on both paged pools: sharers map the
+    anchor's read-only prefix page (fewer pages off the free list than
+    a full allocation), every stream is bit-identical to solo
+    generate(), all-sharers-retired restores the free count EXACTLY,
+    and close() restores the pool fully (index refs released last)."""
+    eng = _engine(registry, kv=kv)
+    plain = _engine(registry, kv=kv, share=False)
+    anchor = GenerationRequest(
+        "tiny", SHARED + " anchor tail", max_new_tokens=90,
+        stop_at_eos=False, seed=1,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    assert len(sess.prefix) == 1  # the anchor published at open
+    sess.step(4)
+    free_before = sess.pool.free_pages
+    j1 = GenerationRequest("tiny", SHARED + " j-one", max_new_tokens=8, seed=3)
+    j2 = GenerationRequest("tiny", SHARED + " j-two!!", max_new_tokens=8, seed=4)
+    assert sess.can_join(j1)
+    pj = sess.join_begin(j1, chunk_tokens=32)
+    assert pj.hit_tokens == 142  # BOS + 140 shared chars + ' '
+    assert pj.shared_pages == 1  # one full page mapped read-only
+    assert sess.pool.refcount(pj.pages[0]) >= 3  # anchor + index + j1
+    while not sess.join_step(pj):
+        pass
+    sess.join_commit(pj)
+    sess.join(j2)  # the one-shot join path shares too
+    results = {}
+    while len(results) < 2:  # both sharers retire; anchor still live
+        for res in sess.step(8):
+            results[id(res.request)] = res
+    assert sess.active == 1
+    assert sess.pool.free_pages == free_before  # exact restoration
+    for res in _drain(sess):
+        results[id(res.request)] = res
+    for r in (anchor, j1, j2):
+        assert results[id(r)].tokens == plain.generate(r).tokens
+    total = sess.pool.n_pages
+    sess.close()
+    assert sess.pool.free_pages == total - 1  # only parking stays held
+
+
+@pytest.mark.parametrize(
+    "paged,kv",
+    [(False, None), (False, "int8"), (True, None), (True, "int8")],
+    ids=["contig-bf16", "contig-int8", "paged-bf16", "paged-int8"],
+)
+def test_cow_divergence_mid_page_parity_all_layouts(registry, paged, kv):
+    """A joiner diverging MID-PAGE (141 shared ids = 1 full page + 13
+    partial) seeds the boundary from the index and recomputes only the
+    tail — token parity with solo generate() on all four cache layouts
+    (paged pools share pages; contiguous sessions get seed-only reuse)."""
+    eng = _engine(registry, paged=paged, kv=kv)
+    anchor = GenerationRequest(
+        "tiny", SHARED + " anchor", max_new_tokens=60,
+        stop_at_eos=False, seed=1,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    sess.step(4)
+    joiner = GenerationRequest(
+        "tiny", SHARED + " divergent continuation", max_new_tokens=12, seed=9
+    )
+    hits0 = PREFIX_HIT_TOKENS_C.labels().value
+    pj = sess.join_begin(joiner, chunk_tokens=32)
+    assert pj.hit_tokens > 0
+    assert PREFIX_HIT_TOKENS_C.labels().value - hits0 == pj.hit_tokens
+    while not sess.join_step(pj):
+        pass
+    sess.join_commit(pj)
+    results = {id(r.request): r for r in _drain(sess)}
+    ref = _engine(registry, paged=paged, kv=kv, share=False)
+    assert results[id(anchor)].tokens == ref.generate(anchor).tokens
+    assert results[id(joiner)].tokens == ref.generate(joiner).tokens
+
+
+def test_cow_copy_counted_and_shared_pages_gauge(registry):
+    eng = _engine(registry)
+    anchor = GenerationRequest(
+        "tiny", SHARED + " anchor", max_new_tokens=60,
+        stop_at_eos=False, seed=1,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    cow0 = PREFIX_COW_COPIES_C.labels().value
+    sess.join(GenerationRequest("tiny", SHARED + " q", max_new_tokens=6, seed=2))
+    # hit 142 tokens > 1 shared page * 128 -> the partial page was CoW'd
+    assert PREFIX_COW_COPIES_C.labels().value == cow0 + 1
+    assert PREFIX_SHARED_PAGES_G.labels().value >= 1
+    _drain(sess)
+    sess.close()
+    assert PREFIX_SHARED_PAGES_G.labels().value == 0
+
+
+def test_cancelled_sharer_restores_shared_refs_exactly(registry):
+    """Cancellation (the disconnect/deadline retirement path) drops
+    exactly one reference per mapped page — the ISSUE 6 exact page-free
+    accounting composes with sharing."""
+    eng = _engine(registry)
+    anchor = GenerationRequest(
+        "tiny", SHARED + " anchor", max_new_tokens=90,
+        stop_at_eos=False, seed=1,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    sess.step(4)
+    free0 = sess.pool.free_pages
+    victim = GenerationRequest(
+        "tiny", SHARED + " cancelled", max_new_tokens=60,
+        stop_at_eos=False, seed=5,
+    )
+    sess.join(victim)
+    shared_page = sess.prefix._entries[0].pages[0]
+    refs_mid = sess.pool.refcount(shared_page)
+    sess.step(4)
+    assert sess.cancel(victim)
+    assert sess.pool.free_pages == free0
+    assert sess.pool.refcount(shared_page) == refs_mid - 1
+    _drain(sess)
+    sess.close()
+
+
+def test_join_abort_restores_shared_refs(registry):
+    eng = _engine(registry)
+    anchor = GenerationRequest(
+        "tiny", SHARED + " anchor", max_new_tokens=60,
+        stop_at_eos=False, seed=1,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    free0 = sess.pool.free_pages
+    pj = sess.join_begin(
+        GenerationRequest("tiny", SHARED + " aborted", max_new_tokens=8, seed=6),
+        chunk_tokens=32,
+    )
+    assert pj.shared_pages == 1 and sess.pool.free_pages < free0
+    sess.join_abort(pj)
+    assert sess.pool.free_pages == free0
+    _drain(sess)
+    sess.close()
+
+
+def test_can_join_bills_shared_pages_once(registry):
+    """Admission billing: with the free list squeezed to exactly the
+    DIVERGENT-TAIL pages, a sharer still fits (its prefix pages are
+    billed once, to the publisher) while an equal-shape non-sharer is
+    deferred."""
+    eng = _engine(registry)
+    anchor = GenerationRequest(
+        "tiny", SHARED + " anchor", max_new_tokens=60,
+        stop_at_eos=False, seed=1,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    sharer = GenerationRequest(
+        "tiny", SHARED + " sq", max_new_tokens=8, seed=7
+    )
+    stranger = GenerationRequest(
+        "tiny", "x" * 140 + " sq", max_new_tokens=8, seed=7
+    )
+    # same shape, same page need — only the prefix differs
+    need = sess._pages_needed(145, 8)
+    hog = sess.pool.alloc(sess.pool.free_pages - (need - 1))
+    assert sess.can_join(sharer)  # needs need-1 own pages (1 shared)
+    assert not sess.can_join(stranger)  # needs all `need` pages
+    sess.pool.free(hog)
+    _drain(sess)
+    sess.close()
+
+
+def test_joiner_publish_is_page_capped_but_seeds_grow(registry):
+    """A joiner's commit publishes its prompt for future SEED reuse but
+    references only the already-shared pages — its own tail pages die
+    with it (that is what keeps sharers' retirement exact). A later
+    joiner matching the longer prompt seeds MORE tokens than the
+    anchor-only match would give."""
+    eng = _engine(registry)
+    anchor = GenerationRequest(
+        "tiny", SHARED + " anchor", max_new_tokens=90,
+        stop_at_eos=False, seed=1,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    sess.step(2)
+    long_tail = SHARED + " shared-second-stage continuation body"
+    j1 = GenerationRequest("tiny", long_tail + " one", max_new_tokens=6, seed=2)
+    sess.join(j1)
+    assert len(sess.prefix) == 2
+    j1_entry = next(
+        e for e in sess.prefix._entries if len(e.ids) > len(SHARED) + 10
+    )
+    assert len(j1_entry.pages) == 1  # capped at the shared region
+    j2 = GenerationRequest("tiny", long_tail + " two", max_new_tokens=6, seed=3)
+    pj = sess.join_begin(j2, chunk_tokens=32)
+    assert pj.hit_tokens > 142  # seeded past the anchor's common prefix
+    assert pj.shared_pages == 1
+    while not sess.join_step(pj):
+        pass
+    sess.join_commit(pj)
+    results = {id(r.request): r for r in _drain(sess)}
+    ref = _engine(registry, share=False)
+    for r in (j1, j2):
+        assert results[id(r)].tokens == ref.generate(r).tokens
+    sess.close()
+
+
+def test_contiguous_index_has_no_pages_and_close_clears(registry):
+    eng = _engine(registry, paged=False)
+    anchor = GenerationRequest(
+        "tiny", SHARED + " anchor", max_new_tokens=24,
+        stop_at_eos=False, seed=1,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    assert len(sess.prefix) == 1
+    assert sess.prefix._entries[0].pages == []
+    assert sess.debug_state()["prefix"]["entries"] == 1
+    _drain(sess)
+    sess.close()
+    assert len(sess.prefix) == 0
+
+
+def test_prefix_share_off_is_default_and_inert(registry):
+    eng = JaxEngine(registry=dict(registry), dtype=jnp.float32, paged_kv=True)
+    assert eng.prefix_share is False
+    sess = eng.decode_open(
+        [GenerationRequest("tiny", SHARED + " a", max_new_tokens=6, seed=1)]
+    )
+    assert sess.prefix is None
+    assert "prefix" not in sess.debug_state()
+    _drain(sess)
+    sess.close()
+
+
+def test_max_admission_rows_bills_shared_prefix_once(registry, monkeypatch):
+    """The budget-aware admission estimate admits a LARGER fleet under
+    prefix sharing: sharers are billed only their divergent-tail pages,
+    so the same KV budget caps more rows."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine import (
+        jax_engine as je,
+    )
+
+    req = GenerationRequest(
+        "tiny", "s" * 600, max_new_tokens=8, stop_at_eos=False
+    )
+    share_eng = _engine(registry)
+    plain_eng = _engine(registry, share=False)
+    cfg = share_eng.registry["tiny"]
+    # 601 prompt ids + 8 generation tokens -> 5 legacy pages per row;
+    # 4 of them shared. Budget sized to EXACTLY the shared bill of one
+    # 64-row chunk (anchor pays 5, every sharer 1): the full bill
+    # (64 x 5 pages) blows it and stays at the 32-row floor.
+    need = -(-(601 + 8) // 128)
+    g_bucket = je._bucket(8, je.GEN_BUCKETS)
+    budget = plain_eng._paged_chunk_bytes(
+        cfg, [need] + [1] * 63, 64, g_bucket, False
+    )
+    monkeypatch.setattr(je, "BATCH_KV_BUDGET_BYTES", int(budget))
+    assert plain_eng.max_admission_rows(req) == 32  # full bill: floor
+    assert share_eng.max_admission_rows(req) == 64  # shared billed once
+
+
+def test_engine_validates_prefix_index_entries(registry):
+    with pytest.raises(ValueError, match="prefix_index_entries"):
+        JaxEngine(registry=dict(registry), prefix_index_entries=0)

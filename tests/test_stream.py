@@ -523,3 +523,99 @@ def test_stream_cancelled_exception_type():
         assert isinstance(terminal[-1].error, StreamCancelled)
     finally:
         sched.stop()
+
+
+# -- SSE keep-alive during idle prefill gaps (ISSUE 7 satellite) ---------------
+
+
+def test_sse_keepalive_comment_golden():
+    """The keep-alive comment's wire bytes are a contract (SSE spec: a
+    ':'-prefixed line every parser must skip) — pin them."""
+    assert protocol.SSE_KEEPALIVE == b": keep-alive\n\n"
+    # our own parser skips it, deltas survive around it
+    lines = [": keep-alive\n", "\n", 'data: {"v": 2}\n', "\n"]
+    assert list(protocol.sse_records(lines)) == [{"v": 2}]
+
+
+def test_token_stream_events_yield_keepalives_when_idle():
+    """A silent producer yields NON-terminal keepalive events every
+    keepalive_s; the overall timeout_s still terminates the stream."""
+    chan = TokenStream()
+    kinds = [
+        e.kind for e in chan.events(timeout_s=0.25, keepalive_s=0.05)
+    ]
+    assert kinds[-1] == "error"  # the overall bound still fires
+    assert kinds.count("keepalive") >= 2  # comments flowed in between
+
+
+def test_token_stream_keepalive_resets_on_activity():
+    """An event arriving resets the silence clock: a stream with
+    activity inside every keepalive window never yields keepalives."""
+    chan = TokenStream()
+
+    def producer():
+        for i in range(4):
+            time.sleep(0.02)
+            chan.push("x", [i])
+        chan.finish(
+            FakeBackend().generate(GenerationRequest("m", "x", 4))
+        )
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    events = list(chan.events(timeout_s=5.0, keepalive_s=0.5))
+    t.join()
+    assert [e.kind for e in events] == ["delta"] * 4 + ["done"]
+
+
+def test_http_keepalive_comments_flow_during_idle_gaps(monkeypatch):
+    """End-to-end pin of the ISSUE 6 follow-on: with slices far apart
+    (a long idle gap between deltas — the shape of a chunked join's
+    prefill), the SSE socket carries ': keep-alive' comments between
+    events, and the client still parses the stream to an identical
+    final result (comments are invisible to sse_records)."""
+    import urllib.request
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import (
+        server as srv_mod,
+    )
+
+    monkeypatch.setattr(srv_mod, "STREAM_KEEPALIVE_S", 0.05)
+    srv = GenerationServer(
+        # 16-step slices at 40 tok/s = 0.4 s between delta pushes —
+        # many keep-alive windows of producer silence per gap
+        FakeBackend(tokens_per_s=40.0, simulate_delay=True),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/api/generate",
+            data=json.dumps(
+                {
+                    "model": "m",
+                    "prompt": "keepalive probe",
+                    "stream": True,
+                    "options": {"num_predict": 48},
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            raw = resp.read()
+        assert b": keep-alive\n\n" in raw  # comments hit the wire
+        records = list(
+            protocol.sse_records(
+                ln + "\n" for ln in raw.decode().split("\n")
+            )
+        )
+        assert records and records[-1].get("done") is True
+        solo = FakeBackend().generate(
+            GenerationRequest("m", "keepalive probe", max_new_tokens=48)
+        )
+        assert records[-1]["x_text"] == solo.text  # parity through comments
+    finally:
+        srv.stop()
