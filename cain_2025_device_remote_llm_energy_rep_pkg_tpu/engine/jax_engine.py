@@ -142,6 +142,22 @@ def _to_host_list(arr) -> "list":
     return np.asarray(arr).tolist()
 
 
+def _stepped_donation() -> Dict[str, Any]:
+    """``jax.jit`` kwargs donating the stepped carry argument — on
+    accelerator backends only. XLA:CPU silently accepts the aliasing
+    request but reuses donated buffers unsoundly under async dispatch:
+    with the carry donated, a mid-flight join's eager page scatter
+    intermittently corrupted a COMPANION row's pool pages (token-parity
+    divergence right after the join, ~1-in-3 full-suite runs on the
+    8-virtual-device CPU harness; never on the default no-donation CPU
+    path). On TPU the donation is the point: the output carry aliases
+    the input buffers and the KV pool never holds 2× liveness across a
+    slice."""
+    if jax.default_backend() == "cpu":
+        return {}
+    return {"donate_argnums": (1,)}
+
+
 def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
     for b in buckets:
         if n <= b:
@@ -2070,6 +2086,49 @@ class JaxEngine(GenerationBackend):
         return decode
 
     # -- stepped (iteration-level) decode --------------------------------------
+    # -- stepped-carry SPMD hooks (engine/stepped.py sessions) ---------------
+    def _stepped_carry_shardings(self, cfg: ModelConfig, carry):
+        """Per-leaf NamedShardings for a stepped session carry, or None
+        on the single-device engine (jit's default placement is already
+        right there). The TP engine returns the
+        ``parallel/sharding.py::stepped_carry_shardings`` pytree —
+        KV payload sharded over heads when they divide the mesh,
+        row-control state replicated."""
+        return None
+
+    def _place_carry(self, cfg: ModelConfig, carry):
+        """Explicitly place an assembled stepped carry on the device(s).
+        Identity here; the TP engine device_puts every leaf with its
+        carry sharding so the session starts (and stays) committed to
+        the mesh placement the jitted slice step declares."""
+        return carry
+
+    def _stepped_jit(self, cfg: ModelConfig, carry, fn) -> Callable:
+        """jit one stepped slice step ``(params, carry, n_real) ->
+        (out_tokens, n_row, carry)``. On accelerator backends the carry
+        argument is DONATED — the slice's output carry aliases its input
+        buffers, so a session's KV pool never holds 2× liveness across a
+        step. The TP override adds explicit
+        ``in_shardings``/``out_shardings`` from the carry's sharding
+        pytree, making the compiled step a pure SPMD program that never
+        bounces the carry through host memory."""
+        return jax.jit(fn, **_stepped_donation())
+
+    def _stepped_compute_ctx(self):
+        """Context the stepped session wraps device compute in
+        (open/step/join chunks). Null here; the TP engine disables the
+        int4 Pallas kernel inside it — the same GSPMD-partitioning rule
+        its generate paths already apply."""
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def mesh_info(self) -> Optional[Dict[str, Any]]:
+        """Device-mesh description for debug/introspection surfaces
+        (``GET /debug/state``): None on the single-device engine; the TP
+        engine reports device count, axis sizes and platform."""
+        return None
+
     def _batch_decode_step_fn(
         self,
         model: str,
@@ -2077,6 +2136,7 @@ class JaxEngine(GenerationBackend):
         top_k: int,
         use_top_p: bool,
         use_rp: bool,
+        carry=None,
     ) -> Callable:
         """Stepped twin of :meth:`_batch_decode_fn` for iteration-level
         scheduling: runs AT MOST ``n_real`` (≤ the compiled ``n_steps``
@@ -2089,7 +2149,19 @@ class JaxEngine(GenerationBackend):
         path samples and then discards at ``take = min(n_row,
         budget)``), and done rows freeze their offsets (a retired slot
         must not walk its write position across the cache while it
-        idles; a live row's offsets advance identically)."""
+        idles; a live row's offsets advance identically).
+
+        The carry travels as ONE pytree (`{"tokens", "offsets",
+        "prompt_lens", "k_cache", "v_cache", "rngs", "presence",
+        "done", "remaining", "temps", "top_ps", "rps"}`), jitted via
+        :meth:`_stepped_jit`: the carry argument is donated on
+        accelerator backends, and on a
+        sharded engine every leaf carries an explicit NamedSharding —
+        sampling-knob leaves the loop doesn't advance pass through
+        unchanged (input→output aliased), which is what lets the host
+        keep them in the same pytree without paying a copy per slice.
+        ``carry`` here is a structure/placement EXAMPLE for the jit
+        wrapper; the compiled fn is cached per (model, slice, knobs)."""
         key = ("batch-step", model, n_steps, top_k, use_top_p, use_rp)
         if key in self._decode_cache:
             return self._decode_cache[key]
@@ -2100,22 +2172,17 @@ class JaxEngine(GenerationBackend):
 
         from ..ops.sampling import sample_token_per_row
 
-        @jax.jit
-        def decode(
-            params,
-            first_tokens,  # [B] — each row's current last token
-            offsets,  # [B]
-            k_cache,
-            v_cache,
-            temperature,  # [B]
-            rngs,  # [B] keys
-            n_real,  # scalar: max steps this slice
-            remaining,  # [B] — per-row token budget left BEFORE this slice
-            top_p,  # [B]
-            repeat_penalty,  # [B]
-            presence,  # [B, vocab]
-            done0,  # [B] — retired/free slots enter (and stay) done
-        ):
+        def decode(params, carry, n_real):
+            first_tokens = carry["tokens"]  # [B] — each row's last token
+            offsets = carry["offsets"]  # [B]
+            k_cache, v_cache = carry["k_cache"], carry["v_cache"]
+            temperature = carry["temps"]  # [B]
+            rngs = carry["rngs"]  # [B] keys
+            remaining = carry["remaining"]  # [B] budget BEFORE this slice
+            top_p = carry["top_ps"]  # [B]
+            repeat_penalty = carry["rps"]  # [B]
+            presence = carry["presence"]  # [B, vocab]
+            done0 = carry["done"]  # [B] — retired/free slots stay done
             b = first_tokens.shape[0]
 
             def cond(carry):
@@ -2168,11 +2235,20 @@ class JaxEngine(GenerationBackend):
                 token, offs, kc, vc, rngs_out, done, _, out_tokens,
                 pres_out, n_row,
             ) = jax.lax.while_loop(cond, body, init)
-            return (
-                out_tokens, n_row, token, offs, kc, vc, rngs_out,
-                pres_out, done,
+            new_carry = dict(
+                carry,
+                tokens=token,
+                offsets=offs,
+                k_cache=kc,
+                v_cache=vc,
+                rngs=rngs_out,
+                presence=pres_out,
+                done=done,
+                remaining=remaining - n_row,
             )
+            return out_tokens, n_row, new_carry
 
+        decode = self._stepped_jit(cfg, carry, decode)
         self._decode_cache[key] = decode
         return decode
 
@@ -2185,16 +2261,29 @@ class JaxEngine(GenerationBackend):
         use_rp: bool,
         stacked: bool,
         quantized: bool,
+        carry=None,
     ) -> Callable:
         """Stepped twin of :meth:`_paged_batch_decode_fn`. Differences
-        forced by resumability: the pool/table/side-caches arrive as
-        ARGUMENTS instead of closures (a mid-flight join scatters new
+        forced by resumability: the pool/table/side-caches travel in the
+        carry instead of closures (a mid-flight join scatters new
         prefill pages into the pool between slices, so the compiled fn
         must read the caller's current arrays), ``prompt_lens`` is an
-        explicit input (at slice ≥ 2 the entry offsets are no longer the
-        prompt lengths), and the full carry returns. The per-row
-        ``remaining`` budget replaces the monolithic loop's ``budgets``
-        with the same step arithmetic."""
+        explicit carry leaf (at slice ≥ 2 the entry offsets are no
+        longer the prompt lengths), and the full carry returns. The
+        per-row ``remaining`` budget replaces the monolithic loop's
+        ``budgets`` with the same step arithmetic.
+
+        Carry pytree (paged): the contiguous leaves minus the batch
+        cache, plus ``{"pool_k", "pool_v", "table", "side_k",
+        "side_v"}``. In stacked mode the pool passes through unchanged
+        (read-only per slice — generated tokens land in the side
+        caches) and the side caches thread the loop; legacy mode
+        threads the pool and passes the scalar side sentinel through.
+        Same jit discipline as the contiguous twin: carry donated on
+        accelerator backends,
+        explicit shardings on a mesh (heads-sharded pool/side payload,
+        replicated table/row-control — see
+        ``parallel/sharding.py::stepped_carry_shardings``)."""
         decode_attention = self._paged_decode_attention(
             self._models[model].cfg
         )
@@ -2210,26 +2299,22 @@ class JaxEngine(GenerationBackend):
 
         from ..ops.sampling import sample_token_per_row
 
-        @jax.jit
-        def decode(
-            params,
-            first_tokens,  # [B]
-            offsets,  # [B]
-            prompt_lens,  # [B] — static per row between joins
-            pool_k,  # [L, P, Hkv, page, D] — or {"q","s"}
-            pool_v,
-            table,  # [B, Jmax] int32
-            side_k,  # stacked: [L, B, Hkv, Tgen, D] (or {"q","s"}); else 0
-            side_v,
-            temperature,
-            rngs,
-            n_real,  # scalar
-            remaining,  # [B]
-            top_p,
-            repeat_penalty,
-            presence,
-            done0,
-        ):
+        def decode(params, carry, n_real):
+            first_tokens = carry["tokens"]  # [B]
+            offsets = carry["offsets"]  # [B]
+            prompt_lens = carry["prompt_lens"]  # [B] static between joins
+            pool_k = carry["pool_k"]  # [L, P, Hkv, page, D] — or {"q","s"}
+            pool_v = carry["pool_v"]
+            table = carry["table"]  # [B, Jmax] int32
+            side_k = carry["side_k"]  # stacked: [L,B,Hkv,Tgen,D]; else 0
+            side_v = carry["side_v"]
+            temperature = carry["temps"]
+            rngs = carry["rngs"]
+            remaining = carry["remaining"]  # [B]
+            top_p = carry["top_ps"]
+            repeat_penalty = carry["rps"]
+            presence = carry["presence"]
+            done0 = carry["done"]
             b = first_tokens.shape[0]
             l = (pool_k["q"] if quantized else pool_k).shape[0]
             table_c = (
@@ -2310,11 +2395,24 @@ class JaxEngine(GenerationBackend):
                 token, offs, ck, cv, rngs_out, done, _, out_tokens,
                 pres_out, n_row,
             ) = jax.lax.while_loop(cond, body, init)
-            return (
-                out_tokens, n_row, token, offs, ck, cv, rngs_out,
-                pres_out, done,
+            threaded = (
+                {"side_k": ck, "side_v": cv}
+                if stacked
+                else {"pool_k": ck, "pool_v": cv}
             )
+            new_carry = dict(
+                carry,
+                tokens=token,
+                offsets=offs,
+                rngs=rngs_out,
+                presence=pres_out,
+                done=done,
+                remaining=remaining - n_row,
+                **threaded,
+            )
+            return out_tokens, n_row, new_carry
 
+        decode = self._stepped_jit(cfg, carry, decode)
         self._decode_cache[key] = decode
         return decode
 

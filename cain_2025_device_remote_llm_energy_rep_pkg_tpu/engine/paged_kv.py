@@ -184,6 +184,16 @@ class PagePool:
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 
+    def payload_nbytes(self) -> int:
+        """Total bytes of the pool's K+V payload (int8 pools count codes
+        AND per-position scales) — the global figure the sharded
+        session's per-device accounting divides by its mesh placement."""
+        total = 0
+        for leaf in (self.k, self.v):
+            parts = leaf.values() if isinstance(leaf, dict) else (leaf,)
+            total += sum(int(arr.nbytes) for arr in parts)
+        return total
+
     def debug_state(self) -> dict:
         """JSON-able pool snapshot for ``GET /debug/state`` (same
         definitions as the gauges — see :func:`_fragmentation`)."""
@@ -198,6 +208,7 @@ class PagePool:
             ),
             "fragmentation": round(_fragmentation(self._free), 4),
             "shared_pages": self.shared_pages,
+            "payload_bytes": self.payload_nbytes(),
         }
 
     def alloc(self, n_pages: int) -> List[int]:

@@ -158,16 +158,61 @@ class _Row:
         self.streamed = 0
 
 
+def _carry_leaf(key: str) -> property:
+    """Expose one carry-pytree leaf as a session attribute: reads and
+    writes go to ``self.carry[key]``, so host-side per-row updates
+    (joins, cancels, table parks) mutate the SAME pytree the jitted
+    slice step returns (and, on accelerator backends, donates) — there
+    is exactly one device state, and it round-trips the compiled step
+    without a host copy."""
+
+    def get(self):
+        return self.carry[key]
+
+    def set_(self, value):
+        self.carry[key] = value
+
+    return property(get, set_)
+
+
 class SteppedDecodeSession:
     """One resumable batched decode (see the module docstring).
 
-    The device state mirrors the monolithic batch loops' carries; the
-    host state is one :class:`_Row` per live slot. ``rows[r] is None``
-    marks a free slot (never admitted, or retired) — free slots ride
-    along pre-done, replicating row 0's offsets so their masked
+    The device state is ONE explicit pytree, ``self.carry`` — the full
+    loop carry of the stepped decode fns (row-control leaves plus the
+    KV payload: batch cache, or page pool + table + side caches). The
+    slice step is jitted over that pytree with the carry DONATED on
+    accelerator backends (jax_engine._stepped_donation), and
+    on a sharded engine (parallel/tp.py) every leaf declares a
+    NamedSharding — KV payload sharded over heads when they divide the
+    mesh, row-control replicated — so the same scheduler loop is
+    device-count-agnostic: the carry never bounces through host memory
+    between slices, on one chip or eight.
+
+    The host state is one :class:`_Row` per live slot. ``rows[r] is
+    None`` marks a free slot (never admitted, or retired) — free slots
+    ride along pre-done, replicating row 0's offsets so their masked
     attention never softmaxes an empty row, exactly the monolithic
     paths' padding-row convention.
     """
+
+    # every device leaf lives in self.carry; these names stay usable as
+    # plain attributes so per-row update sites read naturally
+    tokens = _carry_leaf("tokens")
+    offsets = _carry_leaf("offsets")
+    prompt_lens = _carry_leaf("prompt_lens")
+    remaining = _carry_leaf("remaining")
+    temps = _carry_leaf("temps")
+    top_ps = _carry_leaf("top_ps")
+    rps = _carry_leaf("rps")
+    presence = _carry_leaf("presence")
+    done = _carry_leaf("done")
+    rngs = _carry_leaf("rngs")
+    k_cache = _carry_leaf("k_cache")
+    v_cache = _carry_leaf("v_cache")
+    table = _carry_leaf("table")
+    side_k = _carry_leaf("side_k")
+    side_v = _carry_leaf("side_v")
 
     def __init__(self, engine, model: str, top_k: int) -> None:
         self.engine = engine
@@ -175,6 +220,7 @@ class SteppedDecodeSession:
         self.top_k = top_k
         self.closed = False
         self.paged = bool(engine.paged_kv)
+        self.carry: Dict[str, Any] = {}
         self.rows: List[Optional[_Row]] = []
         # slot -> _PendingJoin: chunked joiners mid-prefill. A reserved
         # slot is not free (free_slots/can_join account for it) and not
@@ -239,10 +285,23 @@ class SteppedDecodeSession:
             max(r.max_new_tokens for r in requests), GEN_BUCKETS
         )
         self.slice_bucket = max(1, int(slice_steps or DECODE_SLICE_STEPS))
-        if self.paged:
-            self._open_paged(requests, all_ids)
-        else:
-            self._open_contiguous(requests, all_ids)
+        # the engine's stepped-compute context covers every compile/run
+        # in the open (TP: the int4 Pallas kernel has no GSPMD rule —
+        # same guard its generate paths apply)
+        with engine._stepped_compute_ctx():
+            if self.paged:
+                self._open_paged(requests, all_ids)
+            else:
+                self._open_contiguous(requests, all_ids)
+            # one explicit placement for the assembled carry: identity on
+            # a single device; on a mesh every leaf is device_put to the
+            # sharding the jitted slice step declares (heads-sharded KV
+            # payload, replicated row control), so the session starts
+            # committed to the SPMD layout it will keep
+            self.carry = engine._place_carry(self.cfg, self.carry)
+            if self.paged:
+                self.pool.k = self.carry["pool_k"]
+                self.pool.v = self.carry["pool_v"]
         return self
 
     def _open_common(self, requests, states, pad: int) -> None:
@@ -443,11 +502,9 @@ class SteppedDecodeSession:
             all_k,
             all_v,
         )
-        table = jnp.asarray(table_np)
-        self.pool.k, self.pool.v, table = eng._place_pool(
-            cfg, self.pool.k, self.pool.v, table
-        )
-        self.table = table
+        # placement happens once, over the WHOLE carry, at the end of
+        # open() (_place_carry) — the pool/table join it below
+        self.table = jnp.asarray(table_np)
         if self.stacked:
             side_shape = (
                 cfg.n_layers, self.b_bucket, cfg.n_kv_heads,
@@ -466,7 +523,10 @@ class SteppedDecodeSession:
                 self.side_k = jnp.zeros(side_shape, dtype=eng.dtype)
                 self.side_v = jnp.zeros(side_shape, dtype=eng.dtype)
         else:
-            self.side_k = self.side_v = jnp.int32(0)
+            # two DISTINCT scalar sentinels: the carry is donated on
+            # accelerators, and XLA rejects one buffer donated twice
+            self.side_k = jnp.int32(0)
+            self.side_v = jnp.int32(0)
         self._open_common(requests, states, pad)
         for row, pages in zip(self.rows, row_pages):
             row.pages = pages
@@ -475,6 +535,11 @@ class SteppedDecodeSession:
                 self._publish_prefix(
                     ids, st["k_cache"], st["v_cache"], row.pages
                 )
+        # pool payload enters the carry last (scatters above built it);
+        # PagePool.k/v stay views of the same arrays (re-synced after
+        # placement and after every slice)
+        self.carry["pool_k"] = self.pool.k
+        self.carry["pool_v"] = self.pool.v
 
     def _pages_needed(self, s_real: int, max_new_tokens: int) -> int:
         """Pages one row pins: prompt-only in stacked mode (generated
@@ -600,9 +665,52 @@ class SteppedDecodeSession:
         }
         if self.paged:
             state["pool"] = self.pool.debug_state()
+        mesh_info = getattr(self.engine, "mesh_info", None)
+        info = mesh_info() if callable(mesh_info) else None
+        if info is not None:
+            # sharded session: report the mesh and what each device
+            # actually holds — per-device KV payload bytes come from the
+            # carry leaves' own committed shardings (shard_shape), so a
+            # placement regression shows up here, not just in step time
+            state["mesh"] = dict(info)
+            state["mesh"]["per_device_kv_bytes"] = self._per_device_kv_bytes()
+            if self.paged:
+                state["pool"]["per_device"] = {
+                    "bytes": self._per_device_kv_bytes(pool_only=True),
+                    "pages": self.pool.n_pages,
+                    "occupancy": state["pool"]["occupancy"],
+                }
         if self.prefix is not None:
             state["prefix"] = self.prefix.debug_state()
         return state
+
+    def _per_device_kv_bytes(self, pool_only: bool = False) -> int:
+        """Bytes of KV payload ONE device holds under the carry's
+        committed shardings (pool + side caches, or the contiguous batch
+        cache). Head-sharded layouts report 1/tp of the total; a
+        replicated fallback (heads don't divide the mesh) reports the
+        full payload — the honest number either way."""
+        keys = (
+            ("pool_k", "pool_v") if pool_only
+            else ("pool_k", "pool_v", "side_k", "side_v")
+            if self.paged
+            else ("k_cache", "v_cache")
+        )
+        total = 0
+        for key in keys:
+            leaf = self.carry.get(key)
+            if leaf is None:
+                continue
+            parts = leaf.values() if isinstance(leaf, dict) else (leaf,)
+            for arr in parts:
+                if getattr(arr, "ndim", 0) == 0:
+                    continue  # legacy-mode side sentinel
+                shard = arr.sharding.shard_shape(arr.shape)
+                n = 1
+                for d in shard:
+                    n *= d
+                total += n * arr.dtype.itemsize
+        return int(total)
 
     # -- stepping -------------------------------------------------------------
     def step(self, max_steps: Optional[int] = None) -> List[GenerationResult]:
@@ -620,67 +728,33 @@ class SteppedDecodeSession:
         params = eng._models[self.model].params
         n_real = min(max_steps or self.slice_bucket, self.slice_bucket)
         t1 = time.monotonic()
-        if self.paged:
-            decode = eng._paged_batch_decode_step_fn(
-                self.model, self.slice_bucket, self.top_k,
-                self.use_top_p, self.use_rp, self.stacked, self.quantized,
-            )
-            (
-                out, n_row, tokens, offsets, ck, cv, rngs, presence, done,
-            ) = decode(
-                params,
-                self.tokens,
-                self.offsets,
-                self.prompt_lens,
-                self.pool.k,
-                self.pool.v,
-                self.table,
-                self.side_k,
-                self.side_v,
-                self.temps,
-                self.rngs,
-                jnp.int32(n_real),
-                self.remaining,
-                self.top_ps,
-                self.rps,
-                self.presence,
-                self.done,
-            )
-            if self.stacked:
-                self.side_k, self.side_v = ck, cv
+        # ONE carry in, ONE carry out: on accelerators the compiled
+        # slice step donates the input pytree (its buffers alias the
+        # output's), and on a sharded engine runs under explicit in/out
+        # shardings — the whole per-iteration state stays resident on
+        # the device(s)
+        with eng._stepped_compute_ctx():
+            if self.paged:
+                decode = eng._paged_batch_decode_step_fn(
+                    self.model, self.slice_bucket, self.top_k,
+                    self.use_top_p, self.use_rp, self.stacked,
+                    self.quantized, carry=self.carry,
+                )
             else:
-                self.pool.k, self.pool.v = ck, cv
-        else:
-            decode = eng._batch_decode_step_fn(
-                self.model, self.slice_bucket, self.top_k,
-                self.use_top_p, self.use_rp,
+                decode = eng._batch_decode_step_fn(
+                    self.model, self.slice_bucket, self.top_k,
+                    self.use_top_p, self.use_rp, carry=self.carry,
+                )
+            out, n_row, self.carry = decode(
+                params, self.carry, jnp.int32(n_real)
             )
-            (
-                out, n_row, tokens, offsets, ck, cv, rngs, presence, done,
-            ) = decode(
-                params,
-                self.tokens,
-                self.offsets,
-                self.k_cache,
-                self.v_cache,
-                self.temps,
-                self.rngs,
-                jnp.int32(n_real),
-                self.remaining,
-                self.top_ps,
-                self.rps,
-                self.presence,
-                self.done,
-            )
-            self.k_cache, self.v_cache = ck, cv
-        self.tokens, self.offsets = tokens, offsets
-        self.rngs, self.presence = rngs, presence
-        self.remaining = self.remaining - n_row
-        self.done = done
+        if self.paged:
+            self.pool.k = self.carry["pool_k"]
+            self.pool.v = self.carry["pool_v"]
         out = jax.block_until_ready(out)
         out_host = _to_host_list(out)
         n_row_host = _to_host_list(n_row)
-        done_host = _to_host_list(done)
+        done_host = _to_host_list(self.done)
         t2 = time.monotonic()
         slice_tokens = 0
         slice_steps = 0
@@ -752,6 +826,7 @@ class SteppedDecodeSession:
             self.table = self.table.at[r].set(self.parking)
             self.pool.free(row.pages)
             row.pages = []
+            self._recommit_carry()
         self.rows[r] = None
         return result
 
@@ -803,8 +878,24 @@ class SteppedDecodeSession:
                 self.pool.free(row.pages)
                 row.pages = []
             self.rows[r] = None
+            self._recommit_carry()
             return True
         return False
+
+    def _recommit_carry(self) -> None:
+        """Re-pin the carry to the engine's declared placements after a
+        host-side eager mutation batch (row install, cancel). Eager ops
+        let GSPMD choose output shardings, and on a mesh a leaf can
+        drift — e.g. a REPLICATED-KV pool (heads don't divide ``tp``)
+        picks up a partial GSPMD sharding from a join's page scatter —
+        which the next slice's explicit ``in_shardings`` would reject.
+        ``device_put`` to the declared sharding is identity for leaves
+        already in place, a reshard for any that drifted; a no-op
+        entirely on single-device engines (_place_carry is identity)."""
+        self.carry = self.engine._place_carry(self.cfg, self.carry)
+        if self.paged:
+            self.pool.k = self.carry["pool_k"]
+            self.pool.v = self.carry["pool_v"]
 
     # -- admission ------------------------------------------------------------
     def can_join(self, request: GenerationRequest) -> bool:
@@ -993,16 +1084,17 @@ class SteppedDecodeSession:
         tokens = jnp.asarray(
             [ids + [self.tok.pad_id] * (bucket - real)], dtype=jnp.int32
         )
-        prefill = eng._prefill_fn(self.model, bucket, pending.cache_len)
-        logits, pending.k_cache, pending.v_cache = prefill(
-            tf.params,
-            tokens,
-            jnp.int32(start),
-            jnp.asarray([real - 1]),
-            pending.k_cache,
-            pending.v_cache,
-        )
-        jax.block_until_ready(logits)
+        with eng._stepped_compute_ctx():
+            prefill = eng._prefill_fn(self.model, bucket, pending.cache_len)
+            logits, pending.k_cache, pending.v_cache = prefill(
+                tf.params,
+                tokens,
+                jnp.int32(start),
+                jnp.asarray([real - 1]),
+                pending.k_cache,
+                pending.v_cache,
+            )
+            jax.block_until_ready(logits)
         pending.logits = logits
         pending.next_chunk += 1
         pending.prefill_s += time.monotonic() - t0
@@ -1029,18 +1121,19 @@ class SteppedDecodeSession:
         rng = jax.random.PRNGKey(request.seed)
         rng, sub = jax.random.split(rng)
         presence = pending.presence
-        first = sample_token(
-            pending.logits,
-            sub,
-            jnp.float32(request.temperature),
-            request.top_k,
-            jnp.float32(request.top_p) if use_top_p else None,
-            presence if use_rp else None,
-            jnp.float32(request.repeat_penalty) if use_rp else None,
-        )
-        if use_rp:
-            presence = presence.at[jnp.arange(1), first].set(True)
-        jax.block_until_ready(first)
+        with self.engine._stepped_compute_ctx():
+            first = sample_token(
+                pending.logits,
+                sub,
+                jnp.float32(request.temperature),
+                request.top_k,
+                jnp.float32(request.top_p) if use_top_p else None,
+                presence if use_rp else None,
+                jnp.float32(request.repeat_penalty) if use_rp else None,
+            )
+            if use_rp:
+                presence = presence.at[jnp.arange(1), first].set(True)
+            jax.block_until_ready(first)
         pending.prefill_s += time.monotonic() - t0
         if _obs_enabled():
             try:
@@ -1137,13 +1230,19 @@ class SteppedDecodeSession:
                 ck, cv = jnp.pad(ck, padd), jnp.pad(cv, padd)
             if self.quantized:
                 ck, cv = quantize_chunks(ck, cv)
-            self.pool.k, self.pool.v = scatter_pages(
-                self.pool.k,
-                self.pool.v,
+            # scatter into the CARRY's pool leaves: inputs are committed
+            # to the carry sharding, so the eager scatter runs sharded in
+            # place of placement (computation follows data) and the next
+            # slice's jit sees exactly the sharding it declared
+            self.carry["pool_k"], self.carry["pool_v"] = scatter_pages(
+                self.carry["pool_k"],
+                self.carry["pool_v"],
                 jnp.asarray(pages[base:n_prompt_pages], jnp.int32),
                 ck,
                 cv,
             )
+            self.pool.k = self.carry["pool_k"]
+            self.pool.v = self.carry["pool_v"]
             table_row = np.full((self.jmax,), self.parking, dtype=np.int32)
             table_row[: len(pages)] = pages
             self.table = self.table.at[r].set(jnp.asarray(table_row))
@@ -1186,6 +1285,7 @@ class SteppedDecodeSession:
             now,
             pages=pages,
         )
+        self._recommit_carry()
 
     # -- teardown -------------------------------------------------------------
     def close(self) -> None:

@@ -131,6 +131,52 @@ def quant_cache_shardings(
     }
 
 
+def stepped_carry_shardings(
+    cfg: ModelConfig, mesh: Mesh, carry: Dict[str, Any]
+) -> Dict[str, Any]:
+    """NamedSharding pytree for a stepped-decode session carry
+    (engine/stepped.py): the per-iteration SPMD placement that makes the
+    continuous scheduler device-count-agnostic.
+
+    One rule per carry leaf, mirroring the monolithic paths' placements
+    so the jitted slice step neither reshards nor bounces through host:
+
+    - KV payload shards over the heads axis when ``n_kv_heads`` divides
+      ``tp`` (the ONE divisibility rule, ``cache_spec``): the contiguous
+      batch cache ``k_cache``/``v_cache`` [L,B,Hkv,T,Dh], the page pool
+      ``pool_k``/``pool_v`` [L,P,Hkv,page,D] (pages sit in the
+      batch-like position), and the stacked side caches
+      ``side_k``/``side_v`` [L,B,Hkv,Tgen,D]. Int8 ``{"q","s"}`` leaves
+      place codes with the payload spec and the per-position scales with
+      the head-reduced spec (``quant_cache_shardings`` applied
+      leaf-wise).
+    - Everything row-control — tokens, offsets, prompt_lens, remaining,
+      done, rngs, presence, sampling knobs, and the page table —
+      replicates (tiny per-row metadata every device reads each step;
+      the host mutates it between slices with O(B) scatters).
+
+    The returned dict matches ``carry`` leaf-for-leaf, so it is valid as
+    both a ``jax.jit`` in/out_shardings subtree and a ``device_put``
+    target.
+    """
+    spec = cache_spec(cfg, mesh)
+    payload = NamedSharding(mesh, spec)
+    scale = NamedSharding(mesh, P(*tuple(spec)[:-1]))
+    repl = NamedSharding(mesh, P())
+    payload_keys = ("k_cache", "v_cache", "pool_k", "pool_v", "side_k", "side_v")
+
+    def place(key: str, leaf):
+        if key not in payload_keys:
+            return repl
+        if isinstance(leaf, dict):  # int8: codes + per-position scales
+            return {"q": payload, "s": scale}
+        if getattr(leaf, "ndim", 0) == 0:
+            return repl  # legacy-mode side-cache sentinel (scalar 0)
+        return payload
+
+    return {key: place(key, leaf) for key, leaf in carry.items()}
+
+
 def shard_model(params: Dict[str, Any], cfg: ModelConfig, mesh: Mesh) -> Dict[str, Any]:
     """Place an existing params pytree onto the mesh per the TP rules.
 

@@ -26,7 +26,9 @@ from .sharding import (
     cache_shardings,
     paged_pool_shardings,
     quant_cache_shardings,
+    replicated,
     shard_model,
+    stepped_carry_shardings,
 )
 
 
@@ -109,6 +111,54 @@ class TensorParallelEngine(JaxEngine):
             self._place_quant_cache(cfg, kq),
             self._place_quant_cache(cfg, vq),
         )
+
+    # -- stepped-decode sessions on the mesh (ISSUE 8) -----------------------
+    # The continuous scheduler's per-iteration carry (engine/stepped.py)
+    # is one pytree; these four hooks make it SPMD-clean end to end —
+    # explicit placement at open, explicit in/out shardings + donation
+    # on the jitted slice step, and the same int4-kernel guard the
+    # generate paths apply — so `serve --backend jax-tp --scheduler
+    # continuous` runs iteration-level batching on the mesh with the
+    # scheduler loop unchanged.
+    def _stepped_carry_shardings(self, cfg: ModelConfig, carry):
+        """KV payload over heads when they divide ``tp`` (the pool
+        reuses the ``pool_scale`` placement for int8 scales), row
+        control + page table replicated — sharding.py holds the one
+        rule; this hook just binds the session's carry to it."""
+        return stepped_carry_shardings(cfg, self.mesh, carry)
+
+    def _place_carry(self, cfg: ModelConfig, carry):
+        shardings = self._stepped_carry_shardings(cfg, carry)
+        return jax.tree_util.tree_map(jax.device_put, carry, shardings)
+
+    def _stepped_jit(self, cfg: ModelConfig, carry, fn):
+        """The slice step as a pure SPMD program: explicit in/out
+        shardings (so a mis-placed leaf is a visible reshard at the jit
+        boundary, never a silent per-step host bounce) and, on
+        accelerator backends, a donated carry — output KV buffers alias
+        the inputs', exactly the monolithic loop's memory profile (CPU
+        skips the donation: see jax_engine._stepped_donation)."""
+        from ..engine.jax_engine import _stepped_donation
+
+        shardings = self._stepped_carry_shardings(cfg, carry)
+        repl = replicated(self.mesh)
+        return jax.jit(
+            fn,
+            in_shardings=(None, shardings, None),
+            out_shardings=(repl, repl, shardings),
+            **_stepped_donation(),
+        )
+
+    def _stepped_compute_ctx(self):
+        return int4_kernel_disabled()
+
+    def mesh_info(self) -> Optional[Dict]:
+        dev = self.mesh.devices.flat[0]
+        return {
+            "devices": int(self.mesh.devices.size),
+            "axes": {k: int(v) for k, v in self.mesh.shape.items()},
+            "platform": getattr(dev, "platform", "unknown"),
+        }
 
     def _place_pool(self, cfg: ModelConfig, pool_k, pool_v, table):
         """Shard the page pool's heads over the mesh (pages replicated,

@@ -142,7 +142,17 @@ commands:
   prepare              validate the environment (JAX devices, RAPL access)
   serve [opts]         start the HTTP generation server (the framework-native
                        Ollama-equivalent): --host H --port N (default 11434),
-                       --backend jax|jax-tp|fake, --tp N, --models a,b,c,
+                       --backend jax|jax-tp|fake, --tp N, --models a,b,c
+                       (--backend jax-tp --tp N serves from an N-device
+                       tensor-parallel mesh, and composes with
+                       --scheduler continuous: stepped decode sessions
+                       carry an explicitly-sharded SPMD pytree — KV
+                       pool/caches sharded over heads when they divide
+                       the mesh, row state replicated — so joins,
+                       retirements, cancellation and shared-prefix CoW
+                       paging run unchanged on the mesh; on a dev box
+                       XLA_FLAGS=--xla_force_host_platform_device_count=N
+                       exercises the same path on virtual CPU devices),
                        --scheduler window|continuous --window-ms W
                        --max-batch B (request batching of concurrent
                        requests; off by default — --scheduler or

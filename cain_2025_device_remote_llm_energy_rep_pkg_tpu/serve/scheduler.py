@@ -798,6 +798,17 @@ class ContinuousScheduler(_SchedulerBase):
         state["slice_steps"] = self.slice_steps
         state["chunked_joins"] = self.chunked_joins
         state["prefill_chunk_tokens"] = self.prefill_chunk_tokens
+        # Sharded serving (ISSUE 8): a TP backend reports its mesh here
+        # so one /debug/state probe shows WHICH device topology the
+        # continuous loop is driving (None on single-device backends —
+        # the loop itself is device-count-agnostic).
+        mesh_info = getattr(self.backend, "mesh_info", None)
+        try:
+            state["backend_mesh"] = (
+                mesh_info() if callable(mesh_info) else None
+            )
+        except Exception:  # noqa: BLE001 — probe only
+            state["backend_mesh"] = None
         dbg = self._dbg
         if dbg is None:
             state["session"] = None

@@ -265,6 +265,16 @@ class GenerationServer:
                     "scheduler_mode": server.scheduler_mode,
                     "flight": FLIGHT.summary(),
                 }
+                # sharded backends (parallel/tp.py) report their device
+                # mesh at the top level — present even between sessions,
+                # when no live carry exists to introspect
+                try:
+                    mesh_info = getattr(server.backend, "mesh_info", None)
+                    info = mesh_info() if callable(mesh_info) else None
+                    if info is not None:
+                        state["mesh"] = info
+                except Exception:  # noqa: BLE001 — probe only
+                    pass
                 try:
                     if server._scheduler is not None:
                         state["scheduler"] = server._scheduler.debug_state()

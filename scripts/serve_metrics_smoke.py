@@ -46,6 +46,16 @@ Three phases, all over the deterministic fake backend:
    after both rows retired, and the ``prefix_hit`` flight event fired
    linked to the joined ticket's trace.
 
+7. SHARDED CONTINUOUS SERVING (ISSUE 8): the fake-free path — a REAL
+   ``TensorParallelEngine`` (tiny model, paged KV) on a forced-host
+   2-device CPU mesh behind the continuous scheduler. Two staggered
+   requests serve token-for-token through the sharded stepped session
+   (the second joins mid-flight); the scrape asserts the ``llm_sched_*``
+   counters moved (session opened, rows retired) and ``/debug/state``
+   reports the MESH — shape at the top level and under the scheduler's
+   ``backend_mesh``, and (probed mid-flight) the live session's
+   per-device pool occupancy from the carry's committed shardings.
+
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
 """
@@ -57,6 +67,16 @@ import sys
 import threading
 import time
 import urllib.request
+
+# Phase 7 needs ≥2 virtual devices, and the device count is fixed the
+# moment jax initialises — which phase 2's scheduler import triggers —
+# so the flags must be pinned before ANY phase runs.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -520,6 +540,133 @@ def main() -> int:
     finally:
         server6.stop()
 
+    # -- phase 7: sharded continuous serving on a forced-host 2-device mesh ----
+    # The fake-free path: a REAL TP engine (tiny model, paged KV pool)
+    # behind the continuous scheduler. The point is end-to-end SPMD
+    # cleanliness — HTTP → scheduler → sharded stepped session → tokens —
+    # plus the mesh-aware debug surface.
+    import dataclasses as _dc
+
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.mesh import (
+        MeshSpec,
+        build_mesh,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.tp import (
+        TensorParallelEngine,
+    )
+
+    assert len(_jax.devices()) >= 2, (
+        f"phase 7 needs 2 virtual devices, have {len(_jax.devices())} "
+        "(XLA_FLAGS set too late?)"
+    )
+    tiny = _dc.replace(
+        get_model_config("qwen2:1.5b").tiny(),
+        n_heads=8, n_kv_heads=8, d_ff=128, d_model=64, d_head=16,
+    )
+    tp_backend = TensorParallelEngine(
+        mesh=build_mesh(MeshSpec.tp_only(), devices=_jax.devices()[:2]),
+        registry={tiny.name: tiny},
+        dtype=_jnp.float32,
+        paged_kv=True,
+    )
+    server7 = GenerationServer(
+        tp_backend,
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    server7.start()
+    try:
+        base7 = f"http://127.0.0.1:{server7.port}"
+        pre7 = _scrape(base7)
+        sessions_before = _metric_value(pre7, "llm_sched_batches_total")
+        retired_before = _metric_value(pre7, "llm_sched_rows_retired_total")
+        # idle probe: the mesh is visible even with no live session
+        idle_state = _get_json(base7, "/debug/state")
+        assert idle_state["mesh"]["devices"] == 2, idle_state.get("mesh")
+        assert idle_state["mesh"]["axes"] == {"tp": 2}
+        assert (
+            idle_state["scheduler"]["backend_mesh"]["devices"] == 2
+        ), idle_state["scheduler"].get("backend_mesh")
+
+        mid7 = {}
+
+        def probe7():
+            # poll /debug/state while the anchor decodes: the live
+            # session must report the mesh and the pool's per-device
+            # occupancy (bytes from the carry's committed shardings)
+            deadline7 = time.monotonic() + 60.0
+            while time.monotonic() < deadline7 and "per_device" not in mid7:
+                try:
+                    st = _get_json(base7, "/debug/state")
+                    sess_st = (st.get("scheduler") or {}).get("session")
+                    if sess_st and sess_st.get("mesh"):
+                        mid7["session_mesh"] = sess_st["mesh"]
+                        if (sess_st.get("pool") or {}).get("per_device"):
+                            mid7["per_device"] = sess_st["pool"]["per_device"]
+                except Exception:
+                    pass
+                time.sleep(0.05)
+
+        # phase-7 posts use the tiny model's name, not the fake's
+        def _post7(prompt, n):
+            req = urllib.request.Request(
+                f"{base7}/api/generate",
+                data=json.dumps(
+                    {
+                        "model": tiny.name,
+                        "prompt": prompt,
+                        "options": {"num_predict": n},
+                    }
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return json.loads(resp.read())
+
+        threads7 = [
+            threading.Thread(target=lambda: _post7("sharded anchor", 96)),
+            threading.Thread(
+                target=lambda: (
+                    time.sleep(0.2),
+                    _post7("mid-flight joiner", 32),
+                )
+            ),
+            threading.Thread(target=probe7),
+        ]
+        for t in threads7:
+            t.start()
+        for t in threads7:
+            t.join(timeout=180)
+
+        text7 = _scrape(base7)
+        sessions7 = (
+            _metric_value(text7, "llm_sched_batches_total") - sessions_before
+        )
+        retired7 = (
+            _metric_value(text7, "llm_sched_rows_retired_total")
+            - retired_before
+        )
+        assert sessions7 >= 1, "no continuous session opened on the mesh"
+        assert retired7 >= 2, f"expected 2 sharded rows retired, got {retired7}"
+        assert mid7.get("session_mesh", {}).get("devices") == 2, (
+            f"live session never reported the mesh: {mid7}"
+        )
+        per_device = mid7.get("per_device") or {}
+        assert per_device.get("bytes", 0) > 0, (
+            f"no per-device pool occupancy reported: {mid7}"
+        )
+        assert per_device.get("occupancy", 0) > 0
+    finally:
+        server7.stop()
+
     print(
         json.dumps(
             {
@@ -551,6 +698,12 @@ def main() -> int:
                     "hit_tokens": hit_tokens,
                     "shared_pages_mid_flight": shared_mid,
                     "prefix_hit_events": len(prefix_hits),
+                },
+                "tp_continuous": {
+                    "mesh": idle_state["mesh"],
+                    "sessions_opened": sessions7,
+                    "rows_retired": retired7,
+                    "per_device_pool": mid7.get("per_device"),
                 },
             }
         )

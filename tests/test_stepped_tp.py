@@ -1,0 +1,311 @@
+"""Sharded stepped decode sessions on the forced-host mesh (ISSUE 8).
+
+The continuous scheduler's engine half (`engine/stepped.py`) carries one
+explicit SPMD pytree; these tests pin that the SAME session code is
+device-count-agnostic: on a 2- and an 8-device tensor-parallel mesh
+(virtual CPU devices — conftest forces 8), every row's token stream is
+bit-identical to its solo ``generate()`` on all four cache layouts,
+mid-flight joiners and shared-prefix joiners included; cancellation
+restores the pool free count EXACTLY (the PR-6 invariant, now on sharded
+rows); and the carry's declared shardings survive stepping — KV payload
+over heads, row control replicated.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.tp import (
+    TensorParallelEngine,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _tiny8():
+    """A tiny config whose head/ff dims divide tp ∈ {2, 8} (the
+    test_parallel.py convention)."""
+    return dataclasses.replace(
+        get_model_config("mistral:7b").tiny(),
+        n_heads=8,
+        n_kv_heads=8,
+        d_ff=128,
+        d_model=64,
+        d_head=16,
+        max_seq_len=1024,  # room for the ≥1-full-page shared prefix
+    )
+
+
+@pytest.fixture(scope="module")
+def registry():
+    return {"tiny": _tiny8()}
+
+
+def _tp_engine(registry, n_devices, **kwargs):
+    mesh = build_mesh(
+        MeshSpec.tp_only(), devices=jax.devices()[:n_devices]
+    )
+    return TensorParallelEngine(
+        mesh=mesh, registry=dict(registry), dtype=jnp.float32, **kwargs
+    )
+
+
+def _drain(session, max_steps=8, limit=300):
+    out = []
+    for _ in range(limit):
+        if not session.active:
+            break
+        out.extend(session.step(max_steps))
+    assert not session.active, "session did not drain"
+    return out
+
+
+LAYOUTS = [
+    pytest.param(False, None, id="contiguous-bf16"),
+    pytest.param(False, "int8", id="contiguous-int8kv"),
+    pytest.param(True, None, id="paged-bf16"),
+    pytest.param(True, "int8", id="paged-int8kv"),
+]
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+@pytest.mark.parametrize("paged,kv", LAYOUTS)
+def test_tp_stepped_parity_with_mid_flight_join(registry, n_devices, paged, kv):
+    """The acceptance matrix: 4 cache layouts × {2, 8}-device mesh, a
+    mid-flight joiner included — every row token-identical to its own
+    solo generate() on the same sharded engine."""
+    eng = _tp_engine(registry, n_devices, paged_kv=paged, kv_quantize=kv)
+    anchor = GenerationRequest(
+        "tiny", "anchor runs long on the mesh", max_new_tokens=24,
+        stop_at_eos=False,
+    )
+    short = GenerationRequest(
+        "tiny", "short companion", max_new_tokens=6, seed=2
+    )
+    joiner = GenerationRequest(
+        "tiny", "late arrival joins mid-flight", max_new_tokens=10, seed=3
+    )
+    solo = {id(r): eng.generate(r) for r in (anchor, short, joiner)}
+    sess = eng.decode_open([anchor, short], reserve_rows=4)
+    sess.step(4)  # anchor mid-flight
+    assert sess.can_join(joiner)
+    sess.join(joiner)
+    results = {id(r.request): r for r in _drain(sess)}
+    for req in (anchor, short, joiner):
+        assert results[id(req)].tokens == solo[id(req)].tokens, (
+            f"row diverged on tp={n_devices} paged={paged} kv={kv}"
+        )
+    sess.close()
+
+
+def test_tp_carry_shardings_declared_and_stable(registry):
+    """The tentpole's contract, directly: KV payload leaves shard over
+    the heads axis, row-control leaves replicate, and one compiled
+    slice step returns the carry with the SAME placements (explicit
+    out_shardings — no silent reshard, no host bounce)."""
+    from jax.sharding import PartitionSpec as P
+
+    eng = _tp_engine(registry, 8, paged_kv=True)
+    sess = eng.decode_open(
+        [
+            GenerationRequest(
+                "tiny", "sharding probe", max_new_tokens=20,
+                stop_at_eos=False,
+            )
+        ],
+        reserve_rows=2,
+    )
+
+    def specs():
+        out = {}
+        for key, leaf in sess.carry.items():
+            arr = leaf["q"] if isinstance(leaf, dict) else leaf
+            out[key] = arr.sharding.spec
+        return out
+
+    before = specs()
+    assert before["pool_k"] == P(None, None, "tp", None, None)
+    assert before["pool_v"] == P(None, None, "tp", None, None)
+    for key in ("tokens", "done", "remaining", "table", "presence"):
+        assert before[key] == P(), key
+    sess.step(4)
+    assert specs() == before  # one slice later: placements unchanged
+    # per-device accounting reflects the head shard: each of the 8
+    # devices holds 1/8 of the pool payload
+    state = sess.debug_state()
+    assert state["mesh"]["devices"] == 8
+    assert state["mesh"]["axes"] == {"tp": 8}
+    pool_leaf = sess.carry["pool_k"]
+    total = pool_leaf.nbytes + sess.carry["pool_v"].nbytes
+    assert state["pool"]["per_device"]["bytes"] == total // 8
+    sess.close()
+
+
+def test_tp_carry_falls_back_to_replicated_kv(registry):
+    """Heads that don't divide the mesh replicate the KV payload — the
+    documented fallback keeps the session correct (and the debug
+    surface honest) instead of crashing the mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    cfg = dataclasses.replace(_tiny8(), n_heads=6, n_kv_heads=3, d_ff=128)
+    eng = _tp_engine({"tiny3": cfg}, 2, paged_kv=True)
+    req = GenerationRequest(
+        "tiny3", "odd heads", max_new_tokens=16, stop_at_eos=False
+    )
+    joiner = GenerationRequest(
+        "tiny3", "replicated joiner", max_new_tokens=6, seed=4
+    )
+    solo = eng.generate(req)
+    solo_joiner = eng.generate(joiner)
+    sess = eng.decode_open([req], reserve_rows=2)
+    arr = sess.carry["pool_k"]
+    assert arr.sharding.spec == P(None, None, None, None, None)
+    sess.step(4)
+    # the regression that shipped this assert: a JOIN's eager page
+    # scatter let GSPMD re-shard the replicated pool, and the next
+    # slice's explicit in_shardings rejected the arg — _recommit_carry
+    # re-pins the placement after every host-side mutation batch
+    sess.join(joiner)
+    assert sess.carry["pool_k"].sharding.spec == P(
+        None, None, None, None, None
+    )
+    results = {id(r.request): r for r in _drain(sess)}
+    assert results[id(req)].tokens == solo.tokens
+    assert results[id(joiner)].tokens == solo_joiner.tokens
+    sess.close()
+
+
+def test_tp_shared_prefix_joiner_parity_and_exact_restoration(registry):
+    """Shared-prefix CoW paging composes on the mesh: the joiner maps
+    read-only head-sharded prefix pages, chunk-prefills only the
+    divergent tail, stays solo-identical — and retirement + close()
+    restore the pool free count exactly (refcounted pages, PR 7)."""
+    eng = _tp_engine(registry, 8, paged_kv=True, prefix_share=True)
+    # ≥1 FULL 128-token page of shared prefix (character tokenizer —
+    # the test_prefix.py convention)
+    prefix = "s" * 140 + " "
+    anchor = GenerationRequest(
+        "tiny", prefix + "anchor question", max_new_tokens=24,
+        stop_at_eos=False,
+    )
+    sharer = GenerationRequest(
+        "tiny", prefix + "different tail", max_new_tokens=8, seed=5
+    )
+    solo_sharer = eng.generate(sharer)
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    sess.step(2)
+    assert sess.can_join(sharer)
+    free_before_join = sess.pool.free_pages
+    pj = sess.join_begin(sharer)
+    assert pj.hit_tokens > 0, "joiner did not hit the published prefix"
+    assert pj.shared_pages > 0, "no pool pages were mapped read-only"
+    # only the divergent tail came off the free list — the shared page
+    # is a refcounted read-only mapping, billed once
+    assert free_before_join - sess.pool.free_pages < len(pj.pages)
+    while not sess.join_step(pj):
+        pass
+    sess.join_commit(pj)
+    assert sess.pool.shared_pages > 0  # live CoW mapping on the mesh
+    results = {id(r.request): r for r in _drain(sess)}
+    assert results[id(sharer)].tokens == solo_sharer.tokens
+    # exact restoration: close releases rows, then index refs LAST —
+    # every refcount reaches zero and only the parking page stays out
+    sess.close()
+    assert sess.pool.free_pages == sess.pool.n_pages - 1
+
+
+def test_tp_cancel_restores_free_count_exactly(registry):
+    """PR-6's cancellation invariant on SHARDED rows (the ROADMAP
+    follow-on): cancel() parks the table row and frees the victim's
+    pages mid-flight with exact free-count restoration, and the
+    surviving anchor decodes on, unperturbed, to its solo stream."""
+    eng = _tp_engine(registry, 8, paged_kv=True)
+    anchor = GenerationRequest(
+        "tiny", "anchor", max_new_tokens=40, stop_at_eos=False
+    )
+    victim = GenerationRequest(
+        "tiny", "victim row to cancel", max_new_tokens=40,
+        stop_at_eos=False, seed=3,
+    )
+    solo_anchor = eng.generate(anchor)
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    free_before_join = sess.pool.free_pages
+    sess.step(4)
+    sess.join(victim)
+    victim_pages = next(
+        row.pages
+        for row in sess.rows
+        if row is not None and row.request is victim
+    )
+    assert sess.pool.free_pages == free_before_join - len(victim_pages)
+    sess.step(4)
+    assert sess.cancel(victim)
+    assert sess.pool.free_pages == free_before_join
+    assert sess.active == 1
+    results = _drain(sess)
+    assert results[0].tokens == solo_anchor.tokens
+    sess.close()
+
+
+def test_tp_deadline_reap_through_continuous_scheduler(registry):
+    """Deadline reaping propagates into the sharded session: a
+    mid-flight ``deadline_ms`` expiry retires the row through the
+    continuous scheduler's reap sweep (session.cancel on the mesh) and
+    the caller fails with DeadlineExceeded — not a hang, not a stuck
+    slot."""
+    import threading
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.stream import (
+        DeadlineExceeded,
+    )
+
+    eng = _tp_engine(registry, 2, paged_kv=True)
+    # warm the compiled shapes so the deadline races decode, not XLA
+    warm = GenerationRequest(
+        "tiny", "warm", max_new_tokens=200, stop_at_eos=False
+    )
+    sess = eng.decode_open([warm], reserve_rows=2)
+    sess.step(2)
+    sess.close()
+    sched = ContinuousScheduler(eng, slice_steps=2)
+    sched.start()
+    try:
+        doomed = GenerationRequest(
+            "tiny", "doomed long row", max_new_tokens=200,
+            stop_at_eos=False, deadline_ms=300.0,
+        )
+        errs = {}
+
+        def run():
+            try:
+                sched.submit(doomed)
+            except BaseException as exc:  # noqa: BLE001
+                errs["exc"] = exc
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join(timeout=60)
+        assert not t.is_alive(), "deadline-doomed request hung"
+        assert isinstance(errs.get("exc"), DeadlineExceeded), errs
+        # the session closed behind the reaped row: the scheduler's
+        # debug surface shows no live session holding mesh state
+        assert sched.debug_state()["backend_mesh"]["devices"] == 2
+    finally:
+        sched.stop()
