@@ -39,6 +39,7 @@ class _FakeStepSession:
         backend: "FakeBackend",
         requests: List[GenerationRequest],
         max_rows: int = 64,
+        spec_accept_floor: "Optional[float]" = None,
     ) -> None:
         self.backend = backend
         self.max_rows = max_rows
@@ -47,6 +48,21 @@ class _FakeStepSession:
         self.top_k = requests[0].top_k if requests else 0
         self._rows: List[dict] = []
         self._pending: List[dict] = []  # chunked joiners mid-prefill
+        # Speculative simulation (the hermetic twin of the stepped
+        # sessions' draft-verify mode, ISSUE 9): with backend.spec_k > 0
+        # each step() slice runs ROUNDS, every live row advancing by
+        # 1 + round(spec_acceptance · k) tokens per round, the llm_spec_*
+        # families move, and a measured acceptance below the floor flips
+        # the session to plain advancement (llm_spec_fallback_total).
+        self.spec_k = int(backend.spec_k)
+        self.spec_acceptance = float(backend.spec_acceptance)
+        self.spec_accept_floor = (
+            backend.spec_accept_floor
+            if spec_accept_floor is None
+            else spec_accept_floor
+        )
+        self.spec_active = self.spec_k > 0
+        self.spec_fallback = False
         # streaming egress twins of SteppedDecodeSession's: the scheduler
         # flips stream_tokens on while any live ticket streams, and
         # retired rows buffer their unstreamed tails for the next
@@ -104,6 +120,9 @@ class _FakeStepSession:
                 "result": self.backend._result(request),
                 "cursor": 0,
                 "streamed": 0,
+                "spec_rounds": 0,
+                "spec_accepted": 0,
+                "spec_drafted": 0,
                 **self._prefix_probe(request),
             }
         )
@@ -173,7 +192,7 @@ class _FakeStepSession:
         """JSON-able session snapshot — the fake twin of
         ``SteppedDecodeSession.debug_state`` so ``GET /debug/state`` is
         testable hermetically."""
-        return {
+        state = {
             "model": self.model,
             "closed": self.closed,
             "paged": False,
@@ -189,6 +208,14 @@ class _FakeStepSession:
                         row["cursor"], row["result"].generated_tokens
                     ),
                     "budget": row["result"].generated_tokens,
+                    **(
+                        {
+                            "spec_rounds": row["spec_rounds"],
+                            "spec_accepted": row["spec_accepted"],
+                        }
+                        if self.spec_k > 0
+                        else {}
+                    ),
                 }
                 for i, row in enumerate(self._rows)
             ],
@@ -196,6 +223,16 @@ class _FakeStepSession:
                 {"tokens_left": pj["tokens_left"]} for pj in self._pending
             ],
         }
+        if self.spec_k > 0:
+            state["spec"] = {
+                "active": self.spec_active,
+                "draft_model": "fake-draft",
+                "k": self.spec_k,
+                "fallback": self.spec_fallback,
+                "accept_floor": self.spec_accept_floor,
+                "acceptance_recent": self.spec_acceptance,
+            }
+        return state
 
     def step(self, max_steps: int = 16) -> List[GenerationResult]:
         if self.closed:
@@ -204,9 +241,51 @@ class _FakeStepSession:
             # one SHARED window per slice, not per row — the semantics of
             # a real batched decode slice
             time.sleep(max_steps / self.backend.tokens_per_s)
+        # speculative simulation: a slice is ROUNDS — each live row
+        # advances by 1 + accepted-per-round tokens per round, mirroring
+        # the real session's per-row variable stride
+        advance = max_steps
+        if self.spec_active and self._rows:
+            per_round = 1 + max(
+                0, min(self.spec_k, round(self.spec_acceptance * self.spec_k))
+            )
+            advance = max_steps * per_round
+            accepted = (per_round - 1) * max_steps
+            drafted = self.spec_k * max_steps
+            for row in self._rows:
+                row["spec_rounds"] += max_steps
+                row["spec_accepted"] += accepted
+                row["spec_drafted"] += drafted
+            try:
+                from ..obs.metrics import observe_spec
+
+                observe_spec(
+                    max_steps,
+                    accepted * len(self._rows),
+                    drafted * len(self._rows),
+                )
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+            floor = self.spec_accept_floor
+            if floor and drafted and (accepted / drafted) < floor:
+                self.spec_active = False
+                self.spec_fallback = True
+                try:
+                    from ..obs.flight import EV_SPEC_FALLBACK, FLIGHT
+                    from ..obs.metrics import SPEC_FALLBACK_C
+
+                    SPEC_FALLBACK_C.inc()
+                    FLIGHT.emit(
+                        EV_SPEC_FALLBACK,
+                        model=self.model,
+                        acceptance=round(accepted / drafted, 4),
+                        floor=floor,
+                    )
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
         retired, keep = [], []
         for row in self._rows:
-            row["cursor"] += max_steps
+            row["cursor"] += advance
             if row["cursor"] >= row["result"].generated_tokens:
                 res = row["result"]
                 res.extras = {
@@ -214,6 +293,15 @@ class _FakeStepSession:
                     "retire_reason": "budget",
                     "stepped": True,
                 }
+                if self.spec_k > 0:
+                    res.extras["spec"] = {
+                        "rounds": row["spec_rounds"],
+                        "accepted": row["spec_accepted"],
+                        "drafted": row["spec_drafted"],
+                        "k": self.spec_k,
+                        "draft_model": "fake-draft",
+                        "fallback": self.spec_fallback,
+                    }
                 if self.stream_tokens and row["streamed"] < len(res.tokens):
                     tail = res.tokens[row["streamed"] :]
                     self._stream_tail.append(
@@ -276,6 +364,9 @@ class FakeBackend(GenerationBackend):
         tokens_per_s: float = 1000.0,
         simulate_delay: bool = False,
         prefix_share: bool = False,
+        spec_k: int = 0,
+        spec_acceptance: float = 1.0,
+        spec_accept_floor: "Optional[float]" = None,
     ):
         self.tokens_per_s = tokens_per_s
         self.simulate_delay = simulate_delay
@@ -283,6 +374,14 @@ class FakeBackend(GenerationBackend):
         # simulate shared-prefix hits so llm_prefix_* telemetry is
         # CI-testable with no accelerator (see _FakeStepSession)
         self.prefix_share = prefix_share
+        # the fake twin of JaxEngine(speculative=..., spec_accept_floor=):
+        # spec_k > 0 makes stepped sessions speak the draft-verify
+        # protocol with CONFIGURABLE synthetic acceptance — llm_spec_*
+        # families, per-row spec debug fields and the auto-fallback are
+        # CI-testable with no accelerator (see _FakeStepSession.step)
+        self.spec_k = int(spec_k)
+        self.spec_acceptance = float(spec_acceptance)
+        self.spec_accept_floor = spec_accept_floor
         self.loaded: Dict[str, bool] = {}
 
     def load_model(self, model: str) -> None:
@@ -327,8 +426,13 @@ class FakeBackend(GenerationBackend):
         requests: List[GenerationRequest],
         reserve_rows: Optional[int] = None,
         slice_steps: Optional[int] = None,
+        spec_accept_floor: Optional[float] = None,
     ) -> _FakeStepSession:
         """Stepped-decode protocol (see the module docstring);
         ``slice_steps`` is accepted for signature parity with the real
-        engine (the fake session's step takes the width per call)."""
-        return _FakeStepSession(self, requests)
+        engine (the fake session's step takes the width per call);
+        ``spec_accept_floor`` overrides the backend's fallback floor per
+        session, exactly like the real engine's decode_open."""
+        return _FakeStepSession(
+            self, requests, spec_accept_floor=spec_accept_floor
+        )

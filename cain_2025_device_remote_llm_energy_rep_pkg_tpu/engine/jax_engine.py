@@ -292,6 +292,7 @@ class JaxEngine(GenerationBackend):
         hf_checkpoints: Optional[Dict[str, str]] = None,
         prefill_attention: "str | PrefillAttentionFn | None" = "auto",
         speculative: "Optional[Dict[str, Tuple[str, int]]]" = None,
+        spec_accept_floor: float = 0.0,  # stepped-session auto-fallback
         prefix_cache_size: int = 0,  # cached prompt-KV entries per model
         prefix_cache_bytes: Optional[int] = None,  # total KV bytes cap
         kv_quantize: Optional[str] = None,  # None | "int8" (decode path)
@@ -327,21 +328,17 @@ class JaxEngine(GenerationBackend):
         # quantized once before decoding). Halves the cache stream — the
         # dominant per-step bytes for many-KV-head models at long context
         # (phi3: ~0.8 GB/step at 2k). Composes with generate/stream/batch,
-        # the TP engine, paged_kv (int8 page pool) AND the prefix caches:
-        # both the solo LRU (_store_prefix keeps the PRE-quantization bf16
-        # cache, _find_prefix seeds the next bf16 cache before its
-        # post-prefill quantization) and the session prefix index
-        # (engine/prefix.py seed slabs are pre-quantization by
-        # construction) — the former int8×prefix exclusion is retired
-        # (ISSUE 7). Still incompatible with speculative decoding (the
-        # draft/verify loops thread bf16 caches ACROSS decode calls).
+        # the TP engine, paged_kv (int8 page pool), the prefix caches
+        # (both the solo LRU and the session prefix index store/seed
+        # PRE-quantization bf16 — the int8×prefix exclusion retired in
+        # ISSUE 7) AND speculative decoding (ISSUE 9 retires the last
+        # standing exclusion: the TARGET cache is int8 — the verify block
+        # quantizes its k+1 entries with the same per-vector scale math a
+        # step-at-a-time decode would, so accepted tokens see
+        # bit-identical cache state — while the DRAFT cache stays at the
+        # engine dtype: it is tiny, and quantizing it would buy nothing).
         if kv_quantize not in (None, "int8"):
             raise ValueError(f"unsupported kv_quantize mode: {kv_quantize!r}")
-        if kv_quantize and speculative:
-            raise ValueError(
-                "kv_quantize is incompatible with speculative decoding "
-                "(draft-verify threads bf16 caches across decode calls)"
-            )
         # paged_kv=True: generate_batch decodes over a shared page pool
         # (engine/paged_kv.py) instead of one max-shape contiguous cache —
         # each row holds exactly ceil(tokens/page) pages, so mixed-length
@@ -378,8 +375,21 @@ class JaxEngine(GenerationBackend):
         self.prefix_index_entries = int(prefix_index_entries)
         self.quantize = quantize
         # target model → (draft model, k): greedy requests for the target
-        # route through speculative decoding (engine/speculative.py).
+        # route through speculative decoding (engine/speculative.py). A
+        # "default" key applies one draft to EVERY served target (the
+        # `serve --speculative <draft>[:k]` draft-only form); a model
+        # never self-drafts through the default (pure overhead).
         self.speculative = dict(speculative or {})
+        # Stepped-session adaptive policy (engine/stepped.py): when the
+        # rolling measured acceptance of a speculating session drops
+        # below this fraction, the session falls back to plain decode
+        # (speculation is LOSING there: every round pays k draft steps +
+        # a k+1-wide verify for ~1 emitted token). 0 = never fall back.
+        if not 0.0 <= float(spec_accept_floor) < 1.0:
+            raise ValueError(
+                f"spec_accept_floor must be in [0, 1), got {spec_accept_floor}"
+            )
+        self.spec_accept_floor = float(spec_accept_floor)
         # model name → local HF checkpoint dir; load_model converts the
         # trained weights (models/convert.py) instead of random-initialising
         # (the analogue of Ollama's pulled model store, README.md:29-31).
@@ -1634,6 +1644,28 @@ class JaxEngine(GenerationBackend):
         self._observe_result(result, st, t2)
         return result
 
+    def _resolve_spec(self, model: str) -> "Optional[Tuple[str, int]]":
+        """The (draft model, k) speculative config that applies to
+        ``model``: an exact entry wins, else the ``"default"`` entry
+        (the draft-only CLI form). A model never drafts for itself via
+        the default — that would pay k+1 forwards of the SAME weights
+        per round for zero amortization."""
+        spec = self.speculative.get(model)
+        if spec is None:
+            spec = self.speculative.get("default")
+            if spec is not None and spec[0] == model:
+                return None
+        return spec
+
+    @staticmethod
+    def _spec_eligible(request: GenerationRequest) -> bool:
+        """Greedy-only, like the solo path: accepted drafts are exactly
+        target-argmax tokens, so temperature must be 0 and the presence
+        penalty off (it would perturb the argmax per emitted token)."""
+        return (
+            request.temperature == 0.0 and request.repeat_penalty == 1.0
+        )
+
     def generate(self, request: GenerationRequest) -> GenerationResult:
         if request.stop:
             # Stop strings can only be matched on the host, so decode in
@@ -1645,12 +1677,8 @@ class JaxEngine(GenerationBackend):
                 if chunk.done:
                     return chunk.result
             raise RuntimeError("stream ended without a final chunk")
-        spec = self.speculative.get(request.model)
-        if (
-            spec is not None
-            and request.temperature == 0.0
-            and request.repeat_penalty == 1.0
-        ):
+        spec = self._resolve_spec(request.model)
+        if spec is not None and self._spec_eligible(request):
             # Same tokens as plain greedy decode, just faster (the accepted
             # tokens ARE the greedy tokens); sampled requests fall through
             # to the plain loop, as do requests whose speculative cache
@@ -1771,8 +1799,15 @@ class JaxEngine(GenerationBackend):
         g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
         cache_len = s_bucket + g_bucket + _spec_margin(k)
 
-        # target prefill + first greedy token (shared path, margin cache)
-        st = self._start(request, cache_len=cache_len, prompt_ids=prompt_ids)
+        # target prefill + first greedy token (shared path, margin cache);
+        # under kv_quantize the TARGET decodes over the int8 cache — the
+        # verify block's writes quantize per vector exactly like the
+        # plain int8 decode step, so the accepted tokens are the int8
+        # engine's own greedy stream (the draft cache below stays at the
+        # engine dtype: it is tiny)
+        st = self._maybe_quantize_cache(
+            self._start(request, cache_len=cache_len, prompt_ids=prompt_ids)
+        )
 
         # draft prefill over the same token ids
         dft = self._models[draft_model]
@@ -1813,14 +1848,45 @@ class JaxEngine(GenerationBackend):
         take = min(int(n_em), request.max_new_tokens - 1)
         generated = [int(st["first"][0])] + _to_host_list(out[:take])
         result = self._finish(request, generated, st, t2)
-        # merge, not replace — _finish may have attached energy extras
+        rounds, acc = int(rounds), int(acc)
+        # merge, not replace — _finish may have attached energy extras.
+        # The legacy flat keys stay for wire compatibility; the nested
+        # "spec" block is the ISSUE-9 shape the stepped path also emits.
         result.extras = {
             **(result.extras or {}),
-            "spec_rounds": int(rounds),
-            "spec_accepted": int(acc),
+            "spec_rounds": rounds,
+            "spec_accepted": acc,
             "draft_model": draft_model,
             "k": k,
+            "spec": {
+                "rounds": rounds,
+                "accepted": acc,
+                "drafted": rounds * k,
+                "k": k,
+                "draft_model": draft_model,
+            },
         }
+        if _obs_enabled():
+            try:
+                from ..obs.metrics import observe_spec
+
+                observe_spec(rounds, acc, rounds * k)
+                from ..obs.flight import EV_SPEC_ROUND, FLIGHT, trace_of
+
+                FLIGHT.emit(
+                    EV_SPEC_ROUND,
+                    trace=trace_of(_TRACER.current()),
+                    model=request.model,
+                    draft=draft_model,
+                    k=k,
+                    rounds=rounds,
+                    accepted=acc,
+                    acceptance=(
+                        round(acc / (rounds * k), 4) if rounds else None
+                    ),
+                )
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         return result
 
     # -- batched generation ---------------------------------------------------
@@ -2087,23 +2153,36 @@ class JaxEngine(GenerationBackend):
 
     # -- stepped (iteration-level) decode --------------------------------------
     # -- stepped-carry SPMD hooks (engine/stepped.py sessions) ---------------
-    def _stepped_carry_shardings(self, cfg: ModelConfig, carry):
+    def _stepped_carry_shardings(
+        self, cfg: ModelConfig, carry, draft_cfg: Optional[ModelConfig] = None
+    ):
         """Per-leaf NamedShardings for a stepped session carry, or None
         on the single-device engine (jit's default placement is already
         right there). The TP engine returns the
         ``parallel/sharding.py::stepped_carry_shardings`` pytree —
         KV payload sharded over heads when they divide the mesh,
-        row-control state replicated."""
+        row-control state replicated. ``draft_cfg`` names the DRAFT
+        model of a speculative session: its ``draft_k``/``draft_v``
+        leaves shard by the draft's own head count (which may differ
+        from the target's)."""
         return None
 
-    def _place_carry(self, cfg: ModelConfig, carry):
+    def _place_carry(
+        self, cfg: ModelConfig, carry, draft_cfg: Optional[ModelConfig] = None
+    ):
         """Explicitly place an assembled stepped carry on the device(s).
         Identity here; the TP engine device_puts every leaf with its
         carry sharding so the session starts (and stays) committed to
         the mesh placement the jitted slice step declares."""
         return carry
 
-    def _stepped_jit(self, cfg: ModelConfig, carry, fn) -> Callable:
+    def _stepped_jit(
+        self,
+        cfg: ModelConfig,
+        carry,
+        fn,
+        draft_cfg: Optional[ModelConfig] = None,
+    ) -> Callable:
         """jit one stepped slice step ``(params, carry, n_real) ->
         (out_tokens, n_row, carry)``. On accelerator backends the carry
         argument is DONATED — the slice's output carry aliases its input
@@ -2416,11 +2495,53 @@ class JaxEngine(GenerationBackend):
         self._decode_cache[key] = decode
         return decode
 
+    def _spec_batch_decode_step_fn(
+        self,
+        model: str,
+        draft_model: str,
+        k: int,
+        n_steps: int,
+        paged: bool,
+        quantized: bool,
+        carry=None,
+    ) -> Callable:
+        """Speculative twin of the stepped decode fns (ISSUE 9): per
+        slice, ``n_steps`` draft-verify ROUNDS instead of single-token
+        steps — each round k sequential draft steps then ONE target
+        forward over every live row's k+1 candidate positions, rows
+        advancing by their own accepted-prefix length (the loop lives in
+        engine/speculative.py::build_spec_step_fn). ``params`` is the
+        ``(target, draft)`` pair so the carry keeps the donated slot 1,
+        and the jit rides the same hook chain as the plain twins —
+        explicit shardings + donation on the TP engine, with the draft
+        cache leaves sharded by the DRAFT model's own head count."""
+        key = (
+            "spec-step", model, draft_model, k, n_steps, paged, quantized,
+        )
+        if key in self._decode_cache:
+            return self._decode_cache[key]
+        tcfg = self._models[model].cfg
+        dcfg = self._models[draft_model].cfg
+        eos = self._tokenizer_for(model).eos_id
+        from .speculative import build_spec_step_fn
+
+        fn = build_spec_step_fn(
+            tcfg, dcfg, k, n_steps, eos, paged, quantized,
+            # the DRAFT cache is an unquantized contiguous batch cache:
+            # the raw injected kernel applies (never the int8 wrapper —
+            # that keys on the TARGET's cache representation)
+            draft_decode_attention=self.decode_attention,
+        )
+        decode = self._stepped_jit(tcfg, carry, fn, draft_cfg=dcfg)
+        self._decode_cache[key] = decode
+        return decode
+
     def decode_open(
         self,
         requests: "list[GenerationRequest]",
         reserve_rows: Optional[int] = None,
         slice_steps: Optional[int] = None,
+        spec_accept_floor: Optional[float] = None,
     ):
         """Open an iteration-level decode session over ``requests`` (the
         stepped-decode protocol the continuous scheduler drives —
@@ -2433,12 +2554,22 @@ class JaxEngine(GenerationBackend):
         ``len(requests)`` so a session opened by a lone anchor still has
         free slots for mid-flight joins; ``slice_steps`` overrides the
         compiled slice width (default DECODE_SLICE_STEPS — the
-        ``serve --decode-slice-steps`` knob lands here)."""
+        ``serve --decode-slice-steps`` knob lands here).
+
+        When this engine has a speculative config for the model
+        (ctor ``speculative=``, CLI ``--speculative``) and every opening
+        request is greedy, the session runs in DRAFT-VERIFY mode:
+        slices are rounds, rows advance by their accepted-prefix length,
+        and the session's rolling acceptance drives the auto-fallback
+        policy — ``spec_accept_floor`` (default: the engine's ctor
+        value; the ``serve --spec-accept-floor`` knob lands here through
+        the continuous scheduler)."""
         from .stepped import SteppedDecodeSession
 
         return SteppedDecodeSession.open(
             self, requests, reserve_rows=reserve_rows,
             slice_steps=slice_steps,
+            spec_accept_floor=spec_accept_floor,
         )
 
     def _paged_decode_attention(self, cfg: Optional[ModelConfig] = None):
@@ -2962,7 +3093,17 @@ class JaxEngine(GenerationBackend):
         )
         ids = self._tokenizer_for(model).encode(request.prompt)
         width = max(BATCH_BUCKETS)
-        if self.paged_kv and self.prefix_share and ids:
+        # Speculative sessions (ISSUE 9) change the per-row bill: paged
+        # rows run the LEGACY pool-write mode (the verify block writes
+        # k+1 entries through the table) with 2k+2 slack token slots —
+        # the rounds-overshoot margin — so the estimator bills exactly
+        # what the session's _pages_needed will pin; contiguous rows
+        # carry the _spec_margin in their cache shape plus the draft's
+        # own (tiny, unquantized) batch cache.
+        spec = (
+            self._resolve_spec(model) if self._spec_eligible(request) else None
+        )
+        if self.paged_kv and ids and (self.prefix_share or spec is not None):
             # Shared-prefix billing (ISSUE 7): under prefix sharing a
             # fleet anchored by this request shares the prompt's full
             # page-aligned pages — the FIRST row pays them, every later
@@ -2972,16 +3113,51 @@ class JaxEngine(GenerationBackend):
             # (can_join/join_begin); this estimate just stops the row
             # cap from under-admitting the fleet the pool can hold.
             page = self.page_size
-            stacked = self._paged_decode_attention(cfg) is not None
+            stacked = (
+                self._paged_decode_attention(cfg) is not None
+                and spec is None
+            )
+            slack = (2 * spec[1] + 2) if spec is not None else 0
             need = (
                 -(-max(len(ids), 1) // page)
                 if stacked
-                else -(-(len(ids) + request.max_new_tokens) // page)
+                else -(-(len(ids) + request.max_new_tokens + slack) // page)
             )
-            shared = min((len(ids) - 1) // page, need - 1)
+            shared = 0
+            if self.prefix_share:
+                shared = min((len(ids) - 1) // page, need - 1)
             rows_pages = [need] + [need - shared] * (width - 1)
             g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
             return self._paged_rows_cap(cfg, rows_pages, g_bucket, stacked)
+        if spec is not None and not self.paged_kv:
+            g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
+            s_bucket = _prompt_alloc(max(len(ids), 1))
+            margin = _spec_margin(spec[1])
+            bytes_per_row = self._contiguous_row_bytes(
+                cfg, s_bucket + margin, g_bucket
+            )
+            try:
+                dcfg = (
+                    self.registry[spec[0]]
+                    if spec[0] in self.registry
+                    else get_model_config(spec[0])
+                )
+                itemsize = jnp.dtype(self.dtype).itemsize
+                bytes_per_row += (
+                    2 * dcfg.n_layers * dcfg.n_kv_heads
+                    * (s_bucket + g_bucket + margin)
+                    * dcfg.d_head * itemsize
+                )
+            except Exception:  # noqa: BLE001 — estimate only
+                pass
+            max_rows = BATCH_MIN_SPLIT_ROWS
+            for b_ in BATCH_BUCKETS:
+                if (
+                    b_ > max_rows
+                    and b_ * bytes_per_row <= BATCH_KV_BUDGET_BYTES
+                ):
+                    max_rows = b_
+            return max_rows
         return self._max_batch_rows(cfg, [request] * width, [ids] * width)
 
     def generate_batch(

@@ -26,6 +26,23 @@ deterministic workloads.
 The whole multi-round loop is one compiled ``lax.while_loop``: draft scan,
 verify forward, accept/emit arithmetic — no host round-trips between
 rounds.
+
+Two builders live here:
+
+- :func:`build_spec_fn` — the SOLO path (one request, contiguous caches,
+  runs the whole budget in one compiled call);
+- :func:`build_spec_step_fn` — the BATCHED slice step for stepped decode
+  sessions (engine/stepped.py): per slice it runs ``n_real`` rounds where
+  every live row drafts ``k`` tokens sequentially (cheap), then ONE
+  target forward scores each row's ``k+1`` candidate positions
+  (models/transformer.py's per-row-offset block verify), and each row
+  advances by its own longest-accepted-prefix length ``m ∈ [1, k+1]`` —
+  SpecInfer's observation (Miao et al. 2024) that batched draft-verify is
+  where speculation must live to matter for serving. Rows' offsets,
+  budgets and done-masks therefore move at PER-ROW variable stride; the
+  function has the stepped-decode contract (``(params, carry, n_real) →
+  (out, n_row, carry)``) so the session/scheduler machinery — retirement,
+  joins, cancellation, TP shardings, carry donation — is unchanged.
 """
 
 from __future__ import annotations
@@ -163,3 +180,217 @@ def build_spec_fn(
         return out, n_em, rounds, acc
 
     return spec
+
+
+def build_spec_step_fn(
+    tcfg,
+    dcfg,
+    k: int,
+    n_steps: int,
+    eos: int,
+    paged: bool,
+    quantized: bool,
+    draft_decode_attention=None,
+) -> Callable:
+    """Build the BATCHED speculative slice step (see the module
+    docstring). Stepped-decode contract::
+
+        decode((tparams, dparams), carry, n_real)
+            -> (out [B, n_steps*(k+1)], n_row [B], new_carry)
+
+    ``carry`` is a stepped-session carry (engine/stepped.py) grown with
+    the draft state: ``draft_k``/``draft_v`` (a contiguous batch cache —
+    the draft is tiny, it never pages) and ``draft_offsets``, plus the
+    cumulative per-row counters ``spec_rounds``/``spec_accepted``/
+    ``spec_drafted`` the session reads back for telemetry and the
+    adaptive fallback policy. The target KV travels in the usual leaves
+    (``k_cache``/``v_cache``, or ``pool_k``/``pool_v``+``table`` in the
+    LEGACY paged mode — verify writes k+1 entries per row through the
+    page table, which is why speculative paged rows bill ``2k+2`` slack
+    token slots of extra pages).
+
+    Per-round mechanics per live row (vectorized over B): k sequential
+    draft steps + one cache-seating draft forward, ONE target forward
+    over the ``[last, d_1..d_k]`` block, longest-accepted-prefix + the
+    target's own next token, EOS clipping inside the round, and a
+    ``remaining``-budget cut — all per-row, so done-masking, offsets and
+    emission cursors advance by variable ``m``. Rows that are done ride
+    along re-writing garbage at frozen positions that no mask ever
+    attends (the padding-row convention of every batched loop here).
+
+    The verify forward runs the XLA-fused attention paths (no kernel:
+    the block-verify is multi-query, and the numerics caveat in the
+    module docstring applies — parity tests pin float32). Draft steps
+    may use ``draft_decode_attention`` (single-token, bf16 cache).
+    """
+    idx = jnp.arange(k + 1)
+    out_w = n_steps * (k + 1)
+
+    def decode(params, carry, n_real):
+        tparams, dparams = params
+        b = carry["tokens"].shape[0]
+        rows = jnp.arange(b)
+        if paged:
+            table = carry["table"]
+            codes = carry["pool_k"]["q"] if quantized else carry["pool_k"]
+            table_c = jnp.broadcast_to(table, (codes.shape[0],) + table.shape)
+            tk0, tv0 = carry["pool_k"], carry["pool_v"]
+        else:
+            tk0, tv0 = carry["k_cache"], carry["v_cache"]
+
+        def cond(c):
+            done, i = c[7], c[8]
+            return (i < n_real) & ~jnp.all(done)
+
+        def body(c):
+            (
+                last, offs, doffs, tk, tv, dk, dv, done, i, out, n_row,
+                rem, rnds, acc, drafted,
+            ) = c
+            live = ~done
+
+            # k sequential draft proposals + one forward seating d_k's
+            # K/V (a fully-accepted round leaves no hole in the draft
+            # cache — the solo path's convention, per row here)
+            def dstep(dc, _):
+                tok, do_, dk_, dv_ = dc
+                hidden, dk_, dv_ = forward(
+                    dparams, dcfg, tok[:, None], do_, dk_, dv_,
+                    draft_decode_attention,
+                )
+                nxt = jnp.argmax(
+                    logits_for(dparams, dcfg, hidden[:, 0]), axis=-1
+                ).astype(jnp.int32)
+                return (nxt, do_ + 1, dk_, dv_), nxt
+
+            (dlast, do_, dk, dv), drafts = jax.lax.scan(
+                dstep, (last, doffs, dk, dv), None, length=k
+            )
+            drafts = drafts.T  # [k, B] -> [B, k]
+            _, dk, dv = forward(
+                dparams, dcfg, dlast[:, None], do_, dk, dv,
+                draft_decode_attention,
+            )
+
+            # ONE target forward scores every row's k+1 candidate
+            # positions (per-row offsets; candidates written above ARE
+            # the causal context within the block)
+            ver = jnp.concatenate([last[:, None], drafts], axis=1)
+            if paged:
+                kc = {"pool": tk, "table": table_c}
+                vc = {"pool": tv, "table": table_c}
+                hidden, kc, vc = forward(
+                    tparams, tcfg, ver, offs, kc, vc, None, None
+                )
+                tk, tv = kc["pool"], vc["pool"]
+            else:
+                hidden, tk, tv = forward(
+                    tparams, tcfg, ver, offs, tk, tv, None, None
+                )
+            tnext = jnp.argmax(
+                logits_for(tparams, tcfg, hidden), axis=-1
+            ).astype(jnp.int32)  # [B, k+1]
+
+            # longest accepted prefix, then the target's own next token
+            match = drafts == tnext[:, :k]
+            n_acc = jnp.argmin(
+                jnp.concatenate(
+                    [match, jnp.zeros((b, 1), dtype=bool)], axis=1
+                ),
+                axis=1,
+            ).astype(jnp.int32)
+            drafts_pad = jnp.concatenate(
+                [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1
+            )
+            t_at = jnp.take_along_axis(tnext, n_acc[:, None], axis=1)
+            emit = jnp.where(
+                idx[None, :] < n_acc[:, None],
+                drafts_pad,
+                jnp.where(
+                    idx[None, :] == n_acc[:, None], t_at, jnp.int32(eos)
+                ),
+            )
+            m = n_acc + 1
+            # clip each row's round at its first EOS (inclusive — the
+            # plain loop records the EOS then stops)
+            is_eos = (emit == eos) & (idx[None, :] < m[:, None])
+            has_eos = jnp.any(is_eos, axis=1)
+            first_eos = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+            m = jnp.where(has_eos, jnp.minimum(m, first_eos + 1), m)
+            # per-row budget: a live row emits at most its remaining
+            # tokens; done rows emit nothing and stay frozen
+            m_eff = jnp.where(live, jnp.minimum(m, rem), 0)
+            eos_in = jnp.any(
+                is_eos & (idx[None, :] < m_eff[:, None]), axis=1
+            )
+
+            # per-row emission cursors: this round's block lands at each
+            # row's own n_row; a later round overwrites the rejected
+            # tail, and positions past the final count are never read
+            pos = n_row[:, None] + idx[None, :]
+            out = out.at[rows[:, None], pos].set(emit)
+            adv = m_eff > 0
+            last_new = jnp.take_along_axis(
+                emit, jnp.maximum(m_eff - 1, 0)[:, None], axis=1
+            )[:, 0]
+            last = jnp.where(adv, last_new, last)
+            n_row = n_row + m_eff
+            rem = rem - m_eff
+            done = done | eos_in | (rem <= 0)
+            offs = offs + m_eff
+            doffs = doffs + m_eff
+            # accepted-AND-extracted drafts only (EOS clips and budget
+            # cuts discard the tail — counting it would inflate the
+            # acceptance the fallback policy reads)
+            rnds = rnds + live.astype(jnp.int32)
+            acc = acc + jnp.minimum(n_acc, m_eff)
+            drafted = drafted + jnp.where(live, jnp.int32(k), 0)
+            return (
+                last, offs, doffs, tk, tv, dk, dv, done, i + 1, out,
+                n_row, rem, rnds, acc, drafted,
+            )
+
+        out0 = jnp.full((b, out_w), jnp.int32(eos))
+        init = (
+            carry["tokens"],
+            carry["offsets"],
+            carry["draft_offsets"],
+            tk0,
+            tv0,
+            carry["draft_k"],
+            carry["draft_v"],
+            carry["done"],
+            jnp.int32(0),
+            out0,
+            jnp.zeros((b,), jnp.int32),
+            carry["remaining"],
+            carry["spec_rounds"],
+            carry["spec_accepted"],
+            carry["spec_drafted"],
+        )
+        (
+            last, offs, doffs, tk, tv, dk, dv, done, _, out, n_row, rem,
+            rnds, acc, drafted,
+        ) = jax.lax.while_loop(cond, body, init)
+        threaded = (
+            {"pool_k": tk, "pool_v": tv}
+            if paged
+            else {"k_cache": tk, "v_cache": tv}
+        )
+        new_carry = dict(
+            carry,
+            tokens=last,
+            offsets=offs,
+            draft_offsets=doffs,
+            draft_k=dk,
+            draft_v=dv,
+            done=done,
+            remaining=rem,
+            spec_rounds=rnds,
+            spec_accepted=acc,
+            spec_drafted=drafted,
+            **threaded,
+        )
+        return out, n_row, new_carry
+
+    return decode

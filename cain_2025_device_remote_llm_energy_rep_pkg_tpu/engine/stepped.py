@@ -107,6 +107,7 @@ class _PendingJoin:
         "request", "slot", "ids", "chunks", "next_chunk", "cache_len",
         "k_cache", "v_cache", "presence", "logits", "pages",
         "prefill_s", "t0", "hit_tokens", "shared_pages",
+        "draft_k", "draft_v", "draft_chunks", "draft_next",
     )
 
     def __init__(
@@ -129,10 +130,19 @@ class _PendingJoin:
         self.t0 = time.monotonic()
         self.hit_tokens = hit_tokens
         self.shared_pages = shared_pages
+        # Speculative sessions (ISSUE 9): the joiner's DRAFT prefill
+        # rides the same chunked machinery — a private draft cache and
+        # its own chunk cursor over the FULL prompt (a shared-prefix hit
+        # seeds only the TARGET cache; the draft is cheap enough to
+        # recompute, and its chunks interleave like the target's).
+        self.draft_k = None
+        self.draft_v = None
+        self.draft_chunks: List[tuple] = []
+        self.draft_next = 0
 
     @property
     def total_chunks(self) -> int:
-        return len(self.chunks)
+        return len(self.chunks) + len(self.draft_chunks)
 
 
 class _Row:
@@ -222,6 +232,22 @@ class SteppedDecodeSession:
         self.paged = bool(engine.paged_kv)
         self.carry: Dict[str, Any] = {}
         self.rows: List[Optional[_Row]] = []
+        # Speculative draft-verify mode (ISSUE 9): `spec` is the ACTIVE
+        # config ({draft, k, dcfg, floor}) or None; `spec_info` survives
+        # an adaptive fallback so retiring rows still report their
+        # pre-fallback stats. `spec_slack` is the 2k+2 token slots of
+        # rounds-overshoot headroom paged rows bill as extra pages.
+        self.spec: Optional[Dict[str, Any]] = None
+        self.spec_info: Optional[Dict[str, Any]] = None
+        self.spec_fallback = False
+        self.spec_slack = 0
+        self.spec_draft_len = 0
+        self.spec_margin = 0
+        # host-side cumulative per-slot spec counters (mirrors of the
+        # carry leaves, refreshed each slice) + the rolling acceptance
+        # window the fallback policy reads
+        self._spec_host: Dict[str, List[int]] = {}
+        self._spec_recent: "List[tuple]" = []
         # slot -> _PendingJoin: chunked joiners mid-prefill. A reserved
         # slot is not free (free_slots/can_join account for it) and not
         # live (the decode loop's done-mask still marks it done).
@@ -255,6 +281,7 @@ class SteppedDecodeSession:
         requests: "list[GenerationRequest]",
         reserve_rows: Optional[int] = None,
         slice_steps: Optional[int] = None,
+        spec_accept_floor: Optional[float] = None,
     ) -> "SteppedDecodeSession":
         from .jax_engine import (
             BATCH_BUCKETS,
@@ -285,6 +312,10 @@ class SteppedDecodeSession:
             max(r.max_new_tokens for r in requests), GEN_BUCKETS
         )
         self.slice_bucket = max(1, int(slice_steps or DECODE_SLICE_STEPS))
+        # Speculative mode probe BEFORE cache sizing: the target cache
+        # carries the rounds-overshoot margin and paged rows the slack
+        # pages only when the session will actually speculate.
+        self._init_spec(requests, all_ids, spec_accept_floor)
         # the engine's stepped-compute context covers every compile/run
         # in the open (TP: the int4 Pallas kernel has no GSPMD rule —
         # same guard its generate paths apply)
@@ -293,16 +324,126 @@ class SteppedDecodeSession:
                 self._open_paged(requests, all_ids)
             else:
                 self._open_contiguous(requests, all_ids)
+            if self.spec is not None:
+                self._open_draft(all_ids)
             # one explicit placement for the assembled carry: identity on
             # a single device; on a mesh every leaf is device_put to the
             # sharding the jitted slice step declares (heads-sharded KV
-            # payload, replicated row control), so the session starts
-            # committed to the SPMD layout it will keep
-            self.carry = engine._place_carry(self.cfg, self.carry)
+            # payload, replicated row control, a speculating session's
+            # draft cache by the DRAFT model's heads), so the session
+            # starts committed to the SPMD layout it will keep
+            self.carry = engine._place_carry(
+                self.cfg, self.carry, draft_cfg=self._draft_cfg()
+            )
             if self.paged:
                 self.pool.k = self.carry["pool_k"]
                 self.pool.v = self.carry["pool_v"]
         return self
+
+    # -- speculative draft-verify mode (ISSUE 9) -------------------------------
+    def _draft_cfg(self):
+        return self.spec["dcfg"] if self.spec is not None else None
+
+    def _init_spec(
+        self,
+        requests: "list[GenerationRequest]",
+        all_ids: "list[list[int]]",
+        spec_accept_floor: Optional[float],
+    ) -> None:
+        """Decide whether this session runs draft-verify: the engine has
+        a (draft, k) for the model, every opening row is greedy, the
+        draft is co-resident with a matching vocabulary, and the draft's
+        contiguous cache fits its max_seq_len. Any miss serves the
+        session PLAIN — configuring a draft must never fail a request
+        plain decode would serve (the solo path's rule)."""
+        from ..runner import term
+        from .jax_engine import _prompt_alloc, _spec_margin
+
+        eng = self.engine
+        spec = eng._resolve_spec(self.model)
+        if spec is None:
+            return
+        if not all(eng._spec_eligible(r) for r in requests):
+            return
+        draft, k = spec
+        eng.load_model(draft)
+        if self.model not in eng._models:
+            eng.load_model(self.model)  # the draft's load may have evicted it
+        if self.model not in eng._models or draft not in eng._models:
+            term.log_warn(
+                f"speculative session: {self.model} and {draft} cannot be "
+                "co-resident; serving the session without the draft"
+            )
+            return
+        dcfg = eng._models[draft].cfg
+        if dcfg.vocab_size != self.cfg.vocab_size:
+            term.log_warn(
+                f"speculative session: draft {draft} vocab "
+                f"{dcfg.vocab_size} != target vocab "
+                f"{self.cfg.vocab_size}; serving plain"
+            )
+            return
+        margin = _spec_margin(k)
+        draft_len = (
+            max(_prompt_alloc(max(len(i), 1)) for i in all_ids)
+            + self.g_bucket
+            + margin
+        )
+        if draft_len > dcfg.max_seq_len:
+            return
+        slack = 2 * k + 2
+        if self.paged and any(
+            len(ids) + r.max_new_tokens + slack > self.cfg.max_seq_len
+            for r, ids in zip(requests, all_ids)
+        ):
+            return
+        floor = (
+            eng.spec_accept_floor
+            if spec_accept_floor is None
+            else float(spec_accept_floor)
+        )
+        self.spec = {"draft": draft, "k": k, "dcfg": dcfg, "floor": floor}
+        self.spec_info = {"draft_model": draft, "k": k}
+        self.spec_slack = slack
+        self.spec_draft_len = draft_len
+        self.spec_margin = margin
+
+    def _disable_spec_at_open(self) -> None:
+        """Back out of spec mode DURING open (cache would not fit): the
+        session never speculated, so no fallback event/counters."""
+        self.spec = None
+        self.spec_info = None
+        self.spec_slack = 0
+        self.spec_margin = 0
+        self.spec_draft_len = 0
+
+    def _open_draft(self, all_ids: "list[list[int]]") -> None:
+        """Prefill the draft over every opening row's prompt and
+        assemble the contiguous batch draft cache into the carry (the
+        draft never pages and never quantizes — it is tiny). Padding
+        rows replicate row 0 and ride pre-done like everywhere else."""
+        eng = self.engine
+        draft = self.spec["draft"]
+        rows_k, rows_v = [], []
+        for ids in all_ids:
+            _, dk, dv = eng._run_prefill(draft, ids, self.spec_draft_len)
+            rows_k.append(dk)
+            rows_v.append(dv)
+        pad = self.b_bucket - len(all_ids)
+        self.carry["draft_k"] = jnp.concatenate(
+            rows_k + [rows_k[0]] * pad, axis=1
+        )
+        self.carry["draft_v"] = jnp.concatenate(
+            rows_v + [rows_v[0]] * pad, axis=1
+        )
+        offs = [len(i) for i in all_ids] + [len(all_ids[0])] * pad
+        self.carry["draft_offsets"] = jnp.asarray(offs, dtype=jnp.int32)
+        b = self.b_bucket
+        for key in ("spec_rounds", "spec_accepted", "spec_drafted"):
+            self.carry[key] = jnp.zeros((b,), jnp.int32)
+        self._spec_host = {
+            "rounds": [0] * b, "accepted": [0] * b, "drafted": [0] * b,
+        }
 
     def _open_common(self, requests, states, pad: int) -> None:
         """Assemble the per-row device arrays shared by both cache
@@ -376,7 +517,13 @@ class SteppedDecodeSession:
         eng = self.engine
         cfg = self.cfg
         s_buckets = [_prompt_alloc(max(len(i), 1)) for i in all_ids]
-        self.cache_len = max(s_buckets) + self.g_bucket
+        # spec sessions carry the rounds-overshoot margin (verify writes
+        # up to offset+k; _spec_margin rounds 2k+2 to the lane tile) —
+        # when that margin would blow max_seq_len, serve plain instead
+        self.cache_len = max(s_buckets) + self.g_bucket + self.spec_margin
+        if self.spec is not None and self.cache_len > cfg.max_seq_len:
+            self._disable_spec_at_open()
+            self.cache_len = max(s_buckets) + self.g_bucket
         if self.cache_len > cfg.max_seq_len:
             raise ValueError(
                 f"{self.model}: session cache {self.cache_len} exceeds "
@@ -430,7 +577,16 @@ class SteppedDecodeSession:
                     f"{r.max_new_tokens} exceeds max_seq_len "
                     f"{cfg.max_seq_len}"
                 )
-        self.stacked = eng._paged_decode_attention(cfg) is not None
+        # Speculative sessions run the LEGACY paged mode (pool-resident
+        # generated tokens): the stacked-hybrid parts kernel is
+        # single-query, and the verify block writes k+1 entries per row
+        # through the page table — the slack pages exist for exactly
+        # that. A multi-query paged kernel is the stacked×spec follow-on
+        # (docs/PERF.md).
+        self.stacked = (
+            eng._paged_decode_attention(cfg) is not None
+            and self.spec is None
+        )
         self.quantized = bool(eng.kv_quantize)
         self.page_size = page
         states = eng._batch_states(
@@ -544,11 +700,15 @@ class SteppedDecodeSession:
     def _pages_needed(self, s_real: int, max_new_tokens: int) -> int:
         """Pages one row pins: prompt-only in stacked mode (generated
         tokens live in the side caches), prompt + budget in legacy mode
-        — the monolithic paged path's sizing rule."""
+        — the monolithic paged path's sizing rule. Speculative sessions
+        additionally bill ``spec_slack`` (2k+2) token slots: a verify
+        round writes up to k entries past the row's accepted offset, so
+        a row at the edge of its budget still needs in-bounds pages for
+        the overshoot (the candidates a later round overwrites)."""
         page = self.page_size
         if self.stacked:
             return -(-max(s_real, 1) // page)
-        return -(-(s_real + max_new_tokens) // page)
+        return -(-(s_real + max_new_tokens + self.spec_slack) // page)
 
     # -- shared-prefix index (engine/prefix.py, ISSUE 7) -----------------------
     def _publish_prefix(
@@ -647,6 +807,18 @@ class SteppedDecodeSession:
                     "budget": row.budget,
                     "age_s": round(now - row.t0, 4),
                     "pages": len(row.pages),
+                    **(
+                        {
+                            "spec_rounds": int(
+                                self._spec_host["rounds"][r]
+                            ),
+                            "spec_accepted": int(
+                                self._spec_host["accepted"][r]
+                            ),
+                        }
+                        if self.spec_info is not None and self._spec_host
+                        else {}
+                    ),
                 }
                 for r, row in enumerate(self.rows)
                 if row is not None
@@ -663,6 +835,26 @@ class SteppedDecodeSession:
                 for pj in self._pending.values()
             ],
         }
+        if self.spec_info is not None:
+            recent_acc = sum(a for a, _ in self._spec_recent)
+            recent_drafted = sum(d for _, d in self._spec_recent)
+            state["spec"] = {
+                "active": self.spec is not None,
+                "draft_model": self.spec_info["draft_model"],
+                "k": self.spec_info["k"],
+                "fallback": self.spec_fallback,
+                "accept_floor": (
+                    self.spec["floor"] if self.spec is not None else None
+                ),
+                "acceptance_recent": (
+                    round(recent_acc / recent_drafted, 4)
+                    if recent_drafted
+                    else None
+                ),
+                "rounds_total": sum(self._spec_host.get("rounds", [])),
+                "accepted_total": sum(self._spec_host.get("accepted", [])),
+                "drafted_total": sum(self._spec_host.get("drafted", [])),
+            }
         if self.paged:
             state["pool"] = self.pool.debug_state()
         mesh_info = getattr(self.engine, "mesh_info", None)
@@ -696,6 +888,9 @@ class SteppedDecodeSession:
             if self.paged
             else ("k_cache", "v_cache")
         )
+        if not pool_only:
+            # a speculating session's draft cache is KV payload too
+            keys = keys + ("draft_k", "draft_v")
         total = 0
         for key in keys:
             leaf = self.carry.get(key)
@@ -734,20 +929,33 @@ class SteppedDecodeSession:
         # shardings — the whole per-iteration state stays resident on
         # the device(s)
         with eng._stepped_compute_ctx():
-            if self.paged:
+            if self.spec is not None:
+                decode = eng._spec_batch_decode_step_fn(
+                    self.model, self.spec["draft"], self.spec["k"],
+                    self.slice_bucket, self.paged,
+                    self.paged and self.quantized, carry=self.carry,
+                )
+                out, n_row, self.carry = decode(
+                    (params, eng._models[self.spec["draft"]].params),
+                    self.carry, jnp.int32(n_real),
+                )
+            elif self.paged:
                 decode = eng._paged_batch_decode_step_fn(
                     self.model, self.slice_bucket, self.top_k,
                     self.use_top_p, self.use_rp, self.stacked,
                     self.quantized, carry=self.carry,
+                )
+                out, n_row, self.carry = decode(
+                    params, self.carry, jnp.int32(n_real)
                 )
             else:
                 decode = eng._batch_decode_step_fn(
                     self.model, self.slice_bucket, self.top_k,
                     self.use_top_p, self.use_rp, carry=self.carry,
                 )
-            out, n_row, self.carry = decode(
-                params, self.carry, jnp.int32(n_real)
-            )
+                out, n_row, self.carry = decode(
+                    params, self.carry, jnp.int32(n_real)
+                )
         if self.paged:
             self.pool.k = self.carry["pool_k"]
             self.pool.v = self.carry["pool_v"]
@@ -755,6 +963,13 @@ class SteppedDecodeSession:
         out_host = _to_host_list(out)
         n_row_host = _to_host_list(n_row)
         done_host = _to_host_list(self.done)
+        # spec accounting BEFORE retirement: the deltas feed the
+        # llm_spec_* families and may flip the session to plain decode
+        # (adaptive fallback) — retiring rows read the refreshed host
+        # counters for their extras either way
+        spec_rounds_slice = (
+            self._spec_after_slice(live) if self.spec is not None else None
+        )
         t2 = time.monotonic()
         slice_tokens = 0
         slice_steps = 0
@@ -767,6 +982,12 @@ class SteppedDecodeSession:
                 self.rows[r].generated.extend(out_host[r][:cnt])
             if done_host[r]:
                 retired.append(self._retire(r, t2))
+        if spec_rounds_slice is not None:
+            # in spec mode the device executed ROUNDS, not per-token
+            # steps: one target weight-read per round for up to k+1
+            # tokens — that is the amortization the whole mode exists
+            # for, and what tokens-per-target-step measures
+            slice_steps = spec_rounds_slice
         # Goodput accounting (obs/detect.py): the compiled slice steps
         # EVERY bucket row — live, finished-mid-slice, and padding rows
         # alike — so the device executed ~slice_steps × b_bucket row-
@@ -781,6 +1002,110 @@ class SteppedDecodeSession:
             except Exception:  # noqa: BLE001 — telemetry only
                 pass
         return retired
+
+    def _spec_after_slice(self, live: "List[int]") -> int:
+        """Refresh the host mirrors of the carry's cumulative spec
+        counters, publish this slice's deltas (llm_spec_* + one
+        ``spec_round`` flight event), feed the rolling-acceptance window
+        and apply the adaptive fallback policy. Returns the number of
+        draft-verify ROUNDS the compiled loop executed this slice (the
+        max per-row round delta — every live row rides every loop
+        iteration, so the max IS the iteration count)."""
+        from .jax_engine import _to_host_list
+
+        rounds = _to_host_list(self.carry["spec_rounds"])
+        accepted = _to_host_list(self.carry["spec_accepted"])
+        drafted = _to_host_list(self.carry["spec_drafted"])
+        prev = self._spec_host
+        rounds_delta = [a - b for a, b in zip(rounds, prev["rounds"])]
+        acc_delta = sum(accepted) - sum(prev["accepted"])
+        drafted_delta = sum(drafted) - sum(prev["drafted"])
+        self._spec_host = {
+            "rounds": rounds, "accepted": accepted, "drafted": drafted,
+        }
+        slice_rounds = max(
+            [rounds_delta[r] for r in live] or [0]
+        )
+        if _obs_enabled() and slice_rounds:
+            try:
+                from ..obs.flight import EV_SPEC_ROUND, FLIGHT
+                from ..obs.metrics import observe_spec
+
+                observe_spec(slice_rounds, acc_delta, drafted_delta)
+                FLIGHT.emit(
+                    EV_SPEC_ROUND,
+                    model=self.model,
+                    draft=self.spec["draft"],
+                    k=self.spec["k"],
+                    rounds=slice_rounds,
+                    accepted=acc_delta,
+                    drafted=drafted_delta,
+                    acceptance=(
+                        round(acc_delta / drafted_delta, 4)
+                        if drafted_delta
+                        else None
+                    ),
+                )
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        # Adaptive policy: a rolling window of recent slices' (accepted,
+        # drafted); once the window holds enough evidence (≥ 2 slices
+        # and ≥ 2k drafts) and its acceptance sits below the floor,
+        # speculation is LOSING — every round paid k draft steps + a
+        # k+1-wide verify for ~1 emitted token — so the session falls
+        # back to plain decode.
+        floor = self.spec["floor"]
+        if floor > 0.0 and drafted_delta:
+            self._spec_recent.append((acc_delta, drafted_delta))
+            self._spec_recent = self._spec_recent[-4:]
+            win_acc = sum(a for a, _ in self._spec_recent)
+            win_drafted = sum(d for _, d in self._spec_recent)
+            if (
+                len(self._spec_recent) >= 2
+                and win_drafted >= 2 * self.spec["k"]
+                and win_acc / win_drafted < floor
+            ):
+                self._spec_fall_back(win_acc / win_drafted)
+        return slice_rounds
+
+    def _spec_fall_back(self, measured_acceptance: float) -> None:
+        """Switch the session to plain decode mid-flight: drop the draft
+        leaves from the carry (the row-control and target-KV leaves are
+        shared between the two compiled step families, so tokens,
+        offsets, budgets and done-masks carry over exactly — parity is
+        preserved because both modes emit the target's greedy stream)
+        and keep ``spec_info``/host stats for retiring rows' extras."""
+        from ..runner import term
+
+        for key in (
+            "draft_k", "draft_v", "draft_offsets",
+            "spec_rounds", "spec_accepted", "spec_drafted",
+        ):
+            self.carry.pop(key, None)
+        floor = self.spec["floor"]
+        self.spec = None
+        self.spec_fallback = True
+        self._spec_recent = []
+        self._recommit_carry()
+        term.log_warn(
+            f"speculative session [{self.model}]: measured acceptance "
+            f"{measured_acceptance:.2f} < floor {floor:g}; falling back "
+            "to plain decode"
+        )
+        if _obs_enabled():
+            try:
+                from ..obs.flight import EV_SPEC_FALLBACK, FLIGHT
+                from ..obs.metrics import SPEC_FALLBACK_C
+
+                SPEC_FALLBACK_C.inc()
+                FLIGHT.emit(
+                    EV_SPEC_FALLBACK,
+                    model=self.model,
+                    acceptance=round(measured_acceptance, 4),
+                    floor=floor,
+                )
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
 
     def _retire(self, r: int, t2: float) -> GenerationResult:
         from .jax_engine import _apply_stop
@@ -797,6 +1122,20 @@ class SteppedDecodeSession:
         text = self.tok.decode(generated)
         if req.stop:
             generated, text = _apply_stop(generated, text, self.tok, req.stop)
+        extras: Dict[str, Any] = {"retire_reason": reason, "stepped": True}
+        if self.spec_info is not None and self._spec_host:
+            # per-row draft-verify attribution (ISSUE 9): the row's own
+            # rounds/accepted/drafted from the host counter mirrors —
+            # frozen at their pre-fallback values when the adaptive
+            # policy switched the session to plain decode mid-flight
+            extras["spec"] = {
+                "rounds": int(self._spec_host["rounds"][r]),
+                "accepted": int(self._spec_host["accepted"][r]),
+                "drafted": int(self._spec_host["drafted"][r]),
+                "k": self.spec_info["k"],
+                "draft_model": self.spec_info["draft_model"],
+                "fallback": self.spec_fallback,
+            }
         result = GenerationResult(
             request=req,
             tokens=generated,
@@ -806,7 +1145,7 @@ class SteppedDecodeSession:
             prefill_s=row.t1 - row.t0,
             decode_s=t2 - row.t_decode0,
             total_s=t2 - row.t0,
-            extras={"retire_reason": reason, "stepped": True},
+            extras=extras,
         )
         # the row COMPLETED (eos/budget): its DECODE-LOOP tokens were
         # useful device work — the goodput numerator (the first token
@@ -892,7 +1231,9 @@ class SteppedDecodeSession:
         ``device_put`` to the declared sharding is identity for leaves
         already in place, a reshard for any that drifted; a no-op
         entirely on single-device engines (_place_carry is identity)."""
-        self.carry = self.engine._place_carry(self.cfg, self.carry)
+        self.carry = self.engine._place_carry(
+            self.cfg, self.carry, draft_cfg=self._draft_cfg()
+        )
         if self.paged:
             self.pool.k = self.carry["pool_k"]
             self.pool.v = self.carry["pool_v"]
@@ -912,13 +1253,28 @@ class SteppedDecodeSession:
         ids_len = len(ids)
         if ids_len == 0:
             return False  # would fail prefill; let the solo path 400 it
-        if ids_len + request.max_new_tokens > self.cfg.max_seq_len:
+        if ids_len + request.max_new_tokens + self.spec_slack > self.cfg.max_seq_len:
             return False
+        if self.spec is not None:
+            # A speculating session admits GREEDY joiners only (accepted
+            # drafts are target-argmax tokens); a sampled request defers
+            # to its own session. The joiner also inherits the session's
+            # spec config, so its prompt + budget must fit the fixed
+            # draft cache alongside the rounds-overshoot margin.
+            if not self.engine._spec_eligible(request):
+                return False
+            if (
+                _prompt_alloc(ids_len)
+                + _bucket(request.max_new_tokens, GEN_BUCKETS)
+                + self.spec_margin
+                > self.spec_draft_len
+            ):
+                return False
         if not self.paged:
             return (
                 _prompt_alloc(ids_len)
                 + _bucket(request.max_new_tokens, GEN_BUCKETS)
-                <= self.cache_len
+                <= self.cache_len - self.spec_margin
             )
         if self.stacked and request.max_new_tokens - 1 > self.g_bucket:
             return False  # the side caches hold g_bucket columns
@@ -1064,6 +1420,17 @@ class SteppedDecodeSession:
             presence, pages,
             hit_tokens=common, shared_pages=shared,
         )
+        if self.spec is not None:
+            # the joiner inherits the session's spec config: a private
+            # draft cache prefills over the FULL prompt (a prefix hit
+            # seeds the TARGET only — the draft is cheap to recompute)
+            # in chunks that interleave exactly like the target's
+            tf_d = eng._models[self.spec["draft"]]
+            dk, dv = tf_d.init_cache(1, self.spec_draft_len, dtype=eng.dtype)
+            pending.draft_k, pending.draft_v = eng._place_cache(
+                dk, dv, self.spec["dcfg"]
+            )
+            pending.draft_chunks = _prompt_chunks(len(ids), chunk)
         self._pending[r] = pending
         return pending
 
@@ -1072,33 +1439,72 @@ class SteppedDecodeSession:
         private cache — the engine's chunked-prefill path). Returns True
         once the whole prompt is prefilled (commit next). Fenced, so the
         caller's wall-clock around this call IS the in-flight rows'
-        stall for this chunk."""
-        if pending.next_chunk >= len(pending.chunks):
-            return True
+        stall for this chunk. In a speculative session the joiner's
+        DRAFT prefill rides the same machinery: target chunks run
+        first (they gate the first token), then the draft's — still one
+        chunk forward per call, so the interleave's stall bound holds.
+        """
         eng = self.engine
-        tf = eng._models[self.model]
-        t0 = time.monotonic()
-        start, bucket = pending.chunks[pending.next_chunk]
-        ids = pending.ids[start : start + bucket]
-        real = len(ids)
-        tokens = jnp.asarray(
-            [ids + [self.tok.pad_id] * (bucket - real)], dtype=jnp.int32
-        )
-        with eng._stepped_compute_ctx():
-            prefill = eng._prefill_fn(self.model, bucket, pending.cache_len)
-            logits, pending.k_cache, pending.v_cache = prefill(
-                tf.params,
-                tokens,
-                jnp.int32(start),
-                jnp.asarray([real - 1]),
-                pending.k_cache,
-                pending.v_cache,
+        if pending.next_chunk < len(pending.chunks):
+            tf = eng._models[self.model]
+            t0 = time.monotonic()
+            start, bucket = pending.chunks[pending.next_chunk]
+            ids = pending.ids[start : start + bucket]
+            real = len(ids)
+            tokens = jnp.asarray(
+                [ids + [self.tok.pad_id] * (bucket - real)], dtype=jnp.int32
             )
-            jax.block_until_ready(logits)
-        pending.logits = logits
-        pending.next_chunk += 1
-        pending.prefill_s += time.monotonic() - t0
-        return pending.next_chunk >= len(pending.chunks)
+            with eng._stepped_compute_ctx():
+                prefill = eng._prefill_fn(
+                    self.model, bucket, pending.cache_len
+                )
+                logits, pending.k_cache, pending.v_cache = prefill(
+                    tf.params,
+                    tokens,
+                    jnp.int32(start),
+                    jnp.asarray([real - 1]),
+                    pending.k_cache,
+                    pending.v_cache,
+                )
+                jax.block_until_ready(logits)
+            pending.logits = logits
+            pending.next_chunk += 1
+            pending.prefill_s += time.monotonic() - t0
+        elif (
+            self.spec is not None
+            and pending.draft_next < len(pending.draft_chunks)
+        ):
+            draft = self.spec["draft"]
+            tf_d = eng._models[draft]
+            t0 = time.monotonic()
+            start, bucket = pending.draft_chunks[pending.draft_next]
+            ids = pending.ids[start : start + bucket]
+            real = len(ids)
+            tokens = jnp.asarray(
+                [ids + [self.tok.pad_id] * (bucket - real)], dtype=jnp.int32
+            )
+            with eng._stepped_compute_ctx():
+                prefill = eng._prefill_fn(
+                    draft, bucket, self.spec_draft_len
+                )
+                dlogits, pending.draft_k, pending.draft_v = prefill(
+                    tf_d.params,
+                    tokens,
+                    jnp.int32(start),
+                    jnp.asarray([real - 1]),
+                    pending.draft_k,
+                    pending.draft_v,
+                )
+                jax.block_until_ready(dlogits)
+            pending.draft_next += 1
+            pending.prefill_s += time.monotonic() - t0
+        # a session that fell back to plain decode mid-join simply stops
+        # needing the draft chunks (the row decodes plainly from commit)
+        draft_done = (
+            self.spec is None
+            or pending.draft_next >= len(pending.draft_chunks)
+        )
+        return pending.next_chunk >= len(pending.chunks) and draft_done
 
     def join_commit(self, pending: _PendingJoin) -> int:
         """Finish a fully-prefilled pending join: sample the first token
@@ -1146,6 +1552,25 @@ class SteppedDecodeSession:
                 pass
         r = pending.slot
         del self._pending[r]
+        if self.spec is not None:
+            # install the joiner's draft row BEFORE _install_row so its
+            # closing _recommit_carry re-pins every mutated leaf at once
+            self.carry["draft_k"] = _set_row(
+                self.carry["draft_k"], r, pending.draft_k
+            )
+            self.carry["draft_v"] = _set_row(
+                self.carry["draft_v"], r, pending.draft_v
+            )
+            self.carry["draft_offsets"] = (
+                self.carry["draft_offsets"].at[r].set(len(pending.ids))
+            )
+            for ckey, hkey in (
+                ("spec_rounds", "rounds"),
+                ("spec_accepted", "accepted"),
+                ("spec_drafted", "drafted"),
+            ):
+                self.carry[ckey] = self.carry[ckey].at[r].set(0)
+                self._spec_host[hkey][r] = 0
         self._install_row(
             request,
             r,

@@ -277,20 +277,29 @@ def _attention_block(
     else:
         t = (k_cache["q"] if quant_cache else k_cache).shape[2]
     per_seq = jnp.ndim(offset) == 1  # batched decode: one offset per sequence
-    if per_seq and s != 1:
+    # Multi-token blocks at per-row offsets are the speculative VERIFY
+    # forward (one target pass scores a row's k+1 candidate positions —
+    # engine/speculative.py): supported on every decode-era cache layout
+    # except the stacked-hybrid paged mode, whose parts kernel is
+    # single-query (speculative paged sessions run the legacy pool-write
+    # mode instead).
+    if per_seq and s != 1 and paged_cache and "side" in k_cache:
         raise ValueError(
-            "per-sequence offsets are only supported for single-token decode"
+            "stacked-hybrid paged caches support single-token decode only "
+            "(the parts kernel is single-query; speculative sessions use "
+            "the legacy paged mode)"
         )
-    if carry_cache and not (per_seq and s == 1):
+    if carry_cache and not per_seq:
         raise ValueError(
-            "carry-resident caches support batched single-token decode only"
+            "carry-resident caches support batched per-row-offset decode only"
         )
-    if quant_cache and s != 1:
+    if quant_cache and s != 1 and per_seq:
         raise ValueError(
-            "quantized KV caches support decode only (prefill runs on the "
-            "bf16 cache; it is quantized afterwards)"
+            "quantized contiguous caches take multi-token blocks at a "
+            "shared scalar offset only (the solo speculative verify); "
+            "batched per-row verify rides the carry-resident layout"
         )
-    if paged_cache and s != 1:
+    if paged_cache and s != 1 and not per_seq:
         raise ValueError(
             "paged KV caches support decode only (prefill runs contiguous "
             "and is scattered into the pool afterwards)"
@@ -361,8 +370,6 @@ def _attention_block(
             k_cache = side_write(k_cache, k[:, 0])
             v_cache = side_write(v_cache, v[:, 0])
         else:
-            from ..engine.paged_kv import page_slot
-
             pool_k_leaf = k_cache["pool"]
             page_size = (
                 pool_k_leaf["q"]
@@ -370,9 +377,18 @@ def _attention_block(
                 else pool_k_leaf
             ).shape[-2]
             off_b = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b,))
-            pages, slots = page_slot(table, off_b, page_size)  # [B], [B]
+            # Positions of this block's tokens: [B, S] (S == 1 for plain
+            # decode; S == k+1 for the speculative verify block). The
+            # page/slot arithmetic is page_slot's rule applied per
+            # position; a row's positions never collide (distinct slots)
+            # and rows own disjoint pages, so the one scatter is exact.
+            pos = off_b[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+            pages = jnp.take_along_axis(
+                jnp.asarray(table, jnp.int32), pos // page_size, axis=-1
+            )  # [B, S]
+            slots = pos % page_size
 
-            def pool_write(cache, vec):  # vec [B,Hkv,D]
+            def pool_write(cache, vec):  # vec [B,S,Hkv,D]
                 pool = cache["pool"]
                 if isinstance(pool, dict):  # int8 pages: codes + scale
                     q_, s_ = quantize_kv_vector(vec)
@@ -386,69 +402,82 @@ def _attention_block(
                     )
                 return {**cache, "pool": new}
 
-            k_cache = pool_write(k_cache, k[:, 0])
-            v_cache = pool_write(v_cache, v[:, 0])
+            k_cache = pool_write(k_cache, k)
+            v_cache = pool_write(v_cache, v)
     elif quant_cache:
-        # Quantize the new entry and write codes + per-vector scale.
+        # Quantize the new entries and write codes + per-vector scales.
         # Only the solo (scalar-offset) path reaches here: batched
         # per-seq decode over quantized caches is intercepted by
         # run_blocks' carry branch, whose quantized carry write above
-        # does the per-row [layer, row, :, offset] update.
-        kq, ks = quantize_kv_vector(k[:, 0])  # [B,Hkv,dh]
-        vq, vs = quantize_kv_vector(v[:, 0])
+        # does the per-row [layer, row, :, offset] update. S > 1 is the
+        # solo speculative VERIFY block (k+1 positions quantized with
+        # the same per-vector scale math a step-at-a-time decode would
+        # use, so the accepted tokens see bit-identical cache entries).
+        kq, ks = quantize_kv_vector(k.transpose(0, 2, 1, 3))  # [B,Hkv,S,dh]
+        vq, vs = quantize_kv_vector(v.transpose(0, 2, 1, 3))
         k_cache = {
             "q": jax.lax.dynamic_update_slice(
-                k_cache["q"], kq[:, :, None, :], (0, 0, offset, 0)
+                k_cache["q"], kq, (0, 0, offset, 0)
             ),
             "s": jax.lax.dynamic_update_slice(
-                k_cache["s"], ks[:, :, None], (0, 0, offset)
+                k_cache["s"], ks, (0, 0, offset)
             ),
         }
         v_cache = {
             "q": jax.lax.dynamic_update_slice(
-                v_cache["q"], vq[:, :, None, :], (0, 0, offset, 0)
+                v_cache["q"], vq, (0, 0, offset, 0)
             ),
             "s": jax.lax.dynamic_update_slice(
-                v_cache["s"], vs[:, :, None], (0, 0, offset)
+                v_cache["s"], vs, (0, 0, offset)
             ),
         }
     elif carry_cache:
-        # One tiny in-place write into the stacked carry at [layer, row,
-        # :, offset] — the whole point of the carry-resident design (no
-        # per-layer write-back of the untouched 25 MB slice). Quantized
-        # carries write this token's codes + per-vector scale the same
-        # way the per-layer quant branch below does.
+        # Tiny in-place writes into the stacked carry at [layer, row, :,
+        # offset + j] — the whole point of the carry-resident design (no
+        # per-layer write-back of the untouched 25 MB slice). S == 1 for
+        # plain decode; S == k+1 is the batched speculative VERIFY block
+        # (each row's candidate positions land at its own offsets — one
+        # scatter, no index collisions since rows are disjoint).
+        # Quantized carries write codes + per-vector scales the same way
+        # the per-layer quant branch below does.
         li = k_cache["layer"]
         rows = jnp.arange(b)
+        if s == 1:
+            row_idx, pos_idx = rows, offset  # [B] each — the hot path
+            kt, vt = k[:, 0], v[:, 0]  # [B,Hkv,dh]
+        else:
+            row_idx = rows[:, None]  # [B,1]
+            pos_idx = offset[:, None] + jnp.arange(s, dtype=jnp.int32)
+            kt, vt = k, v  # [B,S,Hkv,dh]
         if isinstance(k_cache["all"], dict):
-            kq, ksc = quantize_kv_vector(k[:, 0])  # [B,Hkv,dh], [B,Hkv]
-            vq, vsc = quantize_kv_vector(v[:, 0])
+            kq, ksc = quantize_kv_vector(kt)
+            vq, vsc = quantize_kv_vector(vt)
             k_cache = {
                 "layer": li,
                 "all": {
-                    "q": k_cache["all"]["q"].at[li, rows, :, offset].set(kq),
-                    "s": k_cache["all"]["s"].at[li, rows, :, offset].set(ksc),
+                    "q": k_cache["all"]["q"].at[li, row_idx, :, pos_idx].set(kq),
+                    "s": k_cache["all"]["s"].at[li, row_idx, :, pos_idx].set(ksc),
                 },
             }
             v_cache = {
                 "layer": li,
                 "all": {
-                    "q": v_cache["all"]["q"].at[li, rows, :, offset].set(vq),
-                    "s": v_cache["all"]["s"].at[li, rows, :, offset].set(vsc),
+                    "q": v_cache["all"]["q"].at[li, row_idx, :, pos_idx].set(vq),
+                    "s": v_cache["all"]["s"].at[li, row_idx, :, pos_idx].set(vsc),
                 },
             }
         else:
             k_cache = {
                 "layer": li,
                 "all": k_cache["all"]
-                .at[li, rows, :, offset]
-                .set(k[:, 0].astype(k_cache["all"].dtype)),
+                .at[li, row_idx, :, pos_idx]
+                .set(kt.astype(k_cache["all"].dtype)),
             }
             v_cache = {
                 "layer": li,
                 "all": v_cache["all"]
-                .at[li, rows, :, offset]
-                .set(v[:, 0].astype(v_cache["all"].dtype)),
+                .at[li, row_idx, :, pos_idx]
+                .set(vt.astype(v_cache["all"].dtype)),
             }
     else:
         # Scalar-offset (solo / prefill) contiguous write. Batched
@@ -564,7 +593,12 @@ def _attention_block(
         scores = jnp.einsum("bskgd,bktd->bkgst", qg, kf) * scale
         kpos = jnp.arange(t)
         if per_seq:
-            mask = kpos[None, None, :] <= offset[:, None, None]  # [B,1,T]
+            # per-row causal mask [B,S,T]: query j of row b sees
+            # kpos <= offset[b] + j (S == 1 for plain batched decode;
+            # S == k+1 for the speculative verify block, whose own
+            # candidate entries — written above — ARE its context)
+            qpos = offset[:, None] + jnp.arange(s, dtype=jnp.int32)
+            mask = kpos[None, None, :] <= qpos[:, :, None]
         else:
             qpos = offset + jnp.arange(s)[:, None]
             # causal + only-written-prefix, in one predicate: [1,S,T]
@@ -724,11 +758,12 @@ def run_blocks(
 
     if (
         (isinstance(k_cache, jnp.ndarray) or is_quantized_cache(k_cache))
-        and x.shape[1] == 1
         and jnp.ndim(offset) == 1
     ):
-        # Batched single-token decode over stacked caches (plain arrays
-        # or int8-KV {"q","s"} dicts): the caches ride the scan CARRY
+        # Batched per-row-offset decode over stacked caches (plain
+        # arrays or int8-KV {"q","s"} dicts) — single-token steps and
+        # the speculative verify's k+1-token blocks alike: the caches
+        # ride the scan CARRY
         # and each layer writes only its token's row in place
         # (is_carry_cache). Scanning them as xs AND ys instead makes
         # XLA write back the full per-layer cache every layer —

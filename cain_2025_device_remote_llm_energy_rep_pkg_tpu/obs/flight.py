@@ -57,6 +57,8 @@ EV_BATCH_FALLBACK = "batch_fallback"  # batch/session dispatch failed → bisect
 EV_POOL_EXHAUSTED = "pool_exhausted"  # PagePool refused an allocation
 EV_PREFIX_HIT = "prefix_hit"  # a joiner reused cached shared-prefix KV
 EV_PREFIX_EVICT = "prefix_evict"  # a prefix-index entry was evicted (LRU)
+EV_SPEC_ROUND = "spec_round"  # one speculative window's rounds/acceptance
+EV_SPEC_FALLBACK = "spec_fallback"  # session acceptance fell below the floor
 EV_DECODE_WINDOW = "decode_window"  # engine fence-timed decode window
 EV_ANOMALY = "anomaly"  # detector fired (obs/detect.py)
 EV_CRASH_DUMP = "crash_dump"  # a crash dump was written

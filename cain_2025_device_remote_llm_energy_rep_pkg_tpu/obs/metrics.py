@@ -318,3 +318,48 @@ class MetricsRegistry:
 
 # THE process-wide registry every instrumented module shares.
 REGISTRY = MetricsRegistry()
+
+
+# -- speculative decoding (ISSUE 9) --------------------------------------------
+# Declared here — not in the engine — because THREE producers share them:
+# the solo path (engine/jax_engine.generate_speculative), the batched
+# stepped sessions (engine/stepped.py) and the hermetic fake
+# (engine/fake.py), and a shared definition is what keeps one scrape
+# comparable across all three.
+SPEC_ROUNDS_C = REGISTRY.counter(
+    "llm_spec_rounds_total",
+    "Draft-verify rounds executed (one round = k draft steps + ONE "
+    "target forward over the k+1 candidate positions)",
+)
+SPEC_ACCEPTED_C = REGISTRY.counter(
+    "llm_spec_tokens_accepted_total",
+    "Draft tokens accepted AND emitted by the target's verify (EOS "
+    "clips and budget cuts excluded — same rule as extras['spec'])",
+)
+SPEC_DRAFTED_C = REGISTRY.counter(
+    "llm_spec_tokens_drafted_total",
+    "Draft tokens proposed (k per live row per round)",
+)
+SPEC_ACCEPTANCE_G = REGISTRY.gauge(
+    "llm_spec_acceptance_rate",
+    "Most recent window's accepted/drafted fraction (0..1) — the "
+    "signal the stepped sessions' auto-fallback policy reads",
+)
+SPEC_FALLBACK_C = REGISTRY.counter(
+    "llm_spec_fallback_total",
+    "Speculating sessions that fell back to plain decode because their "
+    "rolling acceptance dropped below --spec-accept-floor",
+)
+
+
+def observe_spec(rounds: float, accepted: float, drafted: float) -> None:
+    """One speculative window's counters + the acceptance gauge (no-op
+    when telemetry is off — the instruments gate themselves, but the
+    gauge division is worth skipping too)."""
+    if not _enabled or rounds <= 0:
+        return
+    SPEC_ROUNDS_C.inc(rounds)
+    SPEC_ACCEPTED_C.inc(accepted)
+    SPEC_DRAFTED_C.inc(drafted)
+    if drafted > 0:
+        SPEC_ACCEPTANCE_G.set(accepted / drafted)

@@ -132,7 +132,10 @@ def quant_cache_shardings(
 
 
 def stepped_carry_shardings(
-    cfg: ModelConfig, mesh: Mesh, carry: Dict[str, Any]
+    cfg: ModelConfig,
+    mesh: Mesh,
+    carry: Dict[str, Any],
+    draft_cfg: "ModelConfig | None" = None,
 ) -> Dict[str, Any]:
     """NamedSharding pytree for a stepped-decode session carry
     (engine/stepped.py): the per-iteration SPMD placement that makes the
@@ -150,10 +153,19 @@ def stepped_carry_shardings(
       place codes with the payload spec and the per-position scales with
       the head-reduced spec (``quant_cache_shardings`` applied
       leaf-wise).
+    - A speculative session's DRAFT cache (``draft_k``/``draft_v`` —
+      engine/speculative.py's batched step) is a contiguous batch cache
+      of the DRAFT model, so it takes ``cache_spec(draft_cfg)``: sharded
+      over the draft's own heads when THEY divide ``tp``, replicated
+      otherwise (a draft whose heads don't divide the mesh is tiny by
+      construction — replication is the honest placement). The draft
+      cache is never quantized.
     - Everything row-control — tokens, offsets, prompt_lens, remaining,
-      done, rngs, presence, sampling knobs, and the page table —
-      replicates (tiny per-row metadata every device reads each step;
-      the host mutates it between slices with O(B) scatters).
+      done, rngs, presence, sampling knobs, the page table, and the
+      speculative per-row state (``draft_offsets``, ``spec_rounds``,
+      ``spec_accepted``, ``spec_drafted``) — replicates (tiny per-row
+      metadata every device reads each step; the host mutates it
+      between slices with O(B) scatters).
 
     The returned dict matches ``carry`` leaf-for-leaf, so it is valid as
     both a ``jax.jit`` in/out_shardings subtree and a ``device_put``
@@ -164,8 +176,13 @@ def stepped_carry_shardings(
     scale = NamedSharding(mesh, P(*tuple(spec)[:-1]))
     repl = NamedSharding(mesh, P())
     payload_keys = ("k_cache", "v_cache", "pool_k", "pool_v", "side_k", "side_v")
+    draft_payload = NamedSharding(
+        mesh, cache_spec(draft_cfg if draft_cfg is not None else cfg, mesh)
+    )
 
     def place(key: str, leaf):
+        if key in ("draft_k", "draft_v"):
+            return draft_payload
         if key not in payload_keys:
             return repl
         if isinstance(leaf, dict):  # int8: codes + per-position scales

@@ -120,27 +120,37 @@ class TensorParallelEngine(JaxEngine):
     # generate paths apply — so `serve --backend jax-tp --scheduler
     # continuous` runs iteration-level batching on the mesh with the
     # scheduler loop unchanged.
-    def _stepped_carry_shardings(self, cfg: ModelConfig, carry):
+    def _stepped_carry_shardings(self, cfg: ModelConfig, carry, draft_cfg=None):
         """KV payload over heads when they divide ``tp`` (the pool
         reuses the ``pool_scale`` placement for int8 scales), row
-        control + page table replicated — sharding.py holds the one
-        rule; this hook just binds the session's carry to it."""
-        return stepped_carry_shardings(cfg, self.mesh, carry)
+        control + page table replicated, a speculative session's draft
+        cache by the DRAFT model's own heads — sharding.py holds the
+        one rule; this hook just binds the session's carry to it."""
+        return stepped_carry_shardings(
+            cfg, self.mesh, carry, draft_cfg=draft_cfg
+        )
 
-    def _place_carry(self, cfg: ModelConfig, carry):
-        shardings = self._stepped_carry_shardings(cfg, carry)
+    def _place_carry(self, cfg: ModelConfig, carry, draft_cfg=None):
+        shardings = self._stepped_carry_shardings(
+            cfg, carry, draft_cfg=draft_cfg
+        )
         return jax.tree_util.tree_map(jax.device_put, carry, shardings)
 
-    def _stepped_jit(self, cfg: ModelConfig, carry, fn):
+    def _stepped_jit(self, cfg: ModelConfig, carry, fn, draft_cfg=None):
         """The slice step as a pure SPMD program: explicit in/out
         shardings (so a mis-placed leaf is a visible reshard at the jit
         boundary, never a silent per-step host bounce) and, on
         accelerator backends, a donated carry — output KV buffers alias
         the inputs', exactly the monolithic loop's memory profile (CPU
-        skips the donation: see jax_engine._stepped_donation)."""
+        skips the donation: see jax_engine._stepped_donation). The
+        params slot takes default placement either way — for the
+        speculative step fn it is the (target, draft) params PAIR, and
+        the carry stays argument 1 so the donation covers it."""
         from ..engine.jax_engine import _stepped_donation
 
-        shardings = self._stepped_carry_shardings(cfg, carry)
+        shardings = self._stepped_carry_shardings(
+            cfg, carry, draft_cfg=draft_cfg
+        )
         repl = replicated(self.mesh)
         return jax.jit(
             fn,

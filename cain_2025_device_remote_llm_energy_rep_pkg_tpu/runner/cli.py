@@ -189,8 +189,20 @@ commands:
                        --quantize int8|int4|none or per-model
                        "m1=int8,m2=int4,default=int8" (int8 for speed, int4
                        for HBM fit), --kv-quantize int8 (halve the decode
-                       KV stream), --speculative target=draft[:k]
-                       (draft-verify), --prefix-cache N (prompt-prefix KV
+                       KV stream), --speculative target=draft[:k] or the
+                       draft-only form --speculative draft[:k] (one draft
+                       for every served target): greedy requests decode
+                       via draft-verify — solo AND batched: continuous
+                       sessions run per-row draft-verify rounds where
+                       rows advance by their accepted-prefix length,
+                       composing with joins, streaming cancellation,
+                       shared-prefix CoW, --kv-quantize int8 (the target
+                       cache is int8, the tiny draft cache stays bf16)
+                       and --backend jax-tp; --spec-accept-floor F makes
+                       a session whose rolling measured acceptance drops
+                       below F fall back to plain decode
+                       (llm_spec_fallback_total; default: never),
+                       --prefix-cache N (prompt-prefix KV
                        LRU), --paged-kv (batched decode over a paged KV
                        pool: mixed-length batches stop paying the widest
                        row's padding),
@@ -234,6 +246,7 @@ def serve_command(args: List[str]) -> None:
     kv_quantize = None
     paged_kv = False
     speculative = {}
+    spec_accept_floor = None  # speculative auto-fallback threshold
     prefix_cache = 0
     prefix_share = False
     prefix_index_entries = None
@@ -311,14 +324,20 @@ def serve_command(args: List[str]) -> None:
         elif arg == "--speculative":
             # --speculative target=draft[:k] (repeatable): greedy requests
             # for `target` decode via draft-and-verify with k proposals.
+            # The DRAFT-ONLY form `--speculative draft[:k]` (no '=')
+            # applies one draft to EVERY served target (stored under the
+            # "default" key; a model never self-drafts through it).
             # Model names may contain colons (qwen2:1.5b), so only a
             # trailing :<int> is treated as k.
             spec = next(it, "")
-            if "=" not in spec:
+            if not spec:
                 raise CommandError(
-                    "serve: --speculative expects target=draft[:k]"
+                    "serve: --speculative expects target=draft[:k] or "
+                    "draft[:k]"
                 )
-            name, _, rest = spec.partition("=")
+            name, eq, rest = spec.partition("=")
+            if not eq:
+                name, rest = "default", spec
             head, _, tail = rest.rpartition(":")
             if head and tail.isdigit():
                 draft, k = head, int(tail)
@@ -326,9 +345,24 @@ def serve_command(args: List[str]) -> None:
                 draft, k = rest, 4
             if not name or not draft or k < 1:
                 raise CommandError(
-                    "serve: --speculative expects target=draft[:k] with k >= 1"
+                    "serve: --speculative expects target=draft[:k] (or "
+                    "draft[:k]) with k >= 1"
                 )
             speculative[name] = (draft, k)
+        elif arg == "--spec-accept-floor":
+            # auto-fallback threshold: a speculating continuous session
+            # whose rolling measured acceptance drops below this
+            # fraction falls back to plain decode (0 disables).
+            try:
+                spec_accept_floor = float(next(it, ""))
+            except ValueError:
+                raise CommandError(
+                    "serve: --spec-accept-floor expects a fraction in [0, 1)"
+                )
+            if not 0.0 <= spec_accept_floor < 1.0:
+                raise CommandError(
+                    "serve: --spec-accept-floor expects a fraction in [0, 1)"
+                )
         elif arg == "--prefix-cache":
             prefix_cache = int(next(it, "4"))
         elif arg == "--prefix-share":
@@ -363,9 +397,22 @@ def serve_command(args: List[str]) -> None:
 
         enable_compilation_cache()
     if backend_kind == "fake":
+        import os
+
         from ..engine.fake import FakeBackend
 
-        backend = FakeBackend()
+        # --speculative on the fake backend runs the synthetic spec
+        # protocol (k from the first configured entry; acceptance via
+        # env FAKE_SPEC_ACCEPTANCE, default 1.0) so the serving surface
+        # is demo-able with no accelerator
+        spec_k = next(iter(speculative.values()))[1] if speculative else 0
+        backend = FakeBackend(
+            spec_k=spec_k,
+            spec_acceptance=float(
+                os.environ.get("FAKE_SPEC_ACCEPTANCE", "1.0")
+            ),
+            spec_accept_floor=spec_accept_floor,
+        )
     elif backend_kind == "jax-tp":
         from ..parallel.mesh import MeshSpec, build_mesh
         from ..parallel.tp import TensorParallelEngine
@@ -378,6 +425,7 @@ def serve_command(args: List[str]) -> None:
             kv_quantize=kv_quantize,
             paged_kv=paged_kv,
             speculative=speculative or None,
+            spec_accept_floor=spec_accept_floor or 0.0,
             prefix_cache_size=prefix_cache,
             prefix_share=prefix_share,
             **(
@@ -396,6 +444,7 @@ def serve_command(args: List[str]) -> None:
             kv_quantize=kv_quantize,
             paged_kv=paged_kv,
             speculative=speculative or None,
+            spec_accept_floor=spec_accept_floor or 0.0,
             prefix_cache_size=prefix_cache,
             prefix_share=prefix_share,
             **(
@@ -424,6 +473,7 @@ def serve_command(args: List[str]) -> None:
         slice_steps=slice_steps,
         prefill_chunk_tokens=prefill_chunk_tokens,
         ttft_slo_ms=ttft_slo_ms,
+        spec_accept_floor=spec_accept_floor,
     )
     server.serve_forever()
 

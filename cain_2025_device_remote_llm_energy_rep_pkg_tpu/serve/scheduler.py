@@ -745,6 +745,7 @@ class ContinuousScheduler(_SchedulerBase):
         prefill_chunk_tokens: Optional[int] = None,
         chunked_joins: bool = True,
         ttft_slo_ms: Optional[float] = None,
+        spec_accept_floor: Optional[float] = None,
     ) -> None:
         super().__init__(
             backend,
@@ -754,6 +755,11 @@ class ContinuousScheduler(_SchedulerBase):
             budget_aware=budget_aware,
             ttft_slo_ms=ttft_slo_ms,
         )
+        # Speculative auto-fallback floor (`serve --spec-accept-floor`,
+        # ISSUE 9): forwarded to every session open — a speculating
+        # session whose rolling measured acceptance drops below it falls
+        # back to plain decode mid-flight. None = the backend's default.
+        self.spec_accept_floor = spec_accept_floor
         if not hasattr(backend, "decode_open"):
             raise ValueError(
                 f"{type(backend).__name__} has no stepped-decode support "
@@ -798,6 +804,7 @@ class ContinuousScheduler(_SchedulerBase):
         state["slice_steps"] = self.slice_steps
         state["chunked_joins"] = self.chunked_joins
         state["prefill_chunk_tokens"] = self.prefill_chunk_tokens
+        state["spec_accept_floor"] = self.spec_accept_floor
         # Sharded serving (ISSUE 8): a TP backend reports its mesh here
         # so one /debug/state probe shows WHICH device topology the
         # continuous loop is driving (None on single-device backends —
@@ -899,12 +906,20 @@ class ContinuousScheduler(_SchedulerBase):
             )
         _BATCH_ROWS_H.observe(len(batch))
         _BATCHES_C.inc()
+        # pass the spec floor only when configured: duck-typed stepped
+        # backends predating the knob keep working unchanged
+        open_kwargs = (
+            {"spec_accept_floor": self.spec_accept_floor}
+            if self.spec_accept_floor is not None
+            else {}
+        )
         try:
             with TRACER.attach(first.span), self._backend_lock:
                 session = self.backend.decode_open(
                     [t.request for t in batch],
                     reserve_rows=min(cap, max(2 * len(batch), 4)),
                     slice_steps=self.slice_steps,
+                    **open_kwargs,
                 )
         except BaseException as exc:  # noqa: BLE001
             # a failed open (one bad prompt poisons the group) salvages

@@ -85,6 +85,7 @@ class GenerationServer:
         slice_steps: Optional[int] = None,  # continuous: decode-slice width
         prefill_chunk_tokens: Optional[int] = None,  # continuous: join chunk
         ttft_slo_ms: Optional[float] = None,  # queued-past-SLO rejection
+        spec_accept_floor: Optional[float] = None,  # speculative fallback
     ) -> None:
         """``batch_window_ms > 0`` or an explicit ``scheduler`` enables
         batching: concurrent non-streaming generate requests coalesce
@@ -118,6 +119,13 @@ class GenerationServer:
         mid-flight joiner's prefill (default: the engine's auto, env
         ``PREFILL_CHUNK_TOKENS``) — together they bound how long
         in-flight rows stall per scheduler iteration.
+
+        ``spec_accept_floor`` (CLI ``--spec-accept-floor``) tunes the
+        continuous scheduler's speculative sessions: a session whose
+        rolling measured draft-acceptance drops below the floor falls
+        back to plain decode mid-flight (llm_spec_fallback_total).
+        None = the backend engine's own default (never fall back unless
+        the engine was built with a floor).
 
         ``ttft_slo_ms`` (CLI ``--ttft-slo-ms``) is the server-wide TTFT
         SLO: a queued request whose wait alone already exceeds it is
@@ -165,6 +173,7 @@ class GenerationServer:
                     slice_steps=slice_steps,
                     prefill_chunk_tokens=prefill_chunk_tokens,
                     ttft_slo_ms=ttft_slo_ms,
+                    spec_accept_floor=spec_accept_floor,
                 )
             else:
                 self._scheduler = BatchScheduler(

@@ -56,6 +56,16 @@ Three phases, all over the deterministic fake backend:
    ``backend_mesh``, and (probed mid-flight) the live session's
    per-device pool occupancy from the carry's committed shardings.
 
+8. BATCHED SPECULATIVE DECODING (ISSUE 9): the fake backend speaks the
+   spec protocol with configurable synthetic acceptance
+   (``FakeBackend(spec_k=4, spec_acceptance=0.75)``): assert the
+   ``llm_spec_*`` counters moved with the exact synthetic arithmetic,
+   the live session's ``/debug/state`` rows carry the per-row
+   ``spec_rounds``/``spec_accepted`` fields, and — on a second server
+   with acceptance 0 under ``spec_accept_floor`` — the AUTO-FALLBACK
+   fires (``llm_spec_fallback_total`` + the ``spec_fallback`` flight
+   event carrying the floor).
+
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
 """
@@ -667,6 +677,100 @@ def main() -> int:
     finally:
         server7.stop()
 
+    # -- phase 8: batched speculative decoding (ISSUE 9) -----------------------
+    # FakeBackend speaks the spec protocol with configurable synthetic
+    # acceptance: drive the continuous fake server, assert the llm_spec_*
+    # counters moved, the spec fields surface in /debug/state's live
+    # session rows, and — on a second server with acceptance 0 and a
+    # floor — the auto-fallback fires (llm_spec_fallback_total + the
+    # spec_fallback flight event).
+    server8 = GenerationServer(
+        FakeBackend(
+            tokens_per_s=400.0, simulate_delay=True,
+            spec_k=4, spec_acceptance=0.75,
+        ),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    server8.start()
+    try:
+        base8 = f"http://127.0.0.1:{server8.port}"
+        pre8 = _scrape(base8)
+
+        def delta8(text_now, name):
+            try:
+                before = _metric_value(pre8, name)
+            except AssertionError:
+                before = 0.0
+            return _metric_value(text_now, name) - before
+
+        mid8 = {}
+
+        def probe8():
+            deadline8 = time.monotonic() + 30.0
+            while time.monotonic() < deadline8 and "row" not in mid8:
+                try:
+                    st = _get_json(base8, "/debug/state")
+                    sess_st = (st.get("scheduler") or {}).get("session")
+                    rows = (sess_st or {}).get("rows") or []
+                    specced = [
+                        r for r in rows if r.get("spec_rounds", 0) > 0
+                    ]
+                    if specced and (sess_st or {}).get("spec"):
+                        mid8["row"] = specced[0]
+                        mid8["spec"] = sess_st["spec"]
+                except Exception:
+                    pass
+                time.sleep(0.01)
+
+        t_probe8 = threading.Thread(target=probe8)
+        t_probe8.start()
+        # spec advancement is 1 + 3 accepted per round: a 512-token row
+        # spans many slices, so the probe catches it live
+        body8 = _post_generate(base8, "speculative row", 512)
+        t_probe8.join(timeout=40)
+        assert body8.get("done"), body8
+        text8 = _scrape(base8)
+        rounds8 = delta8(text8, "llm_spec_rounds_total")
+        accepted8 = delta8(text8, "llm_spec_tokens_accepted_total")
+        drafted8 = delta8(text8, "llm_spec_tokens_drafted_total")
+        assert rounds8 >= 1, f"no spec rounds recorded: {rounds8}"
+        assert drafted8 >= 4 * rounds8, (rounds8, drafted8)
+        assert accepted8 == 3 * rounds8, (rounds8, accepted8)
+        assert "llm_spec_acceptance_rate" in text8
+        assert mid8.get("row", {}).get("spec_rounds", 0) > 0, (
+            f"live session rows never showed spec fields: {mid8}"
+        )
+        assert mid8["spec"]["active"] and mid8["spec"]["k"] == 4, mid8
+    finally:
+        server8.stop()
+
+    # acceptance 0 under a floor: the session must FALL BACK to plain
+    # decode — counter + flight event + result extras agree
+    server8b = GenerationServer(
+        FakeBackend(spec_k=4, spec_acceptance=0.0),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+        spec_accept_floor=0.25,
+    )
+    server8b.start()
+    try:
+        base8b = f"http://127.0.0.1:{server8b.port}"
+        body8b = _post_generate(base8b, "hopeless draft", 64)
+        assert body8b.get("done"), body8b
+        text8b = _scrape(base8b)
+        fallbacks8 = _metric_value(text8b, "llm_spec_fallback_total")
+        assert fallbacks8 >= 1, "auto-fallback never fired at acceptance 0"
+        flight8 = _get_json(base8b, "/debug/flight?type=spec_fallback")
+        assert flight8["events"], "no spec_fallback flight event"
+        assert flight8["events"][-1]["floor"] == 0.25
+    finally:
+        server8b.stop()
+
     print(
         json.dumps(
             {
@@ -704,6 +808,12 @@ def main() -> int:
                     "sessions_opened": sessions7,
                     "rows_retired": retired7,
                     "per_device_pool": mid7.get("per_device"),
+                },
+                "speculative": {
+                    "rounds": rounds8,
+                    "accepted": accepted8,
+                    "drafted": drafted8,
+                    "fallbacks_at_zero_acceptance": fallbacks8,
                 },
             }
         )

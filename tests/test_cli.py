@@ -87,18 +87,108 @@ def test_main_help_and_unknown_command(capsys):
 
 def test_speculative_flag_parsing_handles_colon_names():
     """Model names contain colons (qwen2:1.5b); only a trailing :<int> is
-    k. Malformed values raise CommandError, not a raw traceback."""
+    k. Malformed values raise CommandError, not a raw traceback. (The
+    no-'=' spelling is now the DRAFT-ONLY form — see the knob test
+    below — so only genuinely malformed specs reject.)"""
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
         CommandError,
         serve_command,
     )
 
-    with pytest.raises(CommandError, match="speculative"):
-        serve_command(["--speculative", "no-equals-here"])
     with pytest.raises(CommandError, match="k >= 1"):
         serve_command(["--speculative", "t=d:0"])
     with pytest.raises(CommandError, match="speculative"):
         serve_command(["--speculative", "=d:2"])
+    with pytest.raises(CommandError, match="speculative"):
+        serve_command(["--speculative", ""])
+
+
+def test_serve_speculative_knobs_reach_engine_and_server(monkeypatch):
+    """ISSUE 9 knobs: the draft-only `--speculative draft[:k]` form maps
+    to the engine's "default" entry, `--spec-accept-floor` reaches the
+    engine ctor AND the server (→ continuous scheduler → decode_open),
+    and malformed floors fail fast."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured["backend"] = backend
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    cli.serve_command(
+        [
+            "--backend", "jax", "--port", "0",
+            "--speculative", "qwen2:0.5b:3",
+            "--spec-accept-floor", "0.4",
+        ]
+    )
+    be = captured["backend"]
+    assert be.speculative == {"default": ("qwen2:0.5b", 3)}
+    assert be._resolve_spec("qwen2:1.5b") == ("qwen2:0.5b", 3)
+    assert be._resolve_spec("qwen2:0.5b") is None  # never self-drafts
+    assert be.spec_accept_floor == 0.4
+    assert captured["spec_accept_floor"] == 0.4
+
+    captured.clear()
+    cli.serve_command(
+        [
+            "--backend", "jax", "--port", "0",
+            "--speculative", "qwen2:1.5b=qwen2:0.5b:5",
+        ]
+    )
+    be = captured["backend"]
+    assert be.speculative == {"qwen2:1.5b": ("qwen2:0.5b", 5)}
+    assert captured["spec_accept_floor"] is None
+
+    with pytest.raises(CommandError, match="spec-accept-floor"):
+        serve_command(["--spec-accept-floor", "1.5"])
+    with pytest.raises(CommandError, match="spec-accept-floor"):
+        serve_command(["--spec-accept-floor", "nope"])
+
+
+def test_serve_fake_backend_speculative_knobs(monkeypatch):
+    """--backend fake + --speculative runs the synthetic spec protocol:
+    k lands on the FakeBackend, acceptance comes from
+    FAKE_SPEC_ACCEPTANCE, and the floor rides along."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured["backend"] = backend
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    monkeypatch.setenv("FAKE_SPEC_ACCEPTANCE", "0.5")
+    cli.serve_command(
+        [
+            "--backend", "fake", "--port", "0",
+            "--speculative", "fake-draft:6",
+            "--spec-accept-floor", "0.2",
+        ]
+    )
+    be = captured["backend"]
+    assert be.spec_k == 6
+    assert be.spec_acceptance == 0.5
+    assert be.spec_accept_floor == 0.2
 
 
 def test_serve_quantize_per_model_spec_parses(monkeypatch):

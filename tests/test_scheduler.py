@@ -752,3 +752,51 @@ def test_max_batch_default_is_backend_aware():
     assert BatchScheduler(FakeBackend()).max_batch == 8  # sequential base
     assert BatchScheduler(Batched()).max_batch == 32
     assert BatchScheduler(FakeBackend(), max_batch=16).max_batch == 16
+
+
+def test_fake_backend_speaks_spec_protocol_with_fallback():
+    """ISSUE 9 hermetic twin: FakeBackend(spec_k>0) sessions run the
+    synthetic draft-verify protocol — rows advance by 1 + accepted per
+    round, llm_spec_* move, per-row spec fields surface in debug_state —
+    and a measured acceptance below the scheduler's floor flips the
+    session to plain advancement (llm_spec_fallback_total)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+        FakeBackend,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        REGISTRY,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    def counter(name):
+        return REGISTRY.snapshot().get(name, {}).get("_", 0)
+
+    fb = FakeBackend(spec_k=4, spec_acceptance=0.75)
+    sess = fb.decode_open(
+        [GenerationRequest("m", "probe", max_new_tokens=32)]
+    )
+    rounds0 = counter("llm_spec_rounds_total")
+    sess.step(4)  # 4 rounds × (1 + 3 accepted) = 16 tokens
+    state = sess.debug_state()
+    assert state["spec"]["active"] and state["spec"]["k"] == 4
+    assert state["rows"][0]["spec_rounds"] == 4
+    assert state["rows"][0]["spec_accepted"] == 12
+    assert counter("llm_spec_rounds_total") == rounds0 + 4
+    retired = sess.step(4)  # 32 tokens total: row retires
+    assert retired and retired[0].extras["spec"]["accepted"] == 24
+    sess.close()
+
+    # scheduler floor → decode_open override → fallback at acceptance 0
+    fallbacks0 = counter("llm_spec_fallback_total")
+    sched = ContinuousScheduler(
+        FakeBackend(spec_k=4, spec_acceptance=0.0), spec_accept_floor=0.25
+    )
+    sched.start()
+    try:
+        res = sched.submit(GenerationRequest("m", "zero", max_new_tokens=64))
+    finally:
+        sched.stop()
+    assert res.extras["spec"]["fallback"] is True
+    assert counter("llm_spec_fallback_total") == fallbacks0 + 1

@@ -1,0 +1,362 @@
+"""Batched speculative decoding inside stepped decode sessions (ISSUE 9).
+
+The acceptance mechanics under test: per slice, every live row drafts k
+tokens then ONE target forward scores its k+1 candidate positions, and
+rows advance by their own longest-accepted-prefix length m ∈ [1, k+1] —
+so retirement, EOS clipping, budgets, joins and page accounting all move
+at per-row variable stride. Parity discipline is the usual one: every
+row's stream must be bit-identical to plain greedy decode on the same
+engine configuration (float32 pins, per the numerics caveat in
+engine/speculative.py), whatever the cache layout.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+    JaxEngine,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+
+
+@pytest.fixture(scope="module")
+def registry():
+    tiny = get_model_config("qwen2:1.5b").tiny(max_seq_len=1024)
+    return {
+        "tiny": tiny,
+        # a genuinely different (weaker) draft exercises the rejection
+        # path; same vocab by construction
+        "tiny-d": dataclasses.replace(tiny, n_layers=1),
+        # an alias of the target config: identical seeded weights, so
+        # every draft is accepted — the acceptance-friendly arm
+        "tiny-same": tiny,
+    }
+
+
+@pytest.fixture(scope="module")
+def plain(registry):
+    return JaxEngine(registry=dict(registry), dtype=jnp.float32)
+
+
+def _spec_engine(registry, draft="tiny-d", k=3, **kwargs):
+    return JaxEngine(
+        registry=dict(registry),
+        dtype=jnp.float32,
+        speculative={"tiny": (draft, k)},
+        **kwargs,
+    )
+
+
+def _drain(session, max_steps=8, limit=300):
+    out = []
+    for _ in range(limit):
+        if not session.active:
+            break
+        out.extend(session.step(max_steps))
+    assert not session.active, "session did not drain"
+    return out
+
+
+LAYOUTS = [
+    pytest.param(False, None, id="contig-bf16"),
+    pytest.param(False, "int8", id="contig-int8"),
+    pytest.param(True, None, id="paged-bf16"),
+    pytest.param(True, "int8", id="paged-int8"),
+]
+
+
+@pytest.mark.parametrize("paged,kv", LAYOUTS)
+def test_spec_session_parity_all_layouts_with_join(registry, paged, kv):
+    """The tentpole invariant: a speculating session — mid-flight joiner
+    included — emits exactly the plain greedy stream of the same engine
+    configuration, on all four cache layouts (int8 target KV composes:
+    the former kv_quantize × speculative exclusion is retired)."""
+    eng = _spec_engine(registry, paged_kv=paged, kv_quantize=kv)
+    exp = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32,
+        paged_kv=paged, kv_quantize=kv,
+    )
+    reqs = [
+        GenerationRequest("tiny", "alpha prompt", max_new_tokens=12),
+        GenerationRequest(
+            "tiny", "the longer second row runs on", max_new_tokens=24,
+            stop_at_eos=False, seed=2,
+        ),
+    ]
+    sess = eng.decode_open(reqs, reserve_rows=4)
+    assert sess.spec is not None, "session did not speculate"
+    sess.step(4)
+    joiner = GenerationRequest("tiny", "late joiner", max_new_tokens=10, seed=3)
+    assert sess.can_join(joiner)
+    sess.join(joiner)
+    results = {id(r.request): r for r in _drain(sess)}
+    for r in reqs + [joiner]:
+        assert results[id(r)].tokens == exp._generate_plain(r).tokens, (
+            f"diverged: paged={paged} kv={kv} prompt={r.prompt!r}"
+        )
+        spec = results[id(r)].extras["spec"]
+        assert spec["k"] == 3 and spec["draft_model"] == "tiny-d"
+        assert spec["rounds"] >= 1
+        assert 0 <= spec["accepted"] <= spec["drafted"]
+
+
+def test_spec_rows_advance_multiple_tokens_per_round(registry):
+    """With an identical-weights draft every proposal is accepted: rows
+    advance ~k+1 tokens per target forward — the amortization the mode
+    exists for — and the stream still equals plain greedy decode."""
+    eng = _spec_engine(registry, draft="tiny-same", k=4)
+    plain_eng = JaxEngine(registry=dict(registry), dtype=jnp.float32)
+    req = GenerationRequest(
+        "tiny", "perfect acceptance", max_new_tokens=33, stop_at_eos=False
+    )
+    sess = eng.decode_open([req])
+    res = _drain(sess)[0]
+    assert res.tokens == plain_eng._generate_plain(req).tokens
+    spec = res.extras["spec"]
+    # 32 decode tokens in ≤ ceil(32/5)+1 rounds; acceptance ≈ 1
+    assert spec["rounds"] <= 8, spec
+    assert spec["accepted"] >= spec["rounds"] * 3, spec
+
+
+def test_spec_paged_bills_slack_pages_and_restores_exactly(registry):
+    """Paged speculative rows bill 2k+2 slack token slots of extra pages
+    (the verify block can write k entries past the accepted offset), and
+    retire/cancel/close restore the pool free count EXACTLY — on bf16
+    and int8 pools."""
+    for kv in (None, "int8"):
+        eng = _spec_engine(registry, k=3, paged_kv=True, kv_quantize=kv)
+        plain_eng = JaxEngine(
+            registry=dict(registry), dtype=jnp.float32,
+            paged_kv=True, kv_quantize=kv,
+        )
+        anchor = GenerationRequest(
+            "tiny", "anchor decodes on", max_new_tokens=40, stop_at_eos=False
+        )
+        sess = eng.decode_open([anchor], reserve_rows=4)
+        assert sess.spec is not None
+        # slack billing: the session's own sizing rule includes 2k+2
+        assert sess.spec_slack == 2 * 3 + 2
+        plain_sess = plain_eng.decode_open([anchor], reserve_rows=4)
+        assert (
+            sess._pages_needed(100, 40)
+            >= plain_sess._pages_needed(100, 40)
+        )
+        assert sess._pages_needed(100, 40) == -(-(100 + 40 + 8) // 128)
+        plain_sess.close()
+        free0 = sess.pool.free_pages
+        sess.step(4)
+        victim = GenerationRequest(
+            "tiny", "victim row", max_new_tokens=30, stop_at_eos=False, seed=5
+        )
+        assert sess.can_join(victim)
+        sess.join(victim)
+        victim_pages = next(
+            row.pages
+            for row in sess.rows
+            if row is not None and row.request is victim
+        )
+        assert sess.pool.free_pages == free0 - len(victim_pages)
+        sess.step(4)
+        # cancel restores the victim's pages (slack included) exactly
+        assert sess.cancel(victim)
+        assert sess.pool.free_pages == free0
+        results = _drain(sess)
+        assert results[0].tokens == plain_eng._generate_plain(anchor).tokens
+        sess.close()
+        assert sess.pool.free_pages == sess.pool.n_pages - 1  # parking only
+
+
+def test_spec_chunked_joiner_prefills_draft_too(registry):
+    """A long-prompt joiner into a speculating session: its TARGET
+    prefill chunks interleave as usual AND its DRAFT prefill rides the
+    same chunk machinery (one chunk forward per join_step call) — the
+    committed row then speculates and stays solo-identical."""
+    eng = _spec_engine(registry, k=3)
+    plain_eng = JaxEngine(registry=dict(registry), dtype=jnp.float32)
+    anchor = GenerationRequest(
+        "tiny", "a" * 120, max_new_tokens=40, stop_at_eos=False, seed=1
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    assert sess.spec is not None
+    sess.step(4)
+    joiner = GenerationRequest("tiny", "j" * 100, max_new_tokens=12, seed=3)
+    assert sess.can_join(joiner)
+    pj = sess.join_begin(joiner, chunk_tokens=32)
+    assert len(pj.chunks) >= 3  # 101 prompt ids at 32-token chunks
+    assert len(pj.draft_chunks) >= 3  # the draft prefills the FULL prompt
+    steps = 0
+    done = False
+    while not done:
+        done = sess.join_step(pj)
+        steps += 1
+        if not done:
+            sess.step(2)  # the anchor keeps speculating between chunks
+    assert steps >= len(pj.chunks) + len(pj.draft_chunks)
+    sess.join_commit(pj)
+    results = {id(r.request): r for r in _drain(sess)}
+    assert results[id(anchor)].tokens == plain_eng._generate_plain(anchor).tokens
+    assert results[id(joiner)].tokens == plain_eng._generate_plain(joiner).tokens
+    assert results[id(joiner)].extras["spec"]["rounds"] >= 1
+
+
+def test_spec_session_rejects_sampled_rows_and_joiners(registry):
+    """Greedy-only: sampled anchors open a PLAIN session; a sampled
+    joiner is refused by a speculating session's can_join (it defers to
+    its own session instead)."""
+    eng = _spec_engine(registry)
+    sampled = GenerationRequest(
+        "tiny", "sampled anchor", max_new_tokens=8, temperature=0.9
+    )
+    sess = eng.decode_open([sampled])
+    assert sess.spec is None
+    _drain(sess)
+
+    greedy = GenerationRequest(
+        "tiny", "greedy anchor", max_new_tokens=24, stop_at_eos=False
+    )
+    sess2 = eng.decode_open([greedy], reserve_rows=4)
+    assert sess2.spec is not None
+    sampled_joiner = GenerationRequest(
+        "tiny", "sampled joiner", max_new_tokens=8, temperature=0.7
+    )
+    assert not sess2.can_join(sampled_joiner)
+    greedy_joiner = GenerationRequest("tiny", "ok joiner", max_new_tokens=8)
+    assert sess2.can_join(greedy_joiner)
+    _drain(sess2)
+
+
+def test_spec_adaptive_fallback_preserves_parity(registry):
+    """The adaptive policy: a weak draft under a high floor falls the
+    session back to plain decode mid-flight — llm_spec_fallback_total
+    moves, extras mark fallback, and the stream is STILL the plain
+    greedy stream (both modes emit the target's argmax tokens)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        REGISTRY,
+    )
+
+    eng = _spec_engine(registry, spec_accept_floor=0.95)
+    plain_eng = JaxEngine(registry=dict(registry), dtype=jnp.float32)
+    req = GenerationRequest(
+        "tiny", "long fallback run", max_new_tokens=120, stop_at_eos=False
+    )
+    before = (
+        REGISTRY.snapshot().get("llm_spec_fallback_total", {}).get("_", 0)
+    )
+    sess = eng.decode_open([req])
+    assert sess.spec is not None
+    res = _drain(sess, max_steps=4)[0]
+    assert sess.spec is None and sess.spec_fallback
+    assert res.extras["spec"]["fallback"] is True
+    assert res.tokens == plain_eng._generate_plain(req).tokens
+    after = (
+        REGISTRY.snapshot().get("llm_spec_fallback_total", {}).get("_", 0)
+    )
+    assert after == before + 1
+
+
+def test_spec_session_through_continuous_scheduler(registry):
+    """End-to-end through the serving stack: the continuous scheduler
+    opens a speculating session, a staggered arrival joins it, results
+    carry the spec extras, and every stream is plain-greedy identical.
+    The scheduler's decode_open floor override rides along."""
+    import threading
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    eng = _spec_engine(registry, draft="tiny-same", k=3)
+    plain_eng = JaxEngine(registry=dict(registry), dtype=jnp.float32)
+    anchor = GenerationRequest(
+        "tiny", "scheduler anchor", max_new_tokens=48, stop_at_eos=False
+    )
+    late = GenerationRequest("tiny", "late arrival", max_new_tokens=8, seed=2)
+    # warm compiled shapes outside the scheduler
+    warm = eng.decode_open([anchor, late], reserve_rows=4)
+    _drain(warm)
+    sched = ContinuousScheduler(eng, slice_steps=4, spec_accept_floor=0.05)
+    sched.start()
+    results = {}
+    try:
+        def submit(req):
+            results[id(req)] = sched.submit(req)
+
+        t1 = threading.Thread(target=submit, args=(anchor,))
+        t2 = threading.Thread(target=submit, args=(late,))
+        t1.start()
+        t2.start()
+        t1.join(timeout=120)
+        t2.join(timeout=120)
+    finally:
+        sched.stop()
+    assert set(results) == {id(anchor), id(late)}
+    for req in (anchor, late):
+        assert results[id(req)].tokens == plain_eng._generate_plain(req).tokens
+        assert results[id(req)].extras["spec"]["rounds"] >= 1
+
+
+def test_spec_debug_state_reports_session_and_rows(registry):
+    eng = _spec_engine(registry, k=3)
+    req = GenerationRequest(
+        "tiny", "debug probe", max_new_tokens=24, stop_at_eos=False
+    )
+    sess = eng.decode_open([req])
+    sess.step(4)
+    state = sess.debug_state()
+    assert state["spec"]["active"] is True
+    assert state["spec"]["draft_model"] == "tiny-d"
+    assert state["spec"]["k"] == 3
+    assert state["spec"]["rounds_total"] >= 1
+    assert "spec_rounds" in state["rows"][0]
+    _drain(sess)
+
+
+def test_spec_disabled_when_draft_cache_cannot_fit(registry):
+    """A budget whose draft cache would exceed the draft's max_seq_len
+    serves the session PLAIN (never fails a request plain decode would
+    serve) — the solo path's fallback rule, stepped."""
+    small = {
+        "tiny": get_model_config("qwen2:1.5b").tiny(),  # max_seq_len 256
+        "tiny-d": dataclasses.replace(
+            get_model_config("qwen2:1.5b").tiny(), n_layers=1
+        ),
+    }
+    eng = JaxEngine(
+        registry=small, dtype=jnp.float32, speculative={"tiny": ("tiny-d", 3)}
+    )
+    req = GenerationRequest(
+        "tiny", "big budget", max_new_tokens=128, stop_at_eos=False
+    )
+    sess = eng.decode_open([req])
+    assert sess.spec is None  # margin would blow max_seq_len: plain
+    res = _drain(sess)
+    assert res[0].generated_tokens == 128
+
+
+def test_solo_spec_emits_obs_and_nested_extras(registry):
+    """Satellite: the solo path no longer drops rounds/accepted on the
+    floor — extras['spec'] plus the llm_spec_* families move."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        REGISTRY,
+    )
+
+    eng = _spec_engine(registry, draft="tiny-same", k=4)
+    before = REGISTRY.snapshot().get("llm_spec_rounds_total", {}).get("_", 0)
+    res = eng.generate(
+        GenerationRequest(
+            "tiny", "solo obs", max_new_tokens=17, stop_at_eos=False
+        )
+    )
+    spec = res.extras["spec"]
+    assert spec["rounds"] == res.extras["spec_rounds"]
+    assert spec["accepted"] == res.extras["spec_accepted"]
+    assert spec["drafted"] == spec["rounds"] * 4
+    after = REGISTRY.snapshot().get("llm_spec_rounds_total", {}).get("_", 0)
+    assert after >= before + spec["rounds"]

@@ -228,6 +228,60 @@ def test_tp_shared_prefix_joiner_parity_and_exact_restoration(registry):
     assert sess.pool.free_pages == sess.pool.n_pages - 1
 
 
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_tp_spec_session_parity_and_draft_sharding(registry, n_devices):
+    """ISSUE 9 on the mesh: a speculating stepped session — draft KV
+    leaves in the SPMD carry, sharded by the DRAFT model's own heads —
+    emits the plain greedy stream for every row incl. a mid-flight
+    joiner, and the declared carry placements survive stepping."""
+    from jax.sharding import PartitionSpec as P
+
+    draft_cfg = dataclasses.replace(_tiny8(), n_layers=1)
+    reg = {"tiny": _tiny8(), "tiny-d": draft_cfg}
+    eng = _tp_engine(
+        reg, n_devices, paged_kv=True,
+        speculative={"tiny": ("tiny-d", 3)},
+    )
+    anchor = GenerationRequest(
+        "tiny", "mesh anchor runs long", max_new_tokens=24,
+        stop_at_eos=False,
+    )
+    joiner = GenerationRequest(
+        "tiny", "late mesh arrival", max_new_tokens=10, seed=3
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    assert sess.spec is not None
+    # draft payload sharded over the DRAFT's heads (8 % tp == 0),
+    # per-row spec state replicated
+    assert sess.carry["draft_k"].sharding.spec == P(
+        None, None, "tp", None, None
+    )
+    for key in ("draft_offsets", "spec_rounds", "spec_accepted"):
+        assert sess.carry[key].sharding.spec == P(), key
+    before = {
+        key: leaf.sharding.spec
+        for key, leaf in sess.carry.items()
+        if not isinstance(leaf, dict)
+    }
+    sess.step(4)
+    assert sess.can_join(joiner)
+    sess.join(joiner)
+    results = {id(r.request): r for r in _drain(sess)}
+    after = {
+        key: leaf.sharding.spec
+        for key, leaf in sess.carry.items()
+        if not isinstance(leaf, dict)
+    }
+    assert after == before  # placements stable across spec slices + join
+    for req in (anchor, joiner):
+        assert results[id(req)].tokens == eng._generate_plain(req).tokens, (
+            f"spec row diverged on tp={n_devices}"
+        )
+        assert results[id(req)].extras["spec"]["rounds"] >= 1
+    sess.close()
+    assert sess.pool.free_pages == sess.pool.n_pages - 1
+
+
 def test_tp_cancel_restores_free_count_exactly(registry):
     """PR-6's cancellation invariant on SHARDED rows (the ROADMAP
     follow-on): cancel() parks the table row and frees the victim's
