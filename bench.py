@@ -1056,8 +1056,12 @@ def spec_continuous_bench() -> int:
     tokens / its draft-verify rounds — 1.0 by definition in the plain
     arm; > 1.0 is the acceptance criterion), bit-exact parity of the
     two arms' token streams (both must be the target's greedy stream),
-    and exact pool free-count restoration (slack pages included) after
-    join + cancel + close on bf16 AND int8 paged pools. NEXT TO the
+    and exact pool free-count restoration after join + cancel + close
+    on bf16 AND int8 paged pools. The PAGED-NATIVE arm (ISSUE 10)
+    records pages-billed-per-spec-row — native (slack-free) vs the
+    retired legacy ``2k+2``-slack formula — and max-admission-rows at
+    equal HBM budget for a spec vs a plain engine (the no-admission-tax
+    acceptance criterion: spec ≥ plain). NEXT TO the
     measured CPU-functional numbers sits the v5e ROOFLINE column: the
     modelled speedup E[m]/(1 + k·c) for the paper's serving config
     (qwen2:1.5b int8 weights, ctx 512) with a ¼-depth self-draft
@@ -1177,15 +1181,25 @@ def spec_continuous_bench() -> int:
         )
         arms[str(rows)] = per_rows
 
-    # exact pool free-count restoration (slack pages included) after
-    # join + cancel + retire + close, on bf16 AND int8 paged pools
+    # exact pool free-count restoration after join + cancel + retire +
+    # close, on bf16 AND int8 paged pools — plus the ISSUE-10 paged-
+    # native billing A/B: pages-billed-per-spec-row native vs the
+    # retired legacy slack formula, and max-admission-rows at equal HBM
+    # budget spec vs plain (no spec admission tax)
     restoration = {}
+    paged_native = {}
+    page = 128
     for kv in (None, "int8"):
         eng = JaxEngine(
             registry=dict(registry), dtype=dtype, paged_kv=True,
             kv_quantize=kv,
             decode_attention="auto" if on_accelerator else None,
             speculative={"tiny-spec-target": ("tiny-spec-draft", spec_k)},
+        )
+        plain_paged = JaxEngine(
+            registry=dict(registry), dtype=dtype, paged_kv=True,
+            kv_quantize=kv,
+            decode_attention="auto" if on_accelerator else None,
         )
         # budgets sized so the anchor is STILL live across the join +
         # cancel (spec rounds advance ~k+1 tokens per step at full
@@ -1198,7 +1212,34 @@ def spec_continuous_bench() -> int:
             cfg.name, "victim", max_new_tokens=150, stop_at_eos=False, seed=3
         )
         sess = eng.decode_open([anchor], reserve_rows=4)
-        ok = sess.spec is not None and sess.spec_slack == 2 * spec_k + 2
+        ok = sess.spec is not None
+        # slack-free billing: the session's sizing rule bills a spec row
+        # EXACTLY the plain-decode page count
+        # the legacy column is the RETIRED rule: pre-ISSUE-10 spec rows
+        # were excluded from stacked mode and billed prompt + budget +
+        # 2k+2 slack through the table
+        s_probe, mnt_probe = 100, 150
+        native_pages = sess._pages_needed(s_probe, mnt_probe)
+        legacy_pages = -(-(s_probe + mnt_probe + 2 * spec_k + 2) // page)
+        plain_sess = plain_paged.decode_open([anchor], reserve_rows=2)
+        ok = ok and native_pages == plain_sess._pages_needed(
+            s_probe, mnt_probe
+        )
+        plain_sess.close()
+        admission_req = GenerationRequest(
+            cfg.name, "admission probe", max_new_tokens=mnt_probe,
+            stop_at_eos=False,
+        )
+        adm_spec = eng.max_admission_rows(admission_req)
+        adm_plain = plain_paged.max_admission_rows(admission_req)
+        paged_native["bf16" if kv is None else "int8"] = {
+            "pages_per_spec_row_native": int(native_pages),
+            "pages_per_spec_row_legacy_formula": int(legacy_pages),
+            "verify_mode": sess._verify_mode(),
+            "max_admission_rows_spec": int(adm_spec),
+            "max_admission_rows_plain": int(adm_plain),
+            "no_spec_admission_tax": bool(adm_spec >= adm_plain),
+        }
         free0 = sess.pool.free_pages
         sess.step(2)
         sess.join(victim)
@@ -1253,6 +1294,7 @@ def spec_continuous_bench() -> int:
         "k": spec_k,
         "arms_by_rows": arms,
         "pool_restoration_exact": restoration,
+        "paged_native_billing": paged_native,
         "roofline_v5e": roofline,
         "note": (
             "CPU-functional figures measure the MECHANICS (per-row "

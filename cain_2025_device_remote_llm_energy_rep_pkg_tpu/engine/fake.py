@@ -212,6 +212,7 @@ class _FakeStepSession:
                         {
                             "spec_rounds": row["spec_rounds"],
                             "spec_accepted": row["spec_accepted"],
+                            "verify_mode": "native",
                         }
                         if self.spec_k > 0
                         else {}
@@ -231,6 +232,10 @@ class _FakeStepSession:
                 "fallback": self.spec_fallback,
                 "accept_floor": self.spec_accept_floor,
                 "acceptance_recent": self.spec_acceptance,
+                # the fake models the ISSUE-10 native verify: no slack
+                # billing, no scratch bytes to hold
+                "verify_mode": "native",
+                "scratch_bytes": 0,
             }
         return state
 
@@ -257,13 +262,18 @@ class _FakeStepSession:
                 row["spec_accepted"] += accepted
                 row["spec_drafted"] += drafted
             try:
-                from ..obs.metrics import observe_spec
+                from ..obs.metrics import SPEC_VERIFY_NATIVE_C, observe_spec
 
                 observe_spec(
                     max_steps,
                     accepted * len(self._rows),
                     drafted * len(self._rows),
                 )
+                # the fake simulates the ISSUE-10 native verify (its
+                # rows bill no slack anywhere), so the migration
+                # counter moves in hermetic CI exactly like a real
+                # paged session's
+                SPEC_VERIFY_NATIVE_C.inc(max_steps)
             except Exception:  # noqa: BLE001 — telemetry only
                 pass
             floor = self.spec_accept_floor

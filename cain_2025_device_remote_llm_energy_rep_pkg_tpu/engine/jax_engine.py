@@ -2503,6 +2503,7 @@ class JaxEngine(GenerationBackend):
         n_steps: int,
         paged: bool,
         quantized: bool,
+        stacked: bool = False,
         carry=None,
     ) -> Callable:
         """Speculative twin of the stepped decode fns (ISSUE 9): per
@@ -2514,9 +2515,20 @@ class JaxEngine(GenerationBackend):
         ``(target, draft)`` pair so the carry keeps the donated slot 1,
         and the jit rides the same hook chain as the plain twins —
         explicit shardings + donation on the TP engine, with the draft
-        cache leaves sharded by the DRAFT model's own head count."""
+        cache leaves sharded by the DRAFT model's own head count.
+
+        Paged sessions verify NATIVELY (ISSUE 10): ``stacked=True``
+        routes the verify's [B,k+1,Hq,D] query block through the
+        MULTI-QUERY paged parts kernel (the same ``decode_attention``
+        wrapper the plain stacked twin uses — it dispatches on query
+        rank) with candidates in the side caches; ``stacked=False``
+        (kernel-less fallback) verifies against the gathered pool with
+        candidates in the scratch carry leaves and commits the block
+        through the table after acceptance. Either way no slack pages
+        exist to bill."""
         key = (
-            "spec-step", model, draft_model, k, n_steps, paged, quantized,
+            "spec-step", model, draft_model, k, n_steps, paged,
+            quantized, stacked,
         )
         if key in self._decode_cache:
             return self._decode_cache[key]
@@ -2527,10 +2539,14 @@ class JaxEngine(GenerationBackend):
 
         fn = build_spec_step_fn(
             tcfg, dcfg, k, n_steps, eos, paged, quantized,
+            stacked=stacked,
             # the DRAFT cache is an unquantized contiguous batch cache:
             # the raw injected kernel applies (never the int8 wrapper —
             # that keys on the TARGET's cache representation)
             draft_decode_attention=self.decode_attention,
+            decode_attention=(
+                self._paged_decode_attention(tcfg) if stacked else None
+            ),
         )
         decode = self._stepped_jit(tcfg, carry, fn, draft_cfg=dcfg)
         self._decode_cache[key] = decode
@@ -2584,6 +2600,8 @@ class JaxEngine(GenerationBackend):
             return None
         from ..ops.pallas_paged_attention import (
             pallas_paged_decode_attention,
+            pallas_paged_decode_attention_mq_parts,
+            pallas_paged_decode_attention_mq_parts_int8,
             pallas_paged_decode_attention_parts,
             pallas_paged_decode_attention_parts_int8,
             xla_paged_decode_attention_parts,
@@ -2595,6 +2613,27 @@ class JaxEngine(GenerationBackend):
             # parts impls have a quantized twin with the same (acc, m, l)
             # contract, so the width/Jmax policy below applies unchanged.
             quant = isinstance(kc["pool"], dict)
+            if q.ndim == 4:
+                # MULTI-QUERY verify block [B, k+1, Hq, D] (ISSUE 10):
+                # one kernel pass streams each row's prompt pages once
+                # for all candidate positions. ``offsets`` reconstruct
+                # the absolute position of query 0 from the stacked
+                # leaf's row vectors (the per-query causal cut is inert
+                # over prompt pages — every candidate sits past the
+                # prompt — but the kernel contract is the general one).
+                offsets = kc["write_pos"] + kc["prompt_lens"]
+                if quant:
+                    return pallas_paged_decode_attention_mq_parts_int8(
+                        q,
+                        kc["pool"]["q"], kc["pool"]["s"],
+                        vc["pool"]["q"], vc["pool"]["s"],
+                        kc["table"], lengths, offsets,
+                        layer=kc.get("layer"),
+                    )
+                return pallas_paged_decode_attention_mq_parts(
+                    q, kc["pool"], vc["pool"], kc["table"], lengths,
+                    offsets, layer=kc.get("layer"),
+                )
             if "side" in kc:  # stacked-hybrid mode: unnormalised parts
                 # for the caller's merge (transformer.py). TWO parts
                 # impls, picked by STATIC shapes: the gather+fused-XLA
@@ -3093,17 +3132,18 @@ class JaxEngine(GenerationBackend):
         )
         ids = self._tokenizer_for(model).encode(request.prompt)
         width = max(BATCH_BUCKETS)
-        # Speculative sessions (ISSUE 9) change the per-row bill: paged
-        # rows run the LEGACY pool-write mode (the verify block writes
-        # k+1 entries through the table) with 2k+2 slack token slots —
-        # the rounds-overshoot margin — so the estimator bills exactly
-        # what the session's _pages_needed will pin; contiguous rows
-        # carry the _spec_margin in their cache shape plus the draft's
-        # own (tiny, unquantized) batch cache.
+        # Speculative sessions (ISSUE 9/10): paged rows bill EXACTLY the
+        # plain-decode page count — the native verify keeps candidates
+        # in the side caches / scratch leaves, so there is no slack and
+        # no spec-specific paged arm here (the generic `_max_batch_rows`
+        # below prices spec and plain rows identically — the no-
+        # admission-tax point of ISSUE 10). Contiguous rows still carry
+        # the _spec_margin in their cache shape plus the draft's own
+        # (tiny, unquantized) batch cache.
         spec = (
             self._resolve_spec(model) if self._spec_eligible(request) else None
         )
-        if self.paged_kv and ids and (self.prefix_share or spec is not None):
+        if self.paged_kv and ids and self.prefix_share:
             # Shared-prefix billing (ISSUE 7): under prefix sharing a
             # fleet anchored by this request shares the prompt's full
             # page-aligned pages — the FIRST row pays them, every later
@@ -3113,19 +3153,13 @@ class JaxEngine(GenerationBackend):
             # (can_join/join_begin); this estimate just stops the row
             # cap from under-admitting the fleet the pool can hold.
             page = self.page_size
-            stacked = (
-                self._paged_decode_attention(cfg) is not None
-                and spec is None
-            )
-            slack = (2 * spec[1] + 2) if spec is not None else 0
+            stacked = self._paged_decode_attention(cfg) is not None
             need = (
                 -(-max(len(ids), 1) // page)
                 if stacked
-                else -(-(len(ids) + request.max_new_tokens + slack) // page)
+                else -(-(len(ids) + request.max_new_tokens) // page)
             )
-            shared = 0
-            if self.prefix_share:
-                shared = min((len(ids) - 1) // page, need - 1)
+            shared = min((len(ids) - 1) // page, need - 1)
             rows_pages = [need] + [need - shared] * (width - 1)
             g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
             return self._paged_rows_cap(cfg, rows_pages, g_bucket, stacked)

@@ -190,7 +190,9 @@ def build_spec_step_fn(
     eos: int,
     paged: bool,
     quantized: bool,
+    stacked: bool = False,
     draft_decode_attention=None,
+    decode_attention=None,
 ) -> Callable:
     """Build the BATCHED speculative slice step (see the module
     docstring). Stepped-decode contract::
@@ -204,10 +206,32 @@ def build_spec_step_fn(
     cumulative per-row counters ``spec_rounds``/``spec_accepted``/
     ``spec_drafted`` the session reads back for telemetry and the
     adaptive fallback policy. The target KV travels in the usual leaves
-    (``k_cache``/``v_cache``, or ``pool_k``/``pool_v``+``table`` in the
-    LEGACY paged mode — verify writes k+1 entries per row through the
-    page table, which is why speculative paged rows bill ``2k+2`` slack
-    token slots of extra pages).
+    (``k_cache``/``v_cache``, or ``pool_k``/``pool_v``+``table``+side/
+    scratch on paged sessions).
+
+    Paged sessions verify NATIVELY (ISSUE 10) — the pool stays
+    page-resident during verify, candidates never stream through the
+    page table eagerly, and no slack pages are billed:
+
+    - ``stacked=True`` (multi-query parts kernel present): the verify
+      forward writes the k+1 candidates into the SIDE caches at
+      ``write_pos..write_pos+k`` and reads the prompt pages through
+      ``decode_attention`` (the engine's paged wrapper, which dispatches
+      the [B,k+1,Hq,D] query block to the multi-query kernel). The side
+      cache doubles as the scratch: accepted candidates simply ARE the
+      row's generated-token columns, rejected tails are overwritten by
+      the next round's block. Nothing commits — the pool holds prompt
+      pages only, exactly like plain stacked decode.
+    - ``stacked=False`` (kernel-less fallback): candidates land in the
+      small ``scratch_k``/``scratch_v`` carry leaves ([L,B,Hkv,k+1,Dh],
+      head-sharded on a mesh) during the forward, and the round then
+      commits the whole block through the page table in one scatter —
+      positions past the row's billed pages clamp onto parking-table
+      entries no mask ever reads, so a row bills exactly the plain-
+      decode page count ``ceil((s_real + max_new_tokens)/page)``.
+      Rejected candidates' committed entries sit beyond the advanced
+      offset (never attended) and are overwritten by the next round's
+      commit, which always covers them.
 
     Per-round mechanics per live row (vectorized over B): k sequential
     draft steps + one cache-seating draft forward, ONE target forward
@@ -218,10 +242,10 @@ def build_spec_step_fn(
     along re-writing garbage at frozen positions that no mask ever
     attends (the padding-row convention of every batched loop here).
 
-    The verify forward runs the XLA-fused attention paths (no kernel:
-    the block-verify is multi-query, and the numerics caveat in the
-    module docstring applies — parity tests pin float32). Draft steps
-    may use ``draft_decode_attention`` (single-token, bf16 cache).
+    Contiguous verifies run the XLA-fused attention paths (the
+    block-verify is multi-query; the numerics caveat in the module
+    docstring applies — parity tests pin float32). Draft steps may use
+    ``draft_decode_attention`` (single-token, bf16 cache).
     """
     idx = jnp.arange(k + 1)
     out_w = n_steps * (k + 1)
@@ -230,22 +254,57 @@ def build_spec_step_fn(
         tparams, dparams = params
         b = carry["tokens"].shape[0]
         rows = jnp.arange(b)
-        if paged:
+        scr_k0 = scr_v0 = jnp.int32(0)  # non-scratch modes: inert slots
+        if paged and stacked:
+            table = carry["table"]
+            plens = carry["prompt_lens"]
+            pool_k, pool_v = carry["pool_k"], carry["pool_v"]
+            tk0, tv0 = carry["side_k"], carry["side_v"]
+        elif paged:
             table = carry["table"]
             codes = carry["pool_k"]["q"] if quantized else carry["pool_k"]
             table_c = jnp.broadcast_to(table, (codes.shape[0],) + table.shape)
             tk0, tv0 = carry["pool_k"], carry["pool_v"]
+            scr_k0, scr_v0 = carry["scratch_k"], carry["scratch_v"]
+            page_size = codes.shape[-2]
+            jmax = table.shape[1]
+
+            def commit(pool, scr, offs):
+                """Write the round's k+1 candidates through the page
+                table — scratch [L,B,Hkv,k+1,D] → pool at positions
+                ``offs[b]..offs[b]+k``. Table entries past a row's
+                billed pages hold the parking page and positions past
+                ``jmax·page`` clamp onto it: those writes target slots
+                no mask ever attends (pool reads stop strictly below
+                the row's offset), which is exactly what lets the slack
+                pages go."""
+                pos = offs[:, None] + idx[None, :]  # [B, k+1]
+                jp = jnp.clip(pos // page_size, 0, jmax - 1)
+                pages = jnp.take_along_axis(table, jp, axis=1)
+                slots = pos % page_size
+                if isinstance(pool, dict):  # int8: codes + scales
+                    return {
+                        "q": pool["q"].at[:, pages, :, slots].set(
+                            scr["q"].transpose(1, 3, 0, 2, 4)
+                        ),
+                        "s": pool["s"].at[:, pages, :, slots].set(
+                            scr["s"].transpose(1, 3, 0, 2)
+                        ),
+                    }
+                return pool.at[:, pages, :, slots].set(
+                    scr.transpose(1, 3, 0, 2, 4)
+                )
         else:
             tk0, tv0 = carry["k_cache"], carry["v_cache"]
 
         def cond(c):
-            done, i = c[7], c[8]
+            done, i = c[9], c[10]
             return (i < n_real) & ~jnp.all(done)
 
         def body(c):
             (
-                last, offs, doffs, tk, tv, dk, dv, done, i, out, n_row,
-                rem, rnds, acc, drafted,
+                last, offs, doffs, tk, tv, scr_k, scr_v, dk, dv, done, i,
+                out, n_row, rem, rnds, acc, drafted,
             ) = c
             live = ~done
 
@@ -273,16 +332,39 @@ def build_spec_step_fn(
             )
 
             # ONE target forward scores every row's k+1 candidate
-            # positions (per-row offsets; candidates written above ARE
-            # the causal context within the block)
+            # positions (per-row offsets; candidates written into the
+            # side/scratch/carry cache above ARE the causal context
+            # within the block)
             ver = jnp.concatenate([last[:, None], drafts], axis=1)
-            if paged:
-                kc = {"pool": tk, "table": table_c}
-                vc = {"pool": tv, "table": table_c}
+            if paged and stacked:
+                # NATIVE stacked verify (ISSUE 10): pool read-only
+                # through the multi-query parts kernel, candidates into
+                # the side caches at write_pos..write_pos+k
+                kc = {
+                    "pool": pool_k, "table": table, "side": tk,
+                    "write_pos": offs - plens, "prompt_lens": plens,
+                }
+                vc = {
+                    "pool": pool_v, "table": table, "side": tv,
+                    "write_pos": offs - plens, "prompt_lens": plens,
+                }
+                hidden, kc, vc = forward(
+                    tparams, tcfg, ver, offs, kc, vc,
+                    decode_attention, None,
+                )
+                tk, tv = kc["side"], vc["side"]
+            elif paged:
+                # NATIVE scratch verify: pool read-only for the
+                # forward, candidates in the scratch leaves; the commit
+                # below is the ONLY pool write of the round
+                kc = {"pool": tk, "table": table_c, "scratch": scr_k}
+                vc = {"pool": tv, "table": table_c, "scratch": scr_v}
                 hidden, kc, vc = forward(
                     tparams, tcfg, ver, offs, kc, vc, None, None
                 )
-                tk, tv = kc["pool"], vc["pool"]
+                scr_k, scr_v = kc["scratch"], vc["scratch"]
+                tk = commit(tk, scr_k, offs)
+                tv = commit(tv, scr_v, offs)
             else:
                 hidden, tk, tv = forward(
                     tparams, tcfg, ver, offs, tk, tv, None, None
@@ -346,8 +428,8 @@ def build_spec_step_fn(
             acc = acc + jnp.minimum(n_acc, m_eff)
             drafted = drafted + jnp.where(live, jnp.int32(k), 0)
             return (
-                last, offs, doffs, tk, tv, dk, dv, done, i + 1, out,
-                n_row, rem, rnds, acc, drafted,
+                last, offs, doffs, tk, tv, scr_k, scr_v, dk, dv, done,
+                i + 1, out, n_row, rem, rnds, acc, drafted,
             )
 
         out0 = jnp.full((b, out_w), jnp.int32(eos))
@@ -357,6 +439,8 @@ def build_spec_step_fn(
             carry["draft_offsets"],
             tk0,
             tv0,
+            scr_k0,
+            scr_v0,
             carry["draft_k"],
             carry["draft_v"],
             carry["done"],
@@ -369,14 +453,19 @@ def build_spec_step_fn(
             carry["spec_drafted"],
         )
         (
-            last, offs, doffs, tk, tv, dk, dv, done, _, out, n_row, rem,
-            rnds, acc, drafted,
+            last, offs, doffs, tk, tv, scr_k, scr_v, dk, dv, done, _,
+            out, n_row, rem, rnds, acc, drafted,
         ) = jax.lax.while_loop(cond, body, init)
-        threaded = (
-            {"pool_k": tk, "pool_v": tv}
-            if paged
-            else {"k_cache": tk, "v_cache": tv}
-        )
+        if paged and stacked:
+            # side caches threaded; the pool never changed hands
+            threaded = {"side_k": tk, "side_v": tv}
+        elif paged:
+            threaded = {
+                "pool_k": tk, "pool_v": tv,
+                "scratch_k": scr_k, "scratch_v": scr_v,
+            }
+        else:
+            threaded = {"k_cache": tk, "v_cache": tv}
         new_carry = dict(
             carry,
             tokens=last,

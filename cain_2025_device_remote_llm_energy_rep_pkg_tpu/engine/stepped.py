@@ -235,12 +235,14 @@ class SteppedDecodeSession:
         # Speculative draft-verify mode (ISSUE 9): `spec` is the ACTIVE
         # config ({draft, k, dcfg, floor}) or None; `spec_info` survives
         # an adaptive fallback so retiring rows still report their
-        # pre-fallback stats. `spec_slack` is the 2k+2 token slots of
-        # rounds-overshoot headroom paged rows bill as extra pages.
+        # pre-fallback stats. Paged spec rows verify NATIVELY (ISSUE
+        # 10): candidates live in the side caches / scratch leaves, the
+        # pool stays page-resident, and a row bills exactly the
+        # plain-decode page count — the former 2k+2 `spec_slack` page
+        # billing is gone.
         self.spec: Optional[Dict[str, Any]] = None
         self.spec_info: Optional[Dict[str, Any]] = None
         self.spec_fallback = False
-        self.spec_slack = 0
         self.spec_draft_len = 0
         self.spec_margin = 0
         # host-side cumulative per-slot spec counters (mirrors of the
@@ -312,9 +314,10 @@ class SteppedDecodeSession:
             max(r.max_new_tokens for r in requests), GEN_BUCKETS
         )
         self.slice_bucket = max(1, int(slice_steps or DECODE_SLICE_STEPS))
-        # Speculative mode probe BEFORE cache sizing: the target cache
-        # carries the rounds-overshoot margin and paged rows the slack
-        # pages only when the session will actually speculate.
+        # Speculative mode probe BEFORE cache sizing: the contiguous
+        # target cache carries the rounds-overshoot margin (and a
+        # stacked paged session its side-column overshoot) only when
+        # the session will actually speculate.
         self._init_spec(requests, all_ids, spec_accept_floor)
         # the engine's stepped-compute context covers every compile/run
         # in the open (TP: the int4 Pallas kernel has no GSPMD rule —
@@ -391,12 +394,6 @@ class SteppedDecodeSession:
         )
         if draft_len > dcfg.max_seq_len:
             return
-        slack = 2 * k + 2
-        if self.paged and any(
-            len(ids) + r.max_new_tokens + slack > self.cfg.max_seq_len
-            for r, ids in zip(requests, all_ids)
-        ):
-            return
         floor = (
             eng.spec_accept_floor
             if spec_accept_floor is None
@@ -404,7 +401,6 @@ class SteppedDecodeSession:
         )
         self.spec = {"draft": draft, "k": k, "dcfg": dcfg, "floor": floor}
         self.spec_info = {"draft_model": draft, "k": k}
-        self.spec_slack = slack
         self.spec_draft_len = draft_len
         self.spec_margin = margin
 
@@ -413,7 +409,6 @@ class SteppedDecodeSession:
         session never speculated, so no fallback event/counters."""
         self.spec = None
         self.spec_info = None
-        self.spec_slack = 0
         self.spec_margin = 0
         self.spec_draft_len = 0
 
@@ -577,16 +572,17 @@ class SteppedDecodeSession:
                     f"{r.max_new_tokens} exceeds max_seq_len "
                     f"{cfg.max_seq_len}"
                 )
-        # Speculative sessions run the LEGACY paged mode (pool-resident
-        # generated tokens): the stacked-hybrid parts kernel is
-        # single-query, and the verify block writes k+1 entries per row
-        # through the page table — the slack pages exist for exactly
-        # that. A multi-query paged kernel is the stacked×spec follow-on
-        # (docs/PERF.md).
-        self.stacked = (
-            eng._paged_decode_attention(cfg) is not None
-            and self.spec is None
-        )
+        # Stacked-hybrid mode follows kernel presence alone (ISSUE 10):
+        # the multi-query parts kernel scores a speculating row's k+1
+        # candidate positions in one page-streaming pass, so spec
+        # sessions ride the stacked layout like everyone else —
+        # candidates land in the side caches (sized with a k-column
+        # overshoot below), the pool stays prompt-only and page-resident
+        # during verify, and no slack pages exist. Kernel-less sessions
+        # verify against the gathered pool with candidates in the small
+        # scratch carry leaves, committed through the table only after
+        # acceptance.
+        self.stacked = eng._paged_decode_attention(cfg) is not None
         self.quantized = bool(eng.kv_quantize)
         self.page_size = page
         states = eng._batch_states(
@@ -662,9 +658,16 @@ class SteppedDecodeSession:
         # open() (_place_carry) — the pool/table join it below
         self.table = jnp.asarray(table_np)
         if self.stacked:
+            # a speculating session's verify writes candidates at
+            # write_pos..write_pos+k — up to k columns past the last
+            # budgeted token — so its side caches carry a k-column
+            # overshoot (bytes, not pages: the slack-free billing point)
+            side_cols = self.g_bucket + (
+                self.spec["k"] if self.spec is not None else 0
+            )
             side_shape = (
                 cfg.n_layers, self.b_bucket, cfg.n_kv_heads,
-                self.g_bucket, cfg.d_head,
+                side_cols, cfg.d_head,
             )
             if self.quantized:
                 side0 = {
@@ -691,6 +694,25 @@ class SteppedDecodeSession:
                 self._publish_prefix(
                     ids, st["k_cache"], st["v_cache"], row.pages
                 )
+        if self.spec is not None and not self.stacked:
+            # kernel-less native verify (ISSUE 10): the per-round
+            # candidate K/V live in these small scratch leaves — a mini
+            # contiguous cache [L, B, Hkv, k+1, Dh] so the TP payload
+            # sharding rule applies verbatim — and only the committed
+            # prefix reaches the pool, through one post-acceptance
+            # scatter per round
+            sshape = (
+                cfg.n_layers, self.b_bucket, cfg.n_kv_heads,
+                self.spec["k"] + 1, cfg.d_head,
+            )
+            for key in ("scratch_k", "scratch_v"):
+                if self.quantized:
+                    self.carry[key] = {
+                        "q": jnp.zeros(sshape, jnp.int8),
+                        "s": jnp.zeros(sshape[:-1], jnp.float32),
+                    }
+                else:
+                    self.carry[key] = jnp.zeros(sshape, dtype=eng.dtype)
         # pool payload enters the carry last (scatters above built it);
         # PagePool.k/v stay views of the same arrays (re-synced after
         # placement and after every slice)
@@ -700,15 +722,15 @@ class SteppedDecodeSession:
     def _pages_needed(self, s_real: int, max_new_tokens: int) -> int:
         """Pages one row pins: prompt-only in stacked mode (generated
         tokens live in the side caches), prompt + budget in legacy mode
-        — the monolithic paged path's sizing rule. Speculative sessions
-        additionally bill ``spec_slack`` (2k+2) token slots: a verify
-        round writes up to k entries past the row's accepted offset, so
-        a row at the edge of its budget still needs in-bounds pages for
-        the overshoot (the candidates a later round overwrites)."""
+        — the monolithic paged path's sizing rule, for plain AND
+        speculative rows alike (ISSUE 10): verify candidates live in
+        the side caches / scratch leaves, never in out-of-budget pool
+        slots, so the former 2k+2 slack page bill is gone — a spec row
+        costs exactly what its plain-decode twin costs."""
         page = self.page_size
         if self.stacked:
             return -(-max(s_real, 1) // page)
-        return -(-(s_real + max_new_tokens + self.spec_slack) // page)
+        return -(-(s_real + max_new_tokens) // page)
 
     # -- shared-prefix index (engine/prefix.py, ISSUE 7) -----------------------
     def _publish_prefix(
@@ -815,6 +837,7 @@ class SteppedDecodeSession:
                             "spec_accepted": int(
                                 self._spec_host["accepted"][r]
                             ),
+                            "verify_mode": self._verify_mode(),
                         }
                         if self.spec_info is not None and self._spec_host
                         else {}
@@ -843,6 +866,8 @@ class SteppedDecodeSession:
                 "draft_model": self.spec_info["draft_model"],
                 "k": self.spec_info["k"],
                 "fallback": self.spec_fallback,
+                "verify_mode": self._verify_mode(),
+                "scratch_bytes": self._spec_scratch_bytes(),
                 "accept_floor": (
                     self.spec["floor"] if self.spec is not None else None
                 ),
@@ -876,6 +901,49 @@ class SteppedDecodeSession:
             state["prefix"] = self.prefix.debug_state()
         return state
 
+    def _verify_mode(self) -> str:
+        """How this session's speculative verify touches the target KV
+        (ISSUE 10): ``native`` on paged sessions — candidates live in a
+        carry-side scratch (the side caches' overshoot columns in
+        stacked mode, the dedicated scratch leaves otherwise), the pool
+        stays page-resident and no slack pages are billed; ``legacy``
+        is the contiguous carry-resident verify (no pages exist to
+        bill, so nothing changed there)."""
+        return "native" if self.paged else "legacy"
+
+    def _spec_scratch_bytes(self) -> int:
+        """Bytes of carry-side verify scratch this session holds: the
+        dedicated ``scratch_k/v`` leaves (kernel-less native mode), or
+        the side caches' k overshoot columns (stacked native mode —
+        the candidates' landing strip past the generation budget).
+        Contiguous sessions report 0 (the verify writes land inside the
+        carry cache's existing margin)."""
+        total = 0
+        for key in ("scratch_k", "scratch_v"):
+            leaf = self.carry.get(key)
+            if leaf is None:
+                continue
+            parts = leaf.values() if isinstance(leaf, dict) else (leaf,)
+            total += sum(int(arr.nbytes) for arr in parts)
+        if (
+            total == 0
+            and self.paged
+            and self.spec is not None
+            and self.stacked
+        ):
+            k = self.spec["k"]
+            for key in ("side_k", "side_v"):
+                leaf = self.carry.get(key)
+                parts = (
+                    leaf.values() if isinstance(leaf, dict) else (leaf,)
+                )
+                for arr in parts:
+                    if getattr(arr, "ndim", 0) == 0:
+                        continue
+                    cols = arr.shape[3]  # [L,B,Hkv,Tgen(,D)]
+                    total += int(arr.nbytes) * k // max(cols, 1)
+        return total
+
     def _per_device_kv_bytes(self, pool_only: bool = False) -> int:
         """Bytes of KV payload ONE device holds under the carry's
         committed shardings (pool + side caches, or the contiguous batch
@@ -889,8 +957,9 @@ class SteppedDecodeSession:
             else ("k_cache", "v_cache")
         )
         if not pool_only:
-            # a speculating session's draft cache is KV payload too
-            keys = keys + ("draft_k", "draft_v")
+            # a speculating session's draft cache is KV payload too, as
+            # are the native verify's scratch leaves (ISSUE 10)
+            keys = keys + ("draft_k", "draft_v", "scratch_k", "scratch_v")
         total = 0
         for key in keys:
             leaf = self.carry.get(key)
@@ -933,7 +1002,9 @@ class SteppedDecodeSession:
                 decode = eng._spec_batch_decode_step_fn(
                     self.model, self.spec["draft"], self.spec["k"],
                     self.slice_bucket, self.paged,
-                    self.paged and self.quantized, carry=self.carry,
+                    self.paged and self.quantized,
+                    stacked=self.paged and self.stacked,
+                    carry=self.carry,
                 )
                 out, n_row, self.carry = decode(
                     (params, eng._models[self.spec["draft"]].params),
@@ -1032,6 +1103,12 @@ class SteppedDecodeSession:
                 from ..obs.metrics import observe_spec
 
                 observe_spec(slice_rounds, acc_delta, drafted_delta)
+                if self.paged:
+                    # paged rounds verify NATIVELY (ISSUE 10): the
+                    # counter makes the slack-free migration observable
+                    from ..obs.metrics import SPEC_VERIFY_NATIVE_C
+
+                    SPEC_VERIFY_NATIVE_C.inc(slice_rounds)
                 FLIGHT.emit(
                     EV_SPEC_ROUND,
                     model=self.model,
@@ -1080,6 +1157,7 @@ class SteppedDecodeSession:
         for key in (
             "draft_k", "draft_v", "draft_offsets",
             "spec_rounds", "spec_accepted", "spec_drafted",
+            "scratch_k", "scratch_v",
         ):
             self.carry.pop(key, None)
         floor = self.spec["floor"]
@@ -1253,7 +1331,7 @@ class SteppedDecodeSession:
         ids_len = len(ids)
         if ids_len == 0:
             return False  # would fail prefill; let the solo path 400 it
-        if ids_len + request.max_new_tokens + self.spec_slack > self.cfg.max_seq_len:
+        if ids_len + request.max_new_tokens > self.cfg.max_seq_len:
             return False
         if self.spec is not None:
             # A speculating session admits GREEDY joiners only (accepted

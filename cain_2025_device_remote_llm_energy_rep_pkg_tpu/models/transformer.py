@@ -78,12 +78,19 @@ def is_paged_cache(leaf: Any) -> bool:
     the tokens generated this call, and ``write_pos``/``prompt_lens``
     [B] row vectors. An optional ``layer`` index marks a whole stacked
     pool addressed inside the kernel's DMA offset (the non-default
-    variant, kept parity-tested)."""
+    variant, kept parity-tested). The SCRATCH variant ``{"pool",
+    "table", "scratch"}`` is the kernel-less speculative VERIFY form
+    (ISSUE 10): the pool is READ-ONLY for the forward, the block's
+    candidate K/V land in the small per-layer ``scratch`` [B,Hkv,S,D]
+    (or int8 ``{"q","s"}``) instead of being written through the page
+    table — the caller commits only what survives acceptance."""
     if not isinstance(leaf, dict):
         return False
     keys = set(leaf)
-    return keys == {"pool", "table"} or (
-        {"pool", "table", "side"} <= keys <= STACKED_PAGED_KEYS
+    return (
+        keys == {"pool", "table"}
+        or keys == {"pool", "table", "scratch"}
+        or ({"pool", "table", "side"} <= keys <= STACKED_PAGED_KEYS)
     )
 
 
@@ -279,15 +286,20 @@ def _attention_block(
     per_seq = jnp.ndim(offset) == 1  # batched decode: one offset per sequence
     # Multi-token blocks at per-row offsets are the speculative VERIFY
     # forward (one target pass scores a row's k+1 candidate positions —
-    # engine/speculative.py): supported on every decode-era cache layout
-    # except the stacked-hybrid paged mode, whose parts kernel is
-    # single-query (speculative paged sessions run the legacy pool-write
-    # mode instead).
-    if per_seq and s != 1 and paged_cache and "side" in k_cache:
+    # engine/speculative.py): supported on every decode-era cache
+    # layout. On paged caches the candidates stay OUT of the pool during
+    # verify (ISSUE 10): the stacked-hybrid mode writes them into its
+    # side caches (the multi-query parts kernel streams the prompt pages
+    # once for all k+1 positions), the kernel-less mode into the scratch
+    # leaf — the eager pool-write verify, whose out-of-budget candidate
+    # writes forced 2k+2 slack token slots of page billing, is deleted.
+    if per_seq and s != 1 and paged_cache and set(k_cache) == {
+        "pool", "table"
+    }:
         raise ValueError(
-            "stacked-hybrid paged caches support single-token decode only "
-            "(the parts kernel is single-query; speculative sessions use "
-            "the legacy paged mode)"
+            "paged multi-token verify rides the side caches (stacked-"
+            "hybrid, multi-query kernel) or the scratch leaf (kernel-"
+            "less) - the eager pool-write verify was removed (ISSUE 10)"
         )
     if carry_cache and not per_seq:
         raise ValueError(
@@ -340,35 +352,70 @@ def _attention_block(
             # engine's side caches are {"q","s"} dicts: the step's
             # vector quantizes with the decode-step scale math
             # (quantize_kv_vector) so generated tokens see the same
-            # quantization as the contiguous int8 path's.
+            # quantization as the contiguous int8 path's. S > 1 is the
+            # speculative VERIFY block (ISSUE 10): the k+1 candidates
+            # land at [row, :, wp+j] — the side cache doubles as the
+            # verify scratch, rejected tails are simply overwritten by
+            # the next round's block, and the POOL is never touched, so
+            # paged spec rows bill no slack pages.
             rows = jnp.arange(b)
             wp = k_cache["write_pos"]  # [B]
+            if s == 1:
+                row_idx, pos_idx = rows, wp  # [B] each — the hot path
+            else:
+                row_idx = rows[:, None]  # [B,1]
+                pos_idx = wp[:, None] + jnp.arange(s, dtype=jnp.int32)
 
-            def side_write(cache, vec):  # vec [B,Hkv,D]
+            def side_write(cache, vec):  # vec [B,Hkv,D] or [B,S,Hkv,D]
                 side = cache["side"]
                 sli = cache.get("side_layer")
                 if isinstance(side, dict):
                     q_, s_ = quantize_kv_vector(vec)
                     if sli is not None:
                         new = {
-                            "q": side["q"].at[sli, rows, :, wp].set(q_),
-                            "s": side["s"].at[sli, rows, :, wp].set(s_),
+                            "q": side["q"].at[sli, row_idx, :, pos_idx].set(q_),
+                            "s": side["s"].at[sli, row_idx, :, pos_idx].set(s_),
                         }
                     else:
                         new = {
-                            "q": side["q"].at[rows, :, wp].set(q_),
-                            "s": side["s"].at[rows, :, wp].set(s_),
+                            "q": side["q"].at[row_idx, :, pos_idx].set(q_),
+                            "s": side["s"].at[row_idx, :, pos_idx].set(s_),
                         }
                 elif sli is not None:
-                    new = side.at[sli, rows, :, wp].set(
+                    new = side.at[sli, row_idx, :, pos_idx].set(
                         vec.astype(side.dtype)
                     )
                 else:
-                    new = side.at[rows, :, wp].set(vec.astype(side.dtype))
+                    new = side.at[row_idx, :, pos_idx].set(
+                        vec.astype(side.dtype)
+                    )
                 return {**cache, "side": new}
 
-            k_cache = side_write(k_cache, k[:, 0])
-            v_cache = side_write(v_cache, v[:, 0])
+            k_cache = side_write(k_cache, k[:, 0] if s == 1 else k)
+            v_cache = side_write(v_cache, v[:, 0] if s == 1 else v)
+        elif "scratch" in k_cache:
+            # SCRATCH verify mode (kernel-less paged sessions, ISSUE
+            # 10): the block's candidate K/V replace the small per-layer
+            # scratch wholesale — [B,Hkv,S,D], a mini contiguous cache
+            # so the TP payload sharding rule applies verbatim. The pool
+            # is read-only here; engine/speculative.py commits the
+            # accepted prefix through the page table AFTER acceptance,
+            # with the identical quantization a plain decode step's
+            # pool write would apply (the codes below ARE what commit
+            # copies, so candidates attend to each other through the
+            # same quantized values the old eager write produced).
+            def scratch_write(cache, vec):  # vec [B,S,Hkv,D]
+                vt = vec.transpose(0, 2, 1, 3)  # [B,Hkv,S,D]
+                if isinstance(cache["scratch"], dict):
+                    q_, s_ = quantize_kv_vector(vt)
+                    return {**cache, "scratch": {"q": q_, "s": s_}}
+                return {
+                    **cache,
+                    "scratch": vt.astype(cache["scratch"].dtype),
+                }
+
+            k_cache = scratch_write(k_cache, k)
+            v_cache = scratch_write(v_cache, v)
         else:
             pool_k_leaf = k_cache["pool"]
             page_size = (
@@ -377,11 +424,12 @@ def _attention_block(
                 else pool_k_leaf
             ).shape[-2]
             off_b = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b,))
-            # Positions of this block's tokens: [B, S] (S == 1 for plain
-            # decode; S == k+1 for the speculative verify block). The
-            # page/slot arithmetic is page_slot's rule applied per
-            # position; a row's positions never collide (distinct slots)
-            # and rows own disjoint pages, so the one scatter is exact.
+            # Positions of this block's tokens: [B, S] (S == 1 always —
+            # multi-token blocks ride the side/scratch leaves above; the
+            # eager pool-write verify is gone, ISSUE 10). The page/slot
+            # arithmetic is page_slot's rule applied per position; a
+            # row's positions never collide (distinct slots) and rows
+            # own disjoint pages, so the one scatter is exact.
             pos = off_b[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
             pages = jnp.take_along_axis(
                 jnp.asarray(table, jnp.int32), pos // page_size, axis=-1
@@ -513,8 +561,7 @@ def _attention_block(
     else:
         k_att, v_att = k_cache, v_cache
     if (
-        s == 1
-        and decode_attention is not None
+        decode_attention is not None
         and paged_cache
         and "side" in k_cache
     ):
@@ -523,13 +570,15 @@ def _attention_block(
         # never changes during the loop); the generated tokens, including
         # this step's (written above), attend through the side cache with
         # XLA's fused path (measured best for batched decode, PERF.md);
-        # the two online-softmax parts merge exactly.
+        # the two online-softmax parts merge exactly. S > 1 is the
+        # speculative verify block: the engine's wrapper dispatches the
+        # [B,S,Hq,D] query to the MULTI-QUERY parts kernel (ISSUE 10) —
+        # one pass streams each row's pages once for all k+1 candidate
+        # positions — and the side merge applies the per-query causal
+        # cut ``tpos <= wp[b] + j`` (the candidates written above ARE
+        # their own in-block context).
         group = hq // hkv
-        acc1, m1, l1 = decode_attention(
-            q[:, 0], k_cache, v_cache, k_cache["prompt_lens"]
-        )
         wp = k_cache["write_pos"]
-        qg = q[:, 0].reshape(b, hkv, group, dh).astype(jnp.float32)
 
         def side_view(cache):  # → f32 [B,Hkv,Tgen,D]
             side = cache["side"]
@@ -549,28 +598,100 @@ def _attention_block(
 
         ks = side_view(k_cache)
         vs = side_view(v_cache)
-        s2 = jnp.einsum("bkgd,bktd->bkgt", qg, ks) * scale
         tpos = jnp.arange(ks.shape[2])
-        s2 = jnp.where(
-            (tpos[None, :] <= wp[:, None])[:, None, None, :], s2, -jnp.inf
-        )
-        m2 = jnp.max(s2, axis=-1)  # finite: the current token is col wp
-        p2 = jnp.exp(s2 - m2[..., None])
-        l2 = jnp.sum(p2, axis=-1)
-        acc2 = jnp.einsum("bkgt,bktd->bkgd", p2, vs)
+        if s == 1:
+            acc1, m1, l1 = decode_attention(
+                q[:, 0], k_cache, v_cache, k_cache["prompt_lens"]
+            )
+            qg = q[:, 0].reshape(b, hkv, group, dh).astype(jnp.float32)
+            s2 = jnp.einsum("bkgd,bktd->bkgt", qg, ks) * scale
+            s2 = jnp.where(
+                (tpos[None, :] <= wp[:, None])[:, None, None, :],
+                s2,
+                -jnp.inf,
+            )
+            m2 = jnp.max(s2, axis=-1)  # finite: the current token is col wp
+            p2 = jnp.exp(s2 - m2[..., None])
+            l2 = jnp.sum(p2, axis=-1)
+            acc2 = jnp.einsum("bkgt,bktd->bkgd", p2, vs)
+        else:
+            acc1, m1, l1 = decode_attention(
+                q, k_cache, v_cache, k_cache["prompt_lens"]
+            )  # [B,S,Hkv,G,D] / [B,S,Hkv,G] — per query position
+            acc1 = acc1.transpose(0, 2, 3, 1, 4)  # [B,Hkv,G,S,D]
+            m1 = m1.transpose(0, 2, 3, 1)  # [B,Hkv,G,S]
+            l1 = l1.transpose(0, 2, 3, 1)
+            qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)
+            s2 = jnp.einsum("bskgd,bktd->bkgst", qg, ks) * scale
+            vis = (
+                tpos[None, None, :]
+                <= (wp[:, None] + jnp.arange(s))[:, :, None]
+            )  # [B,S,Tgen]
+            s2 = jnp.where(vis[:, None, None], s2, -jnp.inf)
+            m2 = jnp.max(s2, axis=-1)  # [B,Hkv,G,S] — finite (col wp+j)
+            p2 = jnp.exp(s2 - m2[..., None])
+            l2 = jnp.sum(p2, axis=-1)
+            acc2 = jnp.einsum("bkgst,bktd->bkgsd", p2, vs)
         m_t = jnp.maximum(m1, m2)
         w1 = jnp.exp(m1 - m_t)  # 0 for empty prompts (m1=-inf)
         w2 = jnp.exp(m2 - m_t)
         out = (acc1 * w1[..., None] + acc2 * w2[..., None]) / (
             l1 * w1 + l2 * w2
         )[..., None]
-        out = out.reshape(b, 1, hq, dh).astype(x.dtype)
+        if s == 1:
+            out = out.reshape(b, 1, hq, dh).astype(x.dtype)
+        else:  # [B,Hkv,G,S,D] → [B,S,Hq,D]
+            out = (
+                out.transpose(0, 3, 1, 2, 4)
+                .reshape(b, s, hq, dh)
+                .astype(x.dtype)
+            )
     elif s == 1 and decode_attention is not None:
         lengths = jnp.broadcast_to(offset + 1, (b,)).astype(jnp.int32)
         out = decode_attention(q[:, 0], k_att, v_att, lengths)  # [B,Hq,Dh]
         out = out[:, None]  # [B,1,Hq,Dh]
     elif s > 1 and prefill_attention is not None:
         out = prefill_attention(q, k_att, v_att, offset)  # [B,S,Hq,Dh]
+    elif paged_cache and "scratch" in k_cache:
+        # SCRATCH verify (kernel-less paged mode, ISSUE 10): the gather
+        # fallback materialises the pool's CACHED tokens only — columns
+        # past a row's offset were never written (candidates no longer
+        # stream through the table) — and the block's own candidates
+        # attend from the scratch at their absolute positions
+        # ``offset[b]+i``, visible to query j iff ``i <= j`` (a fixed
+        # lower-triangular block mask). Same math the eager-write verify
+        # computed, with the pool left untouched.
+        group = hq // hkv
+        qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)
+
+        def scratch_view(leaf):  # → f32 [B,Hkv,S,D]
+            scr = leaf["scratch"]
+            if isinstance(scr, dict):
+                return scr["q"].astype(jnp.float32) * scr["s"].astype(
+                    jnp.float32
+                )[..., None]
+            return scr.astype(jnp.float32)
+
+        kf = jnp.concatenate(
+            [_gather_paged(k_cache), scratch_view(k_cache)], axis=2
+        )
+        vf = jnp.concatenate(
+            [_gather_paged(v_cache), scratch_view(v_cache)], axis=2
+        )
+        scores = jnp.einsum("bskgd,bktd->bkgst", qg, kf) * scale
+        kpos = jnp.arange(t)
+        pool_vis = jnp.broadcast_to(
+            (kpos[None, :] < offset[:, None])[:, None, :], (b, s, t)
+        )
+        tri = jnp.broadcast_to(
+            jnp.tril(jnp.ones((s, s), dtype=bool))[None], (b, s, s)
+        )
+        mask = jnp.concatenate([pool_vis, tri], axis=2)  # [B,S,T+S]
+        scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bkgst,bktd->bskgd", probs, vf).reshape(
+            b, s, hq, dh
+        )
     else:
         group = hq // hkv
         qg = q.reshape(b, s, hkv, group, dh).astype(jnp.float32)

@@ -350,6 +350,13 @@ SPEC_FALLBACK_C = REGISTRY.counter(
     "Speculating sessions that fell back to plain decode because their "
     "rolling acceptance dropped below --spec-accept-floor",
 )
+SPEC_VERIFY_NATIVE_C = REGISTRY.counter(
+    "llm_spec_verify_native_total",
+    "Verify rounds run in the PAGE-RESIDENT native mode (ISSUE 10: "
+    "multi-query paged kernel / scratch commit — candidates never "
+    "stream through the page table and no slack pages are billed); "
+    "the migration-observability counter for CI smoke",
+)
 
 
 def observe_spec(rounds: float, accepted: float, drafted: float) -> None:

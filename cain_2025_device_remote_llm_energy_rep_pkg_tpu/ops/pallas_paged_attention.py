@@ -47,9 +47,24 @@ Two entry points share the accumulation body (``_accumulate_page``):
 - :func:`xla_paged_decode_attention_parts_int8` — the gather+fused-XLA
   sibling for wide batches with narrow tables, dequantizing only the
   gathered pages.
+- :func:`pallas_paged_decode_attention_mq_parts` /
+  :func:`pallas_paged_decode_attention_mq_parts_int8` — MULTI-QUERY
+  twins of the parts kernels (ISSUE 10): a ``[B, Q≤k+1, Hq, D]`` query
+  block — the k+1 candidate positions of a speculative verify round
+  (Leviathan et al. ICML 2023) — streams each row's pages ONCE and
+  accumulates an online-softmax ``(acc, m, l)`` triplet per query
+  position, applying the per-row per-query causal limit
+  ``kpos < min(lengths[b], offsets[b] + j + 1)``. The query positions
+  fold into the kernel's group dim (row ``r`` of the [Q·G, page] score
+  tile is query ``r // G``), so the grid, the page streaming and the
+  accumulation body are EXACTLY the single-query kernels' — at Q = 1
+  the kernels reduce to them bit-for-bit. Both take the per-layer-xs
+  and stacked-``layer`` pool forms and the same ``interpret=`` path, so
+  CPU CI pins parity without a chip.
 
 Parity is pinned against a gather-then-attend reference on scattered page
-permutations (tests/test_paged_attention.py, tests/test_paged_int8.py).
+permutations (tests/test_paged_attention.py, tests/test_paged_int8.py,
+tests/test_paged_mq.py).
 """
 
 from __future__ import annotations
@@ -67,9 +82,12 @@ from jax.experimental.pallas import tpu as pltpu
 def _accumulate_page(
     q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, block_start, length, scale
 ):
-    """One page's online-softmax update — THE shared body of both
+    """One page's online-softmax update — THE shared body of the
     kernels. Reshape-based K/V reads serve the per-layer block
-    ([1,1,page,D]) and the stacked block ([1,1,1,page,Dp]) alike."""
+    ([1,1,page,D]) and the stacked block ([1,1,1,page,Dp]) alike.
+    ``length`` is a scalar visible-token count, or a per-score-row
+    [rows, 1] limit column (the multi-query kernels' per-query causal
+    cut — it broadcasts against the [rows, page] position index)."""
     q = q_ref[0, 0].astype(jnp.float32)  # [G,D]
     k = k_ref[...].reshape(k_ref.shape[-2:]).astype(jnp.float32)  # [page,D]
     s = (
@@ -205,7 +223,8 @@ def _accumulate_page_int8(
     p·v dot — two [G,page] multiplies instead of a [page,D] dequant.
     Reshapes serve the per-layer ([1,1,page,Dp]) and stacked
     ([1,1,1,page,Dp]) blocks alike; scales ride a trailing singleton
-    lane dim (see the module docstring)."""
+    lane dim (see the module docstring). ``length`` may be a per-row
+    [rows, 1] limit column like :func:`_accumulate_page`'s."""
     q = q_ref[0, 0].astype(jnp.float32)  # [G,D]
     k = k_ref[...].reshape(k_ref.shape[-2:]).astype(jnp.float32)  # codes
     ks = ks_ref[...].reshape(ks_ref.shape[-2:])[:, 0].astype(jnp.float32)
@@ -283,6 +302,357 @@ def _paged_decode_parts_int8_kernel(
         acc_out_ref[0, 0] = acc_ref[...]
         m_out_ref[0, 0] = m_ref[...]
         l_out_ref[0, 0] = l_ref[...]
+
+
+def _mq_limit(q_rows: int, group: int, length, offset):
+    """Per-score-row visible-token limit of a multi-query block: row
+    ``r`` is query position ``r // group``, which sees cached tokens
+    ``kpos < length`` under the causal cut ``kpos <= offset + r//group``
+    — one [Q·G, 1] column the accumulation bodies broadcast against
+    their [Q·G, page] position index, turning the single-query kernels
+    multi-query without touching their math."""
+    qi = jax.lax.broadcasted_iota(jnp.int32, (q_rows, 1), 0) // group
+    return jnp.minimum(length, offset + qi + 1)
+
+
+def _paged_decode_mq_parts_kernel(
+    page_table_ref,
+    lengths_ref,
+    offsets_ref,  # SMEM [B] int32 — query position 0 of each row
+    _layer_ref,  # consumed by the index maps
+    q_ref,  # VMEM [1, 1, Q·G, Dp]
+    k_ref,  # VMEM [1, 1, (1,) page, Dp]
+    v_ref,
+    acc_out_ref,  # VMEM [1, 1, Q·G, Dp] f32
+    m_out_ref,  # VMEM [1, 1, Q·G, 128] f32
+    l_out_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    page: int,
+    n_pages_per_req: int,
+    scale: float,
+    group: int,
+):
+    """Multi-query twin of :func:`_paged_decode_parts_kernel`: the query
+    positions ride the group dim, so the page loop streams each row's
+    pages ONCE for all Q positions; only the mask column differs per
+    score row (``_mq_limit``). At Q = 1 the limit column collapses to
+    the scalar ``length`` and this IS the single-query kernel."""
+    b_i = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        _init_scratch(m_ref, l_ref, acc_ref)
+
+    length = lengths_ref[b_i]
+    limit = _mq_limit(m_ref.shape[0], group, length, offsets_ref[b_i])
+    block_start = j * page
+
+    @pl.when(block_start < length)
+    def _block():
+        _accumulate_page(
+            q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+            block_start, limit, scale,
+        )
+
+    @pl.when(j == n_pages_per_req - 1)
+    def _emit():
+        acc_out_ref[0, 0] = acc_ref[...]
+        m_out_ref[0, 0] = m_ref[...]
+        l_out_ref[0, 0] = l_ref[...]
+
+
+def _paged_decode_mq_parts_int8_kernel(
+    page_table_ref,
+    lengths_ref,
+    offsets_ref,
+    _layer_ref,
+    q_ref,
+    k_ref,  # int8 codes
+    ks_ref,  # f32 per-position K scales [..., page, 1]
+    v_ref,
+    vs_ref,
+    acc_out_ref,
+    m_out_ref,
+    l_out_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    page: int,
+    n_pages_per_req: int,
+    scale: float,
+    group: int,
+):
+    """Int8 multi-query twin: same per-row limit column, scales folded
+    into the softmax exactly as the single-query int8 kernel."""
+    b_i = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        _init_scratch(m_ref, l_ref, acc_ref)
+
+    length = lengths_ref[b_i]
+    limit = _mq_limit(m_ref.shape[0], group, length, offsets_ref[b_i])
+    block_start = j * page
+
+    @pl.when(block_start < length)
+    def _block():
+        _accumulate_page_int8(
+            q_ref, k_ref, ks_ref, v_ref, vs_ref, m_ref, l_ref, acc_ref,
+            block_start, limit, scale,
+        )
+
+    @pl.when(j == n_pages_per_req - 1)
+    def _emit():
+        acc_out_ref[0, 0] = acc_ref[...]
+        m_out_ref[0, 0] = m_ref[...]
+        l_out_ref[0, 0] = l_ref[...]
+
+
+def _mq_parts_call(
+    q,  # [B, Q, Hq, D]
+    pools,  # (k_pool, v_pool) or (k_pool, ks, v_pool, vs)
+    page_table,
+    lengths,
+    offsets,
+    *,
+    layer,
+    interpret,
+    int8: bool,
+):
+    """Shared pallas_call plumbing of the two multi-query entry points:
+    fold Q into the group dim, run the (B, Hkv, Jmax) grid, unfold the
+    outputs back to per-query-position triplets."""
+    b, qlen, hq, d = q.shape
+    stacked = layer is not None
+    codes = pools[0]
+    if stacked:
+        _, n_pool, hkv, page, dp = codes.shape
+    else:
+        n_pool, hkv, page, dp = codes.shape
+    if dp % 128:
+        raise ValueError(
+            f"pools must be pre-padded to a 128-multiple head "
+            f"dim, got {dp} (per-call padding would copy the pool)"
+        )
+    d_pad = dp - d
+    jmax = page_table.shape[1]
+    group = hq // hkv
+    qg = qlen * group
+    scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    # [B, Q, Hkv, G, D] → [B, Hkv, Q·G, D]: query positions become the
+    # slow half of the group dim (score row r ↔ query r // G)
+    qr = q.reshape(b, qlen, hkv, group, d).transpose(0, 2, 1, 3, 4)
+    qr = qr.reshape(b, hkv, qg, d)
+    if d_pad:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, 0), (0, d_pad)))
+    table = jnp.clip(page_table.astype(jnp.int32), 0, n_pool - 1)
+
+    base_kernel = functools.partial(
+        _paged_decode_mq_parts_int8_kernel if int8
+        else _paged_decode_mq_parts_kernel,
+        page=page,
+        n_pages_per_req=jmax,
+        scale=scale,
+        group=group,
+    )
+
+    if stacked:
+        kernel = base_kernel
+        num_prefetch = 4
+        prefetch_args = (
+            table,
+            lengths.astype(jnp.int32),
+            offsets.astype(jnp.int32),
+            jnp.reshape(layer, (1,)).astype(jnp.int32),
+        )
+
+        def q_index(b_i, h, j, tab, lens, offs, lay):
+            return (b_i, h, 0, 0)
+
+        def kv_index(b_i, h, j, tab, lens, offs, lay):
+            return (
+                lay[0],
+                tab[b_i, _last_valid_page(j, b_i, lens, page)],
+                h,
+                0,
+                0,
+            )
+
+        kv_block = (1, 1, 1, page, dp)
+        scale_block = (1, 1, 1, page, 1)
+    else:
+        def kernel(table_ref, lengths_ref, offsets_ref, *rest):
+            return base_kernel(table_ref, lengths_ref, offsets_ref, None, *rest)
+
+        num_prefetch = 3
+        prefetch_args = (
+            table,
+            lengths.astype(jnp.int32),
+            offsets.astype(jnp.int32),
+        )
+
+        def q_index(b_i, h, j, tab, lens, offs):
+            return (b_i, h, 0, 0)
+
+        def kv_index(b_i, h, j, tab, lens, offs):
+            return (tab[b_i, _last_valid_page(j, b_i, lens, page)], h, 0, 0)
+
+        kv_block = (1, 1, page, dp)
+        scale_block = (1, 1, page, 1)
+
+    if int8:
+        k_pool, ks, v_pool, vs = pools
+        in_specs = [
+            pl.BlockSpec((1, 1, qg, dp), q_index),
+            pl.BlockSpec(kv_block, kv_index),
+            pl.BlockSpec(scale_block, kv_index),
+            pl.BlockSpec(kv_block, kv_index),
+            pl.BlockSpec(scale_block, kv_index),
+        ]
+        operands = (qr, k_pool, ks, v_pool, vs)
+    else:
+        k_pool, v_pool = pools
+        in_specs = [
+            pl.BlockSpec((1, 1, qg, dp), q_index),
+            pl.BlockSpec(kv_block, kv_index),
+            pl.BlockSpec(kv_block, kv_index),
+        ]
+        operands = (qr, k_pool, v_pool)
+
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=num_prefetch,
+            grid=(b, hkv, jmax),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, qg, dp), q_index),
+                pl.BlockSpec((1, 1, qg, 128), q_index),
+                pl.BlockSpec((1, 1, qg, 128), q_index),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((qg, 128), jnp.float32),
+                pltpu.VMEM((qg, 128), jnp.float32),
+                pltpu.VMEM((qg, dp), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, qg, dp), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, qg, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, qg, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*prefetch_args, *operands)
+    if d_pad:
+        acc = acc[..., :d]
+    # [B, Hkv, Q·G, …] → per-query-position [B, Q, Hkv, G, …]
+    acc = acc.reshape(b, hkv, qlen, group, d).transpose(0, 2, 1, 3, 4)
+    m = m[..., 0].reshape(b, hkv, qlen, group).transpose(0, 2, 1, 3)
+    l = l[..., 0].reshape(b, hkv, qlen, group).transpose(0, 2, 1, 3)
+    return acc, m, l
+
+
+def pallas_paged_decode_attention_mq_parts(
+    q: jnp.ndarray,  # [B, Q, Hq, D] — Q candidate positions per row
+    k_pool: jnp.ndarray,  # [P, Hkv, page, Dp] — or [L, P, ...] with layer
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, Jmax] int32
+    lengths: jnp.ndarray,  # [B] int32 — CACHED tokens (candidates excluded)
+    offsets: jnp.ndarray,  # [B] int32 — absolute position of query 0
+    *,
+    layer: Optional[jnp.ndarray] = None,  # scalar int32: stacked pools
+    interpret: Optional[bool] = None,
+) -> "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]":
+    """Multi-query unnormalised flash-decode parts over the cached
+    tokens of a pool — the speculative-verify twin of
+    :func:`pallas_paged_decode_attention_parts` (ISSUE 10): one pass
+    streams each row's pages once for all ``Q ≤ k+1`` candidate
+    positions and returns ``(acc [B,Q,Hkv,G,D] f32, m [B,Q,Hkv,G], l
+    [B,Q,Hkv,G])``, each query position masked by the per-row causal
+    cut ``kpos < min(lengths[b], offsets[b] + j + 1)``. The caller
+    merges the candidates' own K/V (side cache / scratch — they never
+    touch the pool during verify) through the standard online-softmax
+    part merge. Same per-layer-xs vs stacked-``layer`` duality and
+    pre-padded-Dp requirement as the single-query parts kernel; at
+    Q = 1 the two are identical."""
+    return _mq_parts_call(
+        q, (k_pool, v_pool), page_table, lengths, offsets,
+        layer=layer, interpret=interpret, int8=False,
+    )
+
+
+def pallas_paged_decode_attention_mq_parts_int8(
+    q: jnp.ndarray,  # [B, Q, Hq, D]
+    k_pool: jnp.ndarray,  # int8 codes [P, Hkv, page, Dp] — or [L, P, ...]
+    k_scale: jnp.ndarray,  # f32 [P, Hkv, page] — or [L, P, Hkv, page]
+    v_pool: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, Jmax] int32
+    lengths: jnp.ndarray,  # [B] int32
+    offsets: jnp.ndarray,  # [B] int32
+    *,
+    layer: Optional[jnp.ndarray] = None,
+    interpret: Optional[bool] = None,
+) -> "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]":
+    """Multi-query int8 parts — the quantized twin of
+    :func:`pallas_paged_decode_attention_mq_parts`, math-identical to
+    running it on the dequantized pool (K's per-position scale
+    multiplies its score column, V's folds into the probability row —
+    the single-query int8 kernel's trick, unchanged). Scales ship with
+    the trailing singleton lane dim for the same Mosaic tiling reason."""
+    ks = k_scale.astype(jnp.float32)[..., None]
+    vs = v_scale.astype(jnp.float32)[..., None]
+    return _mq_parts_call(
+        q, (k_pool, ks, v_pool, vs), page_table, lengths, offsets,
+        layer=layer, interpret=interpret, int8=True,
+    )
+
+
+def paged_mq_attention_reference(
+    q: jnp.ndarray,  # [B, Q, Hq, D]
+    k_pool: jnp.ndarray,  # [P, Hkv, page, D] (bf16/f32 — dequantized)
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    offsets: jnp.ndarray,
+) -> "tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]":
+    """jnp reference for the multi-query parts contract: gather the
+    pages, dense per-query-masked score/softmax parts — used only to
+    pin the MQ kernels' numerics (tests/test_paged_mq.py)."""
+    b, qlen, hq, d = q.shape
+    _, hkv, page, _ = k_pool.shape
+    jmax = page_table.shape[1]
+    t = jmax * page
+    group = hq // hkv
+    table = jnp.clip(page_table.astype(jnp.int32), 0, k_pool.shape[0] - 1)
+    kf = k_pool[table].transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, d)
+    vf = v_pool[table].transpose(0, 2, 1, 3, 4).reshape(b, hkv, t, d)
+    qg = q.reshape(b, qlen, hkv, group, d).astype(jnp.float32)
+    scores = jnp.einsum(
+        "bskgd,bktd->bskgt", qg, kf.astype(jnp.float32)
+    ) / math.sqrt(d)
+    kpos = jnp.arange(t)
+    limit = jnp.minimum(
+        lengths[:, None],
+        offsets[:, None] + jnp.arange(qlen)[None, :] + 1,
+    )  # [B, Q]
+    mask = kpos[None, None, :] < limit[..., None]  # [B, Q, T]
+    scores = jnp.where(mask[:, :, None, None], scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bskgt,bktd->bskgd", p, vf.astype(jnp.float32))
+    return acc, m, l
 
 
 def pallas_paged_decode_attention(

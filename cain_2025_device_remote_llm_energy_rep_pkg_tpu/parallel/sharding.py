@@ -148,8 +148,12 @@ def stepped_carry_shardings(
       ``tp`` (the ONE divisibility rule, ``cache_spec``): the contiguous
       batch cache ``k_cache``/``v_cache`` [L,B,Hkv,T,Dh], the page pool
       ``pool_k``/``pool_v`` [L,P,Hkv,page,D] (pages sit in the
-      batch-like position), and the stacked side caches
-      ``side_k``/``side_v`` [L,B,Hkv,Tgen,D]. Int8 ``{"q","s"}`` leaves
+      batch-like position), the stacked side caches
+      ``side_k``/``side_v`` [L,B,Hkv,Tgen,D], and a kernel-less
+      speculative session's native-verify scratch
+      ``scratch_k``/``scratch_v`` [L,B,Hkv,k+1,Dh] (ISSUE 10 — a mini
+      contiguous cache holding one round's candidate K/V, so the same
+      head rule applies verbatim). Int8 ``{"q","s"}`` leaves
       place codes with the payload spec and the per-position scales with
       the head-reduced spec (``quant_cache_shardings`` applied
       leaf-wise).
@@ -175,7 +179,10 @@ def stepped_carry_shardings(
     payload = NamedSharding(mesh, spec)
     scale = NamedSharding(mesh, P(*tuple(spec)[:-1]))
     repl = NamedSharding(mesh, P())
-    payload_keys = ("k_cache", "v_cache", "pool_k", "pool_v", "side_k", "side_v")
+    payload_keys = (
+        "k_cache", "v_cache", "pool_k", "pool_v",
+        "side_k", "side_v", "scratch_k", "scratch_v",
+    )
     draft_payload = NamedSharding(
         mesh, cache_spec(draft_cfg if draft_cfg is not None else cfg, mesh)
     )

@@ -220,6 +220,8 @@ class TensorParallelEngine(JaxEngine):
         from .compat import shard_map
 
         from ..ops.pallas_paged_attention import (
+            pallas_paged_decode_attention_mq_parts,
+            pallas_paged_decode_attention_mq_parts_int8,
             pallas_paged_decode_attention_parts,
             pallas_paged_decode_attention_parts_int8,
         )
@@ -230,6 +232,13 @@ class TensorParallelEngine(JaxEngine):
         scale_spec = P(None, "tp", None)  # [P, Hkv, page]
         acc_spec = P(None, "tp", None, None)  # [B, Hkv, G, D]
         ml_spec = P(None, "tp", None)  # [B, Hkv, G]
+        # multi-query verify block (ISSUE 10): query positions ride a
+        # second batch-like dim, heads still the only sharded axis —
+        # the kernel stays head-independent, so the same shard_map
+        # recipe applies with one more replicated leading dim
+        mq_q_spec = P(None, None, "tp", None)  # [B, Q, Hq, D]
+        mq_acc_spec = P(None, None, "tp", None, None)  # [B, Q, Hkv, G, D]
+        mq_ml_spec = P(None, None, "tp", None)  # [B, Q, Hkv, G]
 
         def decode_attention(q, kc, vc, lengths):
             if "side" not in kc or kc.get("layer") is not None:
@@ -240,6 +249,44 @@ class TensorParallelEngine(JaxEngine):
                     "TP paged rule covers the per-layer stacked parts "
                     "path only"
                 )
+            if q.ndim == 4:
+                offsets = kc["write_pos"] + kc["prompt_lens"]
+                if isinstance(kc["pool"], dict):
+                    def inner_mq_int8(q_, kq_, ks_, vq_, vs_, t_, l_, o_):
+                        return pallas_paged_decode_attention_mq_parts_int8(
+                            q_, kq_, ks_, vq_, vs_, t_, l_, o_
+                        )
+
+                    return shard_map(
+                        inner_mq_int8,
+                        mesh=mesh,
+                        in_specs=(
+                            mq_q_spec, pool_spec, scale_spec,
+                            pool_spec, scale_spec, P(), P(), P(),
+                        ),
+                        out_specs=(mq_acc_spec, mq_ml_spec, mq_ml_spec),
+                        check_vma=False,
+                    )(
+                        q,
+                        kc["pool"]["q"], kc["pool"]["s"],
+                        vc["pool"]["q"], vc["pool"]["s"],
+                        kc["table"], lengths, offsets,
+                    )
+
+                def inner_mq(q_, k_, v_, t_, l_, o_):
+                    return pallas_paged_decode_attention_mq_parts(
+                        q_, k_, v_, t_, l_, o_
+                    )
+
+                return shard_map(
+                    inner_mq,
+                    mesh=mesh,
+                    in_specs=(
+                        mq_q_spec, pool_spec, pool_spec, P(), P(), P(),
+                    ),
+                    out_specs=(mq_acc_spec, mq_ml_spec, mq_ml_spec),
+                    check_vma=False,
+                )(q, kc["pool"], vc["pool"], kc["table"], lengths, offsets)
             if isinstance(kc["pool"], dict):
                 # int8 pool: codes shard like the pool, the per-position
                 # scales like the head-reduced pool_scale placement —

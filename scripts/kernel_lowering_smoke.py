@@ -40,6 +40,8 @@ def main() -> int:
     )
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_paged_attention import (
         pallas_paged_decode_attention,
+        pallas_paged_decode_attention_mq_parts,
+        pallas_paged_decode_attention_mq_parts_int8,
         pallas_paged_decode_attention_parts,
         pallas_paged_decode_attention_parts_int8,
         xla_paged_decode_attention_parts,
@@ -135,6 +137,50 @@ def main() -> int:
                 plens=plens:
                 xla_paged_decode_attention_parts_int8(
                     q, pool8, pscale, pool8, pscale, table, plens
+                ),
+            ))
+            # multi-query verify kernels (ISSUE 10): the k+1-position
+            # query block of the native paged speculative verify, at a
+            # serving-realistic k=4 — bf16 + int8, per-layer + stacked.
+            # Same chip-pending discipline as the PR-1 paged-int8
+            # shapes: interpret-mode CI pins numerics, THIS run pins
+            # Mosaic lowering.
+            qmq = jnp.zeros((b, 5, hq, d), bf16)
+            offs = jnp.full((b,), 130, i32)
+            cases.append((
+                f"paged-mq-parts b={b} q=5 {hq}/{hkv}/{d}",
+                lambda qmq=qmq, pool=pool, table=table, plens=plens,
+                offs=offs:
+                pallas_paged_decode_attention_mq_parts(
+                    qmq, pool, pool, table, plens, offs
+                ),
+            ))
+            cases.append((
+                f"paged-mq-parts-int8 b={b} q=5 {hq}/{hkv}/{d}",
+                lambda qmq=qmq, pool8=pool8, pscale=pscale, table=table,
+                plens=plens, offs=offs:
+                pallas_paged_decode_attention_mq_parts_int8(
+                    qmq, pool8, pscale, pool8, pscale, table, plens,
+                    offs,
+                ),
+            ))
+            pool_l = jnp.zeros((2, 8, hkv, 128, dp), bf16)
+            cases.append((
+                f"paged-mq-parts-stacked b={b} q=5 {hq}/{hkv}/{d}",
+                lambda qmq=qmq, pool_l=pool_l, table=table, plens=plens,
+                offs=offs:
+                pallas_paged_decode_attention_mq_parts(
+                    qmq, pool_l, pool_l, table, plens, offs,
+                    layer=jnp.int32(1),
+                ),
+            ))
+            cases.append((
+                f"paged-mq-parts-int8-stacked b={b} q=5 {hq}/{hkv}/{d}",
+                lambda qmq=qmq, pool8_l=pool8_l, pscale_l=pscale_l,
+                table=table, plens=plens, offs=offs:
+                pallas_paged_decode_attention_mq_parts_int8(
+                    qmq, pool8_l, pscale_l, pool8_l, pscale_l, table,
+                    plens, offs, layer=jnp.int32(1),
                 ),
             ))
     # prefill flash: [B,S] x cache

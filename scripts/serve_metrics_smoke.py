@@ -740,10 +740,18 @@ def main() -> int:
         assert drafted8 >= 4 * rounds8, (rounds8, drafted8)
         assert accepted8 == 3 * rounds8, (rounds8, accepted8)
         assert "llm_spec_acceptance_rate" in text8
+        # ISSUE 10: the native page-resident verify is the only verify
+        # mode left — its migration counter must move with the rounds
+        native8 = delta8(text8, "llm_spec_verify_native_total")
+        assert native8 >= rounds8, (
+            f"native verify counter lagged rounds: {native8} < {rounds8}"
+        )
         assert mid8.get("row", {}).get("spec_rounds", 0) > 0, (
             f"live session rows never showed spec fields: {mid8}"
         )
         assert mid8["spec"]["active"] and mid8["spec"]["k"] == 4, mid8
+        assert mid8["spec"].get("verify_mode") == "native", mid8
+        assert mid8.get("row", {}).get("verify_mode") == "native", mid8
     finally:
         server8.stop()
 

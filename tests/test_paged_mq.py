@@ -1,0 +1,151 @@
+"""Multi-query paged parts kernels (ISSUE 10).
+
+The acceptance matrix, pinned in interpret mode so CPU CI holds parity
+without a chip: per-layer / stacked-``layer`` pools × bf16 / int8 ×
+q ∈ {1, k+1}, against the gather-then-attend multi-query reference
+(`paged_mq_attention_reference`) — and the q = 1 reduction, where the
+multi-query kernels must reproduce the existing single-query parts
+kernels bit-for-bit (same grid, same accumulation body, the limit
+column collapsing to the scalar length).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.ops.pallas_paged_attention import (
+    paged_mq_attention_reference,
+    pallas_paged_decode_attention_mq_parts,
+    pallas_paged_decode_attention_mq_parts_int8,
+    pallas_paged_decode_attention_parts,
+    pallas_paged_decode_attention_parts_int8,
+)
+
+B, HQ, HKV, D, PAGE, JMAX, POOL = 3, 8, 2, 128, 8, 4, 16
+
+
+def _setup(seed=0, dp=D):
+    q1 = jax.random.normal(jax.random.PRNGKey(seed), (B, 5, HQ, D))
+    kp = jax.random.normal(jax.random.PRNGKey(seed + 1), (POOL, HKV, PAGE, dp))
+    vp = jax.random.normal(jax.random.PRNGKey(seed + 2), (POOL, HKV, PAGE, dp))
+    # scattered page permutation — the indirection the kernels exist for
+    table = jax.random.permutation(jax.random.PRNGKey(seed + 3), jnp.arange(POOL))
+    table = table[: B * JMAX].reshape(B, JMAX)
+    lengths = jnp.asarray([5, 17, 30], jnp.int32)
+    # offsets straddle the cached lengths so the per-query causal cut
+    # actually bites (kpos <= offsets+j < lengths for some (b, j))
+    offsets = jnp.asarray([2, 17, 33], jnp.int32)
+    return q1, kp, vp, table, lengths, offsets
+
+
+def _quant(pool):
+    s = jnp.maximum(jnp.max(jnp.abs(pool), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(pool / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+@pytest.mark.parametrize("qlen", [1, 5])
+def test_mq_parts_matches_reference(qlen):
+    q, kp, vp, table, lengths, offsets = _setup()
+    acc, m, l = pallas_paged_decode_attention_mq_parts(
+        q[:, :qlen], kp, vp, table, lengths, offsets, interpret=True
+    )
+    ra, rm, rl = paged_mq_attention_reference(
+        q[:, :qlen], kp, vp, table, lengths, offsets
+    )
+    assert np.allclose(acc, ra, atol=1e-4)
+    assert np.allclose(m, rm, atol=1e-5)
+    assert np.allclose(l, rl, atol=1e-4)
+
+
+@pytest.mark.parametrize("qlen", [1, 5])
+def test_mq_parts_int8_matches_dequantized_reference(qlen):
+    q, kp, vp, table, lengths, offsets = _setup(seed=7)
+    kq, ks = _quant(kp)
+    vq, vs = _quant(vp)
+    acc, m, l = pallas_paged_decode_attention_mq_parts_int8(
+        q[:, :qlen], kq, ks, vq, vs, table, lengths, offsets,
+        interpret=True,
+    )
+    ra, rm, rl = paged_mq_attention_reference(
+        q[:, :qlen],
+        kq.astype(jnp.float32) * ks[..., None],
+        vq.astype(jnp.float32) * vs[..., None],
+        table, lengths, offsets,
+    )
+    assert np.allclose(acc, ra, atol=1e-3)
+    assert np.allclose(m, rm, atol=1e-4)
+    assert np.allclose(l, rl, atol=1e-3)
+
+
+def test_mq_q1_reduces_to_single_query_parts_kernel():
+    """The acceptance criterion directly: at q = 1 with the causal cut
+    past the cached length (the stacked-verify regime), the MQ kernel
+    IS the existing parts kernel."""
+    q, kp, vp, table, lengths, _ = _setup(seed=3)
+    off = lengths + 4  # every cached token visible — the q=1 decode mask
+    a1, m1, l1 = pallas_paged_decode_attention_mq_parts(
+        q[:, :1], kp, vp, table, lengths, off, interpret=True
+    )
+    a0, m0, l0 = pallas_paged_decode_attention_parts(
+        q[:, 0], kp, vp, table, lengths, interpret=True
+    )
+    assert np.array_equal(np.asarray(a1[:, 0]), np.asarray(a0))
+    assert np.array_equal(np.asarray(m1[:, 0]), np.asarray(m0))
+    assert np.array_equal(np.asarray(l1[:, 0]), np.asarray(l0))
+    kq, ks = _quant(kp)
+    vq, vs = _quant(vp)
+    a1, m1, l1 = pallas_paged_decode_attention_mq_parts_int8(
+        q[:, :1], kq, ks, vq, vs, table, lengths, off, interpret=True
+    )
+    a0, m0, l0 = pallas_paged_decode_attention_parts_int8(
+        q[:, 0], kq, ks, vq, vs, table, lengths, interpret=True
+    )
+    assert np.array_equal(np.asarray(a1[:, 0]), np.asarray(a0))
+    assert np.array_equal(np.asarray(m1[:, 0]), np.asarray(m0))
+    assert np.array_equal(np.asarray(l1[:, 0]), np.asarray(l0))
+
+
+@pytest.mark.parametrize("int8", [False, True])
+def test_mq_stacked_layer_form_matches_per_layer(int8):
+    """The whole-stacked-pool ``layer=`` flavor folds the layer into
+    the DMA offset — same parts as slicing the layer out first."""
+    L = 3
+    q, _, _, table, lengths, offsets = _setup(seed=5)
+    kp = jax.random.normal(jax.random.PRNGKey(11), (L, POOL, HKV, PAGE, D))
+    vp = jax.random.normal(jax.random.PRNGKey(12), (L, POOL, HKV, PAGE, D))
+    for layer in (0, 2):
+        if int8:
+            kq, ks = _quant(kp)
+            vq, vs = _quant(vp)
+            a_st, m_st, l_st = pallas_paged_decode_attention_mq_parts_int8(
+                q, kq, ks, vq, vs, table, lengths, offsets,
+                layer=jnp.int32(layer), interpret=True,
+            )
+            a_pl, m_pl, l_pl = pallas_paged_decode_attention_mq_parts_int8(
+                q, kq[layer], ks[layer], vq[layer], vs[layer],
+                table, lengths, offsets, interpret=True,
+            )
+        else:
+            a_st, m_st, l_st = pallas_paged_decode_attention_mq_parts(
+                q, kp, vp, table, lengths, offsets,
+                layer=jnp.int32(layer), interpret=True,
+            )
+            a_pl, m_pl, l_pl = pallas_paged_decode_attention_mq_parts(
+                q, kp[layer], vp[layer], table, lengths, offsets,
+                interpret=True,
+            )
+        assert np.array_equal(np.asarray(a_st), np.asarray(a_pl))
+        assert np.array_equal(np.asarray(m_st), np.asarray(m_pl))
+        assert np.array_equal(np.asarray(l_st), np.asarray(l_pl))
+
+
+def test_mq_parts_rejects_unpadded_head_dim():
+    q, _, _, table, lengths, offsets = _setup()
+    pool = jnp.zeros((POOL, HKV, PAGE, 96))  # 96 % 128 != 0
+    with pytest.raises(ValueError, match="pre-padded"):
+        pallas_paged_decode_attention_mq_parts(
+            q[..., :96], pool, pool, table, lengths, offsets,
+            interpret=True,
+        )

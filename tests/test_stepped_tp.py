@@ -258,6 +258,13 @@ def test_tp_spec_session_parity_and_draft_sharding(registry, n_devices):
     )
     for key in ("draft_offsets", "spec_rounds", "spec_accepted"):
         assert sess.carry[key].sharding.spec == P(), key
+    # ISSUE 10: the kernel-less native verify's scratch leaves ride the
+    # SPMD carry as KV payload — [L,B,Hkv,k+1,Dh], heads over tp
+    assert not sess.stacked  # no kernel on the forced-host mesh
+    for key in ("scratch_k", "scratch_v"):
+        assert sess.carry[key].sharding.spec == P(
+            None, None, "tp", None, None
+        ), key
     before = {
         key: leaf.sharding.spec
         for key, leaf in sess.carry.items()
@@ -277,6 +284,44 @@ def test_tp_spec_session_parity_and_draft_sharding(registry, n_devices):
         assert results[id(req)].tokens == eng._generate_plain(req).tokens, (
             f"spec row diverged on tp={n_devices}"
         )
+        assert results[id(req)].extras["spec"]["rounds"] >= 1
+    sess.close()
+    assert sess.pool.free_pages == sess.pool.n_pages - 1
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_tp_spec_stacked_native_verify_on_mesh(registry, n_devices):
+    """ISSUE 10 × ISSUE 8: the STACKED native verify on a mesh — the
+    multi-query parts kernel runs under shard_map with heads sharded
+    and the verify's candidates in the head-sharded side caches; the
+    speculating session stays plain-greedy identical and bills
+    prompt-only pages. Kernels are enabled by patching the gate (the
+    forced-host mesh has no TPU), which leaves the draft's contiguous
+    decode kernel-free as production would."""
+    draft_cfg = dataclasses.replace(_tiny8(), n_layers=1)
+    reg = {"tiny": _tiny8(), "tiny-d": draft_cfg}
+    eng = _tp_engine(
+        reg, n_devices, paged_kv=True,
+        speculative={"tiny": ("tiny-d", 3)},
+    )
+    eng._specialised_kernels_enabled = lambda: True  # engage the wrapper
+    exp = _tp_engine(reg, n_devices, paged_kv=True)
+    anchor = GenerationRequest(
+        "tiny", "stacked mesh anchor", max_new_tokens=20,
+        stop_at_eos=False,
+    )
+    joiner = GenerationRequest(
+        "tiny", "stacked mesh joiner", max_new_tokens=8, seed=3
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    assert sess.spec is not None and sess.stacked
+    assert sess._pages_needed(100, 40) == -(-100 // 128)  # prompt-only
+    sess.step(2)
+    assert sess.can_join(joiner)
+    sess.join(joiner)
+    results = {id(r.request): r for r in _drain(sess)}
+    for req in (anchor, joiner):
+        assert results[id(req)].tokens == exp._generate_plain(req).tokens
         assert results[id(req)].extras["spec"]["rounds"] >= 1
     sess.close()
     assert sess.pool.free_pages == sess.pool.n_pages - 1
