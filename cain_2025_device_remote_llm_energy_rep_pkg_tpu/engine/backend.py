@@ -33,6 +33,14 @@ class GenerationRequest:
     # queued past the deadline rejects before admission, in-flight past
     # it retires the row (reason="deadline") and fails the caller.
     deadline_ms: Optional[float] = None
+    # SLO tier (wire: x_priority; serve --default-priority). Higher is
+    # more important. The scheduler queue is per-tier FIFO, and the
+    # continuous scheduler may PREEMPT a strictly-lower-tier in-flight
+    # row (pages swapped to host or dropped for recompute) to admit a
+    # higher-tier ticket under overload. The canonical named tiers are
+    # serve/protocol.PRIORITY_TIERS (low=0, normal=1, high=2); any
+    # non-negative integer is a valid tier.
+    priority: int = 1
 
     def __post_init__(self) -> None:
         # Degenerate knobs would silently corrupt sampling (top_p<=0 masks
@@ -59,6 +67,11 @@ class GenerationRequest:
         if self.deadline_ms is not None and self.deadline_ms <= 0:
             raise ValueError(
                 f"deadline_ms must be > 0, got {self.deadline_ms}"
+            )
+        if not isinstance(self.priority, int) or self.priority < 0:
+            raise ValueError(
+                f"priority must be a non-negative integer tier, "
+                f"got {self.priority!r}"
             )
 
 

@@ -34,6 +34,10 @@ FAKE_PREFIX_PAGE = 16
 class _FakeStepSession:
     """Stepped-decode session over precomputed deterministic streams."""
 
+    # bytes one simulated swapped token costs — keeps the fake's swap
+    # counters proportional to real KV so dashboards read sanely
+    SWAP_BYTES_PER_TOKEN = 1024
+
     def __init__(
         self,
         backend: "FakeBackend",
@@ -75,6 +79,11 @@ class _FakeStepSession:
         # streams + the count of shared pages live rows currently map
         self._prefix_pub: List[bytes] = []
         self._shared_live = 0
+        # preemption swap ledger — the fake twin of the stepped
+        # session's (ISSUE 11), so smoke/CI can assert the swap gauges
+        # rise and return exactly to zero with no accelerator
+        self._swap_bytes = 0
+        self._swap_rows = 0
         for r in requests:
             self._admit(r)
 
@@ -177,8 +186,110 @@ class _FakeStepSession:
         if pending["tokens_left"] > 0:
             raise RuntimeError("join not fully prefilled")
         self._pending.remove(pending)
+        pr = pending.get("resume")
+        if pr is not None:
+            # re-seat the preempted row exactly where it stopped: the
+            # cursor (and streamed watermark) carry over, so the final
+            # stream is identical to an uninterrupted run
+            row = pr["row"]
+            self._rows.append(row)
+            self._swap_settle(pr, transfer=True)
+            return len(self._rows) - 1
         self._admit(pending["request"])
         return len(self._rows) - 1
+
+    # -- mid-flight preemption (the stepped session's ISSUE-11 twin) -----------
+    def _swap_settle(self, pr: dict, transfer: bool) -> None:
+        """Settle one parked victim's swap ledger (idempotent): count
+        the host→device transfer when it actually resumed."""
+        if pr.get("discharged"):
+            return
+        pr["discharged"] = True
+        nbytes = pr.get("host_bytes", 0)
+        if not nbytes or self.closed:  # close() settled the ledger
+            return
+        from ..obs.metrics import observe_swap, swap_host_adjust
+
+        if transfer:
+            observe_swap("in", nbytes)
+        self._swap_bytes = max(0, self._swap_bytes - nbytes)
+        self._swap_rows = max(0, self._swap_rows - 1)
+        swap_host_adjust(-nbytes, rows=-1)
+        pr["host_bytes"] = 0
+
+    def preempt(self, request: GenerationRequest, policy: str = "swap"):
+        """Retire a live row NOW and capture what resume needs — the
+        fake twin of ``SteppedDecodeSession.preempt``. ``swap`` counts
+        simulated KV bytes out (restored at resume); ``recompute``
+        parks the row with its re-prefill cost charged at resume."""
+        for row in self._rows:
+            if row["request"] is request:
+                self._rows.remove(row)
+                self._prefix_release(row)
+                tokens_resident = row["result"].prompt_tokens + min(
+                    row["cursor"], row["result"].generated_tokens
+                )
+                host_bytes = (
+                    tokens_resident * self.SWAP_BYTES_PER_TOKEN
+                    if policy == "swap"
+                    else 0
+                )
+                pr = {
+                    "request": request,
+                    "row": row,
+                    "policy": policy,
+                    "generated": row["result"].tokens[
+                        : min(row["cursor"], row["result"].generated_tokens)
+                    ],
+                    "host_bytes": host_bytes,
+                    "discharged": False,
+                }
+                if host_bytes:
+                    from ..obs.metrics import (
+                        observe_swap,
+                        swap_host_adjust,
+                    )
+
+                    observe_swap("out", host_bytes)
+                    self._swap_bytes += host_bytes
+                    self._swap_rows += 1
+                    swap_host_adjust(host_bytes, rows=1)
+                return pr
+        return None
+
+    def can_resume(self, pr: dict) -> bool:
+        return (
+            not self.closed
+            and len(self._rows) + len(self._pending) < self.max_rows
+        )
+
+    def resume_begin(
+        self, pr: dict, chunk_tokens: "Optional[int]" = None
+    ) -> dict:
+        """Re-admit a preempted row through the chunked-join machinery:
+        a swap resume has no prefill to redo (zero-token pending); a
+        recompute resume re-prefills prompt + generated-so-far in
+        chunks that interleave like any joiner's."""
+        if not self.can_resume(pr):
+            raise RuntimeError("preempted row cannot resume")
+        row = pr["row"]
+        if pr["policy"] == "swap":
+            tokens_left = 0
+        else:
+            tokens_left = row["result"].prompt_tokens + min(
+                row["cursor"], row["result"].generated_tokens
+            )
+        pending = {
+            "request": pr["request"],
+            "chunk_tokens": max(1, int(chunk_tokens or 256)),
+            "tokens_left": tokens_left,
+            "resume": pr,
+        }
+        self._pending.append(pending)
+        return pending
+
+    def resume_discard(self, pr: dict) -> None:
+        self._swap_settle(pr, transfer=False)
 
     def join_abort(self, pending: dict) -> None:
         if pending in self._pending:
@@ -223,6 +334,10 @@ class _FakeStepSession:
             "pending": [
                 {"tokens_left": pj["tokens_left"]} for pj in self._pending
             ],
+            "swap": {
+                "host_rows": self._swap_rows,
+                "host_bytes": self._swap_bytes,
+            },
         }
         if self.spec_k > 0:
             state["spec"] = {
@@ -366,6 +481,14 @@ class _FakeStepSession:
         self._pending = []
         self._stream_tail = []
         self._prefix_pub = []
+        if self._swap_bytes or self._swap_rows:
+            # parked victims die with the session: settle the ledger so
+            # the host-residency gauges return exactly to idle
+            from ..obs.metrics import swap_host_adjust
+
+            swap_host_adjust(-self._swap_bytes, rows=-self._swap_rows)
+            self._swap_bytes = 0
+            self._swap_rows = 0
 
 
 class FakeBackend(GenerationBackend):
@@ -377,9 +500,13 @@ class FakeBackend(GenerationBackend):
         spec_k: int = 0,
         spec_acceptance: float = 1.0,
         spec_accept_floor: "Optional[float]" = None,
+        max_rows: int = 64,
     ):
         self.tokens_per_s = tokens_per_s
         self.simulate_delay = simulate_delay
+        # session row capacity: small values simulate a saturated pool
+        # so scheduler preemption (ISSUE 11) is testable hermetically
+        self.max_rows = int(max_rows)
         # the fake twin of JaxEngine(prefix_share=True): stepped sessions
         # simulate shared-prefix hits so llm_prefix_* telemetry is
         # CI-testable with no accelerator (see _FakeStepSession)
@@ -444,5 +571,8 @@ class FakeBackend(GenerationBackend):
         ``spec_accept_floor`` overrides the backend's fallback floor per
         session, exactly like the real engine's decode_open."""
         return _FakeStepSession(
-            self, requests, spec_accept_floor=spec_accept_floor
+            self,
+            requests,
+            max_rows=self.max_rows,
+            spec_accept_floor=spec_accept_floor,
         )

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ..obs.flight import EV_POOL_EXHAUSTED, FLIGHT
-from ..obs.metrics import REGISTRY, enabled as _obs_enabled
+from ..obs.metrics import REGISTRY, enabled as _obs_enabled, observe_swap
 from .prefix import PREFIX_SHARED_PAGES_G
 
 DEFAULT_PAGE_SIZE = 128
@@ -94,6 +94,23 @@ def _codes(leaf):
 
 class PagePoolExhausted(RuntimeError):
     """No free pages left — the scheduler must evict or defer admission."""
+
+
+@dataclasses.dataclass
+class PageSwapBlob:
+    """Host-resident payload of swapped-out pages (ISSUE 11 preemption):
+    page chunks in :func:`scatter_pages` layout — ``[N, L, Hkv, page,
+    D]`` numpy arrays (or ``{"q","s"}`` dicts for int8 pools) — so
+    :meth:`PagePool.swap_in` is literally one allocation plus one
+    scatter. ``nbytes`` is the host footprint the swap gauges account.
+    """
+
+    k_chunks: "object"
+    v_chunks: "object"
+    n_pages: int
+    page_size: int
+    quantized: bool
+    nbytes: int
 
 
 @dataclasses.dataclass
@@ -263,6 +280,93 @@ class PagePool:
                 del self._refs[p]
                 self._free.append(p)
         _publish_pool_gauges(self._free, self.n_pages, self.shared_pages)
+
+    # -- preemption page swap (ISSUE 11) ---------------------------------------
+    def swap_out(self, pages: List[int]) -> PageSwapBlob:
+        """Spill ``pages``' payload to host memory and free them: the
+        device→host half of preemption-by-swap. REFCOUNT-AWARE by
+        refusal — a shared page (refcount > 1) has other live readers
+        whose content must stay device-resident, so callers release
+        (``free``) shared pages and swap only exclusively-owned ones;
+        passing a shared page here is a bookkeeping bug and raises.
+        The free count rises by exactly ``len(pages)`` (the bytes the
+        scheduler preempted FOR); :meth:`swap_in` restores it exactly.
+        """
+        for p in pages:
+            refs = self._refs.get(p)
+            if refs is None:
+                raise ValueError(f"page {p} is free — cannot swap it out")
+            if refs > 1:
+                raise ValueError(
+                    f"page {p} is shared (refcount {refs}) — shared CoW "
+                    "prefix pages are released, never swapped"
+                )
+        idx = jnp.asarray(pages, jnp.int32)
+
+        def gather(pool):
+            if isinstance(pool, dict):
+                return {
+                    # [L, N, ...] → scatter_pages' [N, L, ...] chunk layout
+                    "q": jax.device_get(
+                        pool["q"][:, idx].transpose(1, 0, 2, 3, 4)
+                    ),
+                    "s": jax.device_get(
+                        pool["s"][:, idx].transpose(1, 0, 2, 3)
+                    ),
+                }
+            return jax.device_get(pool[:, idx].transpose(1, 0, 2, 3, 4))
+
+        k_chunks = gather(self.k)
+        v_chunks = gather(self.v)
+        nbytes = 0
+        for chunks in (k_chunks, v_chunks):
+            parts = (
+                chunks.values() if isinstance(chunks, dict) else (chunks,)
+            )
+            nbytes += sum(int(a.nbytes) for a in parts)
+        self.free(pages)
+        observe_swap("out", nbytes)
+        return PageSwapBlob(
+            k_chunks=k_chunks,
+            v_chunks=v_chunks,
+            n_pages=len(pages),
+            page_size=self.page_size,
+            quantized=self.quantized,
+            nbytes=nbytes,
+        )
+
+    def swap_in(
+        self, blob: PageSwapBlob, pages: "Optional[List[int]]" = None
+    ) -> List[int]:
+        """Restore a swapped blob into the pool (host→device): allocate
+        ``blob.n_pages`` fresh pages — or scatter into ``pages`` the
+        caller already reserved (resume reservations are taken at
+        ``resume_begin`` so concurrent joiners cannot oversubscribe) —
+        and write the payload back bit-exactly (int8 blobs carry codes
+        AND per-position scales, so no requantization happens). Returns
+        the page list, in blob chunk order."""
+        if blob.quantized != self.quantized or blob.page_size != self.page_size:
+            raise ValueError(
+                "swap blob does not match this pool's layout "
+                f"(page_size {blob.page_size} vs {self.page_size}, "
+                f"quantized {blob.quantized} vs {self.quantized})"
+            )
+        if pages is None:
+            pages = self.alloc(blob.n_pages)
+        elif len(pages) != blob.n_pages:
+            raise ValueError(
+                f"resume reserved {len(pages)} pages for a "
+                f"{blob.n_pages}-page blob"
+            )
+        self.k, self.v = scatter_pages(
+            self.k,
+            self.v,
+            jnp.asarray(pages, jnp.int32),
+            jax.tree.map(jnp.asarray, blob.k_chunks),
+            jax.tree.map(jnp.asarray, blob.v_chunks),
+        )
+        observe_swap("in", blob.nbytes)
+        return list(pages)
 
 
 def page_slot(table, lengths, page_size: int):

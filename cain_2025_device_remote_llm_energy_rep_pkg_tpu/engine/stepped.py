@@ -91,6 +91,13 @@ def _zero_row(cache, r: int, axis: int = 1):
     return cache.at[tuple(idx)].set(0)
 
 
+def _slab_bytes(slab) -> int:
+    """Host bytes of a (possibly dict-leafed) swapped slab."""
+    if isinstance(slab, dict):
+        return sum(_slab_bytes(v) for v in slab.values())
+    return int(slab.nbytes)
+
+
 class _PendingJoin:
     """One joiner mid-chunked-prefill: the reserved slot, the private
     solo cache the chunks accumulate into, and the cursor over the
@@ -108,6 +115,7 @@ class _PendingJoin:
         "k_cache", "v_cache", "presence", "logits", "pages",
         "prefill_s", "t0", "hit_tokens", "shared_pages",
         "draft_k", "draft_v", "draft_chunks", "draft_next",
+        "resume", "resume_mode",
     )
 
     def __init__(
@@ -139,10 +147,62 @@ class _PendingJoin:
         self.draft_v = None
         self.draft_chunks: List[tuple] = []
         self.draft_next = 0
+        # Preemption resume (ISSUE 11): when set, this pending is a
+        # RESUME riding the chunked-join machinery — ``resume`` is the
+        # PreemptedRow and ``resume_mode`` how commit restores the KV
+        # ("swap": scatter the host blob, zero chunks; "recompute": the
+        # chunk list re-prefills prompt + generated-so-far).
+        self.resume: "Optional[PreemptedRow]" = None
+        self.resume_mode: Optional[str] = None
 
     @property
     def total_chunks(self) -> int:
         return len(self.chunks) + len(self.draft_chunks)
+
+
+class PreemptedRow:
+    """Everything needed to resume a mid-flight row that was retired by
+    :meth:`SteppedDecodeSession.preempt` (ISSUE 11): the exact host copy
+    of the row's control state (last token, rng key, presence, offsets,
+    remaining budget) plus — under the ``swap`` policy — its KV payload
+    (pool-page blob / contiguous row slab / stacked side-cache row).
+    Shared CoW prefix pages are never swapped: their indices are
+    recorded (``shared_pages``) so resume re-shares them from the prefix
+    index, falling back to full recompute when the index entry has been
+    evicted in the meantime."""
+
+    __slots__ = (
+        "request", "ids", "generated", "prompt_len", "offsets",
+        "remaining", "rng", "presence", "use_top_p", "use_rp",
+        "streamed", "t0", "t1", "policy", "paged", "stacked",
+        "blob", "side_blob", "cache_blob",
+        "shared_pages", "n_own_pages", "host_bytes", "discharged",
+    )
+
+    def __init__(self, request, ids, generated, prompt_len) -> None:
+        self.request = request
+        self.ids: List[int] = list(ids)
+        self.generated: List[int] = list(generated)
+        self.prompt_len = prompt_len
+        self.offsets = 0
+        self.remaining = 0
+        self.rng = None
+        self.presence = None
+        self.use_top_p = False
+        self.use_rp = False
+        self.streamed = 0
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self.policy = "swap"
+        self.paged = False
+        self.stacked = False
+        self.blob = None  # paged_kv.PageSwapBlob of the OWN pages
+        self.side_blob = None  # stacked side-cache row (k, v) host slabs
+        self.cache_blob = None  # contiguous row slab (k, v) host slabs
+        self.shared_pages: List[int] = []  # leading shared page indices
+        self.n_own_pages = 0
+        self.host_bytes = 0
+        self.discharged = False  # swap ledger already settled
 
 
 class _Row:
@@ -150,11 +210,12 @@ class _Row:
 
     __slots__ = (
         "request", "s_real", "generated", "budget", "t0", "t1",
-        "t_decode0", "pages", "streamed",
+        "t_decode0", "pages", "streamed", "shared",
     )
 
     def __init__(
-        self, request, s_real, first, budget, t0, t1, t_decode0, pages=None
+        self, request, s_real, first, budget, t0, t1, t_decode0,
+        pages=None, shared=0,
     ):
         self.request = request
         self.s_real = s_real
@@ -166,6 +227,9 @@ class _Row:
         self.pages: List[int] = pages or []
         # egress cursor: tokens already handed out via stream_deltas()
         self.streamed = 0
+        # leading table-row pages mapped read-only from the prefix index
+        # (preemption releases these instead of swapping them)
+        self.shared = shared
 
 
 def _carry_leaf(key: str) -> property:
@@ -274,6 +338,13 @@ class SteppedDecodeSession:
         # stream_deltas() drain (bounded by the session's rows).
         self.stream_tokens = False
         self._stream_tail: List[tuple] = []
+        # Preemption swap ledger (ISSUE 11): bytes/rows of THIS
+        # session's victims currently parked in host memory. The global
+        # gauges (llm_swap_host_bytes/rows) move through _swap_account
+        # only, so after every victim resumed or was discarded they are
+        # back exactly at their idle values.
+        self._swap_bytes = 0
+        self._swap_rows = 0
 
     # -- construction ---------------------------------------------------------
     @classmethod
@@ -880,6 +951,13 @@ class SteppedDecodeSession:
                 "accepted_total": sum(self._spec_host.get("accepted", [])),
                 "drafted_total": sum(self._spec_host.get("drafted", [])),
             }
+        # preemption swap accounting (ISSUE 11): what THIS session has
+        # parked in host memory right now — returns to zeros once every
+        # victim resumed or was discarded
+        state["swap"] = {
+            "host_rows": self._swap_rows,
+            "host_bytes": self._swap_bytes,
+        }
         if self.paged:
             state["pool"] = self.pool.debug_state()
         mesh_info = getattr(self.engine, "mesh_info", None)
@@ -1299,6 +1377,399 @@ class SteppedDecodeSession:
             return True
         return False
 
+    # -- mid-flight preemption (ISSUE 11) --------------------------------------
+    def _row_slab(self, cache, r: int):
+        """Host copy of one row of a (possibly dict-leafed) batch cache,
+        the batch dim kept singleton so ``_set_row`` restores it."""
+        import numpy as np
+
+        if isinstance(cache, dict):
+            return {k: self._row_slab(v, r) for k, v in cache.items()}
+        return np.asarray(jax.device_get(cache[:, r : r + 1]))
+
+    def _swap_account(self, d_bytes: int, d_rows: int) -> None:
+        from ..obs.metrics import swap_host_adjust
+
+        self._swap_bytes = max(0, self._swap_bytes + d_bytes)
+        self._swap_rows = max(0, self._swap_rows + d_rows)
+        swap_host_adjust(d_bytes, rows=d_rows)
+
+    def preempt(
+        self, request: GenerationRequest, policy: str = "swap"
+    ) -> "Optional[PreemptedRow]":
+        """Retire a live row NOW — like :meth:`cancel` — but capture
+        everything :meth:`resume_begin` needs to continue it later with
+        an unchanged token stream: the exact host copy of the row's
+        control leaves (last token, rng key, presence, offsets,
+        remaining budget) plus, under ``policy="swap"``, its KV payload
+        (own pool pages spilled via ``PagePool.swap_out``; the
+        contiguous row slab / stacked side-cache row copied to host).
+        Shared CoW prefix pages are refcounted by other readers and are
+        RELEASED, never swapped — resume re-shares them from the prefix
+        index. ``policy="recompute"`` captures no payload (the KV is
+        re-prefilled from prompt + generated tokens at resume).
+
+        Returns None — and leaves the row running — when the row cannot
+        be preempted safely: no live row for ``request``, an actively
+        speculating session (draft-cache state does not survive the
+        round trip), or a recompute whose re-prefill could not fit this
+        session's static shapes."""
+        from .jax_engine import _prompt_alloc
+
+        if self.closed or self.spec is not None:
+            return None
+        slot = None
+        for r, row in enumerate(self.rows):
+            if row is not None and row.request is request:
+                slot = r
+                break
+        if slot is None:
+            return None
+        r, row = slot, self.rows[slot]
+        if policy == "recompute":
+            # stacked sessions keep generated KV in the side caches; a
+            # re-prefill would have to fold it into pool pages under a
+            # shifted prompt boundary — swap is the supported policy
+            if self.paged and self.stacked:
+                return None
+            total = self.s_prefilled(row)
+            if not self.paged and _prompt_alloc(total) > self.cache_len:
+                return None  # re-prefill would not fit the session cache
+        ids = self.tok.encode(request.prompt)
+        pr = PreemptedRow(request, ids, row.generated, row.s_real)
+        pr.policy = policy
+        pr.paged = self.paged
+        pr.stacked = bool(self.paged and self.stacked)
+        pr.offsets = int(jax.device_get(self.offsets[r]))
+        pr.remaining = int(jax.device_get(self.remaining[r]))
+        pr.rng = jax.device_get(self.rngs[r])
+        pr.use_top_p = request.top_p < 1.0
+        pr.use_rp = request.repeat_penalty != 1.0
+        if pr.use_rp:
+            pr.presence = jax.device_get(self.presence[r])
+        pr.streamed = row.streamed
+        pr.t0, pr.t1 = row.t0, row.t1
+        host_bytes = 0
+        if self.paged:
+            pages = list(row.pages)
+            shared_n = 0
+            while (
+                shared_n < len(pages)
+                and self.pool.refcount(pages[shared_n]) > 1
+            ):
+                shared_n += 1
+            if any(self.pool.refcount(p) > 1 for p in pages[shared_n:]):
+                # shared pages past the leading prefix run would break
+                # the table-rebuild invariant — refuse, keep it running
+                return None
+            pr.shared_pages = pages[:shared_n]
+            own = pages[shared_n:]
+            pr.n_own_pages = len(own)
+            # ordering discipline (same as _retire/cancel): park the
+            # table row BEFORE any page returns to the free list
+            self.table = self.table.at[r].set(self.parking)
+            if policy == "swap":
+                if self.stacked:
+                    side = (
+                        self._row_slab(self.side_k, r),
+                        self._row_slab(self.side_v, r),
+                    )
+                    pr.side_blob = side
+                    side_bytes = _slab_bytes(side[0]) + _slab_bytes(side[1])
+                    from ..obs.metrics import observe_swap
+
+                    observe_swap("out", side_bytes)
+                    host_bytes += side_bytes
+                if own:
+                    pr.blob = self.pool.swap_out(own)
+                    host_bytes += pr.blob.nbytes
+            else:
+                if own:
+                    self.pool.free(own)
+            if pr.shared_pages:
+                self.pool.free(pr.shared_pages)  # drop OUR reference only
+            row.pages = []
+        elif policy == "swap":
+            from ..obs.metrics import observe_swap
+
+            pr.cache_blob = (
+                self._row_slab(self.k_cache, r),
+                self._row_slab(self.v_cache, r),
+            )
+            host_bytes = _slab_bytes(pr.cache_blob[0]) + _slab_bytes(
+                pr.cache_blob[1]
+            )
+            observe_swap("out", host_bytes)
+        pr.host_bytes = host_bytes
+        self._swap_account(host_bytes, 1 if host_bytes else 0)
+        # device-side retirement, exactly as cancel(): the slot rides
+        # along pre-done from the next slice
+        self.done = self.done.at[r].set(True)
+        self.remaining = self.remaining.at[r].set(0)
+        self.rows[r] = None
+        self._recommit_carry()
+        return pr
+
+    @staticmethod
+    def s_prefilled(row_or_pr) -> int:
+        """Positions of KV a row has materialised: prompt + generated
+        minus the last token (sampled but not yet fed through the
+        model). This is what a recompute resume re-prefills."""
+        if isinstance(row_or_pr, PreemptedRow):
+            return len(row_or_pr.ids) + len(row_or_pr.generated) - 1
+        return row_or_pr.s_real + len(row_or_pr.generated) - 1
+
+    def _resume_plan(self, pr: "PreemptedRow") -> "Optional[Dict[str, Any]]":
+        """How ``pr`` can re-enter this session RIGHT NOW: ``{"mode":
+        "swap"|"recompute", "need": free-list pages required, "entry":
+        prefix entry to re-share from}`` — or None when it cannot (a
+        stacked victim whose swap blob degraded, a recompute that no
+        longer fits). Side-effect free; ``can_resume`` probes it."""
+        if pr.request.model != self.model:
+            return None
+        if not self.paged:
+            if pr.policy == "swap" and pr.cache_blob is not None:
+                return {"mode": "swap", "need": 0, "entry": None}
+            from .jax_engine import _prompt_alloc
+
+            if _prompt_alloc(self.s_prefilled(pr)) > self.cache_len:
+                return None
+            return {"mode": "recompute", "need": 0, "entry": None}
+        total_need = self._pages_needed(
+            len(pr.ids), pr.request.max_new_tokens
+        )
+        if pr.policy == "swap":
+            if not pr.shared_pages:
+                return {"mode": "swap", "need": pr.n_own_pages, "entry": None}
+            if self.prefix is not None:
+                m = self.prefix.match(pr.ids)
+                if m is not None:
+                    entry, _common = m
+                    held = list(entry.pages[: len(pr.shared_pages)])
+                    if held == list(pr.shared_pages) and all(
+                        self.pool.refcount(p) >= 1 for p in held
+                    ):
+                        return {
+                            "mode": "swap",
+                            "need": pr.n_own_pages,
+                            "entry": entry,
+                        }
+            # the shared prefix left the index while the victim was
+            # parked: its pages may have been recycled — degrade to a
+            # full recompute (stacked sessions cannot, see preempt)
+            if self.stacked:
+                return None
+            return {"mode": "recompute", "need": total_need, "entry": None}
+        if self.stacked:
+            return None
+        return {"mode": "recompute", "need": total_need, "entry": None}
+
+    def can_resume(self, pr: "PreemptedRow") -> bool:
+        """Whether the preempted row fits back RIGHT NOW (free slot +
+        pages for its plan). Side-effect free — the scheduler probes
+        between slices, exactly like ``can_join``."""
+        if self.closed or self.free_slots == 0:
+            return False
+        plan = self._resume_plan(pr)
+        if plan is None:
+            return False
+        return not self.paged or plan["need"] <= self.pool.free_pages
+
+    def resume_begin(
+        self,
+        pr: "PreemptedRow",
+        chunk_tokens: Optional[int] = None,
+    ) -> _PendingJoin:
+        """Start re-admitting a preempted row through the chunked-join
+        machinery: reserve a free slot and its pages (swap: the blob's
+        page count, shared prefix pages re-shared from the index;
+        recompute: the row's full footprint), and — recompute only —
+        split the re-prefill of prompt + generated-so-far into
+        token-budgeted chunks that interleave with decode slices like
+        any joiner's. Commit (``join_commit``) restores the KV and
+        re-seats the row; a swap resume has zero chunks and commits on
+        the scheduler's next interleave turn."""
+        from .jax_engine import (
+            JOIN_PREFILL_CHUNK_TOKENS,
+            PROMPT_BUCKETS,
+            _floor_bucket,
+            _prompt_chunks,
+        )
+
+        if self.closed:
+            raise RuntimeError("session is closed")
+        plan = self._resume_plan(pr)
+        if plan is None or self.free_slots == 0:
+            raise RuntimeError("preempted row cannot resume in this session")
+        r = next(
+            i
+            for i, row in enumerate(self.rows)
+            if row is None and i not in self._pending
+        )
+        mode = plan["mode"]
+        pages: List[int] = []
+        if self.paged:
+            if mode == "swap":
+                own = self.pool.alloc(pr.n_own_pages)
+                if pr.shared_pages:
+                    self.pool.share(pr.shared_pages)
+                    if plan["entry"] is not None:
+                        self.prefix.touch(plan["entry"])
+                pages = list(pr.shared_pages) + own
+            else:
+                pages = self.pool.alloc(plan["need"])
+        if mode == "swap":
+            ids, chunks, cache_len = pr.ids, [], 0
+            k_cache = v_cache = None
+        else:
+            ids = pr.ids + pr.generated[:-1]
+            chunk = _floor_bucket(
+                int(chunk_tokens or JOIN_PREFILL_CHUNK_TOKENS),
+                PROMPT_BUCKETS,
+            )
+            chunks = _prompt_chunks(len(ids), chunk)
+            if self.paged:
+                cache_len = chunks[-1][0] + chunks[-1][1]
+            else:
+                cache_len = self.cache_len
+                if chunks[-1][0] + chunks[-1][1] > cache_len:
+                    chunks = _prompt_chunks(len(ids), None)
+                if chunks[-1][0] + chunks[-1][1] > cache_len:
+                    if pages:
+                        self.pool.free(pages)
+                    raise RuntimeError(
+                        "resume re-prefill does not fit the session cache"
+                    )
+            tf = self.engine._models[self.model]
+            k_cache, v_cache = tf.init_cache(
+                1, cache_len, dtype=self.engine.dtype
+            )
+            k_cache, v_cache = self.engine._place_cache(
+                k_cache, v_cache, self.cfg
+            )
+        if pr.presence is not None:
+            presence = jnp.asarray(pr.presence)[None]
+        else:
+            presence = jnp.zeros((1, self.cfg.vocab_size), dtype=bool)
+        pending = _PendingJoin(
+            pr.request, r, ids, chunks, cache_len,
+            k_cache, v_cache, presence, pages,
+        )
+        pending.resume = pr
+        pending.resume_mode = mode
+        self._pending[r] = pending
+        return pending
+
+    def resume_discard(self, pr: "PreemptedRow") -> None:
+        """Drop a parked victim for good (its ticket was cancelled, its
+        deadline passed, or the session is shutting down): settle the
+        swap ledger so the host-residency gauges return exactly to
+        their idle values. Idempotent; a closed session already settled
+        its whole ledger."""
+        if pr.discharged:
+            return
+        pr.discharged = True
+        if pr.host_bytes and not self.closed:
+            self._swap_account(-pr.host_bytes, -1)
+        pr.host_bytes = 0
+
+    def _commit_resume(self, pending: _PendingJoin) -> int:
+        """Finish a resume: restore the KV payload (swap: scatter the
+        host blob into the reserved pages / set the row slabs back;
+        recompute: scatter the freshly re-prefilled private cache like
+        any join) and re-seat the row with its captured control state —
+        same last token, rng key, presence and remaining budget, so the
+        continued stream is bit-identical to the uninterrupted run."""
+        import numpy as np
+
+        from ..obs.metrics import observe_swap
+
+        pr = pending.resume
+        r = pending.slot
+        del self._pending[r]
+        mode = pending.resume_mode
+        if mode == "swap":
+            if self.paged:
+                own = pending.pages[len(pr.shared_pages) :]
+                if pr.blob is not None:
+                    # pool.k/v alias the carry leaves; swap_in replaces
+                    # them, so re-sync the carry to the new arrays
+                    self.pool.swap_in(pr.blob, pages=own)
+                    self.carry["pool_k"] = self.pool.k
+                    self.carry["pool_v"] = self.pool.v
+                table_row = np.full(
+                    (self.jmax,), self.parking, dtype=np.int32
+                )
+                table_row[: len(pending.pages)] = pending.pages
+                self.table = self.table.at[r].set(jnp.asarray(table_row))
+                if self.stacked and pr.side_blob is not None:
+                    sk, sv = pr.side_blob
+                    self.side_k = _set_row(
+                        self.side_k, r, jax.tree.map(jnp.asarray, sk)
+                    )
+                    self.side_v = _set_row(
+                        self.side_v, r, jax.tree.map(jnp.asarray, sv)
+                    )
+                    observe_swap(
+                        "in", _slab_bytes(sk) + _slab_bytes(sv)
+                    )
+            else:
+                kb, vb = pr.cache_blob
+                self.k_cache = _set_row(
+                    self.k_cache, r, jax.tree.map(jnp.asarray, kb)
+                )
+                self.v_cache = _set_row(
+                    self.v_cache, r, jax.tree.map(jnp.asarray, vb)
+                )
+                observe_swap("in", _slab_bytes(kb) + _slab_bytes(vb))
+        else:
+            # recompute: the private cache now holds KV for every
+            # prefilled position — scatter it exactly like a join's
+            # (prefilled length plays the "prompt" role; shared base 0)
+            if self.paged:
+                self._scatter_private_cache(
+                    r,
+                    pending.k_cache,
+                    pending.v_cache,
+                    len(pending.ids),
+                    pending.pages,
+                    shared_pages=0,
+                )
+            else:
+                kc_row, vc_row = pending.k_cache, pending.v_cache
+                if self.engine.kv_quantize:
+                    from ..models.quantize import quantize_kv_cache
+
+                    kc_row, vc_row = quantize_kv_cache(kc_row, vc_row)
+                self.k_cache = _set_row(self.k_cache, r, kc_row)
+                self.v_cache = _set_row(self.v_cache, r, vc_row)
+        # settle the ledger: the victim's KV left host memory (swap) or
+        # its blob is obsolete (recompute degraded from swap)
+        if pr.host_bytes:
+            self._swap_account(-pr.host_bytes, -1)
+            pr.host_bytes = 0
+        pr.discharged = True
+        self._seat_row(
+            pr.request,
+            r,
+            first_token=pr.generated[-1],
+            rng=jnp.asarray(pr.rng),
+            presence_row=pending.presence[0],
+            offsets=pr.offsets,
+            prompt_len=pr.prompt_len,
+            remaining=pr.remaining,
+            use_top_p=pr.use_top_p,
+            use_rp=pr.use_rp,
+            pages=pending.pages,
+            t0=pr.t0,
+            t1=pr.t1,
+            t_decode0=time.monotonic(),
+            generated=pr.generated,
+            streamed=pr.streamed,
+            shared=len(pr.shared_pages) if mode == "swap" else 0,
+        )
+        return r
+
     def _recommit_carry(self) -> None:
         """Re-pin the carry to the engine's declared placements after a
         host-side eager mutation batch (row install, cancel). Eager ops
@@ -1593,6 +2064,15 @@ class SteppedDecodeSession:
         bookkeeping. Returns the slot index."""
         from ..ops.sampling import sample_token
 
+        if pending.resume is not None:
+            # a preemption resume riding the same machinery: no first
+            # token is sampled — the captured one continues the stream
+            if pending.next_chunk < len(pending.chunks):
+                raise RuntimeError(
+                    f"resume not fully re-prefilled: chunk "
+                    f"{pending.next_chunk} of {len(pending.chunks)}"
+                )
+            return self._commit_resume(pending)
         if pending.next_chunk < len(pending.chunks):
             raise RuntimeError(
                 f"join not fully prefilled: chunk {pending.next_chunk} of "
@@ -1711,47 +2191,11 @@ class SteppedDecodeSession:
         would be a write to shared state) and the private cache's
         positions past that boundary — the copy-on-write partial page
         plus the computed tail — scatter into the row's OWN pages."""
-        import numpy as np
-
-        from .paged_kv import _paginate, quantize_chunks, scatter_pages
-
         eng = self.engine
         if self.paged:
-            n_prompt_pages = -(-s_real // self.page_size)
-            base = min(shared_pages, n_prompt_pages)
-            start = base * self.page_size
-            ck = _paginate(
-                k_cache[:, 0][:, :, start:], s_real - start, self.page_size
+            self._scatter_private_cache(
+                r, k_cache, v_cache, s_real, pages, shared_pages
             )
-            cv = _paginate(
-                v_cache[:, 0][:, :, start:], s_real - start, self.page_size
-            )
-            if self.d_pool != self.cfg.d_head:
-                padd = [(0, 0)] * (ck.ndim - 1) + [
-                    (0, self.d_pool - self.cfg.d_head)
-                ]
-                ck, cv = jnp.pad(ck, padd), jnp.pad(cv, padd)
-            if self.quantized:
-                ck, cv = quantize_chunks(ck, cv)
-            # scatter into the CARRY's pool leaves: inputs are committed
-            # to the carry sharding, so the eager scatter runs sharded in
-            # place of placement (computation follows data) and the next
-            # slice's jit sees exactly the sharding it declared
-            self.carry["pool_k"], self.carry["pool_v"] = scatter_pages(
-                self.carry["pool_k"],
-                self.carry["pool_v"],
-                jnp.asarray(pages[base:n_prompt_pages], jnp.int32),
-                ck,
-                cv,
-            )
-            self.pool.k = self.carry["pool_k"]
-            self.pool.v = self.carry["pool_v"]
-            table_row = np.full((self.jmax,), self.parking, dtype=np.int32)
-            table_row[: len(pages)] = pages
-            self.table = self.table.at[r].set(jnp.asarray(table_row))
-            if self.stacked:
-                self.side_k = _zero_row(self.side_k, r)
-                self.side_v = _zero_row(self.side_v, r)
         else:
             kc_row, vc_row = k_cache, v_cache
             if eng.kv_quantize:
@@ -1760,34 +2204,140 @@ class SteppedDecodeSession:
                 kc_row, vc_row = quantize_kv_cache(kc_row, vc_row)
             self.k_cache = _set_row(self.k_cache, r, kc_row)
             self.v_cache = _set_row(self.v_cache, r, vc_row)
-        self.tokens = self.tokens.at[r].set(first[0])
-        self.rngs = self.rngs.at[r].set(rng)
-        self.presence = self.presence.at[r].set(presence[0])
-        self.offsets = self.offsets.at[r].set(s_real)
-        self.prompt_lens = self.prompt_lens.at[r].set(s_real)
-        self.remaining = self.remaining.at[r].set(
-            request.max_new_tokens - 1
+        self._seat_row(
+            request,
+            r,
+            first_token=int(first[0]),
+            rng=rng,
+            presence_row=presence[0],
+            offsets=s_real,
+            prompt_len=s_real,
+            remaining=request.max_new_tokens - 1,
+            use_top_p=use_top_p,
+            use_rp=use_rp,
+            pages=pages,
+            t0=t0,
+            t1=t0 + prefill_s,
+            t_decode0=time.monotonic(),
+            shared=shared_pages,
         )
+
+    def _scatter_private_cache(
+        self,
+        r: int,
+        k_cache,
+        v_cache,
+        s_real: int,
+        pages: "List[int]",
+        shared_pages: int = 0,
+    ) -> None:
+        """Scatter a private solo cache's first ``s_real`` positions
+        into the row's pool pages and seat its table row — the paged
+        half of installing a joiner OR a recompute-resumed row (whose
+        "prompt" is its whole re-prefilled history)."""
+        import numpy as np
+
+        from .paged_kv import _paginate, quantize_chunks, scatter_pages
+
+        n_prompt_pages = -(-s_real // self.page_size)
+        base = min(shared_pages, n_prompt_pages)
+        start = base * self.page_size
+        ck = _paginate(
+            k_cache[:, 0][:, :, start:], s_real - start, self.page_size
+        )
+        cv = _paginate(
+            v_cache[:, 0][:, :, start:], s_real - start, self.page_size
+        )
+        if self.d_pool != self.cfg.d_head:
+            padd = [(0, 0)] * (ck.ndim - 1) + [
+                (0, self.d_pool - self.cfg.d_head)
+            ]
+            ck, cv = jnp.pad(ck, padd), jnp.pad(cv, padd)
+        if self.quantized:
+            ck, cv = quantize_chunks(ck, cv)
+        # scatter into the CARRY's pool leaves: inputs are committed
+        # to the carry sharding, so the eager scatter runs sharded in
+        # place of placement (computation follows data) and the next
+        # slice's jit sees exactly the sharding it declared
+        self.carry["pool_k"], self.carry["pool_v"] = scatter_pages(
+            self.carry["pool_k"],
+            self.carry["pool_v"],
+            jnp.asarray(pages[base:n_prompt_pages], jnp.int32),
+            ck,
+            cv,
+        )
+        self.pool.k = self.carry["pool_k"]
+        self.pool.v = self.carry["pool_v"]
+        table_row = np.full((self.jmax,), self.parking, dtype=np.int32)
+        table_row[: len(pages)] = pages
+        self.table = self.table.at[r].set(jnp.asarray(table_row))
+        if self.stacked:
+            self.side_k = _zero_row(self.side_k, r)
+            self.side_v = _zero_row(self.side_v, r)
+
+    def _seat_row(
+        self,
+        request: GenerationRequest,
+        r: int,
+        *,
+        first_token: int,
+        rng,
+        presence_row,
+        offsets: int,
+        prompt_len: int,
+        remaining: int,
+        use_top_p: bool,
+        use_rp: bool,
+        pages: "List[int]",
+        t0: float,
+        t1: float,
+        t_decode0: float,
+        generated: "Optional[List[int]]" = None,
+        streamed: int = 0,
+        shared: int = 0,
+    ) -> None:
+        """Set every per-row control leaf + the host row record — the
+        shared tail of installing a fresh joiner (``offsets ==
+        prompt_len``, full budget) and re-seating a preempted row
+        (captured offsets/remaining/rng, generated tokens carried
+        over). ``done`` folds the budget exactly as the decode loop
+        would: a row with no steps left enters pre-done."""
+        self.tokens = self.tokens.at[r].set(first_token)
+        self.rngs = self.rngs.at[r].set(rng)
+        self.presence = self.presence.at[r].set(presence_row)
+        self.offsets = self.offsets.at[r].set(offsets)
+        self.prompt_lens = self.prompt_lens.at[r].set(prompt_len)
+        self.remaining = self.remaining.at[r].set(remaining)
         self.temps = self.temps.at[r].set(request.temperature)
         self.top_ps = self.top_ps.at[r].set(self._row_top_p(request))
         self.rps = self.rps.at[r].set(request.repeat_penalty)
-        self.done = self.done.at[r].set(request.max_new_tokens <= 1)
+        self.done = self.done.at[r].set(remaining <= 0)
         # sticky for the session: a sentinel makes the filter an identity
         # for rows that never asked for it, so turning a knob on for a
         # joiner cannot perturb a companion's stream
         self.use_top_p = self.use_top_p or use_top_p
         self.use_rp = self.use_rp or use_rp
-        now = time.monotonic()
-        self.rows[r] = _Row(
+        if self._spec_host:
+            # a re-used slot must not inherit a previous occupant's
+            # draft-verify attribution (post-fallback sessions keep the
+            # host mirrors for retiring rows' extras)
+            for key in self._spec_host:
+                self._spec_host[key][r] = 0
+        row = _Row(
             request,
-            s_real,
-            int(first[0]),
+            prompt_len,
+            first_token,
             request.max_new_tokens - 1,
             t0,
-            t0 + prefill_s,
-            now,
+            t1,
+            t_decode0,
             pages=pages,
+            shared=shared,
         )
+        if generated is not None:
+            row.generated = list(generated)
+        row.streamed = streamed
+        self.rows[r] = row
         self._recommit_carry()
 
     # -- teardown -------------------------------------------------------------
@@ -1814,3 +2364,12 @@ class SteppedDecodeSession:
         self._pending.clear()
         self._stream_tail.clear()
         self.rows = [None] * len(self.rows)
+        if self._swap_bytes or self._swap_rows:
+            # victims still parked when the session dies: settle the
+            # ledger so the host-residency gauges return to idle (the
+            # scheduler discards the PreemptedRow objects themselves)
+            from ..obs.metrics import swap_host_adjust
+
+            swap_host_adjust(-self._swap_bytes, rows=-self._swap_rows)
+            self._swap_bytes = 0
+            self._swap_rows = 0

@@ -53,6 +53,11 @@ EV_ROW_RETIRED = "row_retired"  # a row left the session
 #   {eos|budget|error|shutdown|cancelled|deadline}
 EV_REQUEST_REJECTED = "request_rejected"  # queued ticket refused pre-admission
 #   (deadline already passed / TTFT SLO unmeetable)
+EV_ROW_PREEMPTED = "preempted"  # a lower-tier live row was preempted for a
+#   higher-tier ticket (trace = victim; by = preemptor's trace; policy
+#   = swap|recompute; swapped pages/bytes ride along)
+EV_ROW_RESUMED = "resumed"  # a preempted row re-entered its session
+#   (trace = victim; parked_s, aged tier, policy actually used)
 EV_BATCH_FALLBACK = "batch_fallback"  # batch/session dispatch failed → bisection
 EV_POOL_EXHAUSTED = "pool_exhausted"  # PagePool refused an allocation
 EV_PREFIX_HIT = "prefix_hit"  # a joiner reused cached shared-prefix KV

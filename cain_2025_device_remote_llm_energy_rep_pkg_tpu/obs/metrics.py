@@ -359,6 +359,52 @@ SPEC_VERIFY_NATIVE_C = REGISTRY.counter(
 )
 
 
+# -- preemption page swap (ISSUE 11) -------------------------------------------
+# Declared here because THREE producers share them: PagePool.swap_out/
+# swap_in (paged sessions), SteppedDecodeSession's contiguous/side-cache
+# slab swaps, and the hermetic fake's simulated swap — one scrape must
+# stay comparable across all three.
+SWAP_BYTES_C = REGISTRY.counter(
+    "llm_swap_bytes_total",
+    "KV payload bytes moved between device and host by mid-flight "
+    "preemption, by direction (out: device->host at preempt; in: "
+    "host->device at resume)",
+    labels=("direction",),
+)
+SWAP_HOST_BYTES_G = REGISTRY.gauge(
+    "llm_swap_host_bytes",
+    "KV payload bytes currently parked in host memory for preempted "
+    "rows (returns exactly to 0 once every victim resumed or was "
+    "discarded)",
+)
+SWAP_HOST_ROWS_G = REGISTRY.gauge(
+    "llm_swap_host_rows",
+    "Preempted rows whose KV currently lives in host memory",
+)
+
+
+def observe_swap(direction: str, nbytes: float) -> None:
+    """Account one swap TRANSFER (``direction`` = ``out`` at preempt,
+    ``in`` at resume). Counter only — the host-residency gauges are
+    owned by the session's swap ledger (:func:`swap_host_adjust`), the
+    one place that also knows about discards without a transfer."""
+    if not _enabled or nbytes <= 0:
+        return
+    SWAP_BYTES_C.labels(direction=direction).inc(nbytes)
+
+
+def swap_host_adjust(nbytes: float, rows: int = 0) -> None:
+    """Move the host-residency gauges by a delta (clamped at zero so a
+    discard racing a reset cannot leave them negative)."""
+    if not _enabled:
+        return
+    SWAP_HOST_BYTES_G.set(max(0.0, SWAP_HOST_BYTES_G._default.value + nbytes))
+    if rows:
+        SWAP_HOST_ROWS_G.set(
+            max(0.0, SWAP_HOST_ROWS_G._default.value + rows)
+        )
+
+
 def observe_spec(rounds: float, accepted: float, drafted: float) -> None:
     """One speculative window's counters + the acceptance gauge (no-op
     when telemetry is off — the instruments gate themselves, but the

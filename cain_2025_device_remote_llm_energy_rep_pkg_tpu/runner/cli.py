@@ -178,7 +178,25 @@ commands:
                        --ttft-slo-ms N rejects queued requests whose
                        wait alone already exceeds the TTFT SLO (HTTP
                        504, before any prefill is paid; off by
-                       default). Streaming: "stream": true serves SSE
+                       default);
+                       SLO tiers + preemption: requests carry
+                       x_priority (low|normal|high or any integer,
+                       higher = more important; --default-priority T
+                       stamps bare requests, default normal), the
+                       scheduler queue is per-tier FIFO, and under
+                       --scheduler continuous a higher-tier ticket
+                       that cannot be admitted PREEMPTS the youngest
+                       strictly-lower-tier in-flight row:
+                       --preempt-policy swap (default) spills the
+                       victim's KV pages to host memory and restores
+                       them bit-exactly at resume, recompute drops
+                       the KV and re-prefills prompt+generated through
+                       the chunked-join machinery, off disables
+                       preemption (shed-at-the-edge only);
+                       --preempt-max-wait-s S ages a parked victim up
+                       one tier per S seconds waited (starvation
+                       protection, default 30).
+                       Streaming: "stream": true serves SSE
                        through the continuous scheduler's per-slice
                        egress — a client hanging up retires its row
                        mid-flight and recycles its KV pages; requests
@@ -241,6 +259,9 @@ def serve_command(args: List[str]) -> None:
     slice_steps = None  # continuous: engine DECODE_SLICE_STEPS default
     prefill_chunk_tokens = None  # continuous: engine auto default
     ttft_slo_ms = None  # no TTFT SLO: late requests serve late
+    default_priority = None  # tier for requests without x_priority
+    preempt_policy = None  # scheduler default ("swap")
+    preempt_max_wait_s = None  # scheduler default (30 s aging clock)
     hf_checkpoints = {}
     quantize = None
     kv_quantize = None
@@ -294,6 +315,32 @@ def serve_command(args: List[str]) -> None:
             if ttft_slo_ms is not None and ttft_slo_ms <= 0:
                 raise CommandError(
                     "serve: --ttft-slo-ms expects a positive number"
+                )
+        elif arg == "--default-priority":
+            from ..serve.protocol import parse_priority
+
+            try:
+                default_priority = parse_priority(next(it, ""))
+            except ValueError as exc:
+                raise CommandError(f"serve: --default-priority: {exc}")
+        elif arg == "--preempt-policy":
+            preempt_policy = next(it, "")
+            if preempt_policy not in ("off", "swap", "recompute"):
+                raise CommandError(
+                    "serve: --preempt-policy expects 'off', 'swap' or "
+                    "'recompute'"
+                )
+        elif arg == "--preempt-max-wait-s":
+            try:
+                preempt_max_wait_s = float(next(it, ""))
+            except ValueError:
+                raise CommandError(
+                    "serve: --preempt-max-wait-s expects a number of "
+                    "seconds (0 disables starvation aging)"
+                )
+            if preempt_max_wait_s < 0:
+                raise CommandError(
+                    "serve: --preempt-max-wait-s expects a number >= 0"
                 )
         elif arg == "--hf":
             # --hf model=/path/to/checkpoint (repeatable): serve the model
@@ -474,6 +521,9 @@ def serve_command(args: List[str]) -> None:
         prefill_chunk_tokens=prefill_chunk_tokens,
         ttft_slo_ms=ttft_slo_ms,
         spec_accept_floor=spec_accept_floor,
+        default_priority=default_priority,
+        preempt_policy=preempt_policy,
+        preempt_max_wait_s=preempt_max_wait_s,
     )
     server.serve_forever()
 

@@ -42,6 +42,41 @@ DEBUG_FLIGHT_PATH = "/debug/flight"  # flight-recorder events (?n=, ?type=)
 
 SERVER_VERSION = "0.1.0"
 
+# SLO tiers (ISSUE 11): the canonical named priority tiers of the wire
+# field ``x_priority``. Requests may send the name or any non-negative
+# integer; absent means the server's ``--default-priority`` (which
+# itself defaults to "normal"). Higher = more important: the scheduler
+# queue is per-tier FIFO and the continuous scheduler may preempt
+# strictly-lower-tier in-flight rows to admit a higher-tier ticket.
+PRIORITY_TIERS = {"low": 0, "normal": 1, "high": 2}
+DEFAULT_PRIORITY = PRIORITY_TIERS["normal"]
+_TIER_NAMES = {v: k for k, v in PRIORITY_TIERS.items()}
+
+
+def parse_priority(value) -> int:
+    """Wire/CLI priority value → integer tier: a PRIORITY_TIERS name or
+    a non-negative integer (strings of digits accepted)."""
+    if isinstance(value, str):
+        name = value.strip().lower()
+        if name in PRIORITY_TIERS:
+            return PRIORITY_TIERS[name]
+        if not name.isdigit():
+            raise ValueError(
+                f"priority must be one of {sorted(PRIORITY_TIERS)} or a "
+                f"non-negative integer, got {value!r}"
+            )
+        return int(name)
+    tier = int(value)
+    if tier < 0:
+        raise ValueError(f"priority must be >= 0, got {value!r}")
+    return tier
+
+
+def tier_name(priority: int) -> str:
+    """Human/debug name of an integer tier (falls back to the number)."""
+    return _TIER_NAMES.get(priority, str(priority))
+
+
 # Streaming wire format (ISSUE 6): Server-Sent Events over chunked
 # transfer. Each record is one ``data: <json>`` line followed by a blank
 # line (the SSE event separator); the final event's JSON carries the
@@ -109,10 +144,17 @@ def request_to_wire(
             if request.deadline_ms is not None
             else {}
         ),
+        **(
+            {"x_priority": request.priority}
+            if request.priority != DEFAULT_PRIORITY
+            else {}
+        ),
     }
 
 
-def request_from_wire(body: Dict[str, Any]) -> GenerationRequest:
+def request_from_wire(
+    body: Dict[str, Any], default_priority: int = DEFAULT_PRIORITY
+) -> GenerationRequest:
     if "model" not in body or "prompt" not in body:
         raise ValueError("generate request requires 'model' and 'prompt'")
     options = body.get("options") or {}
@@ -139,6 +181,11 @@ def request_from_wire(body: Dict[str, Any]) -> GenerationRequest:
             float(body["x_deadline_ms"])
             if body.get("x_deadline_ms") is not None
             else None
+        ),
+        priority=(
+            parse_priority(body["x_priority"])
+            if body.get("x_priority") is not None
+            else int(default_priority)
         ),
     )
 

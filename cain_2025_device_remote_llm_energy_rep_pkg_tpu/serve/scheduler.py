@@ -56,6 +56,8 @@ from ..obs.flight import (
     EV_JOIN_CHUNK,
     EV_REQUEST_ADMITTED,
     EV_REQUEST_REJECTED,
+    EV_ROW_PREEMPTED,
+    EV_ROW_RESUMED,
     EV_ROW_RETIRED,
     EV_SLICE,
     FLIGHT,
@@ -178,6 +180,29 @@ _JOIN_CHUNKS_C = REGISTRY.counter(
     "(a synchronous join executes its whole prompt as one admit call "
     "and does not count here)",
 )
+# SLO tiers + mid-flight preemption (ISSUE 11): the continuous
+# scheduler preempts the youngest strictly-lower-tier in-flight row
+# when a higher-tier ticket cannot be admitted (pages/slots short),
+# parks the victim — its KV swapped to host (policy=swap) or dropped
+# for re-prefill (policy=recompute) — and resumes it when capacity
+# returns.
+_PREEMPTED_C = REGISTRY.counter(
+    "llm_sched_preempted_total",
+    "In-flight rows preempted for a higher-tier ticket, by policy "
+    "(swap: KV spilled to host memory; recompute: KV dropped, "
+    "re-prefilled at resume)",
+    labels=("policy",),
+)
+_RESUMED_C = REGISTRY.counter(
+    "llm_sched_resumed_total",
+    "Preempted rows re-admitted into their session (through the "
+    "chunked-join machinery; the continued stream is bit-identical to "
+    "an uninterrupted run)",
+)
+_PARKED_G = REGISTRY.gauge(
+    "llm_sched_parked_rows",
+    "Preempted rows currently parked on the resume queue (0 when idle)",
+)
 
 
 class _Ticket:
@@ -199,6 +224,7 @@ class _Ticket:
     __slots__ = (
         "request", "event", "result", "error", "t_submit", "t_first",
         "span", "queue_wait_s", "joined", "join_chunks", "stream",
+        "priority", "preempts", "resumed",
     )
 
     def __init__(self, request: GenerationRequest) -> None:
@@ -213,6 +239,125 @@ class _Ticket:
         self.joined = False
         self.join_chunks = 0
         self.stream: Optional[TokenStream] = None
+        # EFFECTIVE SLO tier: starts at the request's priority; a parked
+        # preemption victim ages UP one tier per --preempt-max-wait-s
+        # waited (starvation protection), so victim selection and resume
+        # ordering read this, never request.priority directly.
+        self.priority = getattr(request, "priority", 0)
+        self.preempts = 0  # times this ticket's row was preempted
+        self.resumed = False
+
+
+class _TierQueue:
+    """Drop-in for the scheduler's ``queue.Queue`` with PER-TIER FIFO
+    order (ISSUE 11): ``get`` returns the oldest ticket of the HIGHEST
+    waiting tier; arrival order is preserved within a tier, so equal
+    traffic keeps today's FIFO semantics exactly. ``None`` — the
+    shutdown sentinel — short-circuits ahead of tickets so a stopping
+    scheduler never dispatches new work first (its queued tickets are
+    failed by ``stop()``'s drains either way)."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._tiers: Dict[int, deque] = {}
+        self._control = 0  # queued None sentinels
+
+    def put(self, item) -> None:
+        with self._cond:
+            if item is None:
+                self._control += 1
+            else:
+                tier = getattr(item, "priority", 0)
+                self._tiers.setdefault(tier, deque()).append(item)
+            self._cond.notify()
+
+    def _pop(self):
+        # caller holds the condition lock; IndexError when empty
+        if self._control:
+            self._control -= 1
+            return None
+        for tier in sorted(self._tiers, reverse=True):
+            q = self._tiers[tier]
+            if q:
+                return q.popleft()
+        raise IndexError("empty")
+
+    def get(self, timeout: Optional[float] = None):
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        with self._cond:
+            while True:
+                try:
+                    return self._pop()
+                except IndexError:
+                    pass
+                remaining = (
+                    None
+                    if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise queue.Empty
+                self._cond.wait(remaining)
+
+    def get_nowait(self):
+        with self._cond:
+            try:
+                return self._pop()
+            except IndexError:
+                raise queue.Empty from None
+
+    def qsize(self) -> int:
+        with self._cond:
+            return self._control + sum(
+                len(q) for q in self._tiers.values()
+            )
+
+    def max_tier(self) -> Optional[int]:
+        """Highest tier with a waiting ticket (None when no tickets) —
+        the resume phase's anti-thrash probe: a victim does not swap
+        back in under a strictly-higher-tier backlog that would preempt
+        it again immediately."""
+        with self._cond:
+            waiting = [t for t, q in self._tiers.items() if q]
+            return max(waiting) if waiting else None
+
+    def depths(self) -> Dict[int, int]:
+        """Per-tier queue depth snapshot for ``/debug/state``."""
+        with self._cond:
+            return {t: len(q) for t, q in sorted(self._tiers.items()) if q}
+
+
+class _Parked:
+    """One preempted victim waiting on the resume queue: its ticket,
+    the engine's :class:`~..engine.stepped.PreemptedRow` capture, and
+    the clocks the starvation-aging policy reads."""
+
+    __slots__ = ("ticket", "pr", "t_parked", "base_tier")
+
+    def __init__(self, ticket: _Ticket, pr) -> None:
+        self.ticket = ticket
+        self.pr = pr
+        self.t_parked = time.monotonic()
+        self.base_tier = ticket.priority
+
+
+def _is_resume(pj) -> bool:
+    """Whether a pending-join object is a preemption RESUME riding the
+    chunked-join machinery (works for the engine's _PendingJoin and the
+    fake backend's dict pendings alike)."""
+    if isinstance(pj, dict):
+        return pj.get("resume") is not None
+    return getattr(pj, "resume", None) is not None
+
+
+def _pr_field(pr, name: str, default=None):
+    """Read a field off a PreemptedRow capture — the engine's object or
+    the fake backend's dict twin."""
+    if isinstance(pr, dict):
+        return pr.get(name, default)
+    return getattr(pr, name, default)
 
 
 class _SchedulerBase:
@@ -267,7 +412,10 @@ class _SchedulerBase:
         # Shared with the server's streaming path so batched and streamed
         # generations never run concurrently on one accelerator.
         self._backend_lock = lock if lock is not None else threading.Lock()
-        self._queue: "queue.Queue[Optional[_Ticket]]" = queue.Queue()
+        # Per-tier FIFO (ISSUE 11): higher-priority tickets dispatch
+        # first; within a tier, arrival order — with one tier in play
+        # (the default) this is exactly the old FIFO queue.
+        self._queue: "_TierQueue" = _TierQueue()
         self._thread: Optional[threading.Thread] = None
         self._running = False
         # Serialises submit() against stop() so a ticket can never be
@@ -387,6 +535,7 @@ class _SchedulerBase:
             "mode": "window",
             "running": self._running,
             "queue_depth": self._queue.qsize(),
+            "queue_tiers": self._queue.depths(),
             "max_batch": self.max_batch,
             "budget_aware": self.budget_aware,
             "window_s": self.window_s,
@@ -499,6 +648,13 @@ class _SchedulerBase:
             # whole chunked prefill (queue → last chunk → first token)
             sched_extras["joined"] = True
             sched_extras["join_chunks"] = ticket.join_chunks
+        if ticket.preempts:
+            # SLO-tier attribution (ISSUE 11): this row was preempted
+            # mid-flight and completed after resume — the bench's
+            # resumed-row parity check reads these off the wire
+            sched_extras["preempted"] = ticket.preempts
+            sched_extras["resumed"] = ticket.resumed
+            sched_extras["tier"] = ticket.priority
         result.extras = {
             **(result.extras or {}),
             "sched": sched_extras,
@@ -746,6 +902,8 @@ class ContinuousScheduler(_SchedulerBase):
         chunked_joins: bool = True,
         ttft_slo_ms: Optional[float] = None,
         spec_accept_floor: Optional[float] = None,
+        preempt_policy: str = "swap",
+        preempt_max_wait_s: float = 30.0,
     ) -> None:
         super().__init__(
             backend,
@@ -779,6 +937,23 @@ class ContinuousScheduler(_SchedulerBase):
             else None
         )
         self.chunked_joins = bool(chunked_joins)
+        # SLO tiers + mid-flight preemption (ISSUE 11). ``off`` disables
+        # preemption entirely (shed-at-the-edge only — the pre-ISSUE-11
+        # behavior and the bench's baseline arm); ``swap`` spills the
+        # victim's KV pages to host memory and restores them at resume;
+        # ``recompute`` drops the KV and re-prefills prompt + generated
+        # tokens through the chunked-join machinery. With one priority
+        # tier in play nothing ever preempts, so "swap" is safe as the
+        # default. ``preempt_max_wait_s`` is the starvation-protection
+        # clock: a parked victim ages up one tier per full wait (0
+        # disables aging).
+        if preempt_policy not in ("off", "swap", "recompute"):
+            raise ValueError(
+                f"preempt_policy must be 'off', 'swap' or 'recompute', "
+                f"got {preempt_policy!r}"
+            )
+        self.preempt_policy = preempt_policy
+        self.preempt_max_wait_s = float(preempt_max_wait_s or 0.0)
         # Optional fine-grained probe for benches: called with
         # (gap_seconds, live_rows) for every gap between two consecutive
         # decode-slice completions that live rows sat through — the
@@ -805,6 +980,8 @@ class ContinuousScheduler(_SchedulerBase):
         state["chunked_joins"] = self.chunked_joins
         state["prefill_chunk_tokens"] = self.prefill_chunk_tokens
         state["spec_accept_floor"] = self.spec_accept_floor
+        state["preempt_policy"] = self.preempt_policy
+        state["preempt_max_wait_s"] = self.preempt_max_wait_s
         # Sharded serving (ISSUE 8): a TP backend reports its mesh here
         # so one /debug/state probe shows WHICH device topology the
         # continuous loop is driving (None on single-device backends —
@@ -820,7 +997,7 @@ class ContinuousScheduler(_SchedulerBase):
         if dbg is None:
             state["session"] = None
             return state
-        session, live, pending = dbg
+        session, live, pending, parked = dbg
         now = time.monotonic()
         try:
             state["session"] = session.debug_state()
@@ -832,6 +1009,8 @@ class ContinuousScheduler(_SchedulerBase):
                 "age_s": round(now - t.t_submit, 4),
                 "max_new_tokens": t.request.max_new_tokens,
                 "joined": t.joined,
+                "tier": t.priority,
+                "preempts": t.preempts,
                 "streaming": t.stream is not None,
                 "tokens_streamed": (
                     t.stream.tokens_pushed if t.stream is not None else 0
@@ -849,6 +1028,21 @@ class ContinuousScheduler(_SchedulerBase):
                 "trace": trace_of(t.span),
             }
             for t, _pj in list(pending)
+        ]
+        state["parked"] = [
+            {
+                "model": p.ticket.request.model,
+                "tier": p.ticket.priority,
+                "base_tier": p.base_tier,
+                "policy": _pr_field(p.pr, "policy"),
+                "parked_s": round(now - p.t_parked, 4),
+                "host_bytes": _pr_field(p.pr, "host_bytes", 0),
+                "generated_tokens": len(
+                    _pr_field(p.pr, "generated", ()) or ()
+                ),
+                "trace": trace_of(p.ticket.span),
+            }
+            for p in list(parked)
         ]
         return state
 
@@ -952,18 +1146,22 @@ class ContinuousScheduler(_SchedulerBase):
         # round-robin order — _progress_joins advances the head one
         # chunk per loop iteration
         pending: "deque[tuple[_Ticket, object]]" = deque()
-        self._dbg = (session, live, pending)
+        # preemption victims parked for resume (ISSUE 11)
+        parked: "List[_Parked]" = []
+        self._dbg = (session, live, pending, parked)
         _INFLIGHT_G.set(session.active)
         try:
             prev_slice_end: Optional[float] = None
             # prefill tokens egress immediately: a streamed anchor's
             # first chunk exists before any decode slice ran
             self._push_deltas(session, live)
-            while self._running and (session.active or pending):
+            while self._running and (
+                session.active or pending or parked
+            ):
                 # cancellation/deadline sweep BETWEEN slices: a client
                 # that hung up (or a deadline that passed) retires its
                 # row within one decode slice
-                self._reap_expired(session, live, pending)
+                self._reap_expired(session, live, pending, parked)
                 rows_before = session.active
                 if rows_before:
                     t_slice0 = time.monotonic()
@@ -1008,12 +1206,18 @@ class ContinuousScheduler(_SchedulerBase):
                     # back-to-back until one commits
                     prev_slice_end = None
                 self._progress_joins(session, live, pending)
-                self._admit_into(session, live, anchor, pending)
+                # SLO tiers (ISSUE 11): age parked victims up, resume
+                # those that fit (and are not about to be re-preempted),
+                # THEN admit queued tickets — which may itself preempt
+                self._age_parked(parked)
+                self._resume_victims(session, live, pending, parked)
+                self._admit_into(session, live, anchor, pending, parked)
                 # newly committed/admitted streaming rows egress their
                 # prefill token now, and the session's stream_tokens
                 # flag is refreshed before the next slice
                 self._push_deltas(session, live)
                 _INFLIGHT_G.set(session.active + len(pending))
+                _PARKED_G.set(len(parked))
         except BaseException as exc:  # noqa: BLE001 — engine died mid-session
             _BATCH_FALLBACK_C.inc()
             FLIGHT.emit(
@@ -1027,9 +1231,14 @@ class ContinuousScheduler(_SchedulerBase):
                 f"continuous session died: {type(exc).__name__}: {exc}",
                 state=self.debug_state(),
             )
-            leftovers = list(live.values()) + [t for t, _ in pending]
+            leftovers = (
+                list(live.values())
+                + [t for t, _ in pending]
+                + [p.ticket for p in parked]
+            )
             live.clear()
             pending.clear()
+            parked.clear()
             for ticket in leftovers:
                 _ROWS_RETIRED_C.labels(reason="error").inc()
                 FLIGHT.emit(
@@ -1057,6 +1266,20 @@ class ContinuousScheduler(_SchedulerBase):
                     ticket, RuntimeError("server shutting down")
                 )
             pending.clear()
+            for entry in parked:
+                # only reachable when stop() interrupted the loop (the
+                # session's close above already settled the swap ledger)
+                _ROWS_RETIRED_C.labels(reason="shutdown").inc()
+                FLIGHT.emit(
+                    EV_ROW_RETIRED,
+                    trace=trace_of(entry.ticket.span),
+                    reason="shutdown",
+                )
+                self._fail_ticket(
+                    entry.ticket, RuntimeError("server shutting down")
+                )
+            parked.clear()
+            _PARKED_G.set(0)
             for ticket in live.values():
                 # only reachable when stop() interrupted the loop
                 _ROWS_RETIRED_C.labels(reason="shutdown").inc()
@@ -1090,16 +1313,37 @@ class ContinuousScheduler(_SchedulerBase):
                 # TTFT-at-first-chunk: the stream's own first-push clock
                 ticket.t_first = ticket.stream.t_first_chunk
 
-    def _reap_expired(self, session, live, pending) -> None:
+    def _reap_expired(self, session, live, pending, parked=None) -> None:
         """The CANCELLATION/DEADLINE sweep, run between two decode
         slices: live rows whose stream was cancelled (disconnect,
         explicit cancel, or backpressure) or whose ``deadline_ms``
         passed retire NOW through ``session.cancel`` — done-mask set,
         pages back to the pool free-list, ticket failed cleanly — and
-        pending chunked joiners abort their reservation the same way."""
-        if not live and not pending:
+        pending chunked joiners abort their reservation the same way.
+        PARKED preemption victims are swept too: their host blob is
+        discarded (``session.resume_discard`` settles the swap ledger)
+        instead of ever swapping back in."""
+        parked = parked if parked is not None else []
+        if not live and not pending and not parked:
             return
         now = time.monotonic()
+        for entry in list(parked):
+            reason = self._reap_reason(entry.ticket, now)
+            if reason is None:
+                continue
+            try:
+                with self._backend_lock:
+                    discard = getattr(session, "resume_discard", None)
+                    if discard is not None:
+                        discard(entry.pr)
+            except Exception:  # noqa: BLE001 — ledger only
+                pass
+            try:
+                parked.remove(entry)
+            except ValueError:
+                pass
+            _PARKED_G.set(len(parked))
+            self._fail_reaped(entry.ticket, reason)
         for ticket in list(live.values()):
             reason = self._reap_reason(ticket, now)
             if reason is None:
@@ -1218,13 +1462,19 @@ class ContinuousScheduler(_SchedulerBase):
             _DECODE_STALL_H.observe(dt)
         if committed:
             now = time.monotonic()
-            if ticket.stream is None:
+            if ticket.stream is None and ticket.t_first is None:
                 # first token sampled at commit; streamed joiners stamp
-                # t_first at their first pushed chunk instead
+                # t_first at their first pushed chunk instead (a RESUME
+                # keeps its original first-token clock — the row's TTFT
+                # happened before it was ever preempted)
                 ticket.t_first = now
-            ticket.joined = True
-            live[id(ticket.request)] = ticket
-            _ROWS_JOINED_C.inc()
+            if _is_resume(pj):
+                ticket.resumed = True
+                live[id(ticket.request)] = ticket
+            else:
+                ticket.joined = True
+                live[id(ticket.request)] = ticket
+                _ROWS_JOINED_C.inc()
         else:
             pending.append((ticket, pj))  # round-robin: back of the line
 
@@ -1244,12 +1494,187 @@ class ContinuousScheduler(_SchedulerBase):
             return
         self._finish_ticket(ticket, result, now)
 
+    def _age_parked(self, parked: "List[_Parked]") -> None:
+        """Starvation protection: a parked victim ages UP one tier per
+        full ``preempt_max_wait_s`` waited, so a low-tier victim under a
+        sustained high-tier storm eventually outranks the storm (the
+        resume gate reads the EFFECTIVE tier) and cannot be preempted
+        again once resumed at the aged tier."""
+        if not parked or self.preempt_max_wait_s <= 0:
+            return
+        now = time.monotonic()
+        for entry in parked:
+            aged = entry.base_tier + int(
+                (now - entry.t_parked) / self.preempt_max_wait_s
+            )
+            if aged > entry.ticket.priority:
+                entry.ticket.priority = aged
+
+    def _resume_victims(
+        self,
+        session,
+        live: Dict[int, _Ticket],
+        pending: "deque",
+        parked: "List[_Parked]",
+    ) -> None:
+        """The RESUME phase: parked victims re-enter when capacity
+        returns — through the chunked-join machinery (``resume_begin``
+        reserves slot + pages; a recompute victim's re-prefill then
+        interleaves with decode slices like any joiner's, a swap victim
+        commits on the next interleave turn). Highest effective tier
+        resumes first. Anti-thrash gate: while a strictly-higher-tier
+        ticket waits in the queue a victim stays parked (it would be
+        preempted again immediately) — unless the session is otherwise
+        idle, where resuming is always better than stalling. A victim
+        that can never resume (its plan is gone) fails once the session
+        is drained rather than parking forever."""
+        if not parked or not hasattr(session, "resume_begin"):
+            return
+        queue_tier = self._queue.max_tier()
+        for entry in sorted(
+            parked, key=lambda p: (-p.ticket.priority, p.t_parked)
+        ):
+            ticket, pr = entry.ticket, entry.pr
+            idle = session.active == 0 and not pending
+            if (
+                not idle
+                and queue_tier is not None
+                and queue_tier > ticket.priority
+            ):
+                continue
+            try:
+                with self._backend_lock:
+                    ok = session.can_resume(pr)
+            except Exception:  # noqa: BLE001 — probe only
+                ok = False
+            if not ok:
+                if idle and self._queue.qsize() == 0:
+                    # drained session, empty queue, still unresumable:
+                    # that never changes — fail it instead of spinning
+                    try:
+                        with self._backend_lock:
+                            discard = getattr(
+                                session, "resume_discard", None
+                            )
+                            if discard is not None:
+                                discard(pr)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    parked.remove(entry)
+                    _PARKED_G.set(len(parked))
+                    _ROWS_RETIRED_C.labels(reason="error").inc()
+                    FLIGHT.emit(
+                        EV_ROW_RETIRED,
+                        trace=trace_of(ticket.span),
+                        reason="error",
+                        resume_failed=True,
+                    )
+                    self._fail_ticket(
+                        ticket,
+                        RuntimeError(
+                            "preempted row could not resume (its shared "
+                            "prefix or session shapes are gone)"
+                        ),
+                    )
+                continue
+            try:
+                with TRACER.attach(ticket.span), self._backend_lock:
+                    pj = session.resume_begin(
+                        pr, self.prefill_chunk_tokens
+                    )
+            except BaseException as exc:  # noqa: BLE001
+                parked.remove(entry)
+                _PARKED_G.set(len(parked))
+                self._fail_ticket(ticket, exc)
+                continue
+            parked.remove(entry)
+            pending.append((ticket, pj))
+            _RESUMED_C.inc()
+            _PARKED_G.set(len(parked))
+            FLIGHT.emit(
+                EV_ROW_RESUMED,
+                trace=trace_of(ticket.span),
+                policy=_pr_field(pr, "policy"),
+                tier=ticket.priority,
+                aged=ticket.priority - entry.base_tier,
+                parked_s=round(time.monotonic() - entry.t_parked, 4),
+            )
+
+    def _preempt_for(
+        self,
+        session,
+        live: Dict[int, _Ticket],
+        ticket: _Ticket,
+        parked: "List[_Parked]",
+        cap: int,
+        pending: "deque",
+    ) -> bool:
+        """Make room for a higher-tier ticket by preempting the
+        YOUNGEST STRICTLY-LOWER-TIER live row(s), until the ticket fits
+        or no eligible victim remains. Victims park on the resume queue
+        (``_Parked``); each preemption emits the ``preempted`` flight
+        event trace-linked to BOTH tickets. Returns True when at least
+        one victim was parked (the caller retries the admit)."""
+        if not hasattr(session, "preempt"):
+            return False
+        tier = ticket.priority
+        did = False
+        skip: set = set()
+        while True:
+            try:
+                with self._backend_lock:
+                    if session.active + len(pending) < cap and (
+                        session.can_join(ticket.request)
+                    ):
+                        return did
+            except Exception:  # noqa: BLE001 — probe only
+                return did
+            victims = [
+                t
+                for t in live.values()
+                if t.priority < tier and id(t.request) not in skip
+            ]
+            if not victims:
+                return did
+            # lowest tier first; among equals the YOUNGEST (least sunk
+            # decode work is thrown away or swapped)
+            victim = min(
+                victims, key=lambda t: (t.priority, -t.t_submit)
+            )
+            try:
+                with self._backend_lock:
+                    pr = session.preempt(
+                        victim.request, policy=self.preempt_policy
+                    )
+            except Exception:  # noqa: BLE001 — engine refused
+                pr = None
+            if pr is None:
+                skip.add(id(victim.request))
+                continue
+            live.pop(id(victim.request), None)
+            victim.preempts += 1
+            parked.append(_Parked(victim, pr))
+            did = True
+            _PREEMPTED_C.labels(policy=self.preempt_policy).inc()
+            _PARKED_G.set(len(parked))
+            FLIGHT.emit(
+                EV_ROW_PREEMPTED,
+                trace=trace_of(victim.span),
+                by=trace_of(ticket.span),
+                policy=self.preempt_policy,
+                tier=victim.priority,
+                by_tier=tier,
+                generated_tokens=len(_pr_field(pr, "generated", ()) or ()),
+                swapped_bytes=_pr_field(pr, "host_bytes", 0),
+            )
+
     def _admit_into(
         self,
         session,
         live: Dict[int, _Ticket],
         anchor,
         pending: "deque",
+        parked: "Optional[List[_Parked]]" = None,
     ) -> None:
         """The JOIN phase: move queued compatible tickets into freed
         rows, re-evaluating the budget-aware cap at each admission
@@ -1258,9 +1683,15 @@ class ContinuousScheduler(_SchedulerBase):
         only RESERVES (``join_begin``: slot + pages, no device compute);
         the prefill then streams in one chunk per iteration via
         :meth:`_progress_joins`. Otherwise the whole prompt prefills here
-        (synchronous ``join``). Bounded by the queue's snapshot size; a
-        ticket that cannot join right now (incompatible, cap, no free
-        slot/pages) re-queues for the next slice or its own session."""
+        (synchronous ``join``). A compatible ticket that does NOT fit may
+        PREEMPT (ISSUE 11): when the preempt policy is on and a strictly
+        lower-tier live row exists, the youngest such victim is parked
+        (pages swapped out or dropped) and the admit retried — the
+        high-tier ticket enters within the same scheduler iteration.
+        Bounded by the queue's snapshot size; a ticket that cannot join
+        right now (incompatible, cap, no free slot/pages, no victim)
+        re-queues for the next slice or its own session."""
+        parked = parked if parked is not None else []
         chunked = self.chunked_joins and hasattr(session, "join_begin")
         for _ in range(self._queue.qsize()):
             try:
@@ -1277,22 +1708,37 @@ class ContinuousScheduler(_SchedulerBase):
             pj = None
             if self._compatible(anchor, request):
                 cap = self._admission_cap(ticket)
-                if session.active + len(pending) < cap:
-                    try:
-                        with TRACER.attach(ticket.span), self._backend_lock:
-                            if session.can_join(request):
-                                if chunked:
-                                    pj = session.join_begin(
-                                        request, self.prefill_chunk_tokens
-                                    )
-                                else:
-                                    session.join(request)
-                                admitted = True
-                    except BaseException as exc:  # noqa: BLE001
-                        # the join's prefill failed: this request's own
-                        # fault (bad prompt) — fail only its caller
-                        self._fail_ticket(ticket, exc)
-                        continue
+
+                def _try_admit():
+                    nonlocal pj
+                    if session.active + len(pending) >= cap:
+                        return False
+                    with TRACER.attach(ticket.span), self._backend_lock:
+                        if not session.can_join(request):
+                            return False
+                        if chunked:
+                            pj = session.join_begin(
+                                request, self.prefill_chunk_tokens
+                            )
+                        else:
+                            session.join(request)
+                    return True
+
+                try:
+                    admitted = _try_admit()
+                    if (
+                        not admitted
+                        and self.preempt_policy != "off"
+                        and self._preempt_for(
+                            session, live, ticket, parked, cap, pending
+                        )
+                    ):
+                        admitted = _try_admit()
+                except BaseException as exc:  # noqa: BLE001
+                    # the join's prefill failed: this request's own
+                    # fault (bad prompt) — fail only its caller
+                    self._fail_ticket(ticket, exc)
+                    continue
             if admitted:
                 now = time.monotonic()
                 ticket.queue_wait_s = now - ticket.t_submit

@@ -86,6 +86,9 @@ class GenerationServer:
         prefill_chunk_tokens: Optional[int] = None,  # continuous: join chunk
         ttft_slo_ms: Optional[float] = None,  # queued-past-SLO rejection
         spec_accept_floor: Optional[float] = None,  # speculative fallback
+        default_priority: Optional[int] = None,  # tier for bare requests
+        preempt_policy: Optional[str] = None,  # off|swap|recompute
+        preempt_max_wait_s: Optional[float] = None,  # victim aging clock
     ) -> None:
         """``batch_window_ms > 0`` or an explicit ``scheduler`` enables
         batching: concurrent non-streaming generate requests coalesce
@@ -133,8 +136,25 @@ class GenerationServer:
         late — load shedding at the cheapest possible point. Requests
         can additionally carry their own ``x_deadline_ms``, enforced
         both pre-admission and mid-flight (the row retires,
-        ``reason="deadline"``)."""
+        ``reason="deadline"``).
+
+        SLO tiers + preemption (ISSUE 11): ``default_priority`` is the
+        tier stamped on requests that do not send ``x_priority`` (CLI
+        ``--default-priority``, default "normal"); the scheduler queue
+        is per-tier FIFO. ``preempt_policy`` (continuous only; CLI
+        ``--preempt-policy``, default "swap") lets the scheduler
+        preempt a strictly-lower-tier in-flight row — KV pages swapped
+        to host memory, or dropped for re-prefill under "recompute";
+        "off" restores shed-at-the-edge-only overload handling.
+        ``preempt_max_wait_s`` (CLI ``--preempt-max-wait-s``) is the
+        starvation clock: a parked victim ages up one tier per full
+        wait."""
         self.backend = backend
+        self.default_priority = (
+            int(default_priority)
+            if default_priority is not None
+            else protocol.DEFAULT_PRIORITY
+        )
         self.models = list(models) if models else []
         self.quiet = quiet
         self.access_log = access_log
@@ -164,6 +184,11 @@ class GenerationServer:
                 batch_window_ms if batch_window_ms > 0 else 50.0
             ) / 1e3
             if mode == "continuous":
+                preempt_kwargs = {}
+                if preempt_policy is not None:
+                    preempt_kwargs["preempt_policy"] = preempt_policy
+                if preempt_max_wait_s is not None:
+                    preempt_kwargs["preempt_max_wait_s"] = preempt_max_wait_s
                 self._scheduler = ContinuousScheduler(
                     backend,
                     max_batch=max_batch,
@@ -174,6 +199,7 @@ class GenerationServer:
                     prefill_chunk_tokens=prefill_chunk_tokens,
                     ttft_slo_ms=ttft_slo_ms,
                     spec_accept_floor=spec_accept_floor,
+                    **preempt_kwargs,
                 )
             else:
                 self._scheduler = BatchScheduler(
@@ -384,7 +410,9 @@ class GenerationServer:
 
             def _handle_generate(self, body) -> None:
                 try:
-                    request = protocol.request_from_wire(body)
+                    request = protocol.request_from_wire(
+                        body, default_priority=server.default_priority
+                    )
                 except ValueError as exc:
                     self._send_json(400, {"error": str(exc)})
                     return

@@ -82,6 +82,54 @@ def lognormal_prompt_tokens(
     ]
 
 
+def parse_tier_mix(spec: str) -> Dict[str, float]:
+    """``"high=0.2,low=0.8"`` → {"high": 0.2, "low": 0.8}. Tier names
+    are serve/protocol.PRIORITY_TIERS keys or bare integers; fractions
+    need not sum to 1 — the remainder draws "normal"."""
+    out: Dict[str, float] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, frac = entry.partition("=")
+        if not eq:
+            raise ValueError(
+                f"tier mix entry {entry!r} is not name=fraction"
+            )
+        out[name.strip()] = float(frac)
+    if sum(out.values()) > 1.0 + 1e-9:
+        raise ValueError(f"tier mix fractions sum past 1: {spec!r}")
+    return out
+
+
+def draw_tiers(
+    n: int, tier_mix: Optional[Dict[str, float]], seed: int = 0
+) -> List[int]:
+    """``n`` seeded priority tiers drawn from ``tier_mix`` (fraction
+    mass not covered by the mix draws "normal"). Uses its own derived
+    seed, so enabling tiers replays the SAME arrivals/lengths — the
+    property the preemption bench's A/B arms depend on."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.protocol import (
+        DEFAULT_PRIORITY,
+        parse_priority,
+    )
+
+    if not tier_mix:
+        return [DEFAULT_PRIORITY] * n
+    rng = random.Random((seed << 16) ^ 0x71E5)
+    names = sorted(tier_mix)
+    tiers = []
+    for _ in range(n):
+        u, acc, drawn = rng.random(), 0.0, DEFAULT_PRIORITY
+        for name in names:
+            acc += tier_mix[name]
+            if u < acc:
+                drawn = parse_priority(name)
+                break
+        tiers.append(drawn)
+    return tiers
+
+
 def build_cancellations(
     n: int,
     cancel_frac: float,
@@ -140,6 +188,7 @@ def build_workload(
     prefix_pool: int = 1,
     shared_prefix_tokens: int = 192,
     anchor_shared_prefix: bool = False,
+    tier_mix: Optional[Dict[str, float]] = None,
 ) -> List[Tuple[float, GenerationRequest]]:
     """``[(arrival_offset_s, request), ...]`` — Poisson arrivals (seeded
     exponential inter-arrival; the first request arrives at t=0) over a
@@ -162,8 +211,14 @@ def build_workload(
     ``shared_prefix_tokens``-token system prompts in front of its own
     (always-unique) tail — the workload shared-prefix CoW paging is
     built for. A/B arms replay the SAME trace because the share draws
-    use their own derived seed."""
+    use their own derived seed.
+
+    ``tier_mix`` (ISSUE 11, :func:`parse_tier_mix`'s shape) stamps each
+    request with a seeded SLO tier — the priority-class traffic the
+    preemption bench A/Bs; the tier stream is independent of arrivals/
+    lengths, so the same trace replays across policy arms."""
     rng = random.Random(seed)
+    tiers = draw_tiers(n, tier_mix, seed=seed)
     share_rng = random.Random((seed << 16) ^ 0x5F1C)
     prefixes = (
         shared_prefix_texts(max(1, prefix_pool), shared_prefix_tokens)
@@ -223,6 +278,7 @@ def build_workload(
                     seed=i,
                     stop_at_eos=stop_at_eos,
                     deadline_ms=deadline_ms,
+                    priority=tiers[i],
                 ),
             )
         )
@@ -256,7 +312,11 @@ def run_load(
         if delay > 0:
             time.sleep(delay)
         t_submit = time.monotonic()
-        rec: Dict = {"offset_s": offset, "t_submit": t_submit - start}
+        rec: Dict = {
+            "offset_s": offset,
+            "t_submit": t_submit - start,
+            "tier": getattr(request, "priority", None),
+        }
         cancel_after = cancellations[i] if cancellations else None
         try:
             if cancel_after is not None and stream_submit is not None:
@@ -307,6 +367,8 @@ def _record_result(rec, result, t_submit, t_done, start) -> None:
         sched_completion_s=sched.get("completion_s"),
         joined=sched.get("joined"),
         join_chunks=sched.get("join_chunks"),
+        preempted=sched.get("preempted"),
+        resumed=sched.get("resumed"),
         t_done=t_done - start,
     )
 
@@ -408,6 +470,40 @@ def summarize(records: List[Dict]) -> Dict:
     if ttfts:
         out["ttft_p50_s"] = round(percentile(ttfts, 50), 4)
         out["ttft_p95_s"] = round(percentile(ttfts, 95), 4)
+        out["ttft_p99_s"] = round(percentile(ttfts, 99), 4)
+    preempted = [r for r in ok if r.get("preempted")]
+    if preempted:
+        out["preempted"] = len(preempted)
+        out["resumed"] = sum(1 for r in preempted if r.get("resumed"))
+    # per-tier breakdown (ISSUE 11): the high-tier TTFT tail under
+    # overload is THE number the preemption A/B trades for — reported
+    # per tier so one summary line carries both sides of the trade
+    tiers = sorted({r.get("tier") for r in records if r.get("tier") is not None})
+    if len(tiers) > 1:
+        by_tier = {}
+        for tier in tiers:
+            t_recs = [r for r in records if r.get("tier") == tier]
+            t_ok = [r for r in t_recs if "error" not in r]
+            t_done = [r for r in t_ok if not r.get("cancelled")]
+            t_ttfts = [
+                r["ttft_s"] for r in t_ok if r.get("ttft_s") is not None
+            ]
+            t_comps = [r["completion_s"] for r in t_done]
+            entry = {
+                "requests": len(t_recs),
+                "errors": len(t_recs) - len(t_ok),
+                "completion_p50_s": round(percentile(t_comps, 50), 4),
+                "completion_p95_s": round(percentile(t_comps, 95), 4),
+            }
+            if t_ttfts:
+                entry["ttft_p50_s"] = round(percentile(t_ttfts, 50), 4)
+                entry["ttft_p95_s"] = round(percentile(t_ttfts, 95), 4)
+                entry["ttft_p99_s"] = round(percentile(t_ttfts, 99), 4)
+            t_pre = [r for r in t_ok if r.get("preempted")]
+            if t_pre:
+                entry["preempted"] = len(t_pre)
+            by_tier[str(tier)] = entry
+        out["tiers"] = by_tier
     return out
 
 
@@ -457,6 +553,13 @@ def main() -> int:
         help="token length of each shared prefix",
     )
     ap.add_argument(
+        "--tier-mix", default=None,
+        help="seeded SLO-tier mix, e.g. 'high=0.2,low=0.8' (names from "
+        "serve/protocol.PRIORITY_TIERS or bare integers; uncovered "
+        "fraction mass draws 'normal'); the summary gains a per-tier "
+        "percentile breakdown",
+    )
+    ap.add_argument(
         "--fake", action="store_true",
         help="drive an in-process fake-backend continuous scheduler "
         "instead of a live server (hermetic demo/CI)",
@@ -494,6 +597,7 @@ def main() -> int:
         shared_prefix_frac=args.shared_prefix_frac,
         prefix_pool=args.prefix_pool,
         shared_prefix_tokens=args.shared_prefix_tokens,
+        tier_mix=parse_tier_mix(args.tier_mix) if args.tier_mix else None,
     )
     cancellations = None
     if args.cancel_frac > 0:

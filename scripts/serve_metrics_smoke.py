@@ -66,6 +66,18 @@ Three phases, all over the deterministic fake backend:
    fires (``llm_spec_fallback_total`` + the ``spec_fallback`` flight
    event carrying the floor).
 
+9. SLO TIERS + MID-FLIGHT PREEMPTION (ISSUE 11): two long LOW-tier
+   requests fill a 2-row fake session; a HIGH-tier request
+   (``x_priority: "high"``) arrives and must be admitted by PREEMPTING
+   the youngest low-tier row (swap policy — simulated KV bytes move to
+   host). Asserts the ``preempted``/``resumed`` flight events (trace-
+   linked to both tickets), ``llm_sched_preempted_total{policy}`` /
+   ``llm_sched_resumed_total``, ``llm_swap_bytes_total{direction}``
+   moving symmetrically, the mid-flight ``/debug/state`` showing
+   per-tier queue depths + the parked victim + non-zero session swap
+   accounting, the victim COMPLETING after resume with its full
+   stream, and the host-residency gauges returning exactly to zero.
+
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
 """
@@ -91,16 +103,19 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _post_generate(base: str, prompt: str, num_predict: int):
+def _post_generate(
+    base: str, prompt: str, num_predict: int, priority=None
+):
+    body = {
+        "model": "smoke:1b",
+        "prompt": prompt,
+        "options": {"num_predict": num_predict},
+    }
+    if priority is not None:
+        body["x_priority"] = priority
     req = urllib.request.Request(
         f"{base}/api/generate",
-        data=json.dumps(
-            {
-                "model": "smoke:1b",
-                "prompt": prompt,
-                "options": {"num_predict": num_predict},
-            }
-        ).encode(),
+        data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"},
     )
     with urllib.request.urlopen(req, timeout=30) as resp:
@@ -779,6 +794,113 @@ def main() -> int:
     finally:
         server8b.stop()
 
+    # -- phase 9: SLO tiers + mid-flight preemption (ISSUE 11) -----------------
+    # A 2-row fake session saturated by two long low-tier requests; a
+    # high-tier arrival preempts the YOUNGEST low row (swap policy),
+    # decodes, retires — and the victim resumes and completes. The
+    # asserts cover the whole observability surface: flight events,
+    # counters, per-tier /debug/state queues, swap accounting to zero.
+    server9 = GenerationServer(
+        FakeBackend(tokens_per_s=150.0, simulate_delay=True, max_rows=2),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    server9.start()
+    try:
+        base9 = f"http://127.0.0.1:{server9.port}"
+        pre9 = _scrape(base9)
+
+        def delta9(text_now, name):
+            try:
+                before = _metric_value(pre9, name)
+            except AssertionError:
+                before = 0.0
+            return _metric_value(text_now, name) - before
+
+        results9 = {}
+
+        def client9(name, prompt, num_predict, priority, delay_s):
+            time.sleep(delay_s)
+            results9[name] = _post_generate(
+                base9, prompt, num_predict, priority=priority
+            )
+
+        mid9 = {}
+
+        def probe9():
+            deadline9 = time.monotonic() + 30.0
+            while time.monotonic() < deadline9 and "parked" not in mid9:
+                try:
+                    st = _get_json(base9, "/debug/state")
+                    sch = st.get("scheduler") or {}
+                    parked = sch.get("parked") or []
+                    swap = (sch.get("session") or {}).get("swap") or {}
+                    if parked and swap.get("host_bytes", 0) > 0:
+                        mid9["parked"] = parked
+                        mid9["swap"] = swap
+                        mid9["queue_tiers"] = sch.get("queue_tiers")
+                except Exception:
+                    pass
+                time.sleep(0.003)
+
+        threads9 = [
+            threading.Thread(
+                target=client9, args=("low_old", "low tier old", 160, "low", 0.0)
+            ),
+            threading.Thread(
+                target=client9,
+                args=("low_young", "low tier young", 160, "low", 0.2),
+            ),
+            threading.Thread(
+                target=client9, args=("high", "high tier", 48, "high", 0.45)
+            ),
+            threading.Thread(target=probe9),
+        ]
+        for t in threads9:
+            t.start()
+        for t in threads9:
+            t.join(timeout=40)
+        for name in ("low_old", "low_young", "high"):
+            body9 = results9.get(name)
+            assert body9 and body9.get("done"), (name, body9)
+        # the victim completed its FULL stream after resume
+        assert results9["low_young"]["eval_count"] == 160, results9
+        victim_sched = results9["low_young"]["x_extras"]["sched"]
+        assert victim_sched.get("preempted") == 1, victim_sched
+        assert victim_sched.get("resumed") is True, victim_sched
+        assert "preempted" not in results9["high"]["x_extras"]["sched"]
+
+        text9 = _scrape(base9)
+        assert delta9(text9, "llm_sched_preempted_total") >= 1
+        assert delta9(text9, "llm_sched_resumed_total") >= 1
+        swap_out9 = delta9(text9, "llm_swap_bytes_total")
+        assert swap_out9 > 0, "swap byte counters never moved"
+        # host-residency gauges returned exactly to idle
+        assert _metric_value(text9, "llm_swap_host_bytes") == 0.0
+        assert _metric_value(text9, "llm_swap_host_rows") == 0.0
+
+        # flight story: preempted (trace-linked to BOTH tickets) then
+        # resumed for the same victim trace
+        pre_ev = _get_json(base9, "/debug/flight?type=preempted")["events"]
+        res_ev = _get_json(base9, "/debug/flight?type=resumed")["events"]
+        assert pre_ev and res_ev, (pre_ev, res_ev)
+        assert pre_ev[-1]["policy"] == "swap"
+        assert pre_ev[-1].get("trace") and pre_ev[-1].get("by")
+        assert pre_ev[-1]["by_tier"] > pre_ev[-1]["tier"]
+        assert res_ev[-1]["trace"] == pre_ev[-1]["trace"]
+
+        # the mid-flight probe saw the parked victim, its host-resident
+        # bytes, and the per-tier queue surface
+        assert mid9.get("parked"), f"probe never saw a parked victim: {mid9}"
+        assert mid9["parked"][0]["policy"] == "swap"
+        assert mid9["swap"]["host_rows"] == 1
+        assert mid9["swap"]["host_bytes"] > 0
+        assert isinstance(mid9.get("queue_tiers"), dict)
+    finally:
+        server9.stop()
+
     print(
         json.dumps(
             {
@@ -822,6 +944,13 @@ def main() -> int:
                     "accepted": accepted8,
                     "drafted": drafted8,
                     "fallbacks_at_zero_acceptance": fallbacks8,
+                },
+                "preemption": {
+                    "swap_bytes": swap_out9,
+                    "parked_mid_flight": len(mid9.get("parked", [])),
+                    "victim_completed_tokens": results9["low_young"][
+                        "eval_count"
+                    ],
                 },
             }
         )

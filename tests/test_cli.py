@@ -350,6 +350,54 @@ def test_serve_ttft_slo_knob(monkeypatch):
         serve_command(["--ttft-slo-ms", "-5"])
 
 
+def test_serve_preemption_knobs(monkeypatch):
+    """--default-priority / --preempt-policy / --preempt-max-wait-s
+    reach the server (ISSUE 11); tier names parse, bad values fail
+    fast, and omitting the flags leaves the scheduler defaults."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    cli.serve_command(
+        [
+            "--backend", "fake", "--port", "0",
+            "--default-priority", "high",
+            "--preempt-policy", "recompute",
+            "--preempt-max-wait-s", "7.5",
+        ]
+    )
+    assert captured["default_priority"] == 2  # "high"
+    assert captured["preempt_policy"] == "recompute"
+    assert captured["preempt_max_wait_s"] == 7.5
+
+    captured.clear()
+    cli.serve_command(["--backend", "fake", "--port", "0"])
+    assert captured["default_priority"] is None  # server default (normal)
+    assert captured["preempt_policy"] is None  # scheduler default (swap)
+    assert captured["preempt_max_wait_s"] is None
+
+    with pytest.raises(CommandError, match="default-priority"):
+        serve_command(["--default-priority", "urgent-ish"])
+    with pytest.raises(CommandError, match="preempt-policy"):
+        serve_command(["--preempt-policy", "maybe"])
+    with pytest.raises(CommandError, match="preempt-max-wait-s"):
+        serve_command(["--preempt-max-wait-s", "-1"])
+
+
 def test_serve_prefix_share_knobs(monkeypatch):
     """--prefix-share / --prefix-index-entries reach the ENGINE (ISSUE
     7: shared-prefix CoW paging is a backend capability, not a

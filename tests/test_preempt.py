@@ -1,0 +1,523 @@
+"""SLO tiers + mid-flight preemption (ISSUE 11).
+
+Engine half: ``PagePool.swap_out/swap_in`` round-trips pages through
+host memory with exact free-count and payload restoration (bf16 AND
+int8 — codes + per-position scales), refcounted CoW pages are refused
+by swap, and a preempted-then-resumed row's token stream is
+bit-identical to an uninterrupted solo ``generate()`` on every cache
+layout and under both policies (swap / recompute).
+
+Scheduler half: per-tier FIFO ordering, a higher-tier ticket preempting
+the youngest lower-tier live row when the session is full, the victim
+completing after resume, starvation aging, and the monotonic-clock
+regression pin (a wall-clock step must neither mass-expire nor
+immortalize in-flight rows).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+    FakeBackend,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+    JaxEngine,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.paged_kv import (
+    PagePool,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+    REGISTRY,
+    SWAP_HOST_BYTES_G,
+    SWAP_HOST_ROWS_G,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import protocol
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+    ContinuousScheduler,
+    _TierQueue,
+    _Ticket,
+)
+
+
+# -- PagePool swap -------------------------------------------------------------
+def _fill_pool_pages(pool, pages):
+    """Write a recognizable payload into ``pages`` and return the host
+    expectation, [N, L, Hkv, page, D]-chunk-shaped like a swap blob."""
+    import numpy as np
+
+    idx = jnp.asarray(pages, jnp.int32)
+    if pool.quantized:
+        qshape = pool.k["q"][:, idx].shape  # [L, N, Hkv, page, D]
+        sshape = pool.k["s"][:, idx].shape
+        kq = jnp.arange(np.prod(qshape), dtype=jnp.int32).reshape(qshape)
+        kq = (kq % 251 - 125).astype(jnp.int8)
+        ks = (
+            jnp.arange(np.prod(sshape), dtype=jnp.float32).reshape(sshape)
+            / 7.0
+            + 0.5
+        )
+        pool.k = {
+            "q": pool.k["q"].at[:, idx].set(kq),
+            "s": pool.k["s"].at[:, idx].set(ks),
+        }
+        pool.v = {
+            "q": pool.v["q"].at[:, idx].set(-kq),
+            "s": pool.v["s"].at[:, idx].set(ks * 2.0),
+        }
+    else:
+        shape = pool.k[:, idx].shape
+        payload = jnp.arange(
+            np.prod(shape), dtype=jnp.float32
+        ).reshape(shape) / 3.0
+        pool.k = pool.k.at[:, idx].set(payload.astype(pool.k.dtype))
+        pool.v = pool.v.at[:, idx].set((-payload).astype(pool.v.dtype))
+    return jax.device_get(
+        jax.tree.map(lambda a: a[:, idx], (pool.k, pool.v))
+    )
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_pagepool_swap_roundtrip_exact(quantized):
+    pool = PagePool.create(
+        n_layers=2, n_pages=8, n_kv_heads=2, d_head=4,
+        page_size=4, quantized=quantized,
+    )
+    pages = pool.alloc(3)
+    expect_k, expect_v = _fill_pool_pages(pool, pages)
+    assert pool.free_pages == 5
+    blob = pool.swap_out(pages)
+    # exact free-count restoration: every swapped page is free again
+    assert pool.free_pages == 8
+    assert blob.n_pages == 3 and blob.nbytes > 0
+    back = pool.swap_in(blob)
+    assert pool.free_pages == 5
+    import numpy as np
+
+    got_k, got_v = jax.device_get(
+        jax.tree.map(
+            lambda a: a[:, jnp.asarray(back, jnp.int32)], (pool.k, pool.v)
+        )
+    )
+    for exp, got in ((expect_k, got_k), (expect_v, got_v)):
+        if quantized:
+            np.testing.assert_array_equal(exp["q"], got["q"])
+            np.testing.assert_array_equal(exp["s"], got["s"])
+        else:
+            np.testing.assert_array_equal(exp, got)
+
+
+def test_pagepool_swap_refuses_shared_and_free_pages():
+    pool = PagePool.create(
+        n_layers=1, n_pages=4, n_kv_heads=1, d_head=4, page_size=4
+    )
+    pages = pool.alloc(2)
+    pool.share(pages[:1])  # a CoW prefix reader
+    with pytest.raises(ValueError, match="shared"):
+        pool.swap_out(pages)
+    # releasing the extra reader makes it swappable again
+    pool.free(pages[:1])
+    blob = pool.swap_out(pages)
+    assert blob.n_pages == 2
+    with pytest.raises(ValueError, match="free"):
+        pool.swap_out(pages)  # already free → bookkeeping bug
+
+
+def test_pagepool_swap_in_rejects_layout_mismatch():
+    pool = PagePool.create(
+        n_layers=1, n_pages=4, n_kv_heads=1, d_head=4, page_size=4
+    )
+    other = PagePool.create(
+        n_layers=1, n_pages=4, n_kv_heads=1, d_head=4,
+        page_size=4, quantized=True,
+    )
+    blob = pool.swap_out(pool.alloc(1))
+    with pytest.raises(ValueError, match="layout"):
+        other.swap_in(blob)
+
+
+# -- stepped-session preempt/resume parity -------------------------------------
+@pytest.fixture(scope="module")
+def engines():
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    cache = {}
+
+    def get(paged, kvq):
+        key = (paged, kvq)
+        if key not in cache:
+            cache[key] = JaxEngine(
+                registry=dict(registry),
+                dtype=jnp.float32,
+                paged_kv=paged,
+                kv_quantize=kvq,
+            )
+        return cache[key]
+
+    return get
+
+
+def _host_gauges():
+    return (
+        SWAP_HOST_BYTES_G._default.value,
+        SWAP_HOST_ROWS_G._default.value,
+    )
+
+
+@pytest.mark.parametrize(
+    "paged,kvq,policy",
+    [
+        (False, None, "swap"),
+        (True, None, "swap"),
+        (False, "int8", "swap"),
+        (True, "int8", "swap"),
+        (False, None, "recompute"),
+        (True, "int8", "recompute"),
+    ],
+    ids=[
+        "contig-bf16-swap", "paged-bf16-swap", "contig-int8-swap",
+        "paged-int8-swap", "contig-bf16-recompute", "paged-int8-recompute",
+    ],
+)
+def test_preempt_resume_token_parity(engines, paged, kvq, policy):
+    """A preempted-then-resumed row's stream is identical to solo
+    generate(); companions are unperturbed; the pool free count and the
+    host-swap gauges return exactly to their idle values."""
+    eng = engines(paged, kvq)
+    anchor = GenerationRequest(
+        "tiny", "anchor keeps decoding", max_new_tokens=32,
+        stop_at_eos=False,
+    )
+    victim = GenerationRequest(
+        "tiny", "victim of the overload", max_new_tokens=24,
+        stop_at_eos=False, seed=7, priority=0,
+    )
+    solo_v = eng.generate(victim).tokens
+    solo_a = eng.generate(anchor).tokens
+    idle_bytes, idle_rows = _host_gauges()
+    sess = eng.decode_open([anchor, victim], reserve_rows=4)
+    # idle pool = every page free except the session's parking page
+    pool_idle = sess.pool.n_pages - 1 if paged else None
+    sess.step(4)
+    free_before = sess.pool.free_pages if paged else None
+    pr = sess.preempt(victim, policy=policy)
+    assert pr is not None
+    if paged:
+        # every page the victim held is back on the free list
+        assert sess.pool.free_pages == free_before + pr.n_own_pages + len(
+            pr.shared_pages
+        )
+    if policy == "swap":
+        assert pr.host_bytes > 0
+        assert _host_gauges() == (idle_bytes + pr.host_bytes, idle_rows + 1)
+    else:
+        assert pr.host_bytes == 0 and pr.blob is None
+    sess.step(4)  # the anchor decodes on while the victim is parked
+    assert sess.can_resume(pr)
+    pend = sess.resume_begin(pr, 64)
+    while not sess.join_step(pend):
+        pass
+    sess.join_commit(pend)
+    assert _host_gauges() == (idle_bytes, idle_rows)
+    results = {}
+    while sess.active:
+        for res in sess.step(8):
+            results[id(res.request)] = res
+    assert results[id(victim)].tokens == solo_v
+    assert results[id(anchor)].tokens == solo_a
+    assert results[id(victim)].prompt_tokens == len(sess.tok.encode(victim.prompt))
+    sess.close()
+    if paged:
+        assert sess.pool.free_pages == pool_idle
+
+
+def test_preempt_during_pending_join(engines):
+    """Preempting a live row while a chunked joiner holds a pending
+    reservation: the joiner commits, the victim resumes, every stream
+    stays solo-identical and close() restores the pool exactly."""
+    eng = engines(True, None)
+    anchor = GenerationRequest(
+        "tiny", "anchor holds the session open for everyone",
+        max_new_tokens=40, stop_at_eos=False,
+    )
+    victim = GenerationRequest(
+        "tiny", "victim row", max_new_tokens=24, stop_at_eos=False, seed=3
+    )
+    joiner = GenerationRequest(
+        "tiny", "j" * 90, max_new_tokens=12, seed=5
+    )
+    solo = {r: eng.generate(r).tokens for r in (anchor, victim, joiner)}
+    sess = eng.decode_open([anchor, victim], reserve_rows=4)
+    pool_idle = sess.pool.n_pages - 1  # the parking page stays held
+    sess.step(4)
+    pend_join = sess.join_begin(joiner, 32)  # mid-prefill reservation
+    pr = sess.preempt(victim, policy="swap")
+    assert pr is not None
+    while not sess.join_step(pend_join):
+        pass
+    sess.join_commit(pend_join)
+    assert sess.can_resume(pr)
+    pend = sess.resume_begin(pr)
+    while not sess.join_step(pend):
+        pass
+    sess.join_commit(pend)
+    results = {}
+    while sess.active:
+        for res in sess.step(8):
+            results[id(res.request)] = res
+    for req, tokens in solo.items():
+        assert results[id(req)].tokens == tokens, req.prompt[:16]
+    sess.close()
+    assert sess.pool.free_pages == pool_idle
+
+
+def test_preempt_refuses_unknown_and_retired_rows(engines):
+    eng = engines(True, None)
+    req = GenerationRequest("tiny", "only row", max_new_tokens=6)
+    sess = eng.decode_open([req], reserve_rows=2)
+    stranger = GenerationRequest("tiny", "never admitted", max_new_tokens=4)
+    assert sess.preempt(stranger) is None
+    while sess.active:
+        sess.step(8)
+    assert sess.preempt(req) is None  # already retired
+    sess.close()
+
+
+def test_preempted_streaming_row_resumes_delta_cursor(engines):
+    """A streaming victim's egress cursor survives the round trip: no
+    token is delivered twice and none is lost."""
+    eng = engines(True, None)
+    anchor = GenerationRequest(
+        "tiny", "anchor", max_new_tokens=30, stop_at_eos=False
+    )
+    victim = GenerationRequest(
+        "tiny", "streamed victim", max_new_tokens=20,
+        stop_at_eos=False, seed=11,
+    )
+    sess = eng.decode_open([anchor, victim], reserve_rows=4)
+    sess.stream_tokens = True
+    delivered = []
+    sess.step(4)
+    for request, tokens, _text in sess.stream_deltas():
+        if request is victim:
+            delivered.extend(tokens)
+    pr = sess.preempt(victim, policy="swap")
+    assert pr is not None and pr.streamed == len(delivered)
+    sess.step(2)
+    pend = sess.resume_begin(pr)
+    while not sess.join_step(pend):
+        pass
+    sess.join_commit(pend)
+    final = None
+    while sess.active:
+        retired = sess.step(4)
+        for request, tokens, _text in sess.stream_deltas():
+            if request is victim:
+                delivered.extend(tokens)
+        for res in retired:
+            if res.request is victim:
+                final = res
+    assert final is not None
+    assert delivered == final.tokens
+    sess.close()
+
+
+# -- scheduler: tier queue, preemption end-to-end ------------------------------
+def test_tier_queue_orders_by_tier_then_fifo():
+    q = _TierQueue()
+    mk = lambda prio, tag: _Ticket(
+        GenerationRequest("m", tag, max_new_tokens=4, priority=prio)
+    )
+    low1, low2 = mk(0, "low1"), mk(0, "low2")
+    high = mk(2, "high")
+    norm = mk(1, "norm")
+    for t in (low1, low2, norm, high):
+        q.put(t)
+    assert q.qsize() == 4
+    assert q.depths() == {0: 2, 1: 1, 2: 1}
+    order = [q.get_nowait().request.prompt for _ in range(4)]
+    assert order == ["high", "norm", "low1", "low2"]
+    import queue as _queue
+
+    with pytest.raises(_queue.Empty):
+        q.get_nowait()
+
+
+def _snapshot(name):
+    fam = REGISTRY.snapshot().get(name) or {}
+    return sum(v for v in fam.values() if isinstance(v, (int, float)))
+
+
+def test_scheduler_preempts_lowest_tier_victim_and_resumes():
+    """Full session under a full fake pool: the high-tier ticket is
+    admitted by preempting the YOUNGEST low-tier row; the victim parks,
+    resumes when the high-tier row retires, and completes with its full
+    stream; counters + extras tell the story."""
+    pre0 = _snapshot("llm_sched_preempted_total")
+    res0 = _snapshot("llm_sched_resumed_total")
+    sched = ContinuousScheduler(
+        FakeBackend(tokens_per_s=200.0, simulate_delay=True, max_rows=2),
+        preempt_policy="swap",
+    )
+    sched.start()
+    results = {}
+
+    def run(name, req):
+        try:
+            results[name] = sched.submit(req)
+        except Exception as exc:  # noqa: BLE001
+            results[name] = exc
+
+    low_old = GenerationRequest(
+        "m", "older low row", max_new_tokens=128, priority=0
+    )
+    low_young = GenerationRequest(
+        "m", "younger low row", max_new_tokens=128, priority=0
+    )
+    high = GenerationRequest("m", "high tier", max_new_tokens=16, priority=2)
+    threads = [threading.Thread(target=run, args=("low_old", low_old))]
+    threads[0].start()
+    time.sleep(0.15)
+    threads.append(threading.Thread(target=run, args=("low_young", low_young)))
+    threads[1].start()
+    time.sleep(0.25)
+    t_high = time.monotonic()
+    threads.append(threading.Thread(target=run, args=("high", high)))
+    threads[2].start()
+    for t in threads:
+        t.join(timeout=30)
+    try:
+        for name in ("low_old", "low_young", "high"):
+            assert not isinstance(results.get(name), Exception), results
+        # the high-tier ticket did not wait for a 128-token row to drain
+        high_sched = results["high"].extras["sched"]
+        assert high_sched["completion_s"] < 0.45, high_sched
+        assert results["high"].generated_tokens == 16
+        # the YOUNGEST low row was the victim; it resumed and completed
+        young_sched = results["low_young"].extras["sched"]
+        assert young_sched.get("preempted") == 1
+        assert young_sched.get("resumed") is True
+        assert "preempted" not in results["low_old"].extras["sched"]
+        assert results["low_young"].generated_tokens == 128
+        assert _snapshot("llm_sched_preempted_total") == pre0 + 1
+        assert _snapshot("llm_sched_resumed_total") == res0 + 1
+        # swap ledger drained: nothing host-resident once all completed
+        assert SWAP_HOST_BYTES_G._default.value == 0
+        assert SWAP_HOST_ROWS_G._default.value == 0
+        assert t_high  # silence lint on the admission clock
+    finally:
+        sched.stop()
+
+
+def test_scheduler_preempt_off_keeps_shed_only_behavior():
+    """policy="off": a high-tier arrival waits for capacity instead of
+    preempting — the pre-ISSUE-11 behavior (and the bench baseline)."""
+    pre0 = _snapshot("llm_sched_preempted_total")
+    sched = ContinuousScheduler(
+        FakeBackend(tokens_per_s=400.0, simulate_delay=True, max_rows=1),
+        preempt_policy="off",
+    )
+    sched.start()
+    results = {}
+
+    def run(name, req):
+        results[name] = sched.submit(req)
+
+    low = GenerationRequest("m", "low", max_new_tokens=96, priority=0)
+    high = GenerationRequest("m", "high", max_new_tokens=8, priority=2)
+    t1 = threading.Thread(target=run, args=("low", low))
+    t1.start()
+    time.sleep(0.1)
+    t2 = threading.Thread(target=run, args=("high", high))
+    t2.start()
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    sched.stop()
+    assert _snapshot("llm_sched_preempted_total") == pre0
+    assert "preempted" not in (results["low"].extras or {}).get("sched", {})
+
+
+def test_parked_victim_ages_up_a_tier():
+    """Starvation protection: a parked victim's EFFECTIVE tier rises by
+    one per preempt_max_wait_s waited (victim selection and the resume
+    gate read it)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        _Parked,
+    )
+
+    sched = ContinuousScheduler(
+        FakeBackend(), preempt_policy="swap", preempt_max_wait_s=0.05
+    )
+    ticket = _Ticket(
+        GenerationRequest("m", "victim", max_new_tokens=4, priority=0)
+    )
+    entry = _Parked(ticket, {"policy": "swap", "host_bytes": 0})
+    entry.t_parked -= 0.12  # parked for > 2 aging periods
+    sched._age_parked([entry])
+    assert ticket.priority >= 2
+    # aging never lowers an already-raised tier
+    sched._age_parked([entry])
+    assert ticket.priority >= 2
+
+
+def test_reap_and_aging_survive_wall_clock_step(monkeypatch):
+    """Monotonic-clock pin (ISSUE 11 satellite): deadline reaping and
+    preemption age math run on time.monotonic(); a wall-clock step —
+    time.time() jumping a year — must neither mass-expire nor
+    immortalize in-flight rows."""
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler as sched_mod
+
+    monkeypatch.setattr(
+        sched_mod.time, "time", lambda: 4e9, raising=False
+    )
+    sched = ContinuousScheduler(
+        FakeBackend(tokens_per_s=400.0, simulate_delay=True)
+    )
+    sched.start()
+    try:
+        # a generous deadline: the wall-clock jump must not shed it
+        res = sched.submit(
+            GenerationRequest(
+                "m", "steady", max_new_tokens=16, deadline_ms=30_000
+            )
+        )
+        assert res.generated_tokens == 16
+    finally:
+        sched.stop()
+
+
+# -- wire ----------------------------------------------------------------------
+def test_priority_wire_roundtrip_and_names():
+    req = GenerationRequest("m", "p", max_new_tokens=4, priority=2)
+    wire = protocol.request_to_wire(req)
+    assert wire["x_priority"] == 2
+    back = protocol.request_from_wire(wire)
+    assert back.priority == 2
+    # default tier stays OFF the wire (older servers keep working)
+    plain = protocol.request_to_wire(
+        GenerationRequest("m", "p", max_new_tokens=4)
+    )
+    assert "x_priority" not in plain
+    # names and integers both parse; the server default fills absence
+    named = protocol.request_from_wire(
+        {"model": "m", "prompt": "p", "x_priority": "high"}
+    )
+    assert named.priority == protocol.PRIORITY_TIERS["high"]
+    defaulted = protocol.request_from_wire(
+        {"model": "m", "prompt": "p"}, default_priority=0
+    )
+    assert defaulted.priority == 0
+    with pytest.raises(ValueError):
+        protocol.parse_priority("urgent-ish")
+    with pytest.raises(ValueError):
+        protocol.parse_priority(-1)
+    with pytest.raises(ValueError):
+        GenerationRequest("m", "p", max_new_tokens=4, priority=-2)
