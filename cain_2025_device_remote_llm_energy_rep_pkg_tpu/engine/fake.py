@@ -84,6 +84,7 @@ class _FakeStepSession:
         # rise and return exactly to zero with no accelerator
         self._swap_bytes = 0
         self._swap_rows = 0
+        self._slices_run = 0  # mid-stream death injection clock
         for r in requests:
             self._admit(r)
 
@@ -141,8 +142,12 @@ class _FakeStepSession:
         return len(self._rows)
 
     def can_join(self, request: GenerationRequest) -> bool:
+        # a killed backend (fail_decode_open) admits no NEW rows while
+        # its live rows run to completion — the soft-death shape the
+        # router's zero-lost-tickets guarantee is tested against
         return (
             not self.closed
+            and not self.backend.fail_decode_open
             and len(self._rows) + len(self._pending) < self.max_rows
         )
 
@@ -357,6 +362,14 @@ class _FakeStepSession:
     def step(self, max_steps: int = 16) -> List[GenerationResult]:
         if self.closed:
             raise RuntimeError("session is closed")
+        # simulated mid-stream death (router/failure-path tests): the
+        # session dies AFTER fail_after_slices slices completed — rows
+        # may already have streamed tokens, so a front-door router must
+        # NOT retry (the never-after-first-streamed-token rule)
+        if self.backend.fail_after_slices is not None:
+            self._slices_run += 1
+            if self._slices_run > self.backend.fail_after_slices:
+                raise RuntimeError("fake backend died mid-stream")
         if self.backend.simulate_delay and self._rows:
             # one SHARED window per slice, not per row — the semantics of
             # a real batched decode slice
@@ -504,6 +517,15 @@ class FakeBackend(GenerationBackend):
     ):
         self.tokens_per_s = tokens_per_s
         self.simulate_delay = simulate_delay
+        # Failure injection for router/failure-path tests (ISSUE 12) —
+        # both MUTABLE so a test can kill a live replica mid-trace:
+        # fail_decode_open makes every session open raise (a replica
+        # dying mid-prefill — retryable at the front door);
+        # fail_after_slices kills a live session after that many decode
+        # slices (mid-stream death — NOT retryable, rows already
+        # streamed).
+        self.fail_decode_open = False
+        self.fail_after_slices: Optional[int] = None
         # session row capacity: small values simulate a saturated pool
         # so scheduler preemption (ISSUE 11) is testable hermetically
         self.max_rows = int(max_rows)
@@ -553,6 +575,13 @@ class FakeBackend(GenerationBackend):
         )
 
     def generate(self, request: GenerationRequest) -> GenerationResult:
+        # a dead backend is dead on EVERY path: the continuous
+        # scheduler's engine-death salvage re-runs tickets through this
+        # blocking path, and a truly-dead engine must fail them there
+        # too (that is what surfaces a mid-stream death as a terminal
+        # stream error instead of a silent salvage)
+        if self.fail_decode_open or self.fail_after_slices is not None:
+            raise RuntimeError("fake backend died (simulated)")
         result = self._result(request)
         if self.simulate_delay:
             time.sleep(result.total_s)
@@ -570,6 +599,10 @@ class FakeBackend(GenerationBackend):
         engine (the fake session's step takes the width per call);
         ``spec_accept_floor`` overrides the backend's fallback floor per
         session, exactly like the real engine's decode_open."""
+        if self.fail_decode_open:
+            raise RuntimeError(
+                "fake backend refused decode_open (simulated death)"
+            )
         return _FakeStepSession(
             self,
             requests,

@@ -59,6 +59,13 @@ EV_ROW_PREEMPTED = "preempted"  # a lower-tier live row was preempted for a
 EV_ROW_RESUMED = "resumed"  # a preempted row re-entered its session
 #   (trace = victim; parked_s, aged tier, policy actually used)
 EV_BATCH_FALLBACK = "batch_fallback"  # batch/session dispatch failed → bisection
+# Replica-fleet routing (ISSUE 12, serve/router.py):
+EV_DISPATCHED = "dispatched"  # the router sent a ticket to a replica
+#   (trace = ticket's root; replica, policy, retry flag ride along)
+EV_REPLICA_DOWN = "replica_down"  # a replica turned unhealthy (probe
+#   failure or a dispatch-observed death; error attr says which)
+EV_REPLICA_DRAINED = "replica_drained"  # drain() completed: in-flight
+#   rows finished and the replica detached from the fleet
 EV_POOL_EXHAUSTED = "pool_exhausted"  # PagePool refused an allocation
 EV_PREFIX_HIT = "prefix_hit"  # a joiner reused cached shared-prefix KV
 EV_PREFIX_EVICT = "prefix_evict"  # a prefix-index entry was evicted (LRU)

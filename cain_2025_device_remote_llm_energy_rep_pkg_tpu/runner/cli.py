@@ -238,7 +238,27 @@ commands:
                        /debug/state + /debug/flight introspection
                        endpoints, spans, the flight recorder and
                        per-request energy attribution — default on;
-                       env twin: TPU_LLM_OBS=0)
+                       env twin: TPU_LLM_OBS=0);
+                       Replica fleets: --replicas N runs N fully
+                       INDEPENDENT backend+scheduler replicas in this
+                       process behind the front-door router
+                       (serve/router.py — same wire protocol incl. SSE
+                       streaming, x_priority, x_deadline_ms);
+                       --route-policy least-queue|least-pages|
+                       least-joules|round-robin picks the dispatch
+                       policy (default least-queue) fed by per-replica
+                       /healthz + /metrics probes every
+                       --probe-interval-ms (default 1000); a ticket
+                       whose replica refuses admission or dies before
+                       its first streamed token retries ONCE on a
+                       different replica
+  serve-fleet --targets host:port[,host:port...] [--route-policy P]
+                       [--port N] [--models a,b] [--probe-interval-ms M]
+                       the front-door router over ALREADY-RUNNING
+                       `serve` processes (one per host/chip) — the
+                       multi-host twin of `serve --replicas N`; probes
+                       each target's /healthz + /metrics and dispatches
+                       by the same policies
   help                 show this message
 """
 
@@ -272,6 +292,9 @@ def serve_command(args: List[str]) -> None:
     prefix_share = False
     prefix_index_entries = None
     access_log = False
+    replicas = 1  # >1: a replica fleet behind the front-door router
+    route_policy = None  # router default ("least-queue")
+    probe_interval_ms = None  # router default (1000 ms)
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -426,6 +449,30 @@ def serve_command(args: List[str]) -> None:
                 kv_quantize = None
         elif arg == "--paged-kv":
             paged_kv = True
+        elif arg == "--replicas":
+            # N independent backend+scheduler replicas behind the
+            # front-door router (serve/router.py); 1 = the classic
+            # single-backend server.
+            replicas = int(next(it, "1"))
+            if replicas < 1:
+                raise CommandError(
+                    "serve: --replicas expects a positive integer"
+                )
+        elif arg == "--route-policy":
+            from ..serve.router import ROUTE_POLICIES
+
+            route_policy = next(it, "")
+            if route_policy not in ROUTE_POLICIES:
+                raise CommandError(
+                    "serve: --route-policy expects one of "
+                    + "|".join(ROUTE_POLICIES)
+                )
+        elif arg == "--probe-interval-ms":
+            probe_interval_ms = float(next(it, "0")) or None
+            if probe_interval_ms is not None and probe_interval_ms <= 0:
+                raise CommandError(
+                    "serve: --probe-interval-ms expects a positive number"
+                )
         elif arg == "--access-log":
             access_log = True
         elif arg == "--no-telemetry":
@@ -443,72 +490,121 @@ def serve_command(args: List[str]) -> None:
         from ..utils.compile_cache import enable_compilation_cache
 
         enable_compilation_cache()
-    if backend_kind == "fake":
-        import os
+    def build_backend():
+        """One fresh backend instance — called once for the classic
+        single-backend server, N times for ``--replicas N`` (each
+        replica owns a fully independent engine + KV budget)."""
+        if backend_kind == "fake":
+            import os
 
-        from ..engine.fake import FakeBackend
+            from ..engine.fake import FakeBackend
 
-        # --speculative on the fake backend runs the synthetic spec
-        # protocol (k from the first configured entry; acceptance via
-        # env FAKE_SPEC_ACCEPTANCE, default 1.0) so the serving surface
-        # is demo-able with no accelerator
-        spec_k = next(iter(speculative.values()))[1] if speculative else 0
-        backend = FakeBackend(
-            spec_k=spec_k,
-            spec_acceptance=float(
-                os.environ.get("FAKE_SPEC_ACCEPTANCE", "1.0")
-            ),
-            spec_accept_floor=spec_accept_floor,
-        )
-    elif backend_kind == "jax-tp":
-        from ..parallel.mesh import MeshSpec, build_mesh
-        from ..parallel.tp import TensorParallelEngine
+            # --speculative on the fake backend runs the synthetic spec
+            # protocol (k from the first configured entry; acceptance
+            # via env FAKE_SPEC_ACCEPTANCE, default 1.0) so the serving
+            # surface is demo-able with no accelerator
+            spec_k = (
+                next(iter(speculative.values()))[1] if speculative else 0
+            )
+            return FakeBackend(
+                spec_k=spec_k,
+                spec_acceptance=float(
+                    os.environ.get("FAKE_SPEC_ACCEPTANCE", "1.0")
+                ),
+                spec_accept_floor=spec_accept_floor,
+            )
+        if backend_kind == "jax-tp":
+            from ..parallel.mesh import MeshSpec, build_mesh
+            from ..parallel.tp import TensorParallelEngine
 
-        backend = TensorParallelEngine(
-            mesh=build_mesh(MeshSpec.tp_only(tp)),
-            decode_attention="auto",
-            hf_checkpoints=hf_checkpoints or None,
-            quantize=quantize,
-            kv_quantize=kv_quantize,
-            paged_kv=paged_kv,
-            speculative=speculative or None,
-            spec_accept_floor=spec_accept_floor or 0.0,
-            prefix_cache_size=prefix_cache,
-            prefix_share=prefix_share,
-            **(
-                {"prefix_index_entries": prefix_index_entries}
-                if prefix_index_entries is not None
-                else {}
-            ),
-        )
-    elif backend_kind == "jax":
-        from ..engine.jax_engine import JaxEngine
+            return TensorParallelEngine(
+                mesh=build_mesh(MeshSpec.tp_only(tp)),
+                decode_attention="auto",
+                hf_checkpoints=hf_checkpoints or None,
+                quantize=quantize,
+                kv_quantize=kv_quantize,
+                paged_kv=paged_kv,
+                speculative=speculative or None,
+                spec_accept_floor=spec_accept_floor or 0.0,
+                prefix_cache_size=prefix_cache,
+                prefix_share=prefix_share,
+                **(
+                    {"prefix_index_entries": prefix_index_entries}
+                    if prefix_index_entries is not None
+                    else {}
+                ),
+            )
+        if backend_kind == "jax":
+            from ..engine.jax_engine import JaxEngine
 
-        backend = JaxEngine(
-            decode_attention="auto",
-            hf_checkpoints=hf_checkpoints or None,
-            quantize=quantize,
-            kv_quantize=kv_quantize,
-            paged_kv=paged_kv,
-            speculative=speculative or None,
-            spec_accept_floor=spec_accept_floor or 0.0,
-            prefix_cache_size=prefix_cache,
-            prefix_share=prefix_share,
-            **(
-                {"prefix_index_entries": prefix_index_entries}
-                if prefix_index_entries is not None
-                else {}
-            ),
-        )
-    else:
+            return JaxEngine(
+                decode_attention="auto",
+                hf_checkpoints=hf_checkpoints or None,
+                quantize=quantize,
+                kv_quantize=kv_quantize,
+                paged_kv=paged_kv,
+                speculative=speculative or None,
+                spec_accept_floor=spec_accept_floor or 0.0,
+                prefix_cache_size=prefix_cache,
+                prefix_share=prefix_share,
+                **(
+                    {"prefix_index_entries": prefix_index_entries}
+                    if prefix_index_entries is not None
+                    else {}
+                ),
+            )
         raise CommandError(f"serve: unknown backend {backend_kind!r}")
 
     if models is None and backend_kind != "fake":
         from ..models.config import MODEL_REGISTRY
 
         models = sorted(MODEL_REGISTRY)
+    if replicas > 1:
+        # Replica fleet behind the front-door router (ISSUE 12): N
+        # fully independent backend+scheduler pairs in this process;
+        # real multi-host deployments run one `serve` per host and
+        # attach them with `serve-fleet --targets`.
+        from ..serve.router import LocalReplica, Router, RouterServer
+
+        sched_kwargs = {
+            k: v
+            for k, v in {
+                "max_batch": max_batch,
+                "budget_aware": budget_aware,
+                "slice_steps": slice_steps,
+                "prefill_chunk_tokens": prefill_chunk_tokens,
+                "ttft_slo_ms": ttft_slo_ms,
+                "spec_accept_floor": spec_accept_floor,
+                "preempt_policy": preempt_policy,
+                "preempt_max_wait_s": preempt_max_wait_s,
+            }.items()
+            if v is not None
+        }
+        if batch_window_ms > 0:
+            sched_kwargs["window_s"] = batch_window_ms / 1e3
+        fleet = [
+            LocalReplica(f"r{i}", build_backend(), **sched_kwargs)
+            for i in range(replicas)
+        ]
+        router = Router(
+            fleet,
+            policy=route_policy or "least-queue",
+            **(
+                {"probe_interval_s": probe_interval_ms / 1e3}
+                if probe_interval_ms is not None
+                else {}
+            ),
+        )
+        RouterServer(
+            router,
+            host=host,
+            port=DEFAULT_PORT if port is None else port,
+            models=models,
+            default_priority=default_priority,
+        ).serve_forever()
+        return
     server = GenerationServer(
-        backend,
+        build_backend(),
         host=host,
         port=DEFAULT_PORT if port is None else port,
         models=models,
@@ -526,6 +622,77 @@ def serve_command(args: List[str]) -> None:
         preempt_max_wait_s=preempt_max_wait_s,
     )
     server.serve_forever()
+
+
+def serve_fleet_command(args: List[str]) -> None:
+    """Front-door router over ALREADY-RUNNING replica servers: each
+    ``--targets`` entry is one ``serve`` process (any backend) reached
+    over the wire — the multi-host deployment shape; ``serve
+    --replicas N`` is the in-process (single-host / CI) twin."""
+    port = None
+    host = "0.0.0.0"
+    targets: List[str] = []
+    models: Optional[List[str]] = None
+    route_policy = None
+    probe_interval_ms = None
+    default_priority = None
+    it = iter(args)
+    for arg in it:
+        if arg == "--port":
+            port = int(next(it, "11434"))
+        elif arg == "--host":
+            host = next(it, "0.0.0.0")
+        elif arg == "--targets":
+            targets = [t for t in next(it, "").split(",") if t]
+        elif arg == "--models":
+            models = [m for m in next(it, "").split(",") if m]
+        elif arg == "--route-policy":
+            from ..serve.router import ROUTE_POLICIES
+
+            route_policy = next(it, "")
+            if route_policy not in ROUTE_POLICIES:
+                raise CommandError(
+                    "serve-fleet: --route-policy expects one of "
+                    + "|".join(ROUTE_POLICIES)
+                )
+        elif arg == "--probe-interval-ms":
+            probe_interval_ms = float(next(it, "0")) or None
+        elif arg == "--default-priority":
+            from ..serve.protocol import parse_priority
+
+            try:
+                default_priority = parse_priority(next(it, ""))
+            except ValueError as exc:
+                raise CommandError(f"serve-fleet: --default-priority: {exc}")
+        else:
+            raise CommandError(f"serve-fleet: unrecognised option {arg!r}")
+    if not targets:
+        raise CommandError(
+            "serve-fleet: --targets host:port[,host:port...] is required"
+        )
+    from ..serve.protocol import DEFAULT_PORT
+    from ..serve.router import RemoteReplica, Router, RouterServer
+
+    fleet = []
+    for i, target in enumerate(targets):
+        url = target if target.startswith("http") else f"http://{target}"
+        fleet.append(RemoteReplica(f"r{i}", url))
+    router = Router(
+        fleet,
+        policy=route_policy or "least-queue",
+        **(
+            {"probe_interval_s": probe_interval_ms / 1e3}
+            if probe_interval_ms is not None
+            else {}
+        ),
+    )
+    RouterServer(
+        router,
+        host=host,
+        port=DEFAULT_PORT if port is None else port,
+        models=models,
+        default_priority=default_priority,
+    ).serve_forever()
 
 
 def analyze_command(
@@ -746,6 +913,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             prepare()
         elif cmd == "serve":
             serve_command(args[1:])
+        elif cmd == "serve-fleet":
+            serve_fleet_command(args[1:])
         elif cmd.endswith(".py"):
             run_config_file(Path(cmd))
         else:

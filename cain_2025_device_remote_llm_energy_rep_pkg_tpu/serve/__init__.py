@@ -12,6 +12,20 @@ machine boundary exactly as the reference's did.
 
 from .client import RemoteHTTPBackend
 from .protocol import DEFAULT_PORT
+from .router import (
+    LocalReplica,
+    RemoteReplica,
+    Router,
+    RouterServer,
+)
 from .server import GenerationServer
 
-__all__ = ["GenerationServer", "RemoteHTTPBackend", "DEFAULT_PORT"]
+__all__ = [
+    "GenerationServer",
+    "RemoteHTTPBackend",
+    "DEFAULT_PORT",
+    "Router",
+    "RouterServer",
+    "LocalReplica",
+    "RemoteReplica",
+]

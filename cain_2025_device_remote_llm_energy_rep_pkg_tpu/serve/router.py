@@ -1,0 +1,1006 @@
+"""Replica-fleet serving: a metrics-driven front door over independent
+stepped-session replicas (ISSUE 12).
+
+Everything below this module lives in ONE scheduler driving ONE engine:
+admission is capped by a single PagePool's HBM no matter how good the
+iteration-level scheduler is. This module is the data-parallel layer
+above it — the Orca-style serving analogue of data parallelism: N fully
+independent ``ContinuousScheduler`` + engine replicas behind one HTTP
+front door that speaks the SAME wire protocol (SSE streaming,
+``x_priority``, ``x_deadline_ms``) and dispatches each ticket by live
+replica gauges. The source paper asks where a request should run
+(on-device vs remote) from offline measurements; the router turns that
+into an ONLINE policy — ``least-joules`` routes by the live
+``llm_request_joules_per_token`` attribution, next to the queue-depth
+and pool-occupancy policies.
+
+Pieces:
+
+- :class:`LocalReplica` — an in-process backend + scheduler pair (the
+  CI/test fleet shape, and ``serve --replicas N``): probed by direct
+  calls (``scheduler.health_state()``), dispatched by direct calls
+  (``submit``/``submit_stream``) — no loopback HTTP tax.
+- :class:`RemoteReplica` — a replica living in another process/host,
+  reached through :class:`~.client.RemoteHTTPBackend`: probed via
+  ``GET /healthz`` (liveness + scheduler kind + inflight, works under
+  the replica's telemetry kill switch) plus a best-effort ``/metrics``
+  scrape for the pool-occupancy / J-per-token gauges; dispatched over
+  the wire (``serve-fleet --targets``).
+- :class:`Router` — fleet membership + health probing + the pluggable
+  dispatch policy + the RETRY-ONCE rule: a ticket whose chosen replica
+  refuses admission or dies before its first streamed token is retried
+  on ONE different replica; after the first streamed token a death is
+  surfaced as a terminal stream error, never retried (the client
+  already consumed output — a silent replay would duplicate it).
+  ``drain()`` stops new dispatch to a replica, lets its in-flight rows
+  finish, then detaches it; ``add_replica()`` scales the fleet up.
+- :class:`RouterServer` — the HTTP front door itself: ``/api/generate``
+  (buffered + SSE streaming; a client hanging up mid-stream cancels the
+  replica-side row through the closed chunk iterator), ``/healthz``,
+  ``/metrics``, ``/debug/state`` (per-replica snapshot + last probe)
+  and ``/debug/flight``.
+
+Observability: ``llm_router_dispatch_total{replica,policy}``,
+``llm_router_retries_total{reason}``, the per-replica
+``llm_router_replica_healthy`` gauge, ``llm_router_probe_seconds``, and
+``dispatched`` / ``replica_down`` / ``replica_drained`` flight events
+trace-linked to the ticket's request root.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterator, List, Optional
+
+from ..engine.backend import (
+    GenerationBackend,
+    GenerationChunk,
+    GenerationRequest,
+    GenerationResult,
+)
+from ..obs import metrics as obs_metrics
+from ..obs.flight import (
+    EV_DISPATCHED,
+    EV_REPLICA_DOWN,
+    EV_REPLICA_DRAINED,
+    FLIGHT,
+    trace_of,
+)
+from ..obs.metrics import REGISTRY
+from ..obs.trace import TRACER
+from ..runner import term
+from . import protocol
+from .client import RemoteHTTPBackend, RemoteServerError
+from .stream import DeadlineExceeded, StreamCancelled
+
+ROUTE_POLICIES = (
+    "least-queue",  # fewest queued + in-flight rows (default)
+    "least-pages",  # lowest paged-pool occupancy (falls back to queue)
+    "least-joules",  # lowest recent J/token (falls back to queue)
+    "round-robin",  # membership order, rotating
+)
+
+# How often the background prober refreshes every replica's stats. The
+# dispatch policies additionally weigh the router's own REAL-TIME
+# outstanding-ticket counts, so a stale probe between two ticks cannot
+# pile a burst onto one replica.
+DEFAULT_PROBE_INTERVAL_S = 1.0
+
+_DISPATCH_C = REGISTRY.counter(
+    "llm_router_dispatch_total",
+    "Tickets dispatched to a replica by the front-door router (each "
+    "retry attempt counts again, on the replica that received it)",
+    labels=("replica", "policy"),
+)
+_RETRIES_C = REGISTRY.counter(
+    "llm_router_retries_total",
+    "Tickets re-dispatched to a different replica, by reason (refused: "
+    "the replica declined admission — scheduler stopped or fleet-full; "
+    "dead: the replica errored/disconnected before the ticket's first "
+    "streamed token)",
+    labels=("reason",),
+)
+_REPLICA_HEALTHY_G = REGISTRY.gauge(
+    "llm_router_replica_healthy",
+    "1 while a replica answers its health probe (0: down or detached)",
+    labels=("replica",),
+)
+_PROBE_H = REGISTRY.histogram(
+    "llm_router_probe_seconds",
+    "Wall time of one replica health/metrics probe",
+)
+
+
+def _metrics_gauge(text: str, name: str) -> Optional[float]:
+    """First sample of a gauge family in a Prometheus text exposition
+    (None when absent) — the router's /metrics scrape parser."""
+    m = re.search(
+        rf"^{re.escape(name)}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$",
+        text,
+        re.MULTILINE,
+    )
+    return float(m.group(1)) if m else None
+
+
+def _metrics_hist_mean(text: str, name: str) -> Optional[float]:
+    """Mean of a histogram family (sum/count; None when absent/empty)."""
+    total = _metrics_gauge(text, f"{name}_sum")
+    count = _metrics_gauge(text, f"{name}_count")
+    if total is None or not count:
+        return None
+    return total / count
+
+
+def _retry_reason(exc: BaseException) -> Optional[str]:
+    """Classify a dispatch failure for the retry-once rule: ``refused``
+    (the replica declined admission), ``dead`` (it errored or the
+    connection dropped), or None — the ticket's own terminal outcome
+    (bad request, unknown model, deadline, cancellation), which a
+    different replica would only repeat."""
+    if isinstance(
+        exc, (DeadlineExceeded, StreamCancelled, ValueError, KeyError)
+    ):
+        return None
+    if isinstance(exc, RemoteServerError):
+        if exc.status == 503:
+            return "refused"
+        if exc.status >= 500:
+            return "dead"
+        return None
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        if "not running" in msg or "shutting down" in msg:
+            return "refused"
+        return "dead"
+    if isinstance(exc, (urllib.error.URLError, OSError)):
+        return "dead"
+    if isinstance(exc, Exception):
+        return "dead"  # an engine death surfaces as its own exception
+    return None  # KeyboardInterrupt/SystemExit etc: never retried
+
+
+class Replica:
+    """One fleet member: a name, a dispatch surface (``generate`` /
+    ``stream``), a probe, and the router-side bookkeeping (health,
+    draining flag, real-time outstanding count)."""
+
+    kind = "replica"
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.healthy = True
+        self.draining = False
+        self.outstanding = 0  # tickets the router currently has on us
+        self.dispatched = 0  # attempts routed here (lifetime)
+        self.last_stats: Dict[str, object] = {}
+        self.t_probe: Optional[float] = None
+
+    # -- dispatch surface (subclasses implement) -------------------------------
+    def generate(self, request: GenerationRequest) -> GenerationResult:
+        raise NotImplementedError
+
+    def stream(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
+        raise NotImplementedError
+
+    def probe(self) -> Dict[str, object]:
+        """Liveness + the policy gauges. Raises when the replica is
+        unreachable; returns ``{"running": False, ...}`` when it
+        answers but is shutting down."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release whatever this replica owns (local: stop its
+        scheduler; remote: nothing — the process is not ours)."""
+
+    def debug_state(self) -> Dict[str, object]:
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "healthy": self.healthy,
+            "draining": self.draining,
+            "outstanding": self.outstanding,
+            "dispatched": self.dispatched,
+            "last_probe": self.last_stats,
+            "probe_age_s": (
+                round(time.monotonic() - self.t_probe, 4)
+                if self.t_probe is not None
+                else None
+            ),
+        }
+
+
+class LocalReplica(Replica):
+    """An in-process backend + scheduler pair. The scheduler is built
+    here (continuous for stepped backends, window otherwise — the same
+    auto rule as :class:`~.server.GenerationServer`) and owned here:
+    ``close()`` stops it. Probes and dispatch are direct calls."""
+
+    kind = "local"
+
+    def __init__(
+        self,
+        name: str,
+        backend: GenerationBackend,
+        scheduler: Optional[object] = None,
+        start: bool = True,
+        **scheduler_kwargs,
+    ) -> None:
+        super().__init__(name)
+        self.backend = backend
+        if scheduler is None:
+            from .scheduler import BatchScheduler, ContinuousScheduler
+
+            if hasattr(backend, "decode_open"):
+                scheduler = ContinuousScheduler(backend, **scheduler_kwargs)
+            else:
+                scheduler_kwargs.pop("slice_steps", None)
+                scheduler_kwargs.pop("prefill_chunk_tokens", None)
+                scheduler_kwargs.pop("spec_accept_floor", None)
+                scheduler_kwargs.pop("preempt_policy", None)
+                scheduler_kwargs.pop("preempt_max_wait_s", None)
+                scheduler = BatchScheduler(backend, **scheduler_kwargs)
+        self.scheduler = scheduler
+        if start:
+            self.scheduler.start()
+
+    def generate(self, request: GenerationRequest) -> GenerationResult:
+        return self.scheduler.submit(request)
+
+    def stream(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
+        channel = self.scheduler.submit_stream(request)
+
+        def gen():
+            finished = False
+            try:
+                for event in channel.events():
+                    if event.kind == "delta":
+                        yield GenerationChunk(
+                            text=event.text, tokens=list(event.tokens)
+                        )
+                    elif event.kind == "done":
+                        finished = True
+                        yield GenerationChunk(
+                            text="", tokens=[], done=True, result=event.result
+                        )
+                    elif event.kind == "error":
+                        finished = True
+                        raise event.error
+            finally:
+                # closed early (front-door client hung up, or the retry
+                # machinery abandoned us): cancel the replica-side row
+                # so its pages recycle within one decode slice
+                if not finished:
+                    channel.cancel(cause="disconnect")
+
+        return gen()
+
+    def probe(self) -> Dict[str, object]:
+        stats: Dict[str, object] = dict(self.scheduler.health_state())
+        stats["status"] = "ok" if stats.get("running") else "stopping"
+        # pool occupancy (least-pages), best-effort off the live session
+        try:
+            session = self.scheduler.debug_state().get("session") or {}
+            pool = session.get("pool") or {}
+            if "occupancy" in pool:
+                stats["pool_occupancy"] = pool["occupancy"]
+        except Exception:  # noqa: BLE001 — probe only
+            pass
+        return stats
+
+    def close(self) -> None:
+        self.scheduler.stop()
+
+
+class RemoteReplica(Replica):
+    """A replica in another process/host, spoken to over the wire. The
+    probe is ``GET /healthz`` (cheap, kill-switch-proof) plus a
+    best-effort ``/metrics`` scrape for the pool/energy gauges (absent
+    when the replica runs ``--no-telemetry`` — the queue/inflight
+    fields from /healthz still feed least-queue routing)."""
+
+    kind = "remote"
+
+    def __init__(
+        self,
+        name: str,
+        base_url: str,
+        timeout_s: float = 600.0,
+        probe_timeout_s: float = 5.0,
+    ) -> None:
+        super().__init__(name)
+        self.client = RemoteHTTPBackend(base_url, timeout_s=timeout_s)
+        self.base_url = self.client.base_url
+        self.probe_timeout_s = probe_timeout_s
+
+    def generate(self, request: GenerationRequest) -> GenerationResult:
+        return self.client.generate(request)
+
+    def stream(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
+        return self.client.generate_stream(request)
+
+    def probe(self) -> Dict[str, object]:
+        with urllib.request.urlopen(
+            f"{self.base_url}{protocol.HEALTH_PATH}",
+            timeout=self.probe_timeout_s,
+        ) as resp:
+            stats: Dict[str, object] = json.loads(resp.read().decode("utf-8"))
+        stats["running"] = stats.get("status") == "ok"
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}{protocol.METRICS_PATH}",
+                timeout=self.probe_timeout_s,
+            ) as resp:
+                text = resp.read().decode("utf-8")
+            occ = _metrics_gauge(text, "llm_paged_pool_occupancy")
+            if occ is not None:
+                stats["pool_occupancy"] = occ
+            jpt = _metrics_hist_mean(text, "llm_request_joules_per_token")
+            if jpt is not None:
+                stats["joules_per_token"] = jpt
+        except Exception:  # noqa: BLE001 — telemetry may be off (404)
+            pass
+        return stats
+
+    def debug_state(self) -> Dict[str, object]:
+        state = super().debug_state()
+        state["base_url"] = self.base_url
+        return state
+
+
+class Router:
+    """Fleet membership + probing + policy dispatch + the retry-once
+    rule (see the module docstring). Thread-safe: the HTTP front door
+    dispatches from many handler threads while the prober refreshes
+    stats in the background."""
+
+    def __init__(
+        self,
+        replicas: List[Replica],
+        policy: str = "least-queue",
+        probe_interval_s: float = DEFAULT_PROBE_INTERVAL_S,
+    ) -> None:
+        if policy not in ROUTE_POLICIES:
+            raise ValueError(
+                f"route policy must be one of {ROUTE_POLICIES}, got {policy!r}"
+            )
+        self.policy = policy
+        self.probe_interval_s = float(probe_interval_s)
+        self._lock = threading.Lock()
+        self._replicas: "Dict[str, Replica]" = {}
+        self._rr = itertools.count()  # round-robin cursor
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        for replica in replicas:
+            self.add_replica(replica)
+
+    # -- membership ------------------------------------------------------------
+    def add_replica(self, replica: Replica) -> None:
+        """Scale-up: register (name must be fresh) and probe immediately
+        so the new member is dispatchable the moment this returns."""
+        with self._lock:
+            if replica.name in self._replicas:
+                raise ValueError(f"replica {replica.name!r} already attached")
+            self._replicas[replica.name] = replica
+        self._probe_one(replica)
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def drain(self, name: str, timeout_s: float = 30.0) -> bool:
+        """Elastic scale-down: stop dispatching to ``name``, wait for
+        its in-flight tickets (router-side outstanding AND the
+        replica's own queue/in-flight counts) to finish, then DETACH it
+        — ``replica_drained`` flight event, healthy gauge to 0, local
+        replicas' schedulers stopped. Returns False on timeout: the
+        replica stays attached but draining (no new dispatch), so the
+        caller can retry."""
+        with self._lock:
+            replica = self._replicas.get(name)
+        if replica is None:
+            raise KeyError(f"no replica named {name!r}")
+        replica.draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            idle = replica.outstanding == 0
+            if idle:
+                try:
+                    stats = replica.probe()
+                    idle = (
+                        int(stats.get("queue_depth") or 0) == 0
+                        and int(stats.get("inflight_rows") or 0) == 0
+                    )
+                except Exception:  # noqa: BLE001 — unreachable = idle
+                    idle = True
+            if idle:
+                break
+            time.sleep(0.01)
+        else:
+            return False
+        with self._lock:
+            self._replicas.pop(name, None)
+        _REPLICA_HEALTHY_G.labels(replica=name).set(0)
+        FLIGHT.emit(
+            EV_REPLICA_DRAINED,
+            replica=name,
+            dispatched=replica.dispatched,
+        )
+        try:
+            replica.close()
+        except Exception:  # noqa: BLE001 — detach must not fail the caller
+            pass
+        return True
+
+    # -- probing ---------------------------------------------------------------
+    def _probe_one(self, replica: Replica) -> None:
+        t0 = time.monotonic()
+        error: Optional[str] = None
+        try:
+            stats = replica.probe()
+            healthy = bool(stats.get("running", True))
+        except Exception as exc:  # noqa: BLE001 — down replica
+            stats = {"error": f"{type(exc).__name__}: {exc}"}
+            error = stats["error"]
+            healthy = False
+        _PROBE_H.observe(time.monotonic() - t0)
+        replica.last_stats = stats
+        replica.t_probe = time.monotonic()
+        self._set_health(replica, healthy, error)
+
+    def _set_health(
+        self, replica: Replica, healthy: bool, error: Optional[str]
+    ) -> None:
+        was = replica.healthy
+        replica.healthy = healthy
+        _REPLICA_HEALTHY_G.labels(replica=replica.name).set(
+            1.0 if healthy else 0.0
+        )
+        if was and not healthy:
+            FLIGHT.emit(
+                EV_REPLICA_DOWN,
+                replica=replica.name,
+                error=error or "unhealthy probe",
+            )
+
+    def probe_now(self) -> None:
+        """One synchronous probe sweep (tests, and the prober's tick)."""
+        for replica in self.replicas():
+            self._probe_one(replica)
+
+    def start(self) -> None:
+        """Launch the background prober (idempotent)."""
+        if self._probe_thread is not None:
+            return
+        self._stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="router-prober", daemon=True
+        )
+        self._probe_thread.start()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            self.probe_now()
+
+    def stop(self, close_replicas: bool = True) -> None:
+        self._stop.set()
+        thread, self._probe_thread = self._probe_thread, None
+        if thread is not None:
+            thread.join(timeout=5)
+        if close_replicas:
+            for replica in self.replicas():
+                try:
+                    replica.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # -- policy ----------------------------------------------------------------
+    def _load_key(self, replica: Replica) -> float:
+        """The policy's load figure for one replica: last-probe gauges
+        plus the router's REAL-TIME outstanding count (probes are
+        periodic; outstanding moves per dispatch, so a burst between
+        two probe ticks still spreads). Policies whose gauge a replica
+        cannot provide (no paged pool, telemetry off) fall back to the
+        queue figure — a missing metric must not starve a replica."""
+        stats = replica.last_stats or {}
+        queue_load = (
+            float(stats.get("queue_depth") or 0)
+            + float(stats.get("inflight_rows") or 0)
+            + float(replica.outstanding)
+        )
+        if self.policy == "least-pages":
+            occ = stats.get("pool_occupancy")
+            if occ is not None:
+                # occupancy in [0,1]; outstanding breaks ties so two
+                # equally-full pools still alternate
+                return float(occ) * 1e6 + queue_load
+        elif self.policy == "least-joules":
+            jpt = stats.get("joules_per_token")
+            if jpt is not None:
+                return float(jpt) * 1e6 + queue_load
+        return queue_load
+
+    def _pick(self, exclude: "tuple" = ()) -> Optional[Replica]:
+        with self._lock:
+            candidates = [
+                r
+                for r in self._replicas.values()
+                if r.healthy and not r.draining and r.name not in exclude
+            ]
+            if not candidates:
+                return None
+            if self.policy == "round-robin":
+                return candidates[next(self._rr) % len(candidates)]
+            return min(
+                candidates, key=lambda r: (self._load_key(r), r.name)
+            )
+
+    # -- dispatch --------------------------------------------------------------
+    def _begin(self, replica: Replica, retried: Optional[str]) -> None:
+        with self._lock:
+            replica.outstanding += 1
+            replica.dispatched += 1
+        _DISPATCH_C.labels(replica=replica.name, policy=self.policy).inc()
+        if obs_metrics.enabled():
+            FLIGHT.emit(
+                EV_DISPATCHED,
+                trace=trace_of(TRACER.current()),
+                replica=replica.name,
+                policy=self.policy,
+                retry=retried,
+            )
+
+    def _end(self, replica: Replica) -> None:
+        with self._lock:
+            replica.outstanding = max(0, replica.outstanding - 1)
+
+    def _dispatch_failed(
+        self, replica: Replica, exc: BaseException, reason: str
+    ) -> None:
+        """Account one retryable dispatch failure: the retry counter
+        moves, and a DEAD replica is marked unhealthy immediately (the
+        next probe may resurrect it) — ``refused`` is a capacity
+        answer from a live scheduler, not a death."""
+        _RETRIES_C.labels(reason=reason).inc()
+        if reason == "dead":
+            self._set_health(replica, False, f"{type(exc).__name__}: {exc}")
+
+    def _stamp(
+        self,
+        result: GenerationResult,
+        replica: Replica,
+        retried: Optional[str],
+    ) -> None:
+        """Route attribution onto the wire: ``extras["router"]`` rides
+        ``x_extras`` so load generators and benches can split figures
+        per replica without scraping anything."""
+        router_extras = {"replica": replica.name, "policy": self.policy}
+        if retried:
+            router_extras["retried"] = retried
+        result.extras = {**(result.extras or {}), "router": router_extras}
+
+    def dispatch(self, request: GenerationRequest) -> GenerationResult:
+        """Buffered dispatch with the retry-once rule. Raises the
+        replica's own terminal error (or ``RuntimeError`` when no
+        healthy replica is attached)."""
+        tried: "tuple" = ()
+        retried: Optional[str] = None
+        while True:
+            replica = self._pick(exclude=tried)
+            if replica is None:
+                raise RuntimeError(
+                    "no healthy replica available"
+                    + (f" (after retry: {retried})" if retried else "")
+                )
+            self._begin(replica, retried)
+            try:
+                result = replica.generate(request)
+            except BaseException as exc:  # noqa: BLE001
+                self._end(replica)
+                reason = _retry_reason(exc)
+                if reason is None or retried is not None:
+                    raise
+                self._dispatch_failed(replica, exc, reason)
+                tried = (replica.name,)
+                retried = reason
+                continue
+            self._end(replica)
+            self._stamp(result, replica, retried)
+            return result
+
+    def dispatch_stream(
+        self, request: GenerationRequest
+    ) -> Iterator[GenerationChunk]:
+        """Streaming dispatch with the retry-once rule, which here is
+        cut at the FIRST STREAMED TOKEN: a failure before any delta
+        left the replica retries once elsewhere; after that the failure
+        surfaces as the iterator's terminal exception (the front door
+        turns it into a terminal SSE error event — no silent hang, no
+        duplicate tokens). Closing the iterator cancels the
+        replica-side row."""
+        tried: "tuple" = ()
+        retried: Optional[str] = None
+        while True:
+            replica = self._pick(exclude=tried)
+            if replica is None:
+                raise RuntimeError(
+                    "no healthy replica available"
+                    + (f" (after retry: {retried})" if retried else "")
+                )
+            self._begin(replica, retried)
+            chunks: Optional[Iterator[GenerationChunk]] = None
+            streamed = False
+            try:
+                try:
+                    chunks = replica.stream(request)
+                    for chunk in chunks:
+                        if chunk.done and chunk.result is not None:
+                            self._stamp(chunk.result, replica, retried)
+                        yield chunk
+                        if chunk.tokens or chunk.text:
+                            streamed = True
+                    return
+                except BaseException as exc:  # noqa: BLE001
+                    reason = _retry_reason(exc)
+                    if reason is None or streamed or retried is not None:
+                        raise
+                    self._dispatch_failed(replica, exc, reason)
+                    tried = (replica.name,)
+                    retried = reason
+            finally:
+                self._end(replica)
+                if chunks is not None:
+                    close = getattr(chunks, "close", None)
+                    if close is not None:
+                        close()
+
+    # -- introspection ---------------------------------------------------------
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values() if r.healthy)
+
+    def health_state(self) -> Dict[str, object]:
+        with self._lock:
+            replicas = list(self._replicas.values())
+        healthy = sum(1 for r in replicas if r.healthy)
+        return {
+            "status": "ok" if healthy else "degraded",
+            "role": "router",
+            "policy": self.policy,
+            "replicas": len(replicas),
+            "healthy_replicas": healthy,
+            "draining_replicas": sum(1 for r in replicas if r.draining),
+        }
+
+    def debug_state(self) -> Dict[str, object]:
+        return {
+            "role": "router",
+            "policy": self.policy,
+            "probe_interval_s": self.probe_interval_s,
+            "replicas": [r.debug_state() for r in self.replicas()],
+        }
+
+
+class RouterServer:
+    """The front-door HTTP server: the wire surface of
+    :class:`~.server.GenerationServer` (generate, SSE streaming,
+    healthz, metrics, debug endpoints) served by dispatching every
+    ticket through a :class:`Router`. ``port=0`` picks an ephemeral
+    port (tests); ``start()``/``serve_forever()``/``stop()`` mirror the
+    single-backend server."""
+
+    def __init__(
+        self,
+        router: Router,
+        host: str = "0.0.0.0",
+        port: int = protocol.DEFAULT_PORT,
+        models: Optional[List[str]] = None,
+        quiet: bool = False,
+        default_priority: Optional[int] = None,
+    ) -> None:
+        self.router = router
+        self.models = list(models) if models else []
+        self.quiet = quiet
+        self.default_priority = (
+            int(default_priority)
+            if default_priority is not None
+            else protocol.DEFAULT_PRIORITY
+        )
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._thread: Optional[threading.Thread] = None
+        self._serving = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def _send_json(self, status: int, payload) -> None:
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?", 1)[0]
+                if path == protocol.HEALTH_PATH:
+                    self._send_json(200, server.router.health_state())
+                elif path == protocol.METRICS_PATH:
+                    if not obs_metrics.enabled():
+                        self._send_json(
+                            404,
+                            {"error": "telemetry disabled (TPU_LLM_OBS=0)"},
+                        )
+                        return
+                    body = REGISTRY.exposition().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == protocol.DEBUG_STATE_PATH:
+                    if not obs_metrics.enabled():
+                        self._send_json(
+                            404,
+                            {"error": "telemetry disabled (TPU_LLM_OBS=0)"},
+                        )
+                        return
+                    state = {
+                        "t_s": round(time.monotonic(), 6),
+                        "flight": FLIGHT.summary(),
+                        **server.router.debug_state(),
+                    }
+                    self._send_json(200, state)
+                elif path == protocol.DEBUG_FLIGHT_PATH:
+                    if not obs_metrics.enabled():
+                        self._send_json(
+                            404,
+                            {"error": "telemetry disabled (TPU_LLM_OBS=0)"},
+                        )
+                        return
+                    from urllib.parse import parse_qs
+
+                    query = parse_qs(self.path.partition("?")[2])
+                    try:
+                        n = int(query.get("n", ["200"])[0])
+                    except ValueError:
+                        self._send_json(
+                            400, {"error": "n must be an integer"}
+                        )
+                        return
+                    self._send_json(
+                        200,
+                        {
+                            "summary": FLIGHT.summary(),
+                            "events": FLIGHT.events(
+                                n=n, type_=query.get("type", [None])[0]
+                            ),
+                        },
+                    )
+                elif path == protocol.TAGS_PATH:
+                    self._send_json(
+                        200,
+                        {"models": [{"name": m} for m in server.models]},
+                    )
+                elif path == protocol.VERSION_PATH:
+                    self._send_json(
+                        200, {"version": protocol.SERVER_VERSION}
+                    )
+                else:
+                    self._send_json(
+                        404, {"error": f"unknown path {self.path}"}
+                    )
+
+            def do_POST(self):  # noqa: N802
+                if self.path != protocol.GENERATE_PATH:
+                    self._send_json(
+                        404, {"error": f"unknown path {self.path}"}
+                    )
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(
+                        (self.rfile.read(length) if length else b"{}").decode(
+                            "utf-8"
+                        )
+                    )
+                except (ValueError, json.JSONDecodeError) as exc:
+                    self._send_json(400, {"error": f"bad JSON: {exc}"})
+                    return
+                try:
+                    request = protocol.request_from_wire(
+                        body, default_priority=server.default_priority
+                    )
+                except ValueError as exc:
+                    self._send_json(400, {"error": str(exc)})
+                    return
+                if server.models and request.model not in server.models:
+                    self._send_json(
+                        404, {"error": f"model {request.model!r} not found"}
+                    )
+                    return
+                if body.get("stream"):
+                    with TRACER.span(
+                        "request", model=request.model, stream=True
+                    ):
+                        self._stream(request)
+                    return
+                try:
+                    with TRACER.span("request", model=request.model):
+                        result = server.router.dispatch(request)
+                except BaseException as exc:  # noqa: BLE001
+                    self._send_error(exc)
+                else:
+                    self._send_json(200, protocol.result_to_wire(result))
+
+            def _send_error(self, exc: BaseException) -> None:
+                if isinstance(exc, RemoteServerError):
+                    # forward the replica's own status (404 unknown
+                    # model, 400 bad request, 504 deadline, ...)
+                    self._send_json(exc.status, {"error": str(exc)})
+                elif isinstance(exc, DeadlineExceeded):
+                    self._send_json(504, {"error": str(exc)})
+                elif isinstance(exc, KeyError):
+                    self._send_json(
+                        404, {"error": f"model not found: {exc}"}
+                    )
+                elif isinstance(exc, ValueError):
+                    self._send_json(400, {"error": str(exc)})
+                elif isinstance(exc, RuntimeError) and "no healthy replica" in str(
+                    exc
+                ):
+                    self._send_json(503, {"error": str(exc)})
+                else:
+                    self._send_json(
+                        500, {"error": f"{type(exc).__name__}: {exc}"}
+                    )
+
+            # -- SSE re-framing (same bytes as the single-backend server) ------
+            def _write_sse_chunk(self, payload) -> None:
+                data = protocol.sse_event(payload)
+                self.wfile.write(f"{len(data):X}\r\n".encode("ascii"))
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            def _start_sse(self) -> None:
+                from .server import STREAM_WRITE_TIMEOUT_S
+
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", protocol.STREAM_CONTENT_TYPE
+                )
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                # same stalled-consumer bound as the single-backend
+                # server: one dead front-door socket must not wedge a
+                # handler (and through it a replica row) forever
+                self.connection.settimeout(STREAM_WRITE_TIMEOUT_S)
+
+            def _end_sse(self) -> None:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    self.close_connection = True
+
+            def _final_record(self, result) -> dict:
+                final = protocol.result_to_wire(result)
+                final["response"] = ""
+                final["x_text"] = result.text
+                return final
+
+            def _stream(self, request) -> None:
+                """SSE delivery through the router: replica chunks are
+                re-framed one-for-one; a dead front-door socket closes
+                the chunk iterator, which cancels the replica-side row
+                (local channel cancel / remote connection close). A
+                pre-first-chunk failure surfaces as a clean HTTP status
+                (the retry-once already happened inside
+                dispatch_stream); a later one as a terminal SSE error
+                event."""
+                chunks = server.router.dispatch_stream(request)
+                started = False
+                try:
+                    try:
+                        for chunk in chunks:
+                            if not started:
+                                self._start_sse()
+                                started = True
+                            if chunk.done:
+                                self._write_sse_chunk(
+                                    self._final_record(chunk.result)
+                                )
+                            else:
+                                self._write_sse_chunk(
+                                    protocol.stream_chunk_to_wire(
+                                        request.model,
+                                        chunk.text,
+                                        chunk.tokens,
+                                    )
+                                )
+                    except OSError:
+                        # front-door client hung up: closing the chunk
+                        # iterator (finally) cancels the replica row
+                        self.close_connection = True
+                        return
+                    except BaseException as exc:  # noqa: BLE001
+                        if not started:
+                            self._send_error(exc)
+                            return
+                        try:
+                            self._write_sse_chunk(
+                                {
+                                    "error": (
+                                        f"{type(exc).__name__}: {exc}"
+                                    ),
+                                    "done": True,
+                                }
+                            )
+                        except OSError:
+                            self.close_connection = True
+                            return
+                finally:
+                    close = getattr(chunks, "close", None)
+                    if close is not None:
+                        close()
+                if started:
+                    self._end_sse()
+
+        return Handler
+
+    def start(self) -> None:
+        self.router.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="router-server",
+            daemon=True,
+        )
+        self._thread.start()
+        self._serving.set()
+
+    def serve_forever(self) -> None:
+        if not self.quiet:
+            term.log_ok(
+                f"router listening on :{self.port} "
+                f"({len(self.router.replicas())} replicas, "
+                f"policy {self.router.policy})"
+            )
+        self.router.start()
+        self._serving.set()
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self._serving.clear()
+            self._httpd.server_close()
+            self.router.stop()
+
+    def stop(self) -> None:
+        self.router.stop()
+        if self._serving.is_set():
+            self._httpd.shutdown()
+            self._serving.clear()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

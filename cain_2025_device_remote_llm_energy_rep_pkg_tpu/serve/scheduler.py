@@ -526,6 +526,20 @@ class _SchedulerBase:
         return ticket.stream
 
     # -- introspection --------------------------------------------------------
+    def health_state(self) -> Dict[str, object]:
+        """CHEAP liveness surface for ``GET /healthz`` and the router's
+        probe (ISSUE 12): scheduler kind, whether the loop is running,
+        queue depth and in-flight rows. No telemetry dependency — it
+        must answer under the obs kill switch — and best-effort like
+        :meth:`debug_state` (a torn read costs a stale count, never an
+        exception)."""
+        return {
+            "scheduler": "window",
+            "running": self._running,
+            "queue_depth": self._queue.qsize(),
+            "inflight_rows": 0,
+        }
+
     def debug_state(self) -> Dict[str, object]:
         """Live snapshot for ``GET /debug/state``: what the scheduler is
         doing RIGHT NOW. Best-effort — it races the worker loop by
@@ -966,6 +980,23 @@ class ContinuousScheduler(_SchedulerBase):
         # pending) while a session runs, None when idle. Read
         # best-effort by the /debug/state endpoint — never locked.
         self._dbg = None
+
+    def health_state(self) -> Dict[str, object]:
+        """The base liveness fields plus the continuous loop's in-flight
+        row count (live rows + pending chunked joiners — what a router's
+        least-queue policy should weigh next to the queue depth)."""
+        state = super().health_state()
+        state["scheduler"] = "continuous"
+        dbg = self._dbg
+        if dbg is not None:
+            _session, live, pending, parked = dbg
+            try:
+                state["inflight_rows"] = (
+                    len(live) + len(pending) + len(parked)
+                )
+            except Exception:  # noqa: BLE001 — racing the loop is fine
+                pass
+        return state
 
     def debug_state(self) -> Dict[str, object]:
         """The window snapshot plus the live continuous session: in-

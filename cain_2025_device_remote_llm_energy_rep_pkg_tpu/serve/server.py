@@ -343,6 +343,36 @@ class GenerationServer:
                     },
                 )
 
+            def _send_healthz(self) -> None:
+                """Cheap liveness probe (ISSUE 12): status, scheduler
+                kind and in-flight/queued row counts — the router's
+                probe target and a k8s-style check. Unlike /metrics and
+                /debug/*, this answers under the telemetry kill switch
+                (liveness must not depend on observability), and every
+                field beyond ``status`` is best-effort."""
+                state = {
+                    "status": "ok",
+                    "backend": type(server.backend).__name__,
+                    "scheduler": server.scheduler_mode,
+                    "queue_depth": 0,
+                    "inflight_rows": 0,
+                }
+                try:
+                    if server._scheduler is not None:
+                        health = server._scheduler.health_state()
+                        state["scheduler"] = health.get(
+                            "scheduler", server.scheduler_mode
+                        )
+                        state["queue_depth"] = health.get("queue_depth", 0)
+                        state["inflight_rows"] = health.get(
+                            "inflight_rows", 0
+                        )
+                        if not health.get("running", True):
+                            state["status"] = "stopping"
+                except Exception:  # noqa: BLE001 — probe only
+                    pass
+                self._send_json(200, state)
+
             def _send_json(self, status: int, payload) -> None:
                 body = json.dumps(payload).encode("utf-8")
                 self.send_response(status)
@@ -370,7 +400,7 @@ class GenerationServer:
                 elif self.path.split("?", 1)[0] == protocol.DEBUG_FLIGHT_PATH:
                     self._send_debug_flight()
                 elif self.path == protocol.HEALTH_PATH:
-                    self._send_json(200, {"status": "ok"})
+                    self._send_healthz()
                 elif self.path == protocol.TAGS_PATH:
                     self._send_json(
                         200,

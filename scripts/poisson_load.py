@@ -37,6 +37,7 @@ Exit 0 on success; prints one JSON summary line.
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import math
 import os
@@ -360,6 +361,7 @@ def run_load(
 
 def _record_result(rec, result, t_submit, t_done, start) -> None:
     sched = (result.extras or {}).get("sched", {})
+    router = (result.extras or {}).get("router", {})
     rec.update(
         tokens=result.generated_tokens,
         completion_s=t_done - t_submit,
@@ -371,6 +373,13 @@ def _record_result(rec, result, t_submit, t_done, start) -> None:
         resumed=sched.get("resumed"),
         t_done=t_done - start,
     )
+    # replica attribution (ISSUE 12): stamped by the front-door router
+    # (extras.router) or by the multi-target driver below — either way
+    # the summary can split figures per replica
+    if router.get("replica") is not None:
+        rec["replica"] = router["replica"]
+        if router.get("retried"):
+            rec["retried"] = router["retried"]
 
 
 def _consume_stream(chunks, cancel_after: int):
@@ -475,6 +484,33 @@ def summarize(records: List[Dict]) -> Dict:
     if preempted:
         out["preempted"] = len(preempted)
         out["resumed"] = sum(1 for r in preempted if r.get("resumed"))
+    # per-replica attribution (ISSUE 12): present whenever records
+    # carry a replica stamp — from a router's extras.router or the
+    # multi-target driver — so fleet benches and single-mesh benches
+    # read one summary shape
+    replicas = sorted(
+        {r["replica"] for r in ok if r.get("replica") is not None}
+    )
+    if replicas:
+        per = {}
+        for name in replicas:
+            r_recs = [r for r in ok if r.get("replica") == name]
+            r_tokens = sum(r["tokens"] for r in r_recs)
+            r_ttfts = [
+                r["ttft_s"] for r in r_recs if r.get("ttft_s") is not None
+            ]
+            entry = {
+                "requests": len(r_recs),
+                "tokens": r_tokens,
+                "share": round(r_tokens / tokens, 4) if tokens else None,
+            }
+            if r_ttfts:
+                entry["ttft_p50_s"] = round(percentile(r_ttfts, 50), 4)
+            per[name] = entry
+        out["replicas"] = per
+        retried = sum(1 for r in ok if r.get("retried"))
+        if retried:
+            out["retried"] = retried
     # per-tier breakdown (ISSUE 11): the high-tier TTFT tail under
     # overload is THE number the preemption A/B trades for — reported
     # per tier so one summary line carries both sides of the trade
@@ -510,6 +546,15 @@ def summarize(records: List[Dict]) -> Dict:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--url", help="live server base URL (http://host:port)")
+    ap.add_argument(
+        "--targets",
+        help="comma-separated replica servers (host:port[,host:port...]): "
+        "drive ONE seeded trace at the whole fleet, requests assigned "
+        "round-robin, with per-replica attribution in the summary. "
+        "Point --url at a serve/router.py front door instead to let the "
+        "router pick replicas — its extras.router attribution lands in "
+        "the same summary shape",
+    )
     ap.add_argument("--model", default="qwen2:1.5b")
     ap.add_argument("-n", type=int, default=16, help="number of requests")
     ap.add_argument(
@@ -632,6 +677,58 @@ def main() -> int:
         finally:
             sched.stop()
         target = "fake-continuous"
+    elif args.targets:
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
+            RemoteHTTPBackend,
+        )
+
+        names = [t for t in args.targets.split(",") if t]
+        clients = {
+            name: RemoteHTTPBackend(
+                name if name.startswith("http") else f"http://{name}"
+            )
+            for name in names
+        }
+        counter = itertools.count()
+        lock = threading.Lock()
+
+        def _pick_target():
+            with lock:
+                return names[next(counter) % len(names)]
+
+        def _stamp_target(result, name):
+            router = dict((result.extras or {}).get("router") or {})
+            router.setdefault("replica", name)
+            result.extras = {**(result.extras or {}), "router": router}
+            return result
+
+        def fleet_submit(request):
+            name = _pick_target()
+            return _stamp_target(clients[name].generate(request), name)
+
+        def fleet_stream(request):
+            name = _pick_target()
+            chunks = clients[name].generate_stream(request)
+            try:
+                for chunk in chunks:
+                    if (
+                        getattr(chunk, "done", False)
+                        and chunk.result is not None
+                    ):
+                        _stamp_target(chunk.result, name)
+                    yield chunk
+            finally:
+                # closing this generator (the cancellation plan's
+                # disconnect) must close the wire stream NOW, not at GC
+                chunks.close()
+
+        records = run_load(
+            fleet_submit,
+            workload,
+            stream_submit=fleet_stream,
+            cancellations=cancellations,
+        )
+        target = args.targets
     elif args.url:
         from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
             RemoteHTTPBackend,
@@ -646,7 +743,7 @@ def main() -> int:
         )
         target = args.url
     else:
-        ap.error("one of --url or --fake is required")
+        ap.error("one of --url, --targets or --fake is required")
         return 2
     summary = summarize(records)
     print(json.dumps({"load": "poisson", "target": target, **summary}))

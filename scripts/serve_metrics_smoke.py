@@ -78,6 +78,19 @@ Three phases, all over the deterministic fake backend:
    accounting, the victim COMPLETING after resume with its full
    stream, and the host-residency gauges returning exactly to zero.
 
+10. REPLICA-FLEET ROUTING (ISSUE 12): a 2-replica fake fleet behind the
+    front-door router (``serve/router.py``): dispatch counters split
+    across both replicas (``llm_router_dispatch_total{replica,...}``
+    and the per-request ``x_extras.router`` attribution agree); one
+    replica's engine is KILLED mid-trace while a long accepted stream
+    is still in flight on it — the stream completes in full (zero
+    accepted tickets lost), the next ticket routed to the dead replica
+    is retried ONCE onto the survivor
+    (``llm_router_retries_total``), the ``replica_down`` flight event
+    fires and ``llm_router_replica_healthy`` drops to 0; then the
+    survivor DRAINS cleanly (``replica_drained`` event, membership
+    shrinks) and a final request is shed 503 with nobody healthy left.
+
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
 """
@@ -88,6 +101,7 @@ import re
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 # Phase 7 needs ≥2 virtual devices, and the device count is fixed the
@@ -901,6 +915,154 @@ def main() -> int:
     finally:
         server9.stop()
 
+    # -- phase 10: replica-fleet routing (ISSUE 12) ----------------------------
+    # A 2-replica fake fleet behind the front-door router: dispatch
+    # counters split across replicas; one replica is KILLED mid-trace
+    # (its engine dies — new sessions raise) while a long accepted
+    # stream is still in flight on it — that stream completes (zero
+    # accepted tickets lost), the next ticket routed there is retried
+    # ONCE onto the survivor, the replica_down flight event fires and
+    # the healthy gauge drops; finally the survivor drains cleanly
+    # (replica_drained event, then 503 with nobody left).
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
+        RemoteHTTPBackend,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router import (
+        LocalReplica,
+        Router,
+        RouterServer,
+    )
+
+    backend10_a = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    backend10_b = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    router10 = Router(
+        [
+            LocalReplica("r0", backend10_a),
+            LocalReplica("r1", backend10_b),
+        ],
+        policy="round-robin",
+        probe_interval_s=30.0,  # the smoke probes explicitly
+    )
+    server10 = RouterServer(router10, host="127.0.0.1", port=0, quiet=True)
+    server10.start()
+    try:
+        base10 = f"http://127.0.0.1:{server10.port}"
+        pre10 = _scrape(base10)
+
+        def replica_dispatches(text_now):
+            out = {}
+            for line in text_now.splitlines():
+                m = re.match(
+                    r'^llm_router_dispatch_total\{replica="([^"]+)",'
+                    r'policy="[^"]+"\} ([0-9.e+-]+)$',
+                    line,
+                )
+                if m:
+                    out[m.group(1)] = out.get(m.group(1), 0.0) + float(
+                        m.group(2)
+                    )
+            return out
+
+        # four short tickets: round-robin splits them 2/2
+        for i in range(4):
+            body10 = _post_generate(base10, f"fleet {i}", 8)
+            assert body10.get("done"), body10
+            assert body10["x_extras"]["router"]["replica"] in ("r0", "r1")
+        split10 = replica_dispatches(_scrape(base10))
+        assert split10.get("r0", 0) >= 2 and split10.get("r1", 0) >= 2, (
+            f"dispatches did not split across replicas: {split10}"
+        )
+
+        # a long ACCEPTED stream lands on r0 (cursor parity after 4)...
+        client10 = RemoteHTTPBackend(base10)
+        stream_done = {}
+
+        def long_stream():
+            chunks = list(
+                client10.generate_stream(
+                    GenerationRequest(
+                        "smoke:1b",
+                        "long accepted stream",
+                        max_new_tokens=160,
+                    )
+                )
+            )
+            stream_done["final"] = chunks[-1].result
+            stream_done["tokens"] = sum(
+                len(c.tokens) for c in chunks if not c.done
+            )
+
+        t10 = threading.Thread(target=long_stream)
+        t10.start()
+        time.sleep(0.15)  # the stream is live mid-trace...
+        backend10_a.fail_decode_open = True  # ...when r0's engine DIES
+        # two more tickets: round-robin sends one to the dead replica —
+        # it must be retried ONCE onto the survivor and complete
+        retried_before10 = 0
+        try:
+            retried_before10 = _metric_value(
+                pre10, "llm_router_retries_total"
+            )
+        except AssertionError:
+            pass
+        for i in range(2):
+            body10 = _post_generate(base10, f"after kill {i}", 8)
+            assert body10.get("done"), body10
+            assert body10["x_extras"]["router"]["replica"] == "r1", body10
+        t10.join(timeout=40)
+        final10 = stream_done.get("final")
+        assert final10 is not None, "accepted stream lost after kill"
+        assert final10.generated_tokens == 160, final10.generated_tokens
+        assert stream_done["tokens"] == 160, stream_done
+        assert final10.extras["router"]["replica"] == "r0", final10.extras
+
+        text10 = _scrape(base10)
+        retries10 = (
+            _metric_value(text10, "llm_router_retries_total")
+            - retried_before10
+        )
+        assert retries10 >= 1, "the kill never produced a retry"
+        # healthy gauge dropped for r0 and the flight event fired
+        gauge10 = {}
+        for line in text10.splitlines():
+            m = re.match(
+                r'^llm_router_replica_healthy\{replica="([^"]+)"\} '
+                r"([0-9.e+-]+)$",
+                line,
+            )
+            if m:
+                gauge10[m.group(1)] = float(m.group(2))
+        assert gauge10.get("r0") == 0.0 and gauge10.get("r1") == 1.0, gauge10
+        down10 = _get_json(base10, "/debug/flight?type=replica_down")[
+            "events"
+        ]
+        assert any(e.get("replica") == "r0" for e in down10), down10
+        state10 = _get_json(base10, "/debug/state")
+        by_name10 = {r["name"]: r for r in state10["replicas"]}
+        assert by_name10["r0"]["healthy"] is False
+        assert by_name10["r1"]["healthy"] is True
+
+        # drain the survivor: in-flight work finished, detach is clean
+        assert router10.drain("r1", timeout_s=30.0), "drain timed out"
+        drained10 = _get_json(base10, "/debug/flight?type=replica_drained")[
+            "events"
+        ]
+        assert any(e.get("replica") == "r1" for e in drained10), drained10
+        assert [r["name"] for r in _get_json(base10, "/debug/state")["replicas"]] == [
+            "r0"
+        ]
+        # nobody healthy is left: the front door sheds with 503
+        try:
+            _post_generate(base10, "nobody home", 4)
+            raise AssertionError("dispatch with no healthy replica succeeded")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503, exc.code
+    finally:
+        server10.stop()
+
     print(
         json.dumps(
             {
@@ -951,6 +1113,15 @@ def main() -> int:
                     "victim_completed_tokens": results9["low_young"][
                         "eval_count"
                     ],
+                },
+                "router_fleet": {
+                    "dispatch_split": split10,
+                    "retries_after_kill": retries10,
+                    "accepted_stream_tokens_after_kill": stream_done[
+                        "tokens"
+                    ],
+                    "replica_down_events": len(down10),
+                    "drained": True,
                 },
             }
         )

@@ -495,3 +495,101 @@ def test_prepare_cooldown_promise_matches_consumed_channels(monkeypatch, capsys)
     cli.prepare()
     out = capsys.readouterr().out
     assert "measured HOST energy channel present" in out
+
+
+def test_serve_replica_fleet_knobs(monkeypatch):
+    """--replicas / --route-policy / --probe-interval-ms build the
+    front-door router over N independent local replicas (ISSUE 12);
+    bad values fail fast with CommandError."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    captured = {}
+
+    class FakeRouterServer:
+        def __init__(self, router, **kw):
+            captured["router"] = router
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router as rt
+
+    monkeypatch.setattr(rt, "RouterServer", FakeRouterServer)
+    cli.serve_command(
+        [
+            "--backend", "fake", "--port", "0",
+            "--replicas", "3",
+            "--route-policy", "round-robin",
+            "--probe-interval-ms", "50",
+        ]
+    )
+    router = captured["router"]
+    try:
+        names = [r.name for r in router.replicas()]
+        assert names == ["r0", "r1", "r2"]
+        assert router.policy == "round-robin"
+        assert router.probe_interval_s == 0.05
+        # each replica is fully independent: distinct backend objects
+        backends = {id(r.backend) for r in router.replicas()}
+        assert len(backends) == 3
+    finally:
+        router.stop()
+
+    with pytest.raises(CommandError, match="--replicas"):
+        serve_command(["--replicas", "0"])
+    with pytest.raises(CommandError, match="--route-policy"):
+        serve_command(["--route-policy", "fastest"])
+    with pytest.raises(CommandError, match="--probe-interval-ms"):
+        serve_command(["--probe-interval-ms", "-5"])
+
+
+def test_serve_fleet_command_knobs(monkeypatch):
+    """serve-fleet attaches RemoteReplicas for each --targets entry;
+    missing targets / bad policy fail fast."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_fleet_command,
+    )
+
+    captured = {}
+
+    class FakeRouterServer:
+        def __init__(self, router, **kw):
+            captured["router"] = router
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router as rt
+
+    monkeypatch.setattr(rt, "RouterServer", FakeRouterServer)
+    cli.serve_fleet_command(
+        [
+            "--port", "0",
+            "--targets", "127.0.0.1:9,http://127.0.0.1:10",
+            "--route-policy", "least-pages",
+        ]
+    )
+    router = captured["router"]
+    try:
+        urls = [r.base_url for r in router.replicas()]
+        assert urls == ["http://127.0.0.1:9", "http://127.0.0.1:10"]
+        assert router.policy == "least-pages"
+        # dead targets are tolerated at attach: probed, marked down
+        assert all(not r.healthy for r in router.replicas())
+    finally:
+        router.stop()
+
+    with pytest.raises(CommandError, match="--targets"):
+        serve_fleet_command([])
+    with pytest.raises(CommandError, match="--route-policy"):
+        serve_fleet_command(
+            ["--targets", "a:1", "--route-policy", "nope"]
+        )

@@ -1,0 +1,518 @@
+"""Replica-fleet routing (ISSUE 12, serve/router.py): dispatch
+policies, the retry-once rule and its first-streamed-token cut, drain /
+scale-up membership, cancellation and deadline propagation through the
+front door, and the dispatch/health accounting — all hermetic over
+``FakeBackend`` replicas."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+    FakeBackend,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.flight import FLIGHT
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import router as router_mod
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
+    RemoteHTTPBackend,
+    RemoteServerError,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router import (
+    LocalReplica,
+    RemoteReplica,
+    Router,
+    RouterServer,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.stream import (
+    DeadlineExceeded,
+)
+
+
+def _req(prompt="hello", n=8, **kw):
+    return GenerationRequest("m", prompt, max_new_tokens=n, **kw)
+
+
+def _dispatch_count(name, policy):
+    return router_mod._DISPATCH_C.labels(replica=name, policy=policy).value
+
+
+def _retries(reason):
+    return router_mod._RETRIES_C.labels(reason=reason).value
+
+
+def _healthy(name):
+    return router_mod._REPLICA_HEALTHY_G.labels(replica=name).value
+
+
+@pytest.fixture()
+def fleet2():
+    replicas = [
+        LocalReplica("fa", FakeBackend()),
+        LocalReplica("fb", FakeBackend()),
+    ]
+    router = Router(replicas, policy="round-robin")
+    yield router, replicas
+    router.stop()
+
+
+def test_round_robin_splits_and_attributes(fleet2):
+    router, (ra, rb) = fleet2
+    before = {n: _dispatch_count(n, "round-robin") for n in ("fa", "fb")}
+    seen = []
+    for i in range(6):
+        result = router.dispatch(_req(f"p{i}"))
+        assert result.generated_tokens == 8
+        seen.append(result.extras["router"]["replica"])
+    assert seen.count("fa") == 3 and seen.count("fb") == 3
+    # dispatch accounting exact: one counted attempt per ticket
+    assert _dispatch_count("fa", "round-robin") - before["fa"] == 3
+    assert _dispatch_count("fb", "round-robin") - before["fb"] == 3
+    assert ra.dispatched == 3 and rb.dispatched == 3
+    assert ra.outstanding == 0 and rb.outstanding == 0
+
+
+def test_least_queue_prefers_idle_replica():
+    slow = LocalReplica(
+        "lq_slow", FakeBackend(tokens_per_s=100.0, simulate_delay=True)
+    )
+    idle = LocalReplica("lq_idle", FakeBackend())
+    router = Router([slow, idle], policy="least-queue")
+    try:
+        # occupy the slow replica with a long-running ticket...
+        t = threading.Thread(
+            target=lambda: router.dispatch(_req("long", n=64))
+        )
+        # round 0: both idle — the tie-break (name order) picks lq_idle;
+        # pin the long ticket onto lq_slow directly instead
+        slow.outstanding += 1
+        try:
+            t.start()
+            time.sleep(0.05)
+            # ...so the next three tickets all go to the idle one
+            for i in range(3):
+                result = router.dispatch(_req(f"q{i}", n=4))
+                assert result.extras["router"]["replica"] == "lq_idle"
+        finally:
+            slow.outstanding -= 1
+        t.join(timeout=10)
+    finally:
+        router.stop()
+
+
+def test_refused_admission_retries_once_elsewhere():
+    ra = LocalReplica("ref_a", FakeBackend())
+    rb = LocalReplica("ref_b", FakeBackend())
+    router = Router([ra, rb], policy="round-robin")
+    try:
+        before = _retries("refused")
+        # stop ra AFTER the membership probe: the router still believes
+        # it is healthy, so the first pick lands there and is REFUSED
+        ra.scheduler.stop()
+        results = [router.dispatch(_req(f"r{i}")) for i in range(2)]
+        replicas = [r.extras["router"]["replica"] for r in results]
+        assert replicas == ["ref_b", "ref_b"]
+        # a refusal is a capacity answer, not a death: ref_a stays in
+        # the rotation (and keeps refusing) until a probe sees it down
+        retried = [r for r in results if r.extras["router"].get("retried")]
+        assert len(retried) == 2
+        assert retried[0].extras["router"]["retried"] == "refused"
+        assert _retries("refused") - before == 2
+        # the probe notices the stopped scheduler; dispatch then goes
+        # straight to the survivor with no retry
+        router.probe_now()
+        assert not ra.healthy
+        clean = router.dispatch(_req("r2"))
+        assert clean.extras["router"]["replica"] == "ref_b"
+        assert "retried" not in clean.extras["router"]
+        assert _retries("refused") - before == 2
+    finally:
+        router.stop()
+
+
+def test_dead_replica_mid_prefill_retries_once_elsewhere():
+    backend_a = FakeBackend()
+    ra = LocalReplica("dead_a", backend_a)
+    rb = LocalReplica("dead_b", FakeBackend())
+    router = Router([ra, rb], policy="round-robin")
+    try:
+        before = _retries("dead")
+        backend_a.fail_decode_open = True  # dies at session open
+        got_b = 0
+        for i in range(2):
+            result = router.dispatch(_req(f"d{i}"))
+            assert result.generated_tokens == 8
+            got_b += result.extras["router"]["replica"] == "dead_b"
+        assert got_b == 2
+        assert _retries("dead") - before == 1
+        # a DEAD dispatch marks the replica unhealthy immediately
+        assert not ra.healthy
+        assert _healthy("dead_a") == 0.0
+        down = [e for e in FLIGHT.events(type_="replica_down")]
+        assert any(e["replica"] == "dead_a" for e in down)
+    finally:
+        router.stop()
+
+
+def test_streaming_retry_before_first_token():
+    backend_a = FakeBackend()
+    ra = LocalReplica("sdead_a", backend_a)
+    rb = LocalReplica("sdead_b", FakeBackend())
+    router = Router([ra, rb], policy="round-robin")
+    try:
+        backend_a.fail_decode_open = True
+        tokens, final = [], None
+        for chunk in router.dispatch_stream(_req("s0", n=8)):
+            if chunk.done:
+                final = chunk.result
+            else:
+                tokens.extend(chunk.tokens)
+        assert final is not None and len(tokens) == 8
+        assert final.extras["router"]["replica"] == "sdead_b"
+        assert final.extras["router"]["retried"] == "dead"
+    finally:
+        router.stop()
+
+
+def test_mid_stream_death_is_terminal_error_never_retried():
+    backend_a = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    ra = LocalReplica("mid_a", backend_a)
+    rb = LocalReplica("mid_b", FakeBackend())
+    router = Router([ra, rb], policy="round-robin")
+    try:
+        before_b = _dispatch_count("mid_b", "round-robin")
+        backend_a.fail_after_slices = 1  # dies after one decode slice
+        got = 0
+        with pytest.raises(RuntimeError, match="died"):
+            for chunk in router.dispatch_stream(_req("m0", n=256)):
+                if not chunk.done:
+                    got += len(chunk.tokens)
+        # tokens HAD streamed before the death — so no retry happened
+        assert got > 0
+        assert _dispatch_count("mid_b", "round-robin") == before_b
+    finally:
+        router.stop()
+
+
+def test_retry_only_once_then_error_surfaces():
+    ba, bb = FakeBackend(), FakeBackend()
+    ra, rb = LocalReplica("once_a", ba), LocalReplica("once_b", bb)
+    router = Router([ra, rb], policy="round-robin")
+    try:
+        ba.fail_decode_open = True
+        bb.fail_decode_open = True
+        with pytest.raises(RuntimeError):
+            router.dispatch(_req("x"))
+    finally:
+        router.stop()
+
+
+def test_drain_finishes_inflight_then_detaches(fleet2):
+    router, (ra, rb) = fleet2
+    ra.backend.simulate_delay = True
+    ra.backend.tokens_per_s = 150.0
+    done = {}
+
+    def long_client():
+        done["result"] = router.dispatch(_req("drain-long", n=48))
+
+    # pin the long ticket to fa (round-robin cursor starts there)
+    t = threading.Thread(target=long_client)
+    t.start()
+    time.sleep(0.05)
+    assert ra.outstanding == 1
+    assert router.drain("fa", timeout_s=30.0)
+    t.join(timeout=30)
+    # the in-flight ticket FINISHED (drain waited for it)
+    assert done["result"].generated_tokens == 48
+    assert done["result"].extras["router"]["replica"] == "fa"
+    # ...and fa is detached: gone from membership, gauge dropped, event
+    assert [r.name for r in router.replicas()] == ["fb"]
+    assert _healthy("fa") == 0.0
+    drained = FLIGHT.events(type_="replica_drained")
+    assert any(e["replica"] == "fa" for e in drained)
+    # new dispatch only reaches the survivor
+    for i in range(3):
+        assert (
+            router.dispatch(_req(f"post{i}")).extras["router"]["replica"]
+            == "fb"
+        )
+
+
+def test_drain_unknown_replica_raises(fleet2):
+    router, _ = fleet2
+    with pytest.raises(KeyError):
+        router.drain("nope")
+
+
+def test_add_replica_scales_up(fleet2):
+    router, _ = fleet2
+    router.add_replica(LocalReplica("fc", FakeBackend()))
+    seen = {
+        router.dispatch(_req(f"a{i}")).extras["router"]["replica"]
+        for i in range(6)
+    }
+    assert "fc" in seen
+    with pytest.raises(ValueError):
+        router.add_replica(LocalReplica("fc", FakeBackend()))
+
+
+def test_no_healthy_replica_raises():
+    ra = LocalReplica("none_a", FakeBackend())
+    router = Router([ra], policy="least-queue")
+    try:
+        ra.scheduler.stop()
+        router.probe_now()
+        assert not ra.healthy
+        with pytest.raises(RuntimeError, match="no healthy replica"):
+            router.dispatch(_req("x"))
+    finally:
+        router.stop()
+
+
+def test_cancellation_propagates_to_replica_row():
+    backend = FakeBackend(tokens_per_s=150.0, simulate_delay=True)
+    ra = LocalReplica("can_a", backend)
+    router = Router([ra], policy="least-queue")
+    try:
+        chunks = router.dispatch_stream(_req("cancel me", n=512))
+        got = 0
+        for chunk in chunks:
+            got += len(chunk.tokens)
+            if got >= 8:
+                break
+        chunks.close()  # the front-door disconnect
+        # the replica-side row retires within one slice: the scheduler
+        # goes idle instead of decoding 512 tokens
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            health = ra.scheduler.health_state()
+            if (
+                health["inflight_rows"] == 0
+                and health["queue_depth"] == 0
+            ):
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("replica row never retired after cancel")
+        assert ra.outstanding == 0
+    finally:
+        router.stop()
+
+
+def test_deadline_propagates_through_front_door(fleet2):
+    router, _ = fleet2
+    # a deadline that has effectively already passed is shed by the
+    # replica's scheduler pre-admission and must NOT be retried (the
+    # outcome is the ticket's own, not the replica's)
+    before = [_retries("refused"), _retries("dead")]
+    with pytest.raises(DeadlineExceeded):
+        router.dispatch(_req("late", deadline_ms=0.0001))
+    assert [_retries("refused"), _retries("dead")] == before
+
+
+def test_priority_rides_through_router(fleet2):
+    router, _ = fleet2
+    result = router.dispatch(_req("vip", priority=2))
+    assert result.generated_tokens == 8
+
+
+class _SlowProbeReplica(LocalReplica):
+    probes = 0
+
+    def probe(self):
+        type(self).probes += 1
+        return super().probe()
+
+
+def test_background_prober_ticks():
+    ra = _SlowProbeReplica("probe_a", FakeBackend())
+    router = Router([ra], policy="least-queue", probe_interval_s=0.05)
+    try:
+        base = _SlowProbeReplica.probes
+        router.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if _SlowProbeReplica.probes - base >= 2:
+                break
+            time.sleep(0.01)
+        assert _SlowProbeReplica.probes - base >= 2
+        assert ra.t_probe is not None
+        assert ra.last_stats.get("scheduler") == "continuous"
+    finally:
+        router.stop()
+
+
+# -- the HTTP front door ------------------------------------------------------
+
+
+@pytest.fixture()
+def front_door():
+    replicas = [
+        LocalReplica("h0", FakeBackend()),
+        LocalReplica("h1", FakeBackend()),
+    ]
+    router = Router(replicas, policy="round-robin")
+    server = RouterServer(
+        router, host="127.0.0.1", port=0, models=["m"], quiet=True
+    )
+    server.start()
+    yield server, router, replicas
+    server.stop()
+
+
+def test_front_door_round_trip_and_attribution(front_door):
+    server, _router, _ = front_door
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+    req = _req("front door")
+    result = client.generate(req)
+    assert result.tokens == FakeBackend().generate(req).tokens
+    assert result.extras["router"]["replica"] in ("h0", "h1")
+    assert result.extras["router"]["policy"] == "round-robin"
+    # scheduler attribution from the REPLICA rides through untouched
+    assert "sched" in result.extras
+
+
+def test_front_door_streaming_parity(front_door):
+    server, _router, _ = front_door
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+    req = _req("stream via router", n=12)
+    mono = client.generate(req)
+    chunks = list(client.generate_stream(req))
+    assert chunks[-1].done
+    final = chunks[-1].result
+    assert final.text == mono.text and final.tokens == mono.tokens
+    assert final.extras["router"]["replica"] in ("h0", "h1")
+
+
+def test_front_door_unknown_model_404_and_bad_request_400(front_door):
+    server, _router, _ = front_door
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+    with pytest.raises(RemoteServerError) as exc_info:
+        client.generate(GenerationRequest("nope", "x", max_new_tokens=4))
+    assert exc_info.value.status == 404
+    with pytest.raises(RemoteServerError) as exc_info:
+        client.generate(_req("x", n=99999))
+    assert exc_info.value.status == 400
+
+
+def test_front_door_healthz_and_debug_state(front_door):
+    server, _router, _ = front_door
+    base = f"http://127.0.0.1:{server.port}"
+    with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+        health = json.loads(resp.read())
+    assert health["role"] == "router" and health["status"] == "ok"
+    assert health["replicas"] == 2 and health["healthy_replicas"] == 2
+    with urllib.request.urlopen(f"{base}/debug/state", timeout=5) as resp:
+        state = json.loads(resp.read())
+    assert state["policy"] == "round-robin"
+    names = {r["name"] for r in state["replicas"]}
+    assert names == {"h0", "h1"}
+    for r in state["replicas"]:
+        assert r["healthy"] is True
+        assert r["last_probe"].get("scheduler") == "continuous"
+        assert "queue_depth" in r["last_probe"]
+
+
+def test_front_door_all_replicas_down_is_503(front_door):
+    server, router, replicas = front_door
+    for replica in replicas:
+        replica.scheduler.stop()
+    router.probe_now()
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+    with pytest.raises(RemoteServerError) as exc_info:
+        client.generate(_req("x"))
+    assert exc_info.value.status == 503
+
+
+def test_front_door_kill_one_replica_mid_fleet(front_door):
+    """The smoke's kill scenario, hermetic: one replica dies, the
+    healthy gauge drops, the retried ticket completes on the survivor,
+    and zero accepted tickets are lost."""
+    server, router, (r0, r1) = front_door
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+    r0.backend.fail_decode_open = True  # r0 is now dead mid-prefill
+    results = [client.generate(_req(f"k{i}")) for i in range(4)]
+    assert all(r.generated_tokens == 8 for r in results)
+    assert {r.extras["router"]["replica"] for r in results} == {"h1"}
+    assert not r0.healthy and _healthy("h0") == 0.0
+
+
+def test_front_door_mid_stream_death_is_terminal_sse_error():
+    backend = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    router = Router([LocalReplica("w0", backend)], policy="least-queue")
+    server = RouterServer(router, host="127.0.0.1", port=0, quiet=True)
+    server.start()
+    try:
+        client = RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+        backend.fail_after_slices = 1
+        chunks = []
+        with pytest.raises(RemoteServerError, match="died"):
+            for c in client.generate_stream(_req("wire death", n=256)):
+                chunks.append(c)
+        # deltas arrived before the terminal error record — a clean,
+        # terminated stream, not a hang or an IncompleteRead
+        assert chunks and chunks[0].tokens
+    finally:
+        server.stop()
+
+
+def test_front_door_deadline_maps_to_504(front_door):
+    server, _router, _ = front_door
+    client = RemoteHTTPBackend(f"http://127.0.0.1:{server.port}")
+    with pytest.raises(RemoteServerError) as exc_info:
+        client.generate(_req("late wire", deadline_ms=0.0001))
+    assert exc_info.value.status == 504
+
+
+def test_remote_replica_probe_parses_healthz_and_metrics():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server import (
+        GenerationServer,
+    )
+
+    backend_server = GenerationServer(
+        FakeBackend(),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    backend_server.start()
+    try:
+        replica = RemoteReplica(
+            "remote0", f"http://127.0.0.1:{backend_server.port}"
+        )
+        stats = replica.probe()
+        assert stats["running"] is True
+        assert stats["scheduler"] == "continuous"
+        assert stats["queue_depth"] == 0
+        # dispatch over the wire works too
+        result = replica.generate(_req("remote"))
+        assert result.generated_tokens == 8
+    finally:
+        backend_server.stop()
+
+
+def test_metrics_gauge_parser():
+    text = (
+        "# TYPE llm_paged_pool_occupancy gauge\n"
+        "llm_paged_pool_occupancy 0.25\n"
+        "llm_request_joules_per_token_sum 4.0\n"
+        "llm_request_joules_per_token_count 8\n"
+    )
+    assert router_mod._metrics_gauge(text, "llm_paged_pool_occupancy") == 0.25
+    assert router_mod._metrics_gauge(text, "absent_family") is None
+    assert (
+        router_mod._metrics_hist_mean(text, "llm_request_joules_per_token")
+        == 0.5
+    )
+
+
+def test_route_policy_validation():
+    with pytest.raises(ValueError, match="route policy"):
+        Router([], policy="fastest")

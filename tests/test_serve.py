@@ -71,6 +71,86 @@ def test_health_and_tags(server, client):
     assert client.list_models() == ["qwen2:1.5b", "gemma:2b"]
 
 
+def test_healthz_reports_scheduler_kind_and_inflight():
+    """ISSUE 12 satellite: /healthz is the router's probe target — it
+    must carry the scheduler kind and live queue/inflight counts."""
+    import urllib.request
+
+    srv = GenerationServer(
+        FakeBackend(tokens_per_s=150.0, simulate_delay=True),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def healthz():
+            with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+                return json.loads(r.read())
+
+        idle = healthz()
+        assert idle["status"] == "ok"
+        assert idle["scheduler"] == "continuous"
+        assert idle["inflight_rows"] == 0 and idle["queue_depth"] == 0
+        assert idle["backend"] == "FakeBackend"
+        # one long request in flight: the count rises, then drains
+        cl = RemoteHTTPBackend(base)
+        t = threading.Thread(
+            target=lambda: cl.generate(
+                GenerationRequest("m", "busy", max_new_tokens=96)
+            )
+        )
+        t.start()
+        import time as _time
+
+        saw_inflight = False
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline and not saw_inflight:
+            saw_inflight = healthz()["inflight_rows"] > 0
+            _time.sleep(0.005)
+        t.join(timeout=30)
+        assert saw_inflight
+        assert healthz()["inflight_rows"] == 0
+    finally:
+        srv.stop()
+
+
+def test_healthz_works_under_telemetry_kill_switch(monkeypatch):
+    """/healthz must answer while /metrics and /debug/* 404 (liveness
+    cannot depend on observability)."""
+    import urllib.error
+    import urllib.request
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import (
+        metrics as obs_metrics,
+    )
+
+    srv = GenerationServer(
+        FakeBackend(),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    srv.start()
+    monkeypatch.setattr(obs_metrics, "_enabled", False)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["status"] == "ok"
+        assert body["scheduler"] == "continuous"
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"{base}/metrics", timeout=5)
+        assert exc_info.value.code == 404
+    finally:
+        monkeypatch.setattr(obs_metrics, "_enabled", True)
+        srv.stop()
+
+
 def test_generate_round_trip(client):
     req = GenerationRequest("qwen2:1.5b", "In 100 words, tell me", 32)
     result = client.generate(req)
