@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterator, List, Optional
 
+from ..obs.trace import TraceContext
+
 
 @dataclasses.dataclass(frozen=True)
 class GenerationRequest:
@@ -41,6 +43,13 @@ class GenerationRequest:
     # serve/protocol.PRIORITY_TIERS (low=0, normal=1, high=2); any
     # non-negative integer is a valid tier.
     priority: int = 1
+    # Fleet-wide trace context (wire: x_trace — ISSUE 13): minted at the
+    # front door (router/server) when absent, or accepted from the
+    # caller; every hop the request touches (both attempts of a retry
+    # included) tags its spans and flight events with trace.trace_id,
+    # so GET /debug/timeline?trace= can reassemble the cross-process
+    # story. None = untraced (a hop will mint one).
+    trace: Optional[TraceContext] = None
 
     def __post_init__(self) -> None:
         # Degenerate knobs would silently corrupt sampling (top_p<=0 masks

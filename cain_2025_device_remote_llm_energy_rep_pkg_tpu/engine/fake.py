@@ -246,6 +246,7 @@ class _FakeStepSession:
                     "generated": row["result"].tokens[
                         : min(row["cursor"], row["result"].generated_tokens)
                     ],
+                    "prompt_len": row["result"].prompt_tokens,
                     "host_bytes": host_bytes,
                     "discharged": False,
                 }
@@ -426,6 +427,7 @@ class _FakeStepSession:
             row["cursor"] += advance
             if row["cursor"] >= row["result"].generated_tokens:
                 res = row["result"]
+                self.backend._observe_energy(res)
                 res.extras = {
                     **(res.extras or {}),
                     "retire_reason": "budget",
@@ -514,9 +516,22 @@ class FakeBackend(GenerationBackend):
         spec_acceptance: float = 1.0,
         spec_accept_floor: "Optional[float]" = None,
         max_rows: int = 64,
+        joules_per_token: float = 0.0,
     ):
         self.tokens_per_s = tokens_per_s
         self.simulate_delay = simulate_delay
+        # Synthetic energy attribution (ISSUE 13): a non-zero value makes
+        # this fake report that J/token for every served request — into
+        # the shared llm_request_joules_per_token family (so a remote
+        # fake replica's /metrics scrape feeds the router's least-joules
+        # policy and the fleet J/token rollup) and as the live
+        # ``last_joules_per_token`` attribute LocalReplica probes read.
+        # Two fakes with different figures make least-joules testable
+        # hermetically — the gap the ROADMAP's PR-12 follow-on names.
+        self.joules_per_token = float(joules_per_token)
+        self.last_joules_per_token: "Optional[float]" = (
+            self.joules_per_token or None
+        )
         # Failure injection for router/failure-path tests (ISSUE 12) —
         # both MUTABLE so a test can kill a live replica mid-trace:
         # fail_decode_open makes every session open raise (a replica
@@ -574,6 +589,30 @@ class FakeBackend(GenerationBackend):
             total_s=prefill_s + decode_s,
         )
 
+    def _observe_energy(self, result: GenerationResult) -> None:
+        """Record the configured synthetic J/token for one served result
+        (no-op at the 0.0 default) — the fake twin of the real engine's
+        ``_observe_result`` energy attribution, so llm_request_* energy
+        families and extras["energy_model"] are CI-testable."""
+        if not self.joules_per_token:
+            return
+        try:
+            from ..obs import energy as obs_energy
+
+            jpt = self.joules_per_token
+            est = {
+                "J": jpt * result.generated_tokens,
+                "J_per_token": jpt,
+            }
+            obs_energy.observe_estimate(est)
+            result.extras = {
+                **(result.extras or {}),
+                "energy_model": dict(est),
+            }
+            self.last_joules_per_token = jpt
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
     def generate(self, request: GenerationRequest) -> GenerationResult:
         # a dead backend is dead on EVERY path: the continuous
         # scheduler's engine-death salvage re-runs tickets through this
@@ -585,6 +624,7 @@ class FakeBackend(GenerationBackend):
         result = self._result(request)
         if self.simulate_delay:
             time.sleep(result.total_s)
+        self._observe_energy(result)
         return result
 
     def decode_open(

@@ -1519,11 +1519,11 @@ class JaxEngine(GenerationBackend):
             "decode", t1, t2,
             attrs={"tokens": tokens, "rows": rows, **labels},
         )
-        from ..obs.flight import EV_DECODE_WINDOW, FLIGHT, trace_of
+        from ..obs.flight import EV_DECODE_WINDOW, FLIGHT, trace_attrs
 
         FLIGHT.emit(
             EV_DECODE_WINDOW,
-            trace=trace_of(_TRACER.current()),
+            **trace_attrs(_TRACER.current()),
             tokens=tokens,
             steps=steps,
             rows=rows,
@@ -1558,6 +1558,11 @@ class JaxEngine(GenerationBackend):
             if est is not None:
                 result.extras = {**(result.extras or {}), "energy_model": est}
                 obs_energy.observe_estimate(est)
+                # live figure for router probes (ISSUE 13): LocalReplica
+                # reads this attribute so least-joules routing works on
+                # real engines without a loopback /metrics scrape
+                if est.get("J_per_token") is not None:
+                    self.last_joules_per_token = est["J_per_token"]
         except Exception:  # noqa: BLE001 — telemetry only
             pass
 
@@ -1598,6 +1603,8 @@ class JaxEngine(GenerationBackend):
             if est is None:
                 return
             obs_energy.observe_estimate(est)
+            if est.get("J_per_token") is not None:
+                self.last_joules_per_token = est["J_per_token"]
             for r in results:
                 if not r.generated_tokens:
                     continue

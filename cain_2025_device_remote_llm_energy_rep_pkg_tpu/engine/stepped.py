@@ -1177,8 +1177,9 @@ class SteppedDecodeSession:
         )
         if _obs_enabled() and slice_rounds:
             try:
-                from ..obs.flight import EV_SPEC_ROUND, FLIGHT
+                from ..obs.flight import EV_SPEC_ROUND, FLIGHT, trace_attrs
                 from ..obs.metrics import observe_spec
+                from ..obs.trace import TRACER
 
                 observe_spec(slice_rounds, acc_delta, drafted_delta)
                 if self.paged:
@@ -1189,6 +1190,10 @@ class SteppedDecodeSession:
                     SPEC_VERIFY_NATIVE_C.inc(slice_rounds)
                 FLIGHT.emit(
                     EV_SPEC_ROUND,
+                    # the slice runs on the scheduler thread with the
+                    # anchor's root attached — spec rounds join the
+                    # fleet trace like every other flight event
+                    **trace_attrs(TRACER.current()),
                     model=self.model,
                     draft=self.spec["draft"],
                     k=self.spec["k"],

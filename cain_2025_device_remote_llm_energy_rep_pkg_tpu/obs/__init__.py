@@ -31,11 +31,27 @@ spans, ``/metrics``), ``serve/scheduler.py`` (queue wait, window
 collect, admission caps, batch composition), ``engine/jax_engine.py``
 (prefill/decode windows, tokens/s, attention-path labels, energy
 attribution), ``engine/paged_kv.py`` (pool occupancy / fragmentation).
+
+Fleet-native since ISSUE 13: requests carry a wire trace context
+(``x_trace`` → :class:`.trace.TraceContext`) every hop's spans and
+flight events tag, :mod:`.metrics` parses and MERGES whole expositions
+(``parse_exposition`` / ``merge_expositions`` — the router's
+``llm_fleet_*`` federation), and :mod:`.energy` keeps the wasted-Joules
+ledger (``llm_request_wasted_joules_total{cause=retry|recompute|swap}``)
+that survives retries and preemption.
 """
 
 from .flight import FLIGHT, FlightRecorder
-from .metrics import REGISTRY, MetricsRegistry, disable, enable, enabled
-from .trace import TRACER, Span, SpanTracer
+from .metrics import (
+    REGISTRY,
+    MetricsRegistry,
+    disable,
+    enable,
+    enabled,
+    merge_expositions,
+    parse_exposition,
+)
+from .trace import TRACER, Span, SpanTracer, TraceContext, mint_trace_id
 
 __all__ = [
     "REGISTRY",
@@ -43,9 +59,13 @@ __all__ = [
     "TRACER",
     "Span",
     "SpanTracer",
+    "TraceContext",
+    "mint_trace_id",
     "FLIGHT",
     "FlightRecorder",
     "enabled",
     "enable",
     "disable",
+    "merge_expositions",
+    "parse_exposition",
 ]

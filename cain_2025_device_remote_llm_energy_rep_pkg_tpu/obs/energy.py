@@ -178,3 +178,74 @@ def observe_estimate(est: Optional[Dict[str, Any]]) -> None:
             REQUEST_JPT_BOUND.labels(bound="low").set(est["J_per_token_low"])
         if est.get("J_per_token_high") is not None:
             REQUEST_JPT_BOUND.labels(bound="high").set(est["J_per_token_high"])
+
+
+# -- wasted-energy ledger (ISSUE 13) -------------------------------------------
+# Joules burned on work the caller never benefits from, attributed to a
+# CAUSE and surviving retries and preemption: a retried ticket's first
+# attempt burned prefill on a replica that died before streaming; a
+# recompute-policy resume re-prefills prompt + generated tokens it
+# already paid for once; a swap preemption moves KV payload over the
+# host link twice. The study's unit of account is Joules per fetched
+# response — this ledger is where the Joules that DON'T end up in a
+# response go, so fleet J/token can be read honestly next to it.
+
+WASTED_J = REGISTRY.counter(
+    "llm_request_wasted_joules_total",
+    "Modelled Joules burned on work no response benefits from, by cause "
+    "(retry: burned on a replica that died before the ticket's first "
+    "streamed token; recompute: a preemption victim's re-prefill of "
+    "prompt + generated tokens under --preempt-policy recompute; swap: "
+    "KV payload moved device<->host by a swap preemption)",
+    labels=("cause",),
+)
+WASTED_TOKENS = REGISTRY.counter(
+    "llm_request_wasted_tokens_total",
+    "Token positions computed more than once (or thrown away), by the "
+    "same causes as llm_request_wasted_joules_total (swap moves bytes, "
+    "not tokens: it counts 0 here)",
+    labels=("cause",),
+)
+
+# Fallback J/token when no live attribution exists yet (fresh process,
+# fake backends): the geometric center of ENERGY_BUCKETS' working band —
+# an order-of-magnitude placeholder the live REQUEST_JPT mean replaces
+# the moment real requests have been attributed.
+NOMINAL_JPT_FALLBACK = 0.5
+# Energy of moving one KV byte device<->host for a swap preemption
+# (DMA + DDR write ≈ tens of pJ/byte; nominal, documented as a model).
+SWAP_J_PER_BYTE = 1e-9
+
+
+def live_joules_per_token() -> float:
+    """The process's live mean J/token (REQUEST_JPT sum/count), falling
+    back to :data:`NOMINAL_JPT_FALLBACK` before any request has been
+    attributed — the figure wasted-token charges are priced at."""
+    child = REQUEST_JPT._default
+    if child.count:
+        return child.sum / child.count
+    return NOMINAL_JPT_FALLBACK
+
+
+def charge_wasted(
+    cause: str,
+    tokens: float = 0.0,
+    nbytes: float = 0.0,
+    jpt: Optional[float] = None,
+) -> float:
+    """Charge one waste event to the ledger and return the Joules
+    charged (0.0 when telemetry is off — callers stamp the figure into
+    ``x_extras.energy`` too, so it must come back). ``tokens`` price at
+    ``jpt`` (default: the live process mean), ``nbytes`` at the nominal
+    host-link energy; either may be zero."""
+    if not enabled():
+        return 0.0
+    joules = 0.0
+    if tokens > 0:
+        joules += tokens * (jpt if jpt else live_joules_per_token())
+        WASTED_TOKENS.labels(cause=cause).inc(tokens)
+    if nbytes > 0:
+        joules += nbytes * SWAP_J_PER_BYTE
+    if joules > 0:
+        WASTED_J.labels(cause=cause).inc(joules)
+    return joules

@@ -71,6 +71,9 @@ EV_PREFIX_HIT = "prefix_hit"  # a joiner reused cached shared-prefix KV
 EV_PREFIX_EVICT = "prefix_evict"  # a prefix-index entry was evicted (LRU)
 EV_SPEC_ROUND = "spec_round"  # one speculative window's rounds/acceptance
 EV_SPEC_FALLBACK = "spec_fallback"  # session acceptance fell below the floor
+EV_STREAM_CHUNK = "stream_chunk"  # one egress push of a streaming row's
+#   new tokens into its per-request channel (the wire-visible moment of
+#   token delivery — the "stream chunks" phase of a /debug/timeline)
 EV_DECODE_WINDOW = "decode_window"  # engine fence-timed decode window
 EV_ANOMALY = "anomaly"  # detector fired (obs/detect.py)
 EV_CRASH_DUMP = "crash_dump"  # a crash dump was written
@@ -94,9 +97,12 @@ _EVENTS_C = REGISTRY.counter(
 
 class FlightEvent:
     """One recorded event. ``trace`` is the owning request root's span id
-    (None for events with no request context)."""
+    (None for events with no request context); ``trace_id`` is the
+    FLEET-WIDE wire trace (``x_trace``) the request carries across
+    processes — the key ``/debug/flight?trace=`` and the router's
+    ``/debug/timeline`` filter on (ISSUE 13)."""
 
-    __slots__ = ("seq", "t_s", "type", "trace", "attrs")
+    __slots__ = ("seq", "t_s", "type", "trace", "trace_id", "attrs")
 
     def __init__(
         self,
@@ -105,11 +111,13 @@ class FlightEvent:
         type_: str,
         trace: Optional[int],
         attrs: Dict[str, Any],
+        trace_id: Optional[str] = None,
     ) -> None:
         self.seq = seq
         self.t_s = t_s
         self.type = type_
         self.trace = trace
+        self.trace_id = trace_id
         self.attrs = attrs
 
     def to_dict(self) -> Dict[str, Any]:
@@ -120,6 +128,8 @@ class FlightEvent:
         }
         if self.trace is not None:
             d["trace"] = self.trace
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
         if self.attrs:
             d.update(self.attrs)
         return d
@@ -146,12 +156,18 @@ class FlightRecorder:
 
     # -- recording ------------------------------------------------------------
     def emit(
-        self, type_: str, trace: Optional[int] = None, **attrs: Any
+        self,
+        type_: str,
+        trace: Optional[int] = None,
+        trace_id: Optional[str] = None,
+        **attrs: Any,
     ) -> Optional[FlightEvent]:
         """Record one event. No-op (returns None) when telemetry is off.
 
         ``trace`` is a span id (``Span.span_id``); pass the request
-        root's so the event links back to the span tree.
+        root's so the event links back to the span tree. ``trace_id``
+        is the request's fleet-wide wire trace (``x_trace``) — pass
+        both with :func:`trace_attrs`.
         """
         if not enabled():
             return None
@@ -161,7 +177,9 @@ class FlightRecorder:
             if len(self._events) == self._events.maxlen:
                 self._dropped += 1
                 _DROPPED_C.inc()
-            event = FlightEvent(self._seq, now, type_, trace, attrs)
+            event = FlightEvent(
+                self._seq, now, type_, trace, attrs, trace_id=trace_id
+            )
             self._events.append(event)
             self._counts[type_] = self._counts.get(type_, 0) + 1
         # the labelled counter outside the ring lock (it takes the family
@@ -171,14 +189,32 @@ class FlightRecorder:
 
     # -- introspection --------------------------------------------------------
     def events(
-        self, n: Optional[int] = None, type_: Optional[str] = None
+        self,
+        n: Optional[int] = None,
+        type_: Optional[str] = None,
+        trace: Optional[str] = None,
     ) -> List[Dict[str, Any]]:
         """The last ``n`` events (all when None), oldest first, optionally
-        filtered by type. Returns plain dicts — safe to JSON-serialise."""
+        filtered by type and/or trace. ``trace`` matches the fleet-wide
+        ``trace_id`` (hex string) — or, when it parses as an integer,
+        the process-local span id too, so pre-wire-trace consumers keep
+        working. Returns plain dicts — safe to JSON-serialise."""
         with self._lock:
             snap = list(self._events)
         if type_ is not None:
             snap = [e for e in snap if e.type == type_]
+        if trace is not None:
+            span_id: Optional[int] = None
+            try:
+                span_id = int(trace)
+            except ValueError:
+                pass
+            snap = [
+                e
+                for e in snap
+                if e.trace_id == trace
+                or (span_id is not None and e.trace == span_id)
+            ]
         if n is not None and n >= 0:
             snap = snap[-n:] if n else []
         return [e.to_dict() for e in snap]
@@ -256,6 +292,20 @@ def trace_of(span) -> Optional[int]:
     """The flight-recorder trace id of a span (or None) — one definition
     so scheduler emit sites cannot drift from the span tree's ids."""
     return span.span_id if span is not None else None
+
+
+def trace_attrs(span) -> Dict[str, Any]:
+    """BOTH trace keys of a span for ``FLIGHT.emit(**trace_attrs(s))``:
+    the process-local span id (``trace``) and — when the request carried
+    one — the fleet-wide wire trace (``trace_id``). One definition so
+    every emit site links events identically across processes."""
+    if span is None:
+        return {"trace": None}
+    out: Dict[str, Any] = {"trace": span.span_id}
+    tid = getattr(span, "trace_id", None)
+    if tid is not None:
+        out["trace_id"] = tid
+    return out
 
 
 # THE process-wide recorder every instrumented module shares.

@@ -249,6 +249,12 @@ class MetricsRegistry:
         """
         if not _enabled:
             return ""
+        return self._render()
+
+    def _render(self) -> str:
+        """The exposition body, kill-switch-free: the federation merge
+        (:func:`merge_expositions`) renders its scratch registry through
+        this so the merged text is a pure function of its inputs."""
         lines = []
         with self._lock:
             families = list(self._families.values())
@@ -318,6 +324,295 @@ class MetricsRegistry:
 
 # THE process-wide registry every instrumented module shares.
 REGISTRY = MetricsRegistry()
+
+
+# -- Prometheus text parsing + fleet federation (ISSUE 13) ----------------------
+# The router used to scrape replicas with two ad-hoc regexes; federation
+# (one front-door scrape answering fleet TTFT p99 / aggregate goodput /
+# fleet J-per-token) needs the real thing: a v0.0.4 text parser that
+# understands TYPE lines, label escaping and histogram bucket samples,
+# and a merge that sums counters, merges fixed-bucket histograms
+# BUCKET-WISE, and re-labels gauges {replica=...} (a gauge is a point
+# reading — summing two pool occupancies would be a lie).
+
+
+class ParsedFamily:
+    """One parsed metric family. ``samples`` maps a canonical label key
+    (a tuple of ``(name, value)`` pairs sorted by name) to the float
+    value (counter/gauge/untyped); ``histograms`` maps the same key to
+    ``{"buckets": [(le, cumulative), ...], "sum": float, "count":
+    float}`` with buckets in exposition order."""
+
+    __slots__ = ("name", "kind", "help", "samples", "histograms")
+
+    def __init__(self, name: str, kind: str = "untyped", help_: str = "") -> None:
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.samples: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self.histograms: Dict[Tuple[Tuple[str, str], ...], Dict[str, Any]] = {}
+
+
+def _unescape_label(value: str) -> str:
+    out, i = [], 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_label_str(s: str) -> Dict[str, str]:
+    """``a="x",b="y"`` (escaped per the spec) → dict. Character scanner,
+    not a regex: label VALUES may contain commas, braces and escaped
+    quotes."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(s)
+    while i < n:
+        eq = s.index("=", i)
+        name = s[i:eq].strip()
+        i = eq + 1
+        if i >= n or s[i] != '"':
+            raise ValueError(f"malformed label string: {s!r}")
+        i += 1
+        start = i
+        buf = []
+        while i < n:
+            c = s[i]
+            if c == "\\" and i + 1 < n:
+                buf.append(s[start:i])
+                buf.append(s[i : i + 2])
+                i += 2
+                start = i
+                continue
+            if c == '"':
+                break
+            i += 1
+        buf.append(s[start:i])
+        labels[name] = _unescape_label("".join(buf))
+        i += 1  # past the closing quote
+        while i < n and s[i] in ", ":
+            i += 1
+    return labels
+
+
+def _split_sample(line: str) -> Tuple[str, Dict[str, str], float]:
+    """One sample line → (metric name, labels, value)."""
+    if "{" in line:
+        name, _, rest = line.partition("{")
+        # the closing brace of the LABEL BLOCK is the last '}' before
+        # the value (label values may contain '}' but it is inside
+        # quotes; scanning from the right is safe because the value
+        # itself never contains one)
+        close = rest.rindex("}")
+        labels = _parse_label_str(rest[:close])
+        value = float(rest[close + 1 :].strip())
+        return name, labels, value
+    name, _, value = line.rpartition(" ")
+    return name.strip(), {}, float(value)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+def parse_exposition(text: str) -> Dict[str, ParsedFamily]:
+    """Parse a Prometheus v0.0.4 text exposition into families.
+
+    Histogram ``_bucket``/``_sum``/``_count`` samples fold into their
+    TYPE-declared base family; samples with no TYPE line land in an
+    untyped family under their literal sample name (so ad-hoc scrapes
+    still answer :func:`sample_value`). Unparseable lines are skipped —
+    a probe must degrade, not raise."""
+    families: Dict[str, ParsedFamily] = {}
+    kinds: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    lines = text.splitlines()
+    for line in lines:  # pass 1: metadata
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) >= 4:
+                kinds[parts[2]] = parts[3].strip()
+        elif line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) >= 3:
+                raw = parts[3] if len(parts) > 3 else ""
+                helps[parts[2]] = raw.replace("\\n", "\n").replace("\\\\", "\\")
+    hist_names = {n for n, k in kinds.items() if k == "histogram"}
+    for line in lines:  # pass 2: samples
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name, labels, value = _split_sample(line)
+        except (ValueError, IndexError):
+            continue
+        base = None
+        suffix = None
+        for cand_suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(cand_suffix) and name[: -len(cand_suffix)] in hist_names:
+                base, suffix = name[: -len(cand_suffix)], cand_suffix
+                break
+        if base is not None:
+            fam = families.setdefault(
+                base, ParsedFamily(base, "histogram", helps.get(base, ""))
+            )
+            le = labels.pop("le", None)
+            key = _label_key(labels)
+            hist = fam.histograms.setdefault(
+                key, {"buckets": [], "sum": 0.0, "count": 0.0}
+            )
+            if suffix == "_bucket":
+                hist["buckets"].append((le, value))
+            elif suffix == "_sum":
+                hist["sum"] = value
+            else:
+                hist["count"] = value
+            continue
+        kind = kinds.get(name, "untyped")
+        fam = families.setdefault(
+            name, ParsedFamily(name, kind, helps.get(name, ""))
+        )
+        fam.samples[_label_key(labels)] = value
+    return families
+
+
+def sample_value(
+    families: Dict[str, ParsedFamily], name: str
+) -> Optional[float]:
+    """First sample of a counter/gauge/untyped family (None when
+    absent/empty) — the probe's one-gauge accessor."""
+    fam = families.get(name)
+    if fam is None or not fam.samples:
+        return None
+    return next(iter(fam.samples.values()))
+
+
+def histogram_mean(
+    families: Dict[str, ParsedFamily], name: str
+) -> Optional[float]:
+    """Mean (sum/count over all children) of a histogram family; falls
+    back to bare ``<name>_sum``/``<name>_count`` samples for scrapes
+    with no TYPE line. None when absent or empty."""
+    fam = families.get(name)
+    if fam is not None and fam.histograms:
+        total = sum(h["sum"] for h in fam.histograms.values())
+        count = sum(h["count"] for h in fam.histograms.values())
+        return total / count if count else None
+    total = sample_value(families, f"{name}_sum")
+    count = sample_value(families, f"{name}_count")
+    if total is None or not count:
+        return None
+    return total / count
+
+
+# Families the federation NEVER rolls up: the router's own surface (a
+# replica scrape can only contain these in the degenerate in-process
+# fleet, where the registry is shared) and already-federated output.
+FEDERATION_EXCLUDE_PREFIXES = ("llm_router_", "llm_fleet_")
+FLEET_PREFIX = "llm_fleet_"
+
+
+def merge_expositions(
+    sources: Sequence[Tuple[str, str]],
+    fleet_prefix: str = FLEET_PREFIX,
+    match_prefix: str = "llm_",
+    exclude_prefixes: Sequence[str] = FEDERATION_EXCLUDE_PREFIXES,
+) -> str:
+    """Merge N replica scrapes into ONE fleet exposition (ISSUE 13).
+
+    ``sources`` is ``[(replica_name, exposition_text), ...]``. Each
+    ``llm_<x>`` family becomes ``llm_fleet_<x>``:
+
+    - **counters** sum per label set across replicas;
+    - **histograms** merge BUCKET-WISE (cumulative bucket counts, sums
+      and counts added per ``le``) — sound because every family
+      pre-declares fixed buckets; a family whose bucket bounds disagree
+      across replicas (version skew) is dropped whole rather than
+      merged wrong;
+    - **gauges** are point readings, NOT summable: each replica's child
+      re-labels as ``{replica="<name>", ...}``.
+
+    Deterministic and pure: same scrapes in, same bytes out (the golden
+    federation test and the router's ``/metrics`` both call this).
+    Empty scrapes contribute nothing; an unparseable source is skipped.
+    """
+    out = MetricsRegistry()
+    merged_hist_bounds: Dict[str, Tuple[float, ...]] = {}
+    dropped: set = set()
+    for replica_name, text in sources:
+        try:
+            families = parse_exposition(text or "")
+        except Exception:  # noqa: BLE001 — a bad scrape must not 500 /metrics
+            continue
+        for name in sorted(families):
+            fam = families[name]
+            if not name.startswith(match_prefix) or any(
+                name.startswith(p) for p in exclude_prefixes
+            ):
+                continue
+            fleet_name = fleet_prefix + name[len(match_prefix):]
+            if fleet_name in dropped:
+                continue
+            try:
+                if fam.kind == "counter" or fam.kind == "untyped":
+                    for key, value in fam.samples.items():
+                        names = tuple(k for k, _ in key)
+                        child = out.counter(
+                            fleet_name, fam.help, labels=names
+                        ).labels(**dict(key))
+                        child.value += value
+                elif fam.kind == "gauge":
+                    for key, value in fam.samples.items():
+                        names = ("replica",) + tuple(k for k, _ in key)
+                        child = out.gauge(
+                            fleet_name, fam.help, labels=names
+                        ).labels(replica=replica_name, **dict(key))
+                        child.value = value
+                elif fam.kind == "histogram":
+                    for key, hist in fam.histograms.items():
+                        bounds = tuple(
+                            float(le)
+                            for le, _ in hist["buckets"]
+                            if le not in (None, "+Inf")
+                        )
+                        expect = merged_hist_bounds.setdefault(
+                            fleet_name, bounds
+                        )
+                        if bounds != expect:
+                            raise ValueError("bucket bounds disagree")
+                        names = tuple(k for k, _ in key)
+                        child = out.histogram(
+                            fleet_name, fam.help, labels=names,
+                            buckets=bounds,
+                        ).labels(**dict(key))
+                        # cumulative → per-bucket, then add; the +Inf
+                        # overflow is count minus the last finite cum
+                        cums = [
+                            c
+                            for le, c in hist["buckets"]
+                            if le not in (None, "+Inf")
+                        ]
+                        prev = 0.0
+                        for i, cum in enumerate(cums):
+                            child.counts[i] += int(cum - prev)
+                            prev = cum
+                        child.counts[len(bounds)] += int(
+                            hist["count"] - prev
+                        )
+                        child.sum += hist["sum"]
+                        child.count += int(hist["count"])
+            except ValueError:
+                # registered differently by another source (label or
+                # bucket skew): drop the family from the rollup whole
+                dropped.add(fleet_name)
+                with out._lock:
+                    out._families.pop(fleet_name, None)
+    return out._render()
 
 
 # -- speculative decoding (ISSUE 9) --------------------------------------------

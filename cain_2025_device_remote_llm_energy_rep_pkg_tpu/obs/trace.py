@@ -23,10 +23,12 @@ Honors the same kill switch as the metrics registry
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import json
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -38,10 +40,37 @@ from .metrics import enabled
 MAX_SPANS = 50_000
 
 
-class Span:
-    """One finished (or in-flight) span. ``dur_s`` is None while open."""
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """The FLEET-WIDE trace context a request carries across process
+    boundaries (wire field ``x_trace``, ISSUE 13): a trace id shared by
+    every hop the request touches — front-door router, each dispatch
+    attempt's replica, the replica's scheduler and stepped session —
+    plus the parent span id of the hop that forwarded it, so a
+    cross-process timeline can link a replica's span tree back to the
+    router's. Span ids stay process-local (ints minted per tracer);
+    ``trace_id`` is the one identifier that is globally meaningful."""
 
-    __slots__ = ("name", "span_id", "parent_id", "t0_s", "dur_s", "tid", "attrs", "seq")
+    trace_id: str
+    parent: Optional[str] = None  # forwarding hop's span id (stringed)
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char fleet-wide trace id (random, collision-safe
+    at serving volumes; callers — router front door, load generators —
+    mint once per request and every retry attempt REUSES it)."""
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One finished (or in-flight) span. ``dur_s`` is None while open.
+    ``trace_id`` is the fleet-wide trace the span belongs to (inherited
+    from the parent span unless set explicitly at the request root)."""
+
+    __slots__ = (
+        "name", "span_id", "parent_id", "t0_s", "dur_s", "tid", "attrs",
+        "seq", "trace_id",
+    )
 
     def __init__(
         self,
@@ -51,6 +80,7 @@ class Span:
         t0_s: float,
         tid: int,
         attrs: Optional[Dict[str, Any]],
+        trace_id: Optional[str] = None,
     ) -> None:
         self.name = name
         self.span_id = span_id
@@ -60,6 +90,7 @@ class Span:
         self.tid = tid
         self.attrs = attrs or {}
         self.seq = 0  # assigned at close
+        self.trace_id = trace_id
 
 
 class _SpanCtx:
@@ -130,16 +161,23 @@ class SpanTracer:
             self._spans.append(span)
 
     # -- public surface -------------------------------------------------------
-    def span(self, name: str, **attrs: Any) -> _SpanCtx:
+    def span(
+        self, name: str, trace_id: Optional[str] = None, **attrs: Any
+    ) -> _SpanCtx:
         """Open a span as a context manager, nested under the thread's
-        current span (if any). No-op (yields None) when disabled."""
+        current span (if any). No-op (yields None) when disabled.
+        ``trace_id`` stamps the fleet-wide trace at a request ROOT;
+        nested spans inherit the parent's automatically."""
         if not enabled():
             return _SpanCtx(self, None)
         stack = self._stack()
-        parent = stack[-1].span_id if stack else None
+        parent = stack[-1] if stack else None
         span = Span(
-            name, next(self._ids), parent,
+            name, next(self._ids),
+            parent.span_id if parent is not None else None,
             time.monotonic(), threading.get_ident(), attrs,
+            trace_id=trace_id
+            or (parent.trace_id if parent is not None else None),
         )
         stack.append(span)
         return _SpanCtx(self, span)
@@ -176,6 +214,7 @@ class SpanTracer:
             name, next(self._ids),
             parent.span_id if parent is not None else None,
             t0_s, threading.get_ident(), attrs,
+            trace_id=parent.trace_id if parent is not None else None,
         )
         span.dur_s = max(t1_s - t0_s, 0.0)
         with self._lock:
@@ -212,6 +251,8 @@ class SpanTracer:
             args["span_id"] = s.span_id
             if s.parent_id is not None:
                 args["parent_id"] = s.parent_id
+            if s.trace_id is not None:
+                args["trace_id"] = s.trace_id
             events.append(
                 {
                     "name": s.name,

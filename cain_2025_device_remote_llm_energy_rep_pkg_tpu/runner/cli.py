@@ -251,14 +251,30 @@ commands:
                        --probe-interval-ms (default 1000); a ticket
                        whose replica refuses admission or dies before
                        its first streamed token retries ONCE on a
-                       different replica
+                       different replica (both attempts share ONE
+                       x_trace id; the dead attempt's burned prefill
+                       is charged to llm_request_wasted_joules_total
+                       {cause="retry"} and rides x_extras.energy).
+                       Fleet observability: requests may carry
+                       x_trace {"id": hex, "parent": span} (minted at
+                       the front door when absent) — every hop's spans
+                       and flight events carry the trace id, GET
+                       /debug/flight takes ?trace= and the router's
+                       GET /debug/timeline?trace= reassembles one
+                       request's cross-process lifecycle; the router's
+                       GET /metrics additionally exposes llm_fleet_*
+                       rollups (counters summed, histograms merged
+                       bucket-wise, gauges re-labelled {replica=...})
+                       federated from the replicas' scrapes
   serve-fleet --targets host:port[,host:port...] [--route-policy P]
                        [--port N] [--models a,b] [--probe-interval-ms M]
                        the front-door router over ALREADY-RUNNING
                        `serve` processes (one per host/chip) — the
                        multi-host twin of `serve --replicas N`; probes
                        each target's /healthz + /metrics and dispatches
-                       by the same policies
+                       by the same policies, federates their /metrics
+                       into llm_fleet_* rollups, and serves the
+                       cross-process /debug/timeline
   help                 show this message
 """
 

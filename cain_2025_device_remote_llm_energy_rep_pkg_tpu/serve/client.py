@@ -34,6 +34,30 @@ class RemoteServerError(RuntimeError):
         self.status = status
 
 
+def fetch_flight(
+    base_url: str,
+    trace: Optional[str] = None,
+    n: int = 500,
+    type_: Optional[str] = None,
+    timeout_s: float = 5.0,
+) -> dict:
+    """One ``GET /debug/flight`` fetch (``?trace=`` filters by the
+    fleet-wide wire trace id — ISSUE 13): the router's cross-process
+    timeline pulls each involved replica's story through this. Raises
+    on unreachable/disabled-telemetry replicas; the timeline endpoint
+    degrades that hop to an error entry rather than failing whole."""
+    from urllib.parse import quote
+
+    query = f"n={int(n)}"
+    if trace is not None:
+        query += f"&trace={quote(str(trace), safe='')}"
+    if type_ is not None:
+        query += f"&type={quote(type_, safe='')}"
+    url = f"{base_url.rstrip('/')}{protocol.DEBUG_FLIGHT_PATH}?{query}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
 class RemoteHTTPBackend(GenerationBackend):
     def __init__(
         self,

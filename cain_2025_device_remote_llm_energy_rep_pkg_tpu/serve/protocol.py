@@ -15,6 +15,7 @@ import json
 from typing import Any, Dict, Iterable, Iterator
 
 from ..engine.backend import GenerationRequest, GenerationResult
+from ..obs.trace import TraceContext
 
 DEFAULT_PORT = 11434  # the port the reference's curl targets (README.md:31)
 
@@ -36,9 +37,60 @@ VERSION_PATH = "/api/version"
 LOAD_PATH = "/api/load"  # extension: explicit weight-load outside the window
 HEALTH_PATH = "/healthz"
 METRICS_PATH = "/metrics"  # Prometheus text exposition (obs; 404 when off)
-# Debug introspection (obs; both 404 when telemetry is off):
+# Debug introspection (obs; all 404 when telemetry is off):
 DEBUG_STATE_PATH = "/debug/state"  # live scheduler/session/pool snapshot
-DEBUG_FLIGHT_PATH = "/debug/flight"  # flight-recorder events (?n=, ?type=)
+DEBUG_FLIGHT_PATH = "/debug/flight"  # flight events (?n=, ?type=, ?trace=)
+DEBUG_TIMELINE_PATH = "/debug/timeline"  # router: one request's full
+#   cross-process lifecycle, reassembled per trace id (?trace=, ISSUE 13)
+
+
+def trace_to_wire(trace: "TraceContext | None") -> "Dict[str, Any] | None":
+    """``x_trace`` wire shape of a trace context: ``{"id": <hex>,
+    "parent": <forwarding hop's span id>}`` (parent omitted when the
+    caller minted the trace itself)."""
+    if trace is None:
+        return None
+    out: Dict[str, Any] = {"id": trace.trace_id}
+    if trace.parent is not None:
+        out["parent"] = trace.parent
+    return out
+
+
+def trace_from_wire(value) -> "TraceContext | None":
+    """Parse an ``x_trace`` body field (dict, or a bare trace-id string
+    for curl-friendliness). Malformed values raise ValueError — a trace
+    the caller garbled must 400, not silently drop correlation."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        if not value:
+            raise ValueError("x_trace id must be non-empty")
+        return TraceContext(trace_id=value)
+    if isinstance(value, dict):
+        trace_id = value.get("id")
+        if not trace_id or not isinstance(trace_id, str):
+            raise ValueError("x_trace requires a non-empty string 'id'")
+        parent = value.get("parent")
+        return TraceContext(
+            trace_id=trace_id,
+            parent=str(parent) if parent is not None else None,
+        )
+    raise ValueError(f"x_trace must be a string or object, got {value!r}")
+
+
+def ensure_trace(request: GenerationRequest) -> GenerationRequest:
+    """Give a request a fleet-wide trace if the caller sent none — the
+    front door (router or single server) mints exactly once; every
+    later hop (and every retry attempt) reuses what is already there."""
+    if request.trace is not None:
+        return request
+    import dataclasses
+
+    from ..obs.trace import mint_trace_id
+
+    return dataclasses.replace(
+        request, trace=TraceContext(trace_id=mint_trace_id())
+    )
 
 SERVER_VERSION = "0.1.0"
 
@@ -149,6 +201,11 @@ def request_to_wire(
             if request.priority != DEFAULT_PRIORITY
             else {}
         ),
+        **(
+            {"x_trace": trace_to_wire(request.trace)}
+            if request.trace is not None
+            else {}
+        ),
     }
 
 
@@ -187,6 +244,7 @@ def request_from_wire(
             if body.get("x_priority") is not None
             else int(default_priority)
         ),
+        trace=trace_from_wire(body.get("x_trace")),
     )
 
 

@@ -37,27 +37,39 @@ Pieces:
 - :class:`RouterServer` — the HTTP front door itself: ``/api/generate``
   (buffered + SSE streaming; a client hanging up mid-stream cancels the
   replica-side row through the closed chunk iterator), ``/healthz``,
-  ``/metrics``, ``/debug/state`` (per-replica snapshot + last probe)
-  and ``/debug/flight``.
+  ``/metrics``, ``/debug/state`` (per-replica snapshot + last probe),
+  ``/debug/flight`` and ``/debug/timeline``.
 
-Observability: ``llm_router_dispatch_total{replica,policy}``,
-``llm_router_retries_total{reason}``, the per-replica
-``llm_router_replica_healthy`` gauge, ``llm_router_probe_seconds``, and
-``dispatched`` / ``replica_down`` / ``replica_drained`` flight events
-trace-linked to the ticket's request root.
+Observability (fleet-native since ISSUE 13): the front door mints (or
+adopts) the fleet-wide ``x_trace`` and forwards it on EVERY dispatch
+attempt — a retried ticket's two attempts share one trace id, and
+``GET /debug/timeline?trace=`` reassembles the cross-process story
+from each involved replica's ``/debug/flight?trace=``. ``GET
+/metrics`` additionally serves the ``llm_fleet_*`` federation rollup
+(counters summed, fixed-bucket histograms merged bucket-wise, gauges
+re-labelled ``{replica=...}`` — ``obs/metrics.py::merge_expositions``
+over the replicas' scrapes), and a DEAD dispatch attempt charges the
+wasted-energy ledger (``llm_request_wasted_joules_total{cause=
+"retry"}``, the figure riding the retried ticket's
+``x_extras.energy``). Router families: ``llm_router_dispatch_total
+{replica,policy}``, ``llm_router_retries_total{reason}``, the
+per-replica ``llm_router_replica_healthy`` gauge,
+``llm_router_probe_seconds``, plus ``dispatched`` / ``replica_down`` /
+``replica_drained`` flight events trace-linked to the ticket's
+request root.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import json
-import re
 import threading
 import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..engine.backend import (
     GenerationBackend,
@@ -65,19 +77,26 @@ from ..engine.backend import (
     GenerationRequest,
     GenerationResult,
 )
+from ..obs import energy as obs_energy
 from ..obs import metrics as obs_metrics
 from ..obs.flight import (
     EV_DISPATCHED,
     EV_REPLICA_DOWN,
     EV_REPLICA_DRAINED,
     FLIGHT,
-    trace_of,
+    trace_attrs,
 )
-from ..obs.metrics import REGISTRY
-from ..obs.trace import TRACER
+from ..obs.metrics import (
+    REGISTRY,
+    histogram_mean,
+    merge_expositions,
+    parse_exposition,
+    sample_value,
+)
+from ..obs.trace import TRACER, TraceContext
 from ..runner import term
 from . import protocol
-from .client import RemoteHTTPBackend, RemoteServerError
+from .client import RemoteHTTPBackend, RemoteServerError, fetch_flight
 from .stream import DeadlineExceeded, StreamCancelled
 
 ROUTE_POLICIES = (
@@ -116,26 +135,6 @@ _PROBE_H = REGISTRY.histogram(
     "llm_router_probe_seconds",
     "Wall time of one replica health/metrics probe",
 )
-
-
-def _metrics_gauge(text: str, name: str) -> Optional[float]:
-    """First sample of a gauge family in a Prometheus text exposition
-    (None when absent) — the router's /metrics scrape parser."""
-    m = re.search(
-        rf"^{re.escape(name)}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$",
-        text,
-        re.MULTILINE,
-    )
-    return float(m.group(1)) if m else None
-
-
-def _metrics_hist_mean(text: str, name: str) -> Optional[float]:
-    """Mean of a histogram family (sum/count; None when absent/empty)."""
-    total = _metrics_gauge(text, f"{name}_sum")
-    count = _metrics_gauge(text, f"{name}_count")
-    if total is None or not count:
-        return None
-    return total / count
 
 
 def _retry_reason(exc: BaseException) -> Optional[str]:
@@ -181,6 +180,9 @@ class Replica:
         self.dispatched = 0  # attempts routed here (lifetime)
         self.last_stats: Dict[str, object] = {}
         self.t_probe: Optional[float] = None
+        # last successful /metrics scrape text (remote replicas only) —
+        # the federation's fallback source when a live scrape fails
+        self.last_metrics_text: Optional[str] = None
 
     # -- dispatch surface (subclasses implement) -------------------------------
     def generate(self, request: GenerationRequest) -> GenerationResult:
@@ -194,6 +196,20 @@ class Replica:
         unreachable; returns ``{"running": False, ...}`` when it
         answers but is shutting down."""
         raise NotImplementedError
+
+    def scrape_metrics(self) -> Optional[str]:
+        """This replica's own Prometheus exposition for the federation
+        rollup (ISSUE 13). None for in-process replicas — they share
+        THIS process's registry, which the router's /metrics federates
+        exactly once as the ``local`` source instead."""
+        return None
+
+    def flight_events(self, trace: str) -> List[Dict[str, object]]:
+        """This replica's flight events for one fleet-wide trace id —
+        the per-hop pull of the cross-process timeline. In-process
+        replicas share the router's recorder, so their events are
+        already in the router's own ring (return [] here)."""
+        return []
 
     def close(self) -> None:
         """Release whatever this replica owns (local: stop its
@@ -292,6 +308,13 @@ class LocalReplica(Replica):
                 stats["pool_occupancy"] = pool["occupancy"]
         except Exception:  # noqa: BLE001 — probe only
             pass
+        # live J/token (least-joules): engines — real AND fake — publish
+        # their most recent attribution as an attribute, so the policy
+        # works in-process without a loopback /metrics scrape (ISSUE 13
+        # satellite: the fake fleet can exercise least-joules now)
+        jpt = getattr(self.backend, "last_joules_per_token", None)
+        if jpt:
+            stats["joules_per_token"] = float(jpt)
         return stats
 
     def close(self) -> None:
@@ -333,20 +356,40 @@ class RemoteReplica(Replica):
             stats: Dict[str, object] = json.loads(resp.read().decode("utf-8"))
         stats["running"] = stats.get("status") == "ok"
         try:
-            with urllib.request.urlopen(
-                f"{self.base_url}{protocol.METRICS_PATH}",
-                timeout=self.probe_timeout_s,
-            ) as resp:
-                text = resp.read().decode("utf-8")
-            occ = _metrics_gauge(text, "llm_paged_pool_occupancy")
+            text = self.scrape_metrics()
+            # the shared v0.0.4 parser (obs/metrics.py) replaces the old
+            # two-regex scrape: any gauge/histogram family is readable
+            # generically, and the SAME parse feeds probe stats here and
+            # the fleet federation rollup
+            families = parse_exposition(text or "")
+            occ = sample_value(families, "llm_paged_pool_occupancy")
             if occ is not None:
                 stats["pool_occupancy"] = occ
-            jpt = _metrics_hist_mean(text, "llm_request_joules_per_token")
+            jpt = histogram_mean(
+                families, "llm_request_joules_per_token"
+            )
             if jpt is not None:
                 stats["joules_per_token"] = jpt
         except Exception:  # noqa: BLE001 — telemetry may be off (404)
             pass
         return stats
+
+    def scrape_metrics(self) -> Optional[str]:
+        """Fetch this replica's live /metrics text (also cached for the
+        federation's use when a later scrape fails mid-flight)."""
+        with urllib.request.urlopen(
+            f"{self.base_url}{protocol.METRICS_PATH}",
+            timeout=self.probe_timeout_s,
+        ) as resp:
+            text = resp.read().decode("utf-8")
+        self.last_metrics_text = text
+        return text
+
+    def flight_events(self, trace: str) -> List[Dict[str, object]]:
+        body = fetch_flight(
+            self.base_url, trace=trace, timeout_s=self.probe_timeout_s
+        )
+        return list(body.get("events") or [])
 
     def debug_state(self) -> Dict[str, object]:
         state = super().debug_state()
@@ -542,7 +585,9 @@ class Router:
             )
 
     # -- dispatch --------------------------------------------------------------
-    def _begin(self, replica: Replica, retried: Optional[str]) -> None:
+    def _begin(
+        self, replica: Replica, retried: Optional[str], attempt: int = 1
+    ) -> None:
         with self._lock:
             replica.outstanding += 1
             replica.dispatched += 1
@@ -550,10 +595,11 @@ class Router:
         if obs_metrics.enabled():
             FLIGHT.emit(
                 EV_DISPATCHED,
-                trace=trace_of(TRACER.current()),
                 replica=replica.name,
                 policy=self.policy,
                 retry=retried,
+                attempt=attempt,
+                **trace_attrs(TRACER.current()),
             )
 
     def _end(self, replica: Replica) -> None:
@@ -561,36 +607,79 @@ class Router:
             replica.outstanding = max(0, replica.outstanding - 1)
 
     def _dispatch_failed(
-        self, replica: Replica, exc: BaseException, reason: str
-    ) -> None:
+        self,
+        replica: Replica,
+        exc: BaseException,
+        reason: str,
+        request: Optional[GenerationRequest] = None,
+    ) -> float:
         """Account one retryable dispatch failure: the retry counter
         moves, and a DEAD replica is marked unhealthy immediately (the
         next probe may resurrect it) — ``refused`` is a capacity
-        answer from a live scheduler, not a death."""
+        answer from a live scheduler, not a death. A DEAD attempt also
+        charges the wasted-energy ledger (ISSUE 13): the replica had
+        accepted the ticket and burned (at least) its prompt's prefill
+        before dying unstreamed — estimated at the prompt's token count
+        priced by the replica's last probed J/token (falling back to
+        the process-live figure). Returns the Joules charged so the
+        caller can stamp them on the retried ticket's extras."""
         _RETRIES_C.labels(reason=reason).inc()
-        if reason == "dead":
-            self._set_health(replica, False, f"{type(exc).__name__}: {exc}")
+        if reason != "dead":
+            return 0.0
+        self._set_health(replica, False, f"{type(exc).__name__}: {exc}")
+        if request is None:
+            return 0.0
+        # byte tokenizer estimate (BOS + one id per byte) — the same
+        # convention the engines' prompt accounting uses
+        burned_tokens = len(request.prompt.encode("utf-8")) + 1
+        jpt = (replica.last_stats or {}).get("joules_per_token")
+        return obs_energy.charge_wasted(
+            "retry",
+            tokens=burned_tokens,
+            jpt=float(jpt) if jpt else None,
+        )
 
     def _stamp(
         self,
         result: GenerationResult,
         replica: Replica,
         retried: Optional[str],
+        wasted_j: float = 0.0,
+        trace: Optional[TraceContext] = None,
     ) -> None:
         """Route attribution onto the wire: ``extras["router"]`` rides
         ``x_extras`` so load generators and benches can split figures
-        per replica without scraping anything."""
-        router_extras = {"replica": replica.name, "policy": self.policy}
+        per replica without scraping anything; a retried ticket's
+        first-attempt waste lands in ``extras["energy"]["wasted_J"]``
+        next to the replica's own energy attribution."""
+        router_extras: Dict[str, object] = {
+            "replica": replica.name,
+            "policy": self.policy,
+        }
+        if trace is not None:
+            router_extras["trace"] = trace.trace_id
         if retried:
             router_extras["retried"] = retried
         result.extras = {**(result.extras or {}), "router": router_extras}
+        if wasted_j > 0:
+            energy = dict(result.extras.get("energy") or {})
+            wasted = dict(energy.get("wasted_J") or {})
+            wasted["retry"] = round(
+                wasted.get("retry", 0.0) + wasted_j, 6
+            )
+            energy["wasted_J"] = wasted
+            result.extras["energy"] = energy
 
     def dispatch(self, request: GenerationRequest) -> GenerationResult:
         """Buffered dispatch with the retry-once rule. Raises the
         replica's own terminal error (or ``RuntimeError`` when no
-        healthy replica is attached)."""
+        healthy replica is attached). Both attempts of a retried
+        ticket carry the SAME fleet-wide trace (the trace rides the
+        request; only the dispatched events' attempt index differs)."""
         tried: "tuple" = ()
         retried: Optional[str] = None
+        wasted_j = 0.0
+        attempt = 0
         while True:
             replica = self._pick(exclude=tried)
             if replica is None:
@@ -598,7 +687,8 @@ class Router:
                     "no healthy replica available"
                     + (f" (after retry: {retried})" if retried else "")
                 )
-            self._begin(replica, retried)
+            attempt += 1
+            self._begin(replica, retried, attempt=attempt)
             try:
                 result = replica.generate(request)
             except BaseException as exc:  # noqa: BLE001
@@ -606,12 +696,17 @@ class Router:
                 reason = _retry_reason(exc)
                 if reason is None or retried is not None:
                     raise
-                self._dispatch_failed(replica, exc, reason)
+                wasted_j += self._dispatch_failed(
+                    replica, exc, reason, request
+                )
                 tried = (replica.name,)
                 retried = reason
                 continue
             self._end(replica)
-            self._stamp(result, replica, retried)
+            self._stamp(
+                result, replica, retried,
+                wasted_j=wasted_j, trace=request.trace,
+            )
             return result
 
     def dispatch_stream(
@@ -626,6 +721,8 @@ class Router:
         replica-side row."""
         tried: "tuple" = ()
         retried: Optional[str] = None
+        wasted_j = 0.0
+        attempt = 0
         while True:
             replica = self._pick(exclude=tried)
             if replica is None:
@@ -633,7 +730,8 @@ class Router:
                     "no healthy replica available"
                     + (f" (after retry: {retried})" if retried else "")
                 )
-            self._begin(replica, retried)
+            attempt += 1
+            self._begin(replica, retried, attempt=attempt)
             chunks: Optional[Iterator[GenerationChunk]] = None
             streamed = False
             try:
@@ -641,7 +739,10 @@ class Router:
                     chunks = replica.stream(request)
                     for chunk in chunks:
                         if chunk.done and chunk.result is not None:
-                            self._stamp(chunk.result, replica, retried)
+                            self._stamp(
+                                chunk.result, replica, retried,
+                                wasted_j=wasted_j, trace=request.trace,
+                            )
                         yield chunk
                         if chunk.tokens or chunk.text:
                             streamed = True
@@ -650,7 +751,9 @@ class Router:
                     reason = _retry_reason(exc)
                     if reason is None or streamed or retried is not None:
                         raise
-                    self._dispatch_failed(replica, exc, reason)
+                    wasted_j += self._dispatch_failed(
+                        replica, exc, reason, request
+                    )
                     tried = (replica.name,)
                     retried = reason
             finally:
@@ -684,6 +787,122 @@ class Router:
             "policy": self.policy,
             "probe_interval_s": self.probe_interval_s,
             "replicas": [r.debug_state() for r in self.replicas()],
+        }
+
+    # -- metrics federation (ISSUE 13) -----------------------------------------
+    def federation_sources(self) -> List[Tuple[str, str]]:
+        """The per-replica scrape texts the fleet rollup merges: one
+        live ``GET /metrics`` per REMOTE replica (falling back to the
+        last successful scrape when one fails mid-request), plus — when
+        any in-process replica is attached — THIS process's registry
+        exactly once as the ``local`` source (in-process replicas share
+        it; scraping it per replica would multiply-count)."""
+        sources: List[Tuple[str, str]] = []
+        saw_local = False
+        for replica in self.replicas():
+            try:
+                text = replica.scrape_metrics()
+            except Exception:  # noqa: BLE001 — down replica
+                text = replica.last_metrics_text
+            if text is not None:
+                sources.append((replica.name, text))
+            elif replica.kind == "local":
+                saw_local = True
+        if saw_local:
+            sources.append(("local", REGISTRY.exposition()))
+        return sources
+
+    def fleet_exposition(self) -> str:
+        """The ``llm_fleet_*`` rollup text: counters summed, fixed-bucket
+        histograms merged bucket-wise, gauges re-labelled
+        ``{replica=...}`` — byte-identical to calling
+        :func:`~..obs.metrics.merge_expositions` on the same scrapes
+        (the golden federation test pins that). One front-door scrape
+        therefore answers fleet TTFT p99, aggregate goodput and fleet
+        J/token."""
+        return merge_expositions(self.federation_sources())
+
+    # -- cross-process timeline (ISSUE 13) -------------------------------------
+    def timeline(self, trace: str) -> Dict[str, object]:
+        """One request's full cross-process lifecycle, reassembled from
+        flight recorders: the router's own ring (dispatched / retry /
+        replica_down events — and, for in-process replicas, the whole
+        scheduler story, which shares this ring) interleaved with each
+        involved REMOTE replica's ``/debug/flight?trace=`` pull.
+
+        Clocks are process-local (time.monotonic), so cross-process
+        ordering is by HOP: a remote hop's events splice in directly
+        after the ``dispatched`` event that started it, seq-ordered
+        within the hop and tagged ``hop=<replica>`` for attribution.
+        Events seen in more than one pull (in-process twins sharing a
+        ring) dedupe by (type, seq, t_s)."""
+        own = FLIGHT.events(trace=trace)
+        dispatches = [e for e in own if e.get("type") == EV_DISPATCHED]
+        remote_names = {
+            str(e.get("replica"))
+            for e in dispatches
+            if e.get("replica") is not None
+        }
+        with self._lock:
+            remotes = {
+                name: r
+                for name, r in self._replicas.items()
+                if name in remote_names and r.kind != "local"
+            }
+        hops: List[Dict[str, object]] = []
+        pulled: Dict[str, List[Dict[str, object]]] = {}
+        for name, replica in remotes.items():
+            hop: Dict[str, object] = {"replica": name}
+            try:
+                pulled[name] = replica.flight_events(trace)
+                hop["events"] = len(pulled[name])
+            except Exception as exc:  # noqa: BLE001 — dead hop: degrade
+                hop["error"] = f"{type(exc).__name__}: {exc}"
+                pulled[name] = []
+            hops.append(hop)
+        def _key(event: Dict[str, object]):
+            return (event.get("type"), event.get("seq"), event.get("t_s"))
+
+        # Pass 1: the router's OWN ring in seq order — its dispatch
+        # story plus, for in-process fleets (which share this process's
+        # recorder), the replica-side scheduler events already in
+        # chronological order. Pass 2 then splices each REMOTE hop's
+        # unseen events directly after the dispatched event that
+        # started it (reverse order keeps earlier insert points valid);
+        # events present in both pulls (shared-ring twins) dedupe by
+        # (type, seq, t_s) and keep their pass-1 position.
+        router_types = (EV_DISPATCHED, EV_REPLICA_DOWN, EV_REPLICA_DRAINED)
+        events: List[Dict[str, object]] = [
+            {
+                **event,
+                "hop": (
+                    "router"
+                    if event.get("type") in router_types
+                    else "local"
+                ),
+            }
+            for event in own
+        ]
+        seen = {_key(e) for e in events}
+        dispatch_points = [
+            (i, str(e.get("replica")))
+            for i, e in enumerate(events)
+            if e.get("type") == EV_DISPATCHED
+        ]
+        for i, replica_name in reversed(dispatch_points):
+            fresh = [
+                {**e, "hop": replica_name}
+                for e in pulled.get(replica_name, [])
+                if _key(e) not in seen
+            ]
+            seen.update(_key(e) for e in fresh)
+            events[i + 1 : i + 1] = fresh
+        return {
+            "trace": trace,
+            "attempts": len(dispatches),
+            "dispatches": dispatches,
+            "hops": [{"replica": "router", "events": len(own)}] + hops,
+            "events": events,
         }
 
 
@@ -720,6 +939,21 @@ class RouterServer:
     def port(self) -> int:
         return self._httpd.server_address[1]
 
+    @staticmethod
+    def _with_parent(request: GenerationRequest, root) -> GenerationRequest:
+        """Stamp the router root span as the trace's cross-process
+        parent before dispatch, so a replica's span tree links back to
+        THIS hop (no-op when tracing is off — root is None)."""
+        if root is None or request.trace is None:
+            return request
+        return dataclasses.replace(
+            request,
+            trace=TraceContext(
+                trace_id=request.trace.trace_id,
+                parent=str(root.span_id),
+            ),
+        )
+
     def _make_handler(self):
         server = self
 
@@ -748,7 +982,15 @@ class RouterServer:
                             {"error": "telemetry disabled (TPU_LLM_OBS=0)"},
                         )
                         return
-                    body = REGISTRY.exposition().encode("utf-8")
+                    # the router's own families PLUS the llm_fleet_*
+                    # federation rollup (ISSUE 13): one scrape answers
+                    # fleet TTFT p99 / aggregate goodput / fleet J/token
+                    text = REGISTRY.exposition()
+                    try:
+                        text += server.router.fleet_exposition()
+                    except Exception:  # noqa: BLE001 — rollup is additive
+                        pass
+                    body = text.encode("utf-8")
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
@@ -791,10 +1033,38 @@ class RouterServer:
                         {
                             "summary": FLIGHT.summary(),
                             "events": FLIGHT.events(
-                                n=n, type_=query.get("type", [None])[0]
+                                n=n,
+                                type_=query.get("type", [None])[0],
+                                trace=query.get("trace", [None])[0],
                             ),
                         },
                     )
+                elif path == protocol.DEBUG_TIMELINE_PATH:
+                    if not obs_metrics.enabled():
+                        self._send_json(
+                            404,
+                            {"error": "telemetry disabled (TPU_LLM_OBS=0)"},
+                        )
+                        return
+                    from urllib.parse import parse_qs
+
+                    query = parse_qs(self.path.partition("?")[2])
+                    trace = query.get("trace", [None])[0]
+                    if not trace:
+                        self._send_json(
+                            400,
+                            {"error": "timeline requires ?trace=<trace id>"},
+                        )
+                        return
+                    try:
+                        self._send_json(
+                            200, server.router.timeline(trace)
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        self._send_json(
+                            500,
+                            {"error": f"{type(exc).__name__}: {exc}"},
+                        )
                 elif path == protocol.TAGS_PATH:
                     self._send_json(
                         200,
@@ -837,15 +1107,30 @@ class RouterServer:
                         404, {"error": f"model {request.model!r} not found"}
                     )
                     return
+                # The FRONT DOOR mints the fleet-wide trace (or adopts a
+                # caller-minted one), and every dispatch attempt forwards
+                # it with the router root span as the cross-process
+                # parent — both attempts of a retried ticket therefore
+                # share ONE trace id, on distinct span branches.
+                request = protocol.ensure_trace(request)
                 if body.get("stream"):
                     with TRACER.span(
-                        "request", model=request.model, stream=True
-                    ):
-                        self._stream(request)
+                        "request",
+                        trace_id=request.trace.trace_id,
+                        model=request.model,
+                        stream=True,
+                    ) as root:
+                        self._stream(server._with_parent(request, root))
                     return
                 try:
-                    with TRACER.span("request", model=request.model):
-                        result = server.router.dispatch(request)
+                    with TRACER.span(
+                        "request",
+                        trace_id=request.trace.trace_id,
+                        model=request.model,
+                    ) as root:
+                        result = server.router.dispatch(
+                            server._with_parent(request, root)
+                        )
                 except BaseException as exc:  # noqa: BLE001
                     self._send_error(exc)
                 else:

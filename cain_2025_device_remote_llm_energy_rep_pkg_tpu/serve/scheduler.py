@@ -51,6 +51,7 @@ from ..engine.backend import (
     GenerationResult,
 )
 from ..obs.detect import SLICE_SPIKES
+from ..obs.energy import charge_wasted
 from ..obs.flight import (
     EV_BATCH_FALLBACK,
     EV_JOIN_CHUNK,
@@ -60,7 +61,9 @@ from ..obs.flight import (
     EV_ROW_RESUMED,
     EV_ROW_RETIRED,
     EV_SLICE,
+    EV_STREAM_CHUNK,
     FLIGHT,
+    trace_attrs,
     trace_of,
 )
 from ..obs.metrics import REGISTRY, ROW_BUCKETS, enabled as _obs_enabled
@@ -224,7 +227,7 @@ class _Ticket:
     __slots__ = (
         "request", "event", "result", "error", "t_submit", "t_first",
         "span", "queue_wait_s", "joined", "join_chunks", "stream",
-        "priority", "preempts", "resumed",
+        "priority", "preempts", "resumed", "wasted",
     )
 
     def __init__(self, request: GenerationRequest) -> None:
@@ -239,6 +242,11 @@ class _Ticket:
         self.joined = False
         self.join_chunks = 0
         self.stream: Optional[TokenStream] = None
+        # Wasted-energy ledger (ISSUE 13): modelled Joules burned on
+        # this request's behalf that no response benefits from, by
+        # cause (swap/recompute here; the router adds retry) — merged
+        # into extras["energy"]["wasted_J"] at completion
+        self.wasted: Dict[str, float] = {}
         # EFFECTIVE SLO tier: starts at the request's priority; a parked
         # preemption victim ages UP one tier per --preempt-max-wait-s
         # waited (starvation protection), so victim selection and resume
@@ -608,9 +616,9 @@ class _SchedulerBase:
         _DEADLINE_REJECTED_C.labels(reason=reason).inc()
         FLIGHT.emit(
             EV_REQUEST_REJECTED,
-            trace=trace_of(ticket.span),
             reason=reason,
             wait_s=round(wait, 4),
+            **trace_attrs(ticket.span),
         )
         self._fail_ticket(
             ticket,
@@ -673,6 +681,19 @@ class _SchedulerBase:
             **(result.extras or {}),
             "sched": sched_extras,
         }
+        if ticket.wasted:
+            # wasted-energy attribution (ISSUE 13): the Joules this
+            # request burned that no response benefits from, by cause —
+            # the per-request twin of llm_request_wasted_joules_total
+            # (the router adds its retry charge to the same block)
+            energy = dict(result.extras.get("energy") or {})
+            wasted = dict(energy.get("wasted_J") or {})
+            for cause, joules in ticket.wasted.items():
+                wasted[cause] = round(
+                    wasted.get(cause, 0.0) + joules, 6
+                )
+            energy["wasted_J"] = wasted
+            result.extras["energy"] = energy
         ticket.result = result
         if ticket.stream is not None:
             # the final egress event carries the COMPLETE wire result —
@@ -709,9 +730,9 @@ class _SchedulerBase:
             _BATCH_FALLBACK_C.inc()
             FLIGHT.emit(
                 EV_BATCH_FALLBACK,
-                trace=trace_of(tickets[0].span),
                 rows=len(tickets),
                 stage="bisect",
+                **trace_attrs(tickets[0].span),
             )
             mid = len(tickets) // 2
             self._dispatch_isolated(tickets[:mid])
@@ -803,10 +824,11 @@ class BatchScheduler(_SchedulerBase):
                 for ticket in batch:
                     FLIGHT.emit(
                         EV_REQUEST_ADMITTED,
-                        trace=trace_of(ticket.span),
                         mode="window",
                         rows=len(batch),
                         model=ticket.request.model,
+                        queue_wait_s=round(ticket.queue_wait_s or 0.0, 6),
+                        **trace_attrs(ticket.span),
                     )
             try:
                 # Backend spans (prefill/decode) emitted on THIS thread
@@ -831,10 +853,10 @@ class BatchScheduler(_SchedulerBase):
                     _BATCH_FALLBACK_C.inc()
                     FLIGHT.emit(
                         EV_BATCH_FALLBACK,
-                        trace=trace_of(batch[0].span),
                         rows=len(batch),
                         stage="batch",
                         error=f"{type(exc).__name__}: {exc}",
+                        **trace_attrs(batch[0].span),
                     )
                     # forensics BEFORE the salvage mutates anything: the
                     # last events + live scheduler state, next to the
@@ -1168,10 +1190,11 @@ class ContinuousScheduler(_SchedulerBase):
             live[id(ticket.request)] = ticket
             FLIGHT.emit(
                 EV_REQUEST_ADMITTED,
-                trace=trace_of(ticket.span),
                 mode="continuous",
                 rows=len(batch),
                 model=ticket.request.model,
+                queue_wait_s=round(ticket.queue_wait_s or 0.0, 6),
+                **trace_attrs(ticket.span),
             )
         # chunked joiners mid-prefill: (ticket, pending_join) in
         # round-robin order — _progress_joins advances the head one
@@ -1202,10 +1225,10 @@ class ContinuousScheduler(_SchedulerBase):
                     if _obs_enabled():
                         FLIGHT.emit(
                             EV_SLICE,
-                            trace=trace_of(first.span),
                             rows=rows_before,
                             retired=len(retired),
                             dur_s=round(t_slice_end - t_slice0, 6),
+                            **trace_attrs(first.span),
                         )
                         # spike detection over the slice wall itself:
                         # a slice at a rolling-median multiple fires an
@@ -1253,10 +1276,10 @@ class ContinuousScheduler(_SchedulerBase):
             _BATCH_FALLBACK_C.inc()
             FLIGHT.emit(
                 EV_BATCH_FALLBACK,
-                trace=trace_of(first.span),
                 rows=session.active,
                 stage="session",
                 error=f"{type(exc).__name__}: {exc}",
+                **trace_attrs(first.span),
             )
             FLIGHT.crash_dump(
                 f"continuous session died: {type(exc).__name__}: {exc}",
@@ -1274,8 +1297,8 @@ class ContinuousScheduler(_SchedulerBase):
                 _ROWS_RETIRED_C.labels(reason="error").inc()
                 FLIGHT.emit(
                     EV_ROW_RETIRED,
-                    trace=trace_of(ticket.span),
                     reason="error",
+                    **trace_attrs(ticket.span),
                 )
             self._dispatch_isolated(leftovers)
         finally:
@@ -1290,8 +1313,8 @@ class ContinuousScheduler(_SchedulerBase):
                 _ROWS_RETIRED_C.labels(reason="shutdown").inc()
                 FLIGHT.emit(
                     EV_ROW_RETIRED,
-                    trace=trace_of(ticket.span),
                     reason="shutdown",
+                    **trace_attrs(ticket.span),
                 )
                 self._fail_ticket(
                     ticket, RuntimeError("server shutting down")
@@ -1303,8 +1326,8 @@ class ContinuousScheduler(_SchedulerBase):
                 _ROWS_RETIRED_C.labels(reason="shutdown").inc()
                 FLIGHT.emit(
                     EV_ROW_RETIRED,
-                    trace=trace_of(entry.ticket.span),
                     reason="shutdown",
+                    **trace_attrs(entry.ticket.span),
                 )
                 self._fail_ticket(
                     entry.ticket, RuntimeError("server shutting down")
@@ -1316,8 +1339,8 @@ class ContinuousScheduler(_SchedulerBase):
                 _ROWS_RETIRED_C.labels(reason="shutdown").inc()
                 FLIGHT.emit(
                     EV_ROW_RETIRED,
-                    trace=trace_of(ticket.span),
                     reason="shutdown",
+                    **trace_attrs(ticket.span),
                 )
                 self._fail_ticket(
                     ticket, RuntimeError("server shutting down")
@@ -1343,6 +1366,16 @@ class ContinuousScheduler(_SchedulerBase):
             if ticket.stream.push(text, tokens) and ticket.t_first is None:
                 # TTFT-at-first-chunk: the stream's own first-push clock
                 ticket.t_first = ticket.stream.t_first_chunk
+            if _obs_enabled():
+                # the wire-visible delivery moment — the "stream chunks"
+                # phase of a /debug/timeline (ISSUE 13); one event per
+                # egress push (≈ rows × slices, same order as EV_SLICE)
+                FLIGHT.emit(
+                    EV_STREAM_CHUNK,
+                    tokens=len(tokens),
+                    total=ticket.stream.tokens_pushed,
+                    **trace_attrs(ticket.span),
+                )
 
     def _reap_expired(self, session, live, pending, parked=None) -> None:
         """The CANCELLATION/DEADLINE sweep, run between two decode
@@ -1415,13 +1448,13 @@ class ContinuousScheduler(_SchedulerBase):
         _ROWS_RETIRED_C.labels(reason=reason).inc()
         FLIGHT.emit(
             EV_ROW_RETIRED,
-            trace=trace_of(ticket.span),
             reason=reason,
             generated_tokens=(
                 ticket.stream.tokens_pushed
                 if ticket.stream is not None
                 else None
             ),
+            **trace_attrs(ticket.span),
         )
         if reason == "cancelled":
             self._fail_ticket(
@@ -1470,9 +1503,9 @@ class ContinuousScheduler(_SchedulerBase):
                 pass
             FLIGHT.emit(
                 EV_ROW_RETIRED,
-                trace=trace_of(ticket.span),
                 reason="error",
                 join_aborted=True,
+                **trace_attrs(ticket.span),
             )
             self._fail_ticket(ticket, exc)
             return
@@ -1483,11 +1516,11 @@ class ContinuousScheduler(_SchedulerBase):
         if _obs_enabled():
             FLIGHT.emit(
                 EV_JOIN_CHUNK,
-                trace=trace_of(ticket.span),
                 chunk=ticket.join_chunks,
                 committed=committed,
                 stalled_rows=stalled_rows,
                 dur_s=round(dt, 6),
+                **trace_attrs(ticket.span),
             )
         if stalled_rows:
             _DECODE_STALL_H.observe(dt)
@@ -1517,9 +1550,9 @@ class ContinuousScheduler(_SchedulerBase):
         _ROWS_RETIRED_C.labels(reason=reason).inc()
         FLIGHT.emit(
             EV_ROW_RETIRED,
-            trace=trace_of(ticket.span) if ticket is not None else None,
             reason=reason,
             generated_tokens=result.generated_tokens,
+            **trace_attrs(ticket.span if ticket is not None else None),
         )
         if ticket is None:  # defensive: a row the session invented
             return
@@ -1596,9 +1629,9 @@ class ContinuousScheduler(_SchedulerBase):
                     _ROWS_RETIRED_C.labels(reason="error").inc()
                     FLIGHT.emit(
                         EV_ROW_RETIRED,
-                        trace=trace_of(ticket.span),
                         reason="error",
                         resume_failed=True,
+                        **trace_attrs(ticket.span),
                     )
                     self._fail_ticket(
                         ticket,
@@ -1622,13 +1655,27 @@ class ContinuousScheduler(_SchedulerBase):
             pending.append((ticket, pj))
             _RESUMED_C.inc()
             _PARKED_G.set(len(parked))
+            # Wasted-energy ledger (ISSUE 13): a recompute resume
+            # re-prefills prompt + generated-so-far — token positions
+            # the request already paid for once. Charged at the live
+            # J/token and stamped on the ticket so the figure rides
+            # extras["energy"]["wasted_J"] to the caller.
+            if _pr_field(pr, "policy") == "recompute":
+                redo_tokens = (
+                    _pr_field(pr, "prompt_len", 0) or 0
+                ) + len(_pr_field(pr, "generated", ()) or ())
+                if redo_tokens:
+                    j = charge_wasted("recompute", tokens=redo_tokens)
+                    ticket.wasted["recompute"] = (
+                        ticket.wasted.get("recompute", 0.0) + j
+                    )
             FLIGHT.emit(
                 EV_ROW_RESUMED,
-                trace=trace_of(ticket.span),
                 policy=_pr_field(pr, "policy"),
                 tier=ticket.priority,
                 aged=ticket.priority - entry.base_tier,
                 parked_s=round(time.monotonic() - entry.t_parked, 4),
+                **trace_attrs(ticket.span),
             )
 
     def _preempt_for(
@@ -1688,15 +1735,28 @@ class ContinuousScheduler(_SchedulerBase):
             did = True
             _PREEMPTED_C.labels(policy=self.preempt_policy).inc()
             _PARKED_G.set(len(parked))
+            # Wasted-energy ledger (ISSUE 13): a swap preemption moves
+            # the victim's KV payload over the host link TWICE (out
+            # now, back in at resume) — charged here once at 2× so a
+            # victim discarded while parked still accounts the out leg
+            # it already paid (the in leg it never takes is noise at
+            # SWAP_J_PER_BYTE scale).
+            host_bytes = _pr_field(pr, "host_bytes", 0) or 0
+            if host_bytes:
+                j = charge_wasted("swap", nbytes=2 * host_bytes)
+                victim.wasted["swap"] = (
+                    victim.wasted.get("swap", 0.0) + j
+                )
             FLIGHT.emit(
                 EV_ROW_PREEMPTED,
-                trace=trace_of(victim.span),
                 by=trace_of(ticket.span),
+                by_trace_id=getattr(ticket.span, "trace_id", None),
                 policy=self.preempt_policy,
                 tier=victim.priority,
                 by_tier=tier,
                 generated_tokens=len(_pr_field(pr, "generated", ()) or ()),
-                swapped_bytes=_pr_field(pr, "host_bytes", 0),
+                swapped_bytes=host_bytes,
+                **trace_attrs(victim.span),
             )
 
     def _admit_into(
@@ -1780,11 +1840,12 @@ class ContinuousScheduler(_SchedulerBase):
                 )
                 FLIGHT.emit(
                     EV_REQUEST_ADMITTED,
-                    trace=trace_of(ticket.span),
                     mode="continuous",
                     joined=True,
                     chunked=chunked,
                     model=request.model,
+                    queue_wait_s=round(ticket.queue_wait_s or 0.0, 6),
+                    **trace_attrs(ticket.span),
                 )
                 if chunked:
                     pending.append((ticket, pj))

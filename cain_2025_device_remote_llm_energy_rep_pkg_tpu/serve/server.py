@@ -319,7 +319,10 @@ class GenerationServer:
 
             def _send_debug_flight(self) -> None:
                 """Flight-recorder tail: ``?n=`` bounds the event count
-                (default 200), ``?type=`` filters by event type. 404
+                (default 200), ``?type=`` filters by event type,
+                ``?trace=`` by fleet-wide trace id (or process-local
+                span id — ISSUE 13; the router's timeline endpoint
+                pulls exactly this filter from every replica). 404
                 while telemetry is off."""
                 if not obs_metrics.enabled():
                     self._send_json(
@@ -335,11 +338,14 @@ class GenerationServer:
                     self._send_json(400, {"error": "n must be an integer"})
                     return
                 type_ = query.get("type", [None])[0]
+                trace = query.get("trace", [None])[0]
                 self._send_json(
                     200,
                     {
                         "summary": FLIGHT.summary(),
-                        "events": FLIGHT.events(n=n, type_=type_),
+                        "events": FLIGHT.events(
+                            n=n, type_=type_, trace=trace
+                        ),
                     },
                 )
 
@@ -451,9 +457,23 @@ class GenerationServer:
                         404, {"error": f"model {request.model!r} not found"}
                     )
                     return
+                # Fleet-wide trace (ISSUE 13): adopt the caller's x_trace
+                # (a router hop, or a trace-minting load generator) or
+                # mint one — the root span and every flight event this
+                # request produces carry it, so /debug/flight?trace= and
+                # the router's cross-process timeline can find them.
+                request = protocol.ensure_trace(request)
+                span_attrs = {"model": request.model}
+                if request.trace.parent is not None:
+                    # the forwarding hop's span id — the cross-process
+                    # parent link a timeline viewer stitches on
+                    span_attrs["parent_hop"] = request.trace.parent
                 if body.get("stream"):
                     with TRACER.span(
-                        "request", model=request.model, stream=True
+                        "request",
+                        trace_id=request.trace.trace_id,
+                        stream=True,
+                        **span_attrs,
                     ):
                         self._handle_generate_stream(request)
                     return
@@ -461,7 +481,11 @@ class GenerationServer:
                 # the engine's prefill/decode spans parent under it (the
                 # ticket carries it across the scheduler's thread hop).
                 try:
-                    with TRACER.span("request", model=request.model):
+                    with TRACER.span(
+                        "request",
+                        trace_id=request.trace.trace_id,
+                        **span_attrs,
+                    ):
                         if server._scheduler is not None:
                             result = server._scheduler.submit(request)
                         else:

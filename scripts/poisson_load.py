@@ -53,6 +53,10 @@ from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (  # n
     GenerationRequest,
     GenerationResult,
 )
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.trace import (  # noqa: E402
+    TraceContext,
+    mint_trace_id,
+)
 
 DEFAULT_PROMPTS = (
     "short prompt",
@@ -217,7 +221,13 @@ def build_workload(
     ``tier_mix`` (ISSUE 11, :func:`parse_tier_mix`'s shape) stamps each
     request with a seeded SLO tier — the priority-class traffic the
     preemption bench A/Bs; the tier stream is independent of arrivals/
-    lengths, so the same trace replays across policy arms."""
+    lengths, so the same trace replays across policy arms.
+
+    Every request additionally carries a CALLER-MINTED ``x_trace``
+    (ISSUE 13): the summary prints the trace ids of failed / retried /
+    SLO-missed requests, so a bad run is immediately queryable via the
+    router's ``GET /debug/timeline?trace=`` (or any replica's
+    ``/debug/flight?trace=``) without re-running anything."""
     rng = random.Random(seed)
     tiers = draw_tiers(n, tier_mix, seed=seed)
     share_rng = random.Random((seed << 16) ^ 0x5F1C)
@@ -280,6 +290,7 @@ def build_workload(
                     stop_at_eos=stop_at_eos,
                     deadline_ms=deadline_ms,
                     priority=tiers[i],
+                    trace=TraceContext(trace_id=mint_trace_id()),
                 ),
             )
         )
@@ -317,6 +328,13 @@ def run_load(
             "offset_s": offset,
             "t_submit": t_submit - start,
             "tier": getattr(request, "priority", None),
+            # the caller-minted wire trace (ISSUE 13): carried on every
+            # record so the summary can name WHICH requests went wrong
+            "trace": (
+                request.trace.trace_id
+                if getattr(request, "trace", None) is not None
+                else None
+            ),
         }
         cancel_after = cancellations[i] if cancellations else None
         try:
@@ -511,6 +529,25 @@ def summarize(records: List[Dict]) -> Dict:
         retried = sum(1 for r in ok if r.get("retried"))
         if retried:
             out["retried"] = retried
+    # Trace forensics (ISSUE 13): the trace ids of every request that
+    # went wrong — paste one into the router's GET /debug/timeline?trace=
+    # (or a replica's /debug/flight?trace=) to replay its whole
+    # cross-process story. Capped so one summary line stays one line.
+    def _traces(recs, cap=16):
+        ids = [r["trace"] for r in recs if r.get("trace")]
+        return ids[:cap]
+
+    failed_traces = _traces(
+        [r for r in errors if r not in deadline_exceeded]
+    )
+    if failed_traces:
+        out["failed_traces"] = failed_traces
+    deadline_traces = _traces(deadline_exceeded)
+    if deadline_traces:
+        out["slo_missed_traces"] = deadline_traces
+    retried_traces = _traces([r for r in ok if r.get("retried")])
+    if retried_traces:
+        out["retried_traces"] = retried_traces
     # per-tier breakdown (ISSUE 11): the high-tier TTFT tail under
     # overload is THE number the preemption A/B trades for — reported
     # per tier so one summary line carries both sides of the trade

@@ -91,6 +91,23 @@ Three phases, all over the deterministic fake backend:
     survivor DRAINS cleanly (``replica_drained`` event, membership
     shrinks) and a final request is shed 503 with nobody healthy left.
 
+11. FLEET-WIDE OBSERVABILITY (ISSUE 13): two fake continuous servers
+    reached OVER THE WIRE as RemoteReplicas behind the front-door
+    router (the ``serve-fleet`` shape). Two long low-tier requests
+    saturate replica B's 2-row session; replica A's engine is killed;
+    a caller-traced high-tier stream dispatched through the router
+    lands on dead A, is retried onto B, and preempts a low row there.
+    Asserts: BOTH dispatch attempts share ONE trace id (attempts 1, 2
+    in order); ``GET /debug/timeline?trace=`` reconstructs the story
+    in order (dispatched → retry dispatched → admitted (queue wait
+    attached) → stream chunks → retired) and the VICTIM's trace shows
+    preempted → resumed in order; the router ``/metrics`` carries
+    ``llm_fleet_*`` rollups whose counters equal the sum of the
+    individual replica scrapes (merged by the same
+    ``merge_expositions`` the golden test pins); and
+    ``llm_request_wasted_joules_total{cause="retry"}`` moved, with the
+    same figure riding the retried ticket's ``x_extras.energy``.
+
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
 """
@@ -1063,6 +1080,199 @@ def main() -> int:
     finally:
         server10.stop()
 
+    # -- phase 11: fleet-wide observability (ISSUE 13) -------------------------
+    # The serve-fleet shape: two fake continuous servers reached over
+    # the wire as RemoteReplicas behind the router. One mid-trace kill,
+    # one preemption — then the trace, timeline, federation and
+    # wasted-Joules asserts described in the module docstring.
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        REGISTRY,
+        merge_expositions,
+        parse_exposition,
+        sample_value,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.trace import (
+        TraceContext,
+        mint_trace_id,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router import (
+        RemoteReplica,
+    )
+
+    def wasted_retry_joules():
+        fam = REGISTRY.snapshot().get(
+            "llm_request_wasted_joules_total", {}
+        )
+        return float(fam.get("cause=retry", 0.0))
+
+    backend11_a = FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+    backend11_b = FakeBackend(
+        tokens_per_s=150.0, simulate_delay=True, max_rows=2
+    )
+    server11_a = GenerationServer(
+        backend11_a, host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous",
+    )
+    server11_b = GenerationServer(
+        backend11_b, host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous",
+    )
+    server11_a.start()
+    server11_b.start()
+    base11_a = f"http://127.0.0.1:{server11_a.port}"
+    base11_b = f"http://127.0.0.1:{server11_b.port}"
+    router11 = Router(
+        [
+            RemoteReplica("r0", base11_a),
+            RemoteReplica("r1", base11_b),
+        ],
+        policy="round-robin",
+        probe_interval_s=30.0,
+    )
+    server11 = RouterServer(router11, host="127.0.0.1", port=0, quiet=True)
+    server11.start()
+    try:
+        base11 = f"http://127.0.0.1:{server11.port}"
+        wasted_before = wasted_retry_joules()
+
+        # two low-tier long rows saturate B's 2-row session (sent
+        # DIRECTLY to B — background load, caller-traced so the victim
+        # story is timeline-queryable too)
+        victim_traces = [mint_trace_id(), mint_trace_id()]
+        low_results = {}
+
+        def low_client(i):
+            body = json.loads(
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"{base11_b}/api/generate",
+                        data=json.dumps(
+                            {
+                                "model": "smoke:1b",
+                                "prompt": f"low tier {i}",
+                                "options": {"num_predict": 160},
+                                "x_priority": "low",
+                                "x_trace": {"id": victim_traces[i]},
+                            }
+                        ).encode(),
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=60,
+                ).read()
+            )
+            low_results[i] = body
+
+        threads11 = [
+            threading.Thread(target=low_client, args=(0,)),
+        ]
+        threads11[0].start()
+        time.sleep(0.15)
+        threads11.append(threading.Thread(target=low_client, args=(1,)))
+        threads11[1].start()
+        time.sleep(0.3)
+
+        backend11_a.fail_decode_open = True  # r0 dies mid-trace
+
+        # the traced high-tier STREAM through the router: round-robin
+        # picks dead r0 first -> retried once onto r1 -> preempts the
+        # youngest low row there
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (  # noqa: E501
+            GenerationRequest as _GenReq,
+        )
+
+        tid11 = mint_trace_id()
+        client11 = RemoteHTTPBackend(base11)
+        chunks11 = list(
+            client11.generate_stream(
+                _GenReq(
+                    "smoke:1b",
+                    "retried traced high-tier stream",
+                    max_new_tokens=48,
+                    priority=2,
+                    trace=TraceContext(trace_id=tid11),
+                )
+            )
+        )
+        final11 = chunks11[-1].result
+        assert final11 is not None and final11.generated_tokens == 48
+        router11_extras = final11.extras["router"]
+        assert router11_extras["replica"] == "r1", router11_extras
+        assert router11_extras["retried"] == "dead", router11_extras
+        assert router11_extras["trace"] == tid11
+
+        # wasted-Joules moved for cause=retry, and the same figure rode
+        # the wire on the retried ticket
+        wasted_wire = final11.extras["energy"]["wasted_J"]["retry"]
+        assert wasted_wire > 0, final11.extras
+        wasted_delta = wasted_retry_joules() - wasted_before
+        assert wasted_delta > 0, "llm_request_wasted_joules_total{retry} flat"
+
+        for t in threads11:
+            t.join(timeout=60)
+        assert low_results[0].get("done") and low_results[1].get("done")
+        # the victim completed its full stream after preempt+resume
+        victim_sched = low_results[1]["x_extras"]["sched"]
+        assert victim_sched.get("preempted") == 1, victim_sched
+        assert low_results[1]["eval_count"] == 160
+
+        # both dispatch attempts share ONE trace id, in order
+        disp11 = _get_json(
+            base11, f"/debug/flight?trace={tid11}&type=dispatched"
+        )["events"]
+        assert [(e["attempt"], e["replica"]) for e in disp11] == [
+            (1, "r0"),
+            (2, "r1"),
+        ], disp11
+        assert {e["trace_id"] for e in disp11} == {tid11}
+
+        # the timeline reconstructs the retried request across hops in
+        # order: dispatched -> retry dispatched -> admitted (queue wait
+        # attached) -> stream chunks -> retired
+        tl11 = _get_json(base11, f"/debug/timeline?trace={tid11}")
+        assert tl11["attempts"] == 2
+        types11 = [e["type"] for e in tl11["events"]]
+        d0 = types11.index("dispatched")
+        d1 = types11.index("dispatched", d0 + 1)
+        i_adm = types11.index("request_admitted")
+        i_ret = types11.index("row_retired")
+        assert d0 < d1 < i_adm < i_ret, types11
+        assert "stream_chunk" in types11
+        assert i_adm < types11.index("stream_chunk") < i_ret, types11
+        assert "queue_wait_s" in tl11["events"][i_adm]
+        # every hop is attributed; the retried attempt's replica events
+        # surface under r1 (or, ring-shared in-process, as "local")
+        assert {e["hop"] for e in tl11["events"]} >= {"router"}
+
+        # the VICTIM's trace shows preempted -> resumed in order
+        vic11 = _get_json(
+            base11_b, f"/debug/flight?trace={victim_traces[1]}&n=500"
+        )["events"]
+        vtypes = [e["type"] for e in vic11]
+        assert "preempted" in vtypes and "resumed" in vtypes, vtypes
+        assert vtypes.index("preempted") < vtypes.index("resumed")
+
+        # federation: fleet counters equal the SUM of the individual
+        # replica scrapes (replicas quiesced; merged by the same
+        # function the golden test pins)
+        scrape_a = _scrape(base11_a)
+        scrape_b = _scrape(base11_b)
+        expected11 = merge_expositions([("r0", scrape_a), ("r1", scrape_b)])
+        expected_req = sample_value(
+            parse_exposition(expected11), "llm_fleet_sched_requests_total"
+        )
+        fleet_req = sample_value(
+            parse_exposition(_scrape(base11)),
+            "llm_fleet_sched_requests_total",
+        )
+        assert expected_req is not None and fleet_req == expected_req, (
+            fleet_req,
+            expected_req,
+        )
+    finally:
+        server11.stop()
+        server11_a.stop()
+        server11_b.stop()
+
     print(
         json.dumps(
             {
@@ -1122,6 +1332,13 @@ def main() -> int:
                     ],
                     "replica_down_events": len(down10),
                     "drained": True,
+                },
+                "fleet_obs": {
+                    "retried_trace": tid11,
+                    "dispatch_attempts": len(disp11),
+                    "timeline_events": len(tl11["events"]),
+                    "wasted_retry_joules": round(wasted_delta, 6),
+                    "fleet_requests_total": fleet_req,
                 },
             }
         )

@@ -498,19 +498,37 @@ def test_remote_replica_probe_parses_healthz_and_metrics():
         backend_server.stop()
 
 
-def test_metrics_gauge_parser():
+def test_metrics_scrape_parser():
+    # the shared v0.0.4 parser (obs/metrics.py) replaced the router's
+    # two ad-hoc regexes (ISSUE 13 satellite): probe reads go through
+    # parse_exposition / sample_value / histogram_mean, including the
+    # bare _sum/_count fallback for scrapes with no TYPE line
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        histogram_mean,
+        parse_exposition,
+        sample_value,
+    )
+
     text = (
         "# TYPE llm_paged_pool_occupancy gauge\n"
         "llm_paged_pool_occupancy 0.25\n"
         "llm_request_joules_per_token_sum 4.0\n"
         "llm_request_joules_per_token_count 8\n"
     )
-    assert router_mod._metrics_gauge(text, "llm_paged_pool_occupancy") == 0.25
-    assert router_mod._metrics_gauge(text, "absent_family") is None
-    assert (
-        router_mod._metrics_hist_mean(text, "llm_request_joules_per_token")
-        == 0.5
+    families = parse_exposition(text)
+    assert sample_value(families, "llm_paged_pool_occupancy") == 0.25
+    assert sample_value(families, "absent_family") is None
+    assert histogram_mean(families, "llm_request_joules_per_token") == 0.5
+    # typed histograms parse bucket samples and labelled children
+    typed = (
+        "# TYPE llm_request_ttft_seconds histogram\n"
+        'llm_request_ttft_seconds_bucket{le="0.1"} 3\n'
+        'llm_request_ttft_seconds_bucket{le="+Inf"} 4\n'
+        "llm_request_ttft_seconds_sum 2.0\n"
+        "llm_request_ttft_seconds_count 4\n"
     )
+    tfam = parse_exposition(typed)
+    assert histogram_mean(tfam, "llm_request_ttft_seconds") == 0.5
 
 
 def test_route_policy_validation():
