@@ -923,6 +923,13 @@ def shared_prefix_bench() -> int:
 
     def run_arm(share: bool):
         engine = engines[share]
+        if share and engine.prefix_store is not None:
+            # the ISSUE-14 store is ENGINE-lifetime: drop the previous
+            # run's publications so every arm (warm and measured)
+            # starts empty — this bench measures the WITHIN-session
+            # win at PR-7 semantics; bench.py radix_prefix measures
+            # the cross-session story deliberately
+            engine.prefix_store.release_all()
         sched = ContinuousScheduler(
             engine,
             slice_steps=slice_steps,
@@ -1076,6 +1083,227 @@ def shared_prefix_bench() -> int:
             else None
         ),
         "pool_accounting": accounting,
+    }
+    _attach_obs(line)
+    print(json.dumps(line))
+    return 0
+
+
+def radix_prefix_bench() -> int:
+    """A/B of the ISSUE-14 persistent cross-session prefix store on a
+    seeded MULTI-SESSION trace: the same requests replay through S
+    session segments, each driven by a FRESH ContinuousScheduler over
+    the same engine (a scheduler restart mid-trace), with a high
+    shared-prefix fraction inside every segment.
+
+    Arms (same trace, same engine shapes):
+    - ``session_scoped``: prefix_store_scope="session" — the PR-7
+      lifetime (the store's tree dies with each session's pool), so
+      hits only happen WITHIN a segment;
+    - ``engine_store``: the ISSUE-14 default — publications survive
+      session close and scheduler restarts, so later segments' joiners
+      hit prefixes published before the restart;
+    - ``engine_store_spill``: engine scope under maximal HBM budget
+      pressure (prefix_store_hbm_bytes=0) — every publication spills
+      to host and every cross-session hit must RESTORE, measuring the
+      hit-rate with spill pressure.
+
+    Headlines: cross-session hit tokens (post-restart hit tokens the
+    session-scoped arm cannot get), joiner TTFT p50, prefill tokens
+    actually computed, and the store's hit/spill/restore counters.
+    CPU-functional; RELATIVE positions are the result (docs/PERF.md
+    "Persistent prefix store"). Prints ONE JSON line.
+    """
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(
+        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "scripts")
+    )
+    import jax
+    import jax.numpy as jnp
+    from poisson_load import build_workload, percentile, run_load, summarize
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.prefix import (
+        PREFIX_HIT_TOKENS_C,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.radix_store import (
+        STORE_HITS_C,
+        STORE_RESTORES_C,
+        STORE_SPILLS_C,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    on_accelerator = jax.default_backend() in ("tpu", "axon")
+    cfg = get_model_config("qwen2:1.5b")
+    if not on_accelerator:
+        cfg = cfg.tiny(max_seq_len=1024)
+    dtype = jnp.bfloat16 if on_accelerator else jnp.float32
+
+    sessions = int(_os.environ.get("BENCH_RP_SESSIONS", "3"))
+    n_per = int(_os.environ.get("BENCH_RP_REQUESTS_PER_SESSION", "6"))
+    mean_ms = float(_os.environ.get("BENCH_RP_INTERARRIVAL_MS", "25"))
+    chunk_tokens = int(_os.environ.get("BENCH_RP_CHUNK_TOKENS", "64"))
+    slice_steps = int(_os.environ.get("BENCH_RP_SLICE_STEPS", "8"))
+    prefix_tokens = int(_os.environ.get("BENCH_RP_PREFIX_TOKENS", "192"))
+    share_frac = float(_os.environ.get("BENCH_RP_SHARE_FRAC", "0.75"))
+    budgets = (96, 10, 16)  # anchor outlives the arrivals (see PR-7 bench)
+    segments = [
+        build_workload(
+            n_per,
+            mean_ms / 1e3,
+            seed=7 + s,
+            model=cfg.name,
+            budgets=budgets,
+            stop_at_eos=False,
+            shared_prefix_frac=share_frac,
+            prefix_pool=1,
+            shared_prefix_tokens=prefix_tokens,
+            anchor_shared_prefix=True,
+        )
+        for s in range(sessions)
+    ]
+    all_requests = [req for seg in segments for _, req in seg]
+    prompt_tokens_total = sum(len(r.prompt) + 1 for r in all_requests)
+
+    solo_eng = JaxEngine(
+        registry={cfg.name: cfg},
+        dtype=dtype,
+        decode_attention="auto" if on_accelerator else None,
+        paged_kv=True,
+    )
+    solo = {id(r): solo_eng.generate(r).tokens for r in all_requests}
+
+    def run_arm(scope: str, hbm_bytes=None):
+        engine = JaxEngine(
+            registry={cfg.name: cfg},
+            dtype=dtype,
+            decode_attention="auto" if on_accelerator else None,
+            paged_kv=True,
+            prefix_share=True,
+            prefix_store_scope=scope,
+            prefix_store_hbm_bytes=hbm_bytes,
+        )
+        hits_t0 = PREFIX_HIT_TOKENS_C.labels().value
+        c0 = {
+            "hits": STORE_HITS_C.labels().value,
+            "spills": STORE_SPILLS_C.labels().value,
+            "restores": STORE_RESTORES_C.labels().value,
+        }
+        records = []
+        hit_tokens_by_segment = []
+        tokens_by_req = {}
+        for segment in segments:
+            seg_hits0 = PREFIX_HIT_TOKENS_C.labels().value
+            sched = ContinuousScheduler(
+                engine,
+                slice_steps=slice_steps,
+                prefill_chunk_tokens=chunk_tokens,
+                chunked_joins=True,
+            )
+
+            def submit(req, _s=sched):
+                res = _s.submit(req)
+                tokens_by_req[id(req)] = res.tokens
+                return res
+
+            sched.start()
+            try:
+                records.extend(run_load(submit, segment))
+            finally:
+                sched.stop()  # the mid-trace scheduler restart
+            hit_tokens_by_segment.append(
+                PREFIX_HIT_TOKENS_C.labels().value - seg_hits0
+            )
+        joiners = [r for r in records if r.get("joined")]
+        joiner_ttfts = [
+            r["ttft_s"] for r in joiners if r.get("ttft_s") is not None
+        ]
+        hit_tokens = PREFIX_HIT_TOKENS_C.labels().value - hits_t0
+        return {
+            **summarize(records),
+            "joined": len(joiners),
+            "joiner_ttft_p50_s": (
+                round(percentile(joiner_ttfts, 50), 4)
+                if joiner_ttfts
+                else None
+            ),
+            "prefix_hit_tokens": int(hit_tokens),
+            "hit_tokens_after_restart": int(
+                sum(hit_tokens_by_segment[1:])
+            ),
+            "prefill_tokens_total": prompt_tokens_total,
+            "prefill_tokens_computed": int(prompt_tokens_total - hit_tokens),
+            "store_hits": int(STORE_HITS_C.labels().value - c0["hits"]),
+            "store_spills": int(
+                STORE_SPILLS_C.labels().value - c0["spills"]
+            ),
+            "store_restores": int(
+                STORE_RESTORES_C.labels().value - c0["restores"]
+            ),
+            "parity_vs_solo": all(
+                tokens_by_req.get(i) == toks for i, toks in solo.items()
+            ),
+        }
+
+    run_arm("engine")  # warm every shape outside the measured arms
+    results = {
+        "session_scoped": run_arm("session"),
+        "engine_store": run_arm("engine"),
+        "engine_store_spill": run_arm("engine", hbm_bytes=0),
+    }
+    cross = (
+        results["engine_store"]["hit_tokens_after_restart"]
+        - results["session_scoped"]["hit_tokens_after_restart"]
+    )
+    line = {
+        "metric": "radix_prefix",
+        "unit": "latency_seconds",
+        "model": cfg.name,
+        "backend": jax.default_backend(),
+        "sessions": sessions,
+        "requests_per_session": n_per,
+        "shared_prefix": {"frac": share_frac, "tokens": prefix_tokens},
+        **results,
+        "cross_session_hit_tokens": int(cross),
+        "computed_prefill_ratio": (
+            round(
+                results["engine_store"]["prefill_tokens_computed"]
+                / results["session_scoped"]["prefill_tokens_computed"],
+                3,
+            )
+            if results["session_scoped"]["prefill_tokens_computed"]
+            else None
+        ),
+        "joiner_ttft_p50_ratio": (
+            round(
+                results["session_scoped"]["joiner_ttft_p50_s"]
+                / results["engine_store"]["joiner_ttft_p50_s"],
+                2,
+            )
+            if results["session_scoped"]["joiner_ttft_p50_s"]
+            and results["engine_store"]["joiner_ttft_p50_s"]
+            else None
+        ),
+        "spill_pressure_hit_rate": (
+            round(
+                results["engine_store_spill"]["store_hits"]
+                / max(1, results["engine_store"]["store_hits"]),
+                3,
+            )
+        ),
     }
     _attach_obs(line)
     print(json.dumps(line))
@@ -1778,6 +2006,8 @@ def main() -> int:
         return streaming_cancellation_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "shared_prefix":
         return shared_prefix_bench()
+    if len(sys.argv) > 1 and sys.argv[1] == "radix_prefix":
+        return radix_prefix_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "preemption_overload":
         return preemption_overload_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "spec_continuous":

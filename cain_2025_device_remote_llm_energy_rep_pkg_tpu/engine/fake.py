@@ -29,6 +29,155 @@ from .backend import GenerationBackend, GenerationRequest, GenerationResult
 # Fake "page" granularity for the shared-prefix simulation: small enough
 # that smoke-test prompts span several pages (1 byte ≈ 1 prompt token).
 FAKE_PREFIX_PAGE = 16
+# simulated device bytes of one fake page — keeps the fake store's
+# byte-budget arithmetic proportional to a real pool's
+FAKE_PAGE_BYTES = 1024
+
+
+class _FakePrefixStore:
+    """The hermetic twin of engine/radix_store.py::RadixPrefixStore —
+    BACKEND-owned (it outlives every `_FakeStepSession`), so the CI
+    smoke can assert CROSS-SESSION hits, budget-pressure spills and
+    hit-time restores with no accelerator. Entries are flat published
+    prompt byte-streams with a tier each; the llm_prefix_store_*
+    families move with the same semantics as the real store's."""
+
+    def __init__(self, hbm_bytes=None, host_bytes=None) -> None:
+        self.hbm_bytes = hbm_bytes
+        self.host_bytes = host_bytes
+        self._entries: List[dict] = []  # {prompt, pages, tier, stamp}
+        self._clock = 0
+
+    def _gauges(self) -> None:
+        try:
+            from .radix_store import (
+                STORE_HBM_PAGES_G,
+                STORE_HOST_BYTES_G,
+                STORE_NODES_G,
+            )
+
+            STORE_NODES_G.set(len(self._entries))
+            STORE_HBM_PAGES_G.set(self.hbm_pages_held)
+            STORE_HOST_BYTES_G.set(self.host_bytes_held)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    @property
+    def hbm_pages_held(self) -> int:
+        return sum(
+            e["pages"] for e in self._entries if e["tier"] == "hbm"
+        )
+
+    @property
+    def host_bytes_held(self) -> int:
+        return sum(
+            e["pages"] * FAKE_PAGE_BYTES
+            for e in self._entries
+            if e["tier"] == "host"
+        )
+
+    def debug_state(self) -> dict:
+        tiers = {"hbm": 0, "host": 0, "seed": 0}
+        for e in self._entries:
+            tiers[e["tier"]] += 1
+        return {
+            "scope": "engine",
+            "nodes": len(self._entries),
+            "depth": max((len(e["prompt"]) for e in self._entries), default=0),
+            "tiers": tiers,
+            "hbm_pages": self.hbm_pages_held,
+            "hbm_bytes": self.hbm_pages_held * FAKE_PAGE_BYTES,
+            "hbm_budget_bytes": self.hbm_bytes,
+            "host_bytes": self.host_bytes_held,
+            "host_budget_bytes": self.host_bytes,
+        }
+
+    def probe(self, prompt: bytes) -> dict:
+        """Longest published common prefix (cross-session), restoring a
+        spilled entry on hit; then publish ``prompt`` and enforce the
+        byte budgets — one call models the whole join-time store
+        interaction."""
+        from .radix_store import STORE_HITS_C, STORE_RESTORES_C
+
+        best, best_entry = 0, None
+        for e in self._entries:
+            pub = e["prompt"]
+            n = min(len(pub), len(prompt), len(prompt) - 1)
+            common = 0
+            while common < n and pub[common] == prompt[common]:
+                common += 1
+            if common > best:
+                best, best_entry = common, e
+        out = {"hit_tokens": best, "shared_pages": 0}
+        if best > 0:
+            self._clock += 1
+            best_entry["stamp"] = self._clock
+            if best_entry["tier"] == "host":
+                # hit on a spilled entry: swap it back in
+                best_entry["tier"] = "hbm"
+                STORE_RESTORES_C.inc()
+                self._emit(
+                    "prefix_restore", pages=best_entry["pages"],
+                    tokens=len(best_entry["prompt"]),
+                )
+            out["shared_pages"] = min(
+                best // FAKE_PREFIX_PAGE, best_entry["pages"]
+            )
+            STORE_HITS_C.inc()
+        covered = any(
+            len(e["prompt"]) >= len(prompt)
+            and e["prompt"][: len(prompt)] == prompt
+            for e in self._entries
+        )
+        if not covered:
+            self._clock += 1
+            self._entries.append(
+                {
+                    "prompt": bytes(prompt),
+                    "pages": len(prompt) // FAKE_PREFIX_PAGE,
+                    "tier": "hbm",
+                    "stamp": self._clock,
+                }
+            )
+        self._enforce()
+        self._gauges()
+        return out
+
+    def _emit(self, type_: str, **attrs) -> None:
+        try:
+            from ..obs.flight import FLIGHT, trace_attrs
+            from ..obs.metrics import enabled as _enabled
+            from ..obs.trace import TRACER
+
+            if _enabled():
+                FLIGHT.emit(type_, **trace_attrs(TRACER.current()), **attrs)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    def _enforce(self) -> None:
+        from .radix_store import STORE_EVICTIONS_C, STORE_SPILLS_C
+
+        if self.hbm_bytes is not None:
+            while self.hbm_pages_held * FAKE_PAGE_BYTES > self.hbm_bytes:
+                hbm = [e for e in self._entries if e["tier"] == "hbm"]
+                if not hbm:
+                    break
+                victim = min(hbm, key=lambda e: e["stamp"])
+                victim["tier"] = "host"
+                STORE_SPILLS_C.inc()
+                self._emit(
+                    "prefix_spill", pages=victim["pages"],
+                    tokens=len(victim["prompt"]),
+                )
+        if self.host_bytes is not None:
+            while self.host_bytes_held > self.host_bytes:
+                host = [e for e in self._entries if e["tier"] == "host"]
+                if not host:
+                    break
+                victim = min(host, key=lambda e: e["stamp"])
+                self._entries.remove(victim)
+                STORE_EVICTIONS_C.inc()
+                self._emit("prefix_evict", tokens=len(victim["prompt"]))
 
 
 class _FakeStepSession:
@@ -74,10 +223,9 @@ class _FakeStepSession:
         self.stream_tokens = False
         self._stream_tail: List[tuple] = []
         # shared-prefix simulation (backend.prefix_share — the fake twin
-        # of engine/prefix.py so the CI smoke can assert the
-        # llm_prefix_* families hermetically): published prompt byte
-        # streams + the count of shared pages live rows currently map
-        self._prefix_pub: List[bytes] = []
+        # of engine/radix_store.py, ISSUE 14): publications and hits go
+        # through the BACKEND-owned store (it survives this session),
+        # while the live shared-page gauge stays session accounting
         self._shared_live = 0
         # preemption swap ledger — the fake twin of the stepped
         # session's (ISSUE 11), so smoke/CI can assert the swap gauges
@@ -89,30 +237,26 @@ class _FakeStepSession:
             self._admit(r)
 
     def _prefix_probe(self, request: GenerationRequest) -> dict:
-        """Longest published common prefix for this prompt, page-floored
-        — mirrors SteppedDecodeSession._prefix_hit + observe_hit."""
-        prompt = request.prompt.encode("utf-8")
+        """Longest published common prefix for this prompt (from the
+        BACKEND store — cross-session), page-floored — mirrors
+        SteppedDecodeSession._prefix_hit + observe_hit."""
         out = {"hit_tokens": 0, "shared_pages": 0}
-        if not self.backend.prefix_share:
+        store = self.backend.prefix_store
+        if store is None:
             return out
-        best = 0
-        for pub in self._prefix_pub:
-            n = min(len(pub), len(prompt), len(prompt) - 1)
-            common = 0
-            while common < n and pub[common] == prompt[common]:
-                common += 1
-            best = max(best, common)
-        if best > 0:
+        hit = store.probe(request.prompt.encode("utf-8"))
+        if hit["hit_tokens"] > 0:
             from .prefix import PREFIX_SHARED_PAGES_G, observe_hit
 
-            shared = best // FAKE_PREFIX_PAGE
-            out = {"hit_tokens": best, "shared_pages": shared}
+            out = hit
             observe_hit(
-                best, shared, cow=best > shared * FAKE_PREFIX_PAGE
+                hit["hit_tokens"],
+                hit["shared_pages"],
+                cow=hit["hit_tokens"]
+                > hit["shared_pages"] * FAKE_PREFIX_PAGE,
             )
-            self._shared_live += shared
+            self._shared_live += hit["shared_pages"]
             PREFIX_SHARED_PAGES_G.set(self._shared_live)
-        self._prefix_pub.append(prompt)
         return out
 
     def _prefix_release(self, row: dict) -> None:
@@ -495,7 +639,6 @@ class _FakeStepSession:
         self._rows = []
         self._pending = []
         self._stream_tail = []
-        self._prefix_pub = []
         if self._swap_bytes or self._swap_rows:
             # parked victims die with the session: settle the ledger so
             # the host-residency gauges return exactly to idle
@@ -512,6 +655,8 @@ class FakeBackend(GenerationBackend):
         tokens_per_s: float = 1000.0,
         simulate_delay: bool = False,
         prefix_share: bool = False,
+        prefix_store_hbm_bytes: "Optional[int]" = None,
+        prefix_store_host_bytes: "Optional[int]" = None,
         spec_k: int = 0,
         spec_acceptance: float = 1.0,
         spec_accept_floor: "Optional[float]" = None,
@@ -544,10 +689,19 @@ class FakeBackend(GenerationBackend):
         # session row capacity: small values simulate a saturated pool
         # so scheduler preemption (ISSUE 11) is testable hermetically
         self.max_rows = int(max_rows)
-        # the fake twin of JaxEngine(prefix_share=True): stepped sessions
-        # simulate shared-prefix hits so llm_prefix_* telemetry is
-        # CI-testable with no accelerator (see _FakeStepSession)
+        # the fake twin of JaxEngine(prefix_share=True) + its ISSUE-14
+        # engine store: the BACKEND owns a _FakePrefixStore that
+        # survives sessions, so cross-session hits, budget spills and
+        # restores are CI-testable with no accelerator
         self.prefix_share = prefix_share
+        self.prefix_store = (
+            _FakePrefixStore(
+                hbm_bytes=prefix_store_hbm_bytes,
+                host_bytes=prefix_store_host_bytes,
+            )
+            if prefix_share
+            else None
+        )
         # the fake twin of JaxEngine(speculative=..., spec_accept_floor=):
         # spec_k > 0 makes stepped sessions speak the draft-verify
         # protocol with CONFIGURABLE synthetic acceptance — llm_spec_*

@@ -298,8 +298,11 @@ class JaxEngine(GenerationBackend):
         kv_quantize: Optional[str] = None,  # None | "int8" (decode path)
         paged_kv: bool = False,  # batched decode over a paged pool
         page_size: int = 128,
-        prefix_share: bool = False,  # session shared-prefix CoW paging
-        prefix_index_entries: int = 16,  # per-session prefix-index cap
+        prefix_share: bool = False,  # shared-prefix CoW paging + store
+        prefix_index_entries: int = 16,  # prefix-store node cap (per model)
+        prefix_store_hbm_bytes: Optional[int] = None,  # store HBM budget
+        prefix_store_host_bytes: Optional[int] = None,  # store host budget
+        prefix_store_scope: str = "engine",  # "engine" | "session"
     ) -> None:
         # quantize: one mode for every model (None | "int8" | "int4"), or a
         # per-model dict {model: mode} with an optional "default" key — a
@@ -360,19 +363,41 @@ class JaxEngine(GenerationBackend):
         self.paged_kv = paged_kv
         self.page_size = page_size
         self.kv_quantize = kv_quantize
-        # prefix_share=True: stepped decode sessions keep a session-scoped
-        # shared-prefix index (engine/prefix.py) — joiners whose prompt
-        # shares a published prefix map its refcounted read-only pool
-        # pages and chunk-prefill only the divergent tail (CoW on the
-        # boundary page). Works on all four cache layouts; page sharing
-        # engages on the paged pools, seed-only reuse on contiguous.
-        # CLI twin: `serve --prefix-share` (+ --prefix-index-entries).
+        # prefix_share=True: the ENGINE owns a persistent cross-session
+        # prefix store (engine/radix_store.py, ISSUE 14) — a token-id
+        # radix tree over refcounted pool pages with host-RAM spill.
+        # Stepped sessions consult and publish to it: joiners whose
+        # prompt shares a published prefix map its refcounted read-only
+        # pool pages and chunk-prefill only the divergent tail (CoW on
+        # the boundary page) — including joiners in a FRESH session
+        # after the publisher's session (and its pool) died, and after
+        # a scheduler restart. Works on all four cache layouts; page
+        # sharing engages on the paged pools, seed-only reuse on
+        # contiguous. CLI twin: `serve --prefix-share`
+        # (+ --prefix-index-entries / --prefix-store-hbm-bytes /
+        # --prefix-store-host-bytes).
         self.prefix_share = bool(prefix_share)
         if prefix_index_entries < 1:
             raise ValueError(
                 f"prefix_index_entries must be >= 1, got {prefix_index_entries}"
             )
         self.prefix_index_entries = int(prefix_index_entries)
+        for knob, value in (
+            ("prefix_store_hbm_bytes", prefix_store_hbm_bytes),
+            ("prefix_store_host_bytes", prefix_store_host_bytes),
+        ):
+            if value is not None and int(value) < 0:
+                raise ValueError(f"{knob} must be >= 0, got {value}")
+        self.prefix_store = None
+        if self.prefix_share:
+            from .radix_store import RadixPrefixStore
+
+            self.prefix_store = RadixPrefixStore(
+                capacity=self.prefix_index_entries,
+                hbm_bytes=prefix_store_hbm_bytes,
+                host_bytes=prefix_store_host_bytes,
+                scope=prefix_store_scope,
+            )
         self.quantize = quantize
         # target model → (draft model, k): greedy requests for the target
         # route through speculative decoding (engine/speculative.py). A

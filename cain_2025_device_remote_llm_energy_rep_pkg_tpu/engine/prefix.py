@@ -1,57 +1,31 @@
-"""Shared-prefix index for stepped decode sessions: refcounted
-read-only prefix pages + copy-on-write admission.
+"""Shared-prefix reuse: the metric families and helpers shared by the
+paged pool, the stepped sessions and the engine-level prefix store.
 
-The production workload behind the paper's serving scenario — many
-clients fetching generations from one remote server — is dominated by
-requests sharing a system prompt. Until ISSUE 7 that sharing bought
-nothing on the continuous path: the prompt-prefix KV cache was a
-solo-path feature and every joiner paid its whole prefill. This module
-is the session-scoped index that fixes it, the way vLLM's PagedAttention
-block sharing and SGLang's RadixAttention do:
+History: ISSUE 7 introduced refcounted read-only prefix pages + a
+SESSION-scoped ``PrefixIndex`` living here (flat longest-match list,
+seed-only tail publication, capacity HBM-bound). ISSUE 14 promoted that
+design to :class:`~.radix_store.RadixPrefixStore` — an ENGINE-lifetime
+radix tree over refcounted page runs with page-backed tail publication
+and host-RAM spill — and the flat index was deleted; the
+``llm_prefix_*`` families and the hit/CoW accounting below are the
+stable surface both generations share (the store adds its own
+``llm_prefix_store_*`` families in radix_store.py).
 
-- :class:`PrefixIndex` maps published prompt token streams to (a) the
-  publisher's POOL PAGES covering the prompt's full page-aligned chunks
-  and (b) a bf16 K/V *seed slab* of the prompt's positions;
-- a joiner whose prompt shares a prefix with an entry MAPS the shared
-  full pages into its own page-table row (``PagePool.share`` — the page
-  is billed once and recycled only when its last reader retires) and
-  seeds its private prefill cache from the slab, so it chunk-prefills
-  only the divergent tail;
-- the first PARTIAL page at the divergence boundary is COPY-ON-WRITE:
-  its seeded positions are scattered into the joiner's own page at
-  commit (``llm_prefix_cow_copies_total``) because the joiner's tail
-  prefill / decode writes land in it — shared pages stay read-only.
-
-Why a seed slab next to the pages: the tail prefill must attend to the
-prefix K/V at the precision the solo path would have produced. For int8
-pools, reconstructing bf16 from codes would perturb the tail's logits
-and break the token-parity contract; the slab keeps the publisher's
-exact pre-quantization values (scales are per-position, so the SHARED
-pages themselves need no re-quantization — sharers read the publisher's
-codes+scales directly during decode). For contiguous sessions (no pool)
-the slab alone carries the win: the common prefix is seeded instead of
-recomputed.
-
-The index is SESSION-SCOPED (page indices are pool-relative and the
-pool lives per session); its entries hold their own page references so
-a published prefix outlives its publisher's retirement, and
-``release_all`` at session close returns every reference — the exact
-page-free accounting of ISSUE 6 therefore still holds: after all
-sharers retire the pool free-count is back to its pre-join value, and
-after close it is fully restored. Entry count is bounded by
-``JaxEngine(prefix_index_entries=...)`` / ``serve
---prefix-index-entries`` with LRU eviction (hits refresh recency, so a
-hot system-prompt entry is never the victim). This is deliberately NOT
-under the engine's weight-LRU: the slab + pages live inside the
-session's fixed pool/HBM envelope, while the solo prefix cache
-(`prefix_cache_size`) remains budgeted against resident weights.
+Why a bf16 seed slab next to the pages (unchanged from ISSUE 7): the
+divergent-tail prefill must attend to the prefix K/V at the precision
+the solo path would have produced. For int8 pools, reconstructing bf16
+from codes would perturb the tail's logits and break the token-parity
+contract; the slab keeps the publisher's exact pre-quantization values
+(scales are per-position, so SHARED pages need no re-quantization —
+sharers read the publisher's codes+scales directly during decode). For
+contiguous sessions (no pool) the slab alone carries the win.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List
 
-from ..obs.flight import EV_PREFIX_EVICT, EV_PREFIX_HIT, FLIGHT, trace_of
+from ..obs.flight import EV_PREFIX_HIT, FLIGHT, trace_of
 from ..obs.metrics import REGISTRY, enabled as _obs_enabled
 from ..obs.trace import TRACER
 
@@ -68,14 +42,13 @@ PREFIX_COW_COPIES_C = REGISTRY.counter(
 )
 PREFIX_EVICTIONS_C = REGISTRY.counter(
     "llm_prefix_evictions_total",
-    "Prefix-index entries evicted (LRU capacity pressure or superseded "
-    "by a longer published prefix); their page references return to "
-    "the pool",
+    "Prefix entries/nodes evicted (LRU capacity or byte-budget "
+    "pressure); their page references return to the pool",
 )
 PREFIX_SHARED_PAGES_G = REGISTRY.gauge(
     "llm_prefix_shared_pages",
     "Pages of the most recent page pool currently held by MORE than one "
-    "reader (prefix-index reference + sharer rows)",
+    "reader (prefix-store reference + sharer rows)",
 )
 
 
@@ -105,118 +78,3 @@ def observe_hit(tokens: int, pages: int, cow: bool) -> None:
             shared_pages=pages,
             cow=cow,
         )
-
-
-class PrefixEntry:
-    """One published prompt: its token ids, the publisher's pool pages
-    for the prompt's FULL page-aligned chunks (empty for contiguous
-    sessions), and the bf16 seed slabs ``[L, Hkv, len(ids), D]``. The
-    entry owns one reference on each page (taken at publish, dropped at
-    eviction/close)."""
-
-    __slots__ = ("ids", "pages", "k_seed", "v_seed", "stamp")
-
-    def __init__(self, ids, pages, k_seed, v_seed, stamp) -> None:
-        self.ids: List[int] = list(ids)
-        self.pages: List[int] = list(pages)
-        self.k_seed = k_seed
-        self.v_seed = v_seed
-        self.stamp = stamp
-
-
-class PrefixIndex:
-    """Longest-match map over published prompt prefixes (session-scoped
-    — see the module docstring). Not thread-safe on its own: every
-    caller already holds the scheduler's backend lock around session
-    admission, the only place the index mutates."""
-
-    def __init__(self, capacity: int = 16) -> None:
-        self.capacity = max(1, int(capacity))
-        self._entries: List[PrefixEntry] = []
-        self._clock = 0
-
-    def __len__(self) -> int:
-        return len(self._entries)
-
-    @property
-    def pages_held(self) -> int:
-        return sum(len(e.pages) for e in self._entries)
-
-    def debug_state(self) -> dict:
-        return {
-            "entries": len(self._entries),
-            "capacity": self.capacity,
-            "pages_held": self.pages_held,
-            "tokens_indexed": sum(len(e.ids) for e in self._entries),
-        }
-
-    # -- lookup ---------------------------------------------------------------
-    def match(
-        self, prompt_ids: "List[int]"
-    ) -> "Optional[Tuple[PrefixEntry, int]]":
-        """Longest (entry, common-token-count) whose ids share a prefix
-        with ``prompt_ids``. Side-effect free — ``can_join`` probes it;
-        :meth:`touch` refreshes recency when the hit is consumed."""
-        best: Optional[Tuple[PrefixEntry, int]] = None
-        for entry in self._entries:
-            common = common_prefix_len(entry.ids, prompt_ids)
-            if common and (best is None or common > best[1]):
-                best = (entry, common)
-        return best
-
-    def touch(self, entry: PrefixEntry) -> None:
-        self._clock += 1
-        entry.stamp = self._clock
-
-    # -- publish / evict ------------------------------------------------------
-    def publish(self, ids, pages, k_seed, v_seed, pool=None) -> bool:
-        """Index a completed prompt prefill. ``pages`` are the
-        publisher's pool pages covering the prompt's full page-aligned
-        chunks (the index takes its own ``pool.share`` reference on
-        each); ``k_seed``/``v_seed`` are the prompt's pre-quantization
-        K/V ``[L, Hkv, s_real, D]``. Entries this one fully covers
-        (their ids a prefix of ``ids``) are superseded and released;
-        over-capacity evicts LRU. Returns False when an existing entry
-        already covers ``ids`` (its recency refreshes instead)."""
-        ids = list(ids)
-        for entry in self._entries:
-            if common_prefix_len(entry.ids, ids) == len(ids):
-                self.touch(entry)  # already covered — keep the hot entry
-                return False
-        if pool is not None and pages:
-            pool.share(pages)
-        self._clock += 1
-        new = PrefixEntry(ids, pages, k_seed, v_seed, self._clock)
-        superseded = [
-            e
-            for e in self._entries
-            if common_prefix_len(e.ids, ids) == len(e.ids)
-        ]
-        for entry in superseded:
-            self._evict(entry, pool)
-        self._entries.append(new)
-        while len(self._entries) > self.capacity:
-            victim = min(self._entries, key=lambda e: e.stamp)
-            self._evict(victim, pool)
-        return True
-
-    def _evict(self, entry: PrefixEntry, pool) -> None:
-        self._entries.remove(entry)
-        if pool is not None and entry.pages:
-            pool.free(entry.pages)
-        PREFIX_EVICTIONS_C.inc()
-        if _obs_enabled():
-            FLIGHT.emit(
-                EV_PREFIX_EVICT,
-                tokens=len(entry.ids),
-                pages=len(entry.pages),
-            )
-
-    def release_all(self, pool=None) -> None:
-        """Drop every entry (session close): page references return to
-        the pool so the free-count is exactly restored. Not counted as
-        evictions — nothing was displaced."""
-        for entry in self._entries:
-            if pool is not None and entry.pages:
-                pool.free(entry.pages)
-        self._entries.clear()

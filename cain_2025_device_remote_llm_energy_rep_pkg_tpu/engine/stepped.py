@@ -106,7 +106,7 @@ class _PendingJoin:
     abort frees them. With a shared-prefix hit, ``hit_tokens`` leading
     positions were SEEDED instead of computed (the chunk list starts at
     the divergence) and the first ``shared_pages`` entries of ``pages``
-    are read-only mappings of the index entry's pool pages (one
+    are read-only mappings of the prefix store's pool pages (one
     ``pool.share`` reference each — ``pool.free`` on abort/retire drops
     exactly that reference)."""
 
@@ -167,9 +167,9 @@ class PreemptedRow:
     remaining budget) plus — under the ``swap`` policy — its KV payload
     (pool-page blob / contiguous row slab / stacked side-cache row).
     Shared CoW prefix pages are never swapped: their indices are
-    recorded (``shared_pages``) so resume re-shares them from the prefix
-    index, falling back to full recompute when the index entry has been
-    evicted in the meantime."""
+    recorded (``shared_pages``) so resume re-shares them from the ENGINE
+    prefix store, falling back to full recompute when the store has
+    moved on (spill with different pages, eviction) in the meantime."""
 
     __slots__ = (
         "request", "ids", "generated", "prompt_len", "offsets",
@@ -227,7 +227,7 @@ class _Row:
         self.pages: List[int] = pages or []
         # egress cursor: tokens already handed out via stream_deltas()
         self.streamed = 0
-        # leading table-row pages mapped read-only from the prefix index
+        # leading table-row pages mapped read-only from the prefix store
         # (preemption releases these instead of swapping them)
         self.shared = shared
 
@@ -320,18 +320,14 @@ class SteppedDecodeSession:
         self._pending: Dict[int, _PendingJoin] = {}
         self.use_top_p = False
         self.use_rp = False
-        # Shared-prefix index (ISSUE 7, engine/prefix.py): session-scoped
-        # longest-match map of published prompt prefixes. None when
+        # Persistent cross-session prefix store (ISSUE 14,
+        # engine/radix_store.py): ENGINE-owned — this session consults
+        # and publishes to it, but never owns it; hits survive the
+        # session, its pool, and scheduler restarts. None when
         # engine.prefix_share is off — every prefix code path below
         # guards on it, so the off configuration is bit-for-bit the
         # pre-ISSUE-7 session.
-        self.prefix = None
-        if getattr(engine, "prefix_share", False):
-            from .prefix import PrefixIndex
-
-            self.prefix = PrefixIndex(
-                getattr(engine, "prefix_index_entries", 16)
-            )
+        self.store = getattr(engine, "prefix_store", None)
         # Streaming egress (serve/stream.py): the scheduler flips
         # stream_tokens on while any live ticket streams; only then do
         # retirements buffer their tail deltas for the next
@@ -616,7 +612,8 @@ class SteppedDecodeSession:
             )
         self.k_cache, self.v_cache = k_cache, v_cache
         self._open_common(requests, states, pad)
-        if self.prefix is not None:
+        if self.store is not None:
+            self.store.attach_pool(self.model, None)
             for ids, st, row in zip(all_ids, states, self.rows):
                 self._publish_prefix(
                     ids, st["k_cache"], st["v_cache"], row.pages
@@ -760,7 +757,8 @@ class SteppedDecodeSession:
         self._open_common(requests, states, pad)
         for row, pages in zip(self.rows, row_pages):
             row.pages = pages
-        if self.prefix is not None:
+        if self.store is not None:
+            self.store.attach_pool(self.model, self.pool)
             for ids, st, row in zip(all_ids, states, self.rows):
                 self._publish_prefix(
                     ids, st["k_cache"], st["v_cache"], row.pages
@@ -803,59 +801,58 @@ class SteppedDecodeSession:
             return -(-max(s_real, 1) // page)
         return -(-(s_real + max_new_tokens) // page)
 
-    # -- shared-prefix index (engine/prefix.py, ISSUE 7) -----------------------
-    def _publish_prefix(
-        self, ids, k_cache, v_cache, pages, page_cap: Optional[int] = None
-    ) -> None:
-        """Index a completed prompt prefill: full page-aligned prompt
-        pages (safe to share — prefill wrote them and neither layout
-        writes a FULL prompt page again: decode appends land at
-        positions >= s_real) plus the bf16 seed slab the divergent-tail
-        prefill of a future sharer attends through. ``k_cache`` is the
-        row's PRE-QUANTIZATION private cache ``[L, 1, Hkv, S, D]``.
+    # -- persistent prefix store (engine/radix_store.py, ISSUE 14) -------------
+    def _publish_prefix(self, ids, k_cache, v_cache, pages) -> None:
+        """Publish a completed prompt prefill to the ENGINE store: full
+        page-aligned prompt pages (safe to share — prefill wrote them
+        and neither layout writes a FULL prompt page again: decode
+        appends land at positions >= s_real) plus the bf16 seed slab
+        the divergent-tail prefill of a future sharer attends through.
+        ``k_cache`` is the row's PRE-QUANTIZATION private cache
+        ``[L, 1, Hkv, S, D]``.
 
-        ``page_cap`` bounds how many leading pages the entry references:
-        a JOINER's publish is capped at the pages it itself mapped from
-        the index (already index-held), so a sharer's own tail pages are
-        never pinned past its retirement — that is what keeps the exact
-        free-count restoration invariant ("N sharers admitted then all
-        retired restores the pool") while its seed slab still covers the
-        full prompt for future compute reuse. Anchors (session open)
-        publish uncapped — their prompt pages outliving them is the
-        feature."""
+        Publication is UNCAPPED (ISSUE 14): a joiner's own divergent-
+        tail pages are adopted by the store too, so a second-generation
+        sharer maps the first sharer's tail pages read-only. The store
+        holds one refcount per adopted page — they outlive the
+        publisher's retirement and return to the pool only at store
+        spill/eviction (or pool detach at close)."""
         s_real = len(ids)
-        if self.prefix is None or s_real < 2:
+        if self.store is None or s_real < 2:
             return
         k_seed = k_cache[:, 0, :, :s_real]
         v_seed = v_cache[:, 0, :, :s_real]
         if self.paged:
             full = s_real // self.page_size
-            if page_cap is not None:
-                full = min(full, page_cap)
-            self.prefix.publish(
-                ids, pages[:full], k_seed, v_seed, self.pool
+            self.store.publish(
+                self.model, ids, k_seed, v_seed, pages[:full], self.pool
             )
         else:
-            self.prefix.publish(ids, [], k_seed, v_seed, None)
+            self.store.publish(self.model, ids, k_seed, v_seed, None, None)
 
     def _prefix_hit(self, ids: "List[int]"):
-        """Longest usable index hit for ``ids``: ``(entry, common,
-        shared_full_pages)`` with ``common`` capped so at least one tail
+        """Longest usable store hit for ``ids`` as a PLAN dict —
+        ``{"common", "hbm_lead", "restore_nodes", "restore_pages",
+        "full_pages"}`` — with ``common`` capped so at least one tail
         token is still computed (prefill must produce last-position
-        logits), or None. Side-effect free — ``can_join`` probes it."""
-        if self.prefix is None:
+        logits), or None. Side-effect free — ``can_join`` probes it;
+        ``join_begin`` executes it (restores + page mapping)."""
+        if self.store is None:
             return None
-        m = self.prefix.match(ids)
-        if m is None:
-            return None
-        entry, common = m
+        common = self.store.match_len(self.model, ids)
         common = min(common, len(ids) - 1)
         if common <= 0:
             return None
-        shared = 0
+        plan = {
+            "common": common,
+            "hbm_lead": [],
+            "restore_nodes": [],
+            "restore_pages": 0,
+            "full_pages": 0,
+        }
         if self.paged:
-            shared = min(common // self.page_size, len(entry.pages))
-        return entry, common, shared
+            plan.update(self.store.page_plan(self.model, ids, common))
+        return plan
 
     # -- introspection --------------------------------------------------------
     @property
@@ -975,8 +972,11 @@ class SteppedDecodeSession:
                     "pages": self.pool.n_pages,
                     "occupancy": state["pool"]["occupancy"],
                 }
-        if self.prefix is not None:
-            state["prefix"] = self.prefix.debug_state()
+        if self.store is not None:
+            # the ENGINE store's snapshot (node count, depth, bytes by
+            # tier) — session-independent state, surfaced here so one
+            # /debug/state probe shows what a joiner could hit RIGHT NOW
+            state["prefix_store"] = self.store.debug_state()
         return state
 
     def _verify_mode(self) -> str:
@@ -1411,7 +1411,7 @@ class SteppedDecodeSession:
         contiguous row slab / stacked side-cache row copied to host).
         Shared CoW prefix pages are refcounted by other readers and are
         RELEASED, never swapped — resume re-shares them from the prefix
-        index. ``policy="recompute"`` captures no payload (the KV is
+        store. ``policy="recompute"`` captures no payload (the KV is
         re-prefilled from prompt + generated tokens at resume).
 
         Returns None — and leaves the row running — when the row cannot
@@ -1534,40 +1534,43 @@ class SteppedDecodeSession:
             return None
         if not self.paged:
             if pr.policy == "swap" and pr.cache_blob is not None:
-                return {"mode": "swap", "need": 0, "entry": None}
+                return {"mode": "swap", "need": 0, "reshare": False}
             from .jax_engine import _prompt_alloc
 
             if _prompt_alloc(self.s_prefilled(pr)) > self.cache_len:
                 return None
-            return {"mode": "recompute", "need": 0, "entry": None}
+            return {"mode": "recompute", "need": 0, "reshare": False}
         total_need = self._pages_needed(
             len(pr.ids), pr.request.max_new_tokens
         )
         if pr.policy == "swap":
             if not pr.shared_pages:
-                return {"mode": "swap", "need": pr.n_own_pages, "entry": None}
-            if self.prefix is not None:
-                m = self.prefix.match(pr.ids)
-                if m is not None:
-                    entry, _common = m
-                    held = list(entry.pages[: len(pr.shared_pages)])
-                    if held == list(pr.shared_pages) and all(
-                        self.pool.refcount(p) >= 1 for p in held
-                    ):
-                        return {
-                            "mode": "swap",
-                            "need": pr.n_own_pages,
-                            "entry": entry,
-                        }
-            # the shared prefix left the index while the victim was
-            # parked: its pages may have been recycled — degrade to a
-            # full recompute (stacked sessions cannot, see preempt)
+                return {"mode": "swap", "need": pr.n_own_pages, "reshare": False}
+            if self.store is not None:
+                # the victim's released shared pages must STILL be the
+                # store's leading device-resident run for this prompt —
+                # ids drifted (spill, eviction, a different restore)
+                # means the captured mapping is stale
+                run = self.store.hbm_run(self.model, pr.ids)
+                held = run[: len(pr.shared_pages)]
+                if held == list(pr.shared_pages) and all(
+                    self.pool.refcount(p) >= 1 for p in held
+                ):
+                    return {
+                        "mode": "swap",
+                        "need": pr.n_own_pages,
+                        "reshare": True,
+                    }
+            # the shared prefix left the store (or spilled) while the
+            # victim was parked: its pages may have been recycled —
+            # degrade to a full recompute (stacked sessions cannot,
+            # see preempt)
             if self.stacked:
                 return None
-            return {"mode": "recompute", "need": total_need, "entry": None}
+            return {"mode": "recompute", "need": total_need, "reshare": False}
         if self.stacked:
             return None
-        return {"mode": "recompute", "need": total_need, "entry": None}
+        return {"mode": "recompute", "need": total_need, "reshare": False}
 
     def can_resume(self, pr: "PreemptedRow") -> bool:
         """Whether the preempted row fits back RIGHT NOW (free slot +
@@ -1587,7 +1590,7 @@ class SteppedDecodeSession:
     ) -> _PendingJoin:
         """Start re-admitting a preempted row through the chunked-join
         machinery: reserve a free slot and its pages (swap: the blob's
-        page count, shared prefix pages re-shared from the index;
+        page count, shared prefix pages re-shared from the store;
         recompute: the row's full footprint), and — recompute only —
         split the re-prefill of prompt + generated-so-far into
         token-budgeted chunks that interleave with decode slices like
@@ -1618,8 +1621,8 @@ class SteppedDecodeSession:
                 own = self.pool.alloc(pr.n_own_pages)
                 if pr.shared_pages:
                     self.pool.share(pr.shared_pages)
-                    if plan["entry"] is not None:
-                        self.prefix.touch(plan["entry"])
+                    if plan.get("reshare") and self.store is not None:
+                        self.store.touch(self.model, pr.ids)
                 pages = list(pr.shared_pages) + own
             else:
                 pages = self.pool.alloc(plan["need"])
@@ -1833,13 +1836,23 @@ class SteppedDecodeSession:
         if self.stacked and request.max_new_tokens - 1 > self.g_bucket:
             return False  # the side caches hold g_bucket columns
         need = self._pages_needed(ids_len, request.max_new_tokens)
-        # Shared-prefix billing: pages mapped from the index are billed
-        # ONCE (the publisher/index already hold them) — only the
-        # divergent tail's pages come off the free list. The table row
-        # still holds every page, so the jmax bound uses the full need.
+        if need > self.jmax:
+            return False
+        # Shared-prefix billing (unchanged from ISSUE 7): pages mapped
+        # from the store are billed ONCE — only the divergent tail's
+        # pages come off the free list. Spilled prefix nodes add their
+        # RESTORE pages to the free-list requirement (store pages, not
+        # row pages); when a restore would not fit, the plan degrades
+        # to the already-resident leading run, then to seed-only.
         hit = self._prefix_hit(ids)
-        own = need - (hit[2] if hit is not None else 0)
-        return need <= self.jmax and own <= self.pool.free_pages
+        free = self.pool.free_pages
+        if hit is None:
+            return need <= free
+        own_full = need - hit["full_pages"]
+        if own_full + hit["restore_pages"] <= free:
+            return True
+        # degraded plan: map only the already-resident leading run
+        return need - len(hit["hbm_lead"]) <= free
 
     def join(self, request: GenerationRequest) -> int:
         """Admit ``request`` into a free slot, paying the WHOLE prompt
@@ -1894,13 +1907,20 @@ class SteppedDecodeSession:
         chunk = _floor_bucket(
             int(chunk_tokens or JOIN_PREFILL_CHUNK_TOKENS), PROMPT_BUCKETS
         )
-        # Shared-prefix hit (engine/prefix.py): the leading `common`
-        # positions are SEEDED from the index entry's slab instead of
-        # recomputed — the chunk list covers only the divergent tail,
-        # at absolute offsets (join_step's prefill already takes any
-        # start offset against the partially-filled private cache).
+        # Shared-prefix hit (engine/radix_store.py): the leading
+        # `common` positions are SEEDED from the store's slab instead
+        # of recomputed — the chunk list covers only the divergent
+        # tail, at absolute offsets (join_step's prefill already takes
+        # any start offset against the partially-filled private cache).
         hit = self._prefix_hit(ids)
-        entry, common, shared = hit if hit is not None else (None, 0, 0)
+        seed = None
+        if hit is not None:
+            # fetch the host seed BEFORE committing to the plan: a hit
+            # whose path raced an eviction degrades to a plain join
+            seed = self.store.seed(self.model, ids, hit["common"])
+            if seed is None:
+                hit = None
+        common = hit["common"] if hit is not None else 0
 
         def _tail_chunks(common_, chunk_):
             return [
@@ -1927,35 +1947,63 @@ class SteppedDecodeSession:
                     common -= 1
                     chunks = _tail_chunks(common, None)
                 if common == 0:
-                    entry, shared = None, 0
+                    hit = None
         pages: List[int] = []
+        shared = 0
         if self.paged:
             need = self._pages_needed(len(ids), request.max_new_tokens)
+            shared_ids: List[int] = []
+            if hit is not None and common // self.page_size:
+                # SPILLED prefix nodes on the matched path swap back in
+                # first (fresh store pages — llm_prefix_store_restores);
+                # a restore that no longer fits degrades the plan to the
+                # already-resident leading run. pool.k/v are replaced by
+                # a swap-in scatter, so the carry re-syncs + re-pins.
+                own_full = need - hit["full_pages"]
+                if (
+                    hit["restore_nodes"]
+                    and own_full + hit["restore_pages"]
+                    <= self.pool.free_pages
+                ):
+                    self.store.restore(self.model, ids, common)
+                    self.carry["pool_k"] = self.pool.k
+                    self.carry["pool_v"] = self.pool.v
+                    self._recommit_carry()
+                plan = self.store.page_plan(self.model, ids, common)
+                shared_ids = plan["hbm_lead"]
+            shared = len(shared_ids)
             pages = self.pool.alloc(need - shared)
             if shared:
                 # map the read-only prefix pages into this row: one
                 # reference per sharer — recycled only when the LAST
-                # reader (rows, index entry) frees them
-                self.pool.share(entry.pages[:shared])
-                pages = list(entry.pages[:shared]) + pages
+                # reader (rows, store nodes) frees them
+                self.pool.share(shared_ids)
+                pages = list(shared_ids) + pages
         tf = eng._models[self.model]
         k_cache, v_cache = tf.init_cache(1, cache_len, dtype=eng.dtype)
         k_cache, v_cache = eng._place_cache(k_cache, v_cache, self.cfg)
-        if common:
-            # seed the private prefill cache with the entry's exact
+        if common and hit is not None:
+            # seed the private prefill cache with the store's exact
             # pre-quantization K/V: the tail prefill attends to the
-            # prefix at solo precision (token parity, incl. int8 pools)
+            # prefix at solo precision (token parity, incl. int8 pools).
+            # The contiguous overflow loop above may have REDUCED
+            # common — the slab slices down to it.
+            k_seed, v_seed = seed
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache,
-                entry.k_seed[:, None, :, :common, :].astype(k_cache.dtype),
+                jnp.asarray(k_seed[:, :, :common])[:, None].astype(
+                    k_cache.dtype
+                ),
                 (0, 0, 0, 0, 0),
             )
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache,
-                entry.v_seed[:, None, :, :common, :].astype(v_cache.dtype),
+                jnp.asarray(v_seed[:, :, :common])[:, None].astype(
+                    v_cache.dtype
+                ),
                 (0, 0, 0, 0, 0),
             )
-            self.prefix.touch(entry)
+            self.store.record_hit(self.model, ids)
             from .prefix import observe_hit
 
             # CoW: seeded positions past the last SHARED page boundary
@@ -1966,6 +2014,8 @@ class SteppedDecodeSession:
                 shared,
                 cow=self.paged and common > shared * self.page_size,
             )
+        else:
+            common = 0
         presence = jnp.zeros((1, self.cfg.vocab_size), dtype=bool)
         if request.repeat_penalty != 1.0:
             presence = presence.at[0, jnp.asarray(ids)].set(True)
@@ -2150,14 +2200,15 @@ class SteppedDecodeSession:
             prefill_s=pending.prefill_s,
             shared_pages=pending.shared_pages,
         )
-        if self.prefix is not None:
+        if self.store is not None:
             # publish at join-commit: the next sharer can seed from THIS
             # prompt's slab (the seeded prefix region is in the private
-            # cache too, so the slab is complete). Page references are
-            # capped at the already-shared region — see _publish_prefix.
+            # cache too, so the slab is complete) AND map this joiner's
+            # own divergent-tail pages — publication is page-backed,
+            # uncapped (ISSUE 14).
             self._publish_prefix(
                 pending.ids, pending.k_cache, pending.v_cache,
-                pending.pages, page_cap=pending.shared_pages,
+                pending.pages,
             )
         return r
 
@@ -2191,7 +2242,7 @@ class SteppedDecodeSession:
         """Scatter a prefilled solo cache into slot ``r`` and set every
         per-row device/host field — the shared tail of the one-shot and
         chunked joins. The first ``shared_pages`` page entries are
-        READ-ONLY mappings of index-held prefix pages: they are skipped
+        READ-ONLY mappings of store-held prefix pages: they are skipped
         by the scatter (their content is the publisher's — writing them
         would be a write to shared state) and the private cache's
         positions past that boundary — the copy-on-write partial page
@@ -2362,10 +2413,13 @@ class SteppedDecodeSession:
                 if pending.pages:
                     self.pool.free(pending.pages)
                     pending.pages = []
-        if self.prefix is not None:
-            # the index's own page references return LAST so the pool
-            # free-count is exactly restored (refcounts hit zero here)
-            self.prefix.release_all(self.pool if self.paged else None)
+        if self.store is not None:
+            # detach LAST, with every row/pending reference already
+            # freed: the store is now each adopted page's SOLE holder,
+            # so its device-resident nodes SPILL to host blobs (the
+            # swap frees their pages — the pool free-count is exactly
+            # restored) and survive this session for the next one
+            self.store.detach_pool(self.model, self.pool if self.paged else None)
         self._pending.clear()
         self._stream_tail.clear()
         self.rows = [None] * len(self.rows)

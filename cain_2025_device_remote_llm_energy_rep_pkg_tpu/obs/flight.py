@@ -68,7 +68,11 @@ EV_REPLICA_DRAINED = "replica_drained"  # drain() completed: in-flight
 #   rows finished and the replica detached from the fleet
 EV_POOL_EXHAUSTED = "pool_exhausted"  # PagePool refused an allocation
 EV_PREFIX_HIT = "prefix_hit"  # a joiner reused cached shared-prefix KV
-EV_PREFIX_EVICT = "prefix_evict"  # a prefix-index entry was evicted (LRU)
+EV_PREFIX_EVICT = "prefix_evict"  # a prefix-store node was evicted (LRU)
+EV_PREFIX_SPILL = "prefix_spill"  # a cold prefix-store node's pages were
+#   swapped out to host RAM (ISSUE 14 — the LRU spill tier)
+EV_PREFIX_RESTORE = "prefix_restore"  # a spilled prefix-store node was
+#   swapped back into fresh pool pages on a hit
 EV_SPEC_ROUND = "spec_round"  # one speculative window's rounds/acceptance
 EV_SPEC_FALLBACK = "spec_fallback"  # session acceptance fell below the floor
 EV_STREAM_CHUNK = "stream_chunk"  # one egress push of a streaming row's

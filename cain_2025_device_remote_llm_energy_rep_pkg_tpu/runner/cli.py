@@ -224,14 +224,22 @@ commands:
                        LRU), --paged-kv (batched decode over a paged KV
                        pool: mixed-length batches stop paying the widest
                        row's padding),
-                       --prefix-share (shared-prefix CoW paging:
-                       continuous-session joiners whose prompt shares a
-                       published prefix map its refcounted read-only
-                       pool pages and chunk-prefill only the divergent
-                       tail; works with --paged-kv and --kv-quantize
+                       --prefix-share (persistent cross-session prefix
+                       store: the ENGINE owns a radix tree over
+                       refcounted pages — continuous-session joiners
+                       whose prompt shares a published prefix map its
+                       read-only pool pages and chunk-prefill only the
+                       divergent tail, INCLUDING joiners in a later
+                       session or after a scheduler restart; cold
+                       prefix pages spill to host RAM and restore on
+                       hit; works with --paged-kv and --kv-quantize
                        int8, seed-only reuse on contiguous caches) with
-                       --prefix-index-entries N the per-session index
+                       --prefix-index-entries N the per-model node
                        capacity (default 16, LRU),
+                       --prefix-store-hbm-bytes B the store's device
+                       budget (over-budget spills cold prefix pages to
+                       host) and --prefix-store-host-bytes B its host
+                       budget (over-budget evicts cold leaves),
                        --access-log (structured per-request log line:
                        method/path/status/duration; default off),
                        --no-telemetry (kill switch for /metrics, the
@@ -307,6 +315,8 @@ def serve_command(args: List[str]) -> None:
     prefix_cache = 0
     prefix_share = False
     prefix_index_entries = None
+    prefix_store_hbm_bytes = None  # engine prefix-store HBM byte budget
+    prefix_store_host_bytes = None  # engine prefix-store host byte budget
     access_log = False
     replicas = 1  # >1: a replica fleet behind the front-door router
     route_policy = None  # router default ("least-queue")
@@ -459,6 +469,22 @@ def serve_command(args: List[str]) -> None:
                 raise CommandError(
                     "serve: --prefix-index-entries expects a positive integer"
                 )
+        elif arg == "--prefix-store-hbm-bytes":
+            # device-byte budget of the ISSUE-14 engine prefix store:
+            # over-budget spills LRU-cold prefix pages to host RAM
+            prefix_store_hbm_bytes = int(next(it, "0"))
+            if prefix_store_hbm_bytes < 0:
+                raise CommandError(
+                    "serve: --prefix-store-hbm-bytes expects bytes >= 0"
+                )
+        elif arg == "--prefix-store-host-bytes":
+            # host-byte budget (spilled blobs + seed slabs): over-budget
+            # evicts LRU-cold prefix-store leaves outright
+            prefix_store_host_bytes = int(next(it, "0"))
+            if prefix_store_host_bytes < 0:
+                raise CommandError(
+                    "serve: --prefix-store-host-bytes expects bytes >= 0"
+                )
         elif arg == "--kv-quantize":
             kv_quantize = next(it, "int8")
             if kv_quantize == "none":
@@ -528,6 +554,9 @@ def serve_command(args: List[str]) -> None:
                     os.environ.get("FAKE_SPEC_ACCEPTANCE", "1.0")
                 ),
                 spec_accept_floor=spec_accept_floor,
+                prefix_share=prefix_share,
+                prefix_store_hbm_bytes=prefix_store_hbm_bytes,
+                prefix_store_host_bytes=prefix_store_host_bytes,
             )
         if backend_kind == "jax-tp":
             from ..parallel.mesh import MeshSpec, build_mesh
@@ -549,6 +578,16 @@ def serve_command(args: List[str]) -> None:
                     if prefix_index_entries is not None
                     else {}
                 ),
+                **(
+                    {"prefix_store_hbm_bytes": prefix_store_hbm_bytes}
+                    if prefix_store_hbm_bytes is not None
+                    else {}
+                ),
+                **(
+                    {"prefix_store_host_bytes": prefix_store_host_bytes}
+                    if prefix_store_host_bytes is not None
+                    else {}
+                ),
             )
         if backend_kind == "jax":
             from ..engine.jax_engine import JaxEngine
@@ -566,6 +605,16 @@ def serve_command(args: List[str]) -> None:
                 **(
                     {"prefix_index_entries": prefix_index_entries}
                     if prefix_index_entries is not None
+                    else {}
+                ),
+                **(
+                    {"prefix_store_hbm_bytes": prefix_store_hbm_bytes}
+                    if prefix_store_hbm_bytes is not None
+                    else {}
+                ),
+                **(
+                    {"prefix_store_host_bytes": prefix_store_host_bytes}
+                    if prefix_store_host_bytes is not None
                     else {}
                 ),
             )

@@ -306,6 +306,19 @@ class LocalReplica(Replica):
             pool = session.get("pool") or {}
             if "occupancy" in pool:
                 stats["pool_occupancy"] = pool["occupancy"]
+            if "pages" in pool:
+                stats["pool_pages"] = pool["pages"]
+        except Exception:  # noqa: BLE001 — probe only
+            pass
+        # store-held pages (ISSUE 14): a replica fat with REUSABLE
+        # prefixes must not be penalized like one fat with live traffic
+        # — least-pages discounts these from the occupancy figure
+        try:
+            store = getattr(self.backend, "prefix_store", None)
+            if store is not None:
+                stats["prefix_store_hbm_pages"] = int(
+                    store.debug_state().get("hbm_pages") or 0
+                )
         except Exception:  # noqa: BLE001 — probe only
             pass
         # live J/token (least-joules): engines — real AND fake — publish
@@ -365,6 +378,14 @@ class RemoteReplica(Replica):
             occ = sample_value(families, "llm_paged_pool_occupancy")
             if occ is not None:
                 stats["pool_occupancy"] = occ
+            pages = sample_value(families, "llm_paged_pool_pages")
+            if pages is not None:
+                stats["pool_pages"] = pages
+            store_pages = sample_value(
+                families, "llm_prefix_store_hbm_pages"
+            )
+            if store_pages is not None:
+                stats["prefix_store_hbm_pages"] = store_pages
             jpt = histogram_mean(
                 families, "llm_request_joules_per_token"
             )
@@ -560,9 +581,21 @@ class Router:
         if self.policy == "least-pages":
             occ = stats.get("pool_occupancy")
             if occ is not None:
+                occ = float(occ)
+                # discount STORE-held pages (ISSUE 14): they back
+                # reusable prefixes, not live traffic — a replica hot
+                # with cached prefixes is MORE attractive for matching
+                # traffic, certainly not less, so only live-row pages
+                # count as load
+                store_pages = stats.get("prefix_store_hbm_pages")
+                total = stats.get("pool_pages")
+                if store_pages and total:
+                    occ = max(
+                        0.0, occ - float(store_pages) / float(total)
+                    )
                 # occupancy in [0,1]; outstanding breaks ties so two
                 # equally-full pools still alternate
-                return float(occ) * 1e6 + queue_load
+                return occ * 1e6 + queue_load
         elif self.policy == "least-joules":
             jpt = stats.get("joules_per_token")
             if jpt is not None:

@@ -1046,6 +1046,15 @@ class ContinuousScheduler(_SchedulerBase):
             )
         except Exception:  # noqa: BLE001 — probe only
             state["backend_mesh"] = None
+        # the ENGINE-owned prefix store (ISSUE 14) rides the backend —
+        # a scheduler restart builds a new loop over the same backend,
+        # so this block (and the hits it promises) survives it
+        try:
+            store = getattr(self.backend, "prefix_store", None)
+            if store is not None:
+                state["prefix_store"] = store.debug_state()
+        except Exception:  # noqa: BLE001 — probe only
+            pass
         dbg = self._dbg
         if dbg is None:
             state["session"] = None

@@ -310,6 +310,16 @@ class GenerationServer:
                         state["mesh"] = info
                 except Exception:  # noqa: BLE001 — probe only
                     pass
+                # persistent prefix store (ISSUE 14): ENGINE-owned, so
+                # its snapshot is reported top-level — present between
+                # sessions and across scheduler restarts, exactly the
+                # lifetime the store exists to provide
+                try:
+                    store = getattr(server.backend, "prefix_store", None)
+                    if store is not None:
+                        state["prefix_store"] = store.debug_state()
+                except Exception:  # noqa: BLE001 — probe only
+                    pass
                 try:
                     if server._scheduler is not None:
                         state["scheduler"] = server._scheduler.debug_state()

@@ -456,6 +456,48 @@ def channel_chunks(channel):
     return gen()
 
 
+def session_segments(
+    workload: List[Tuple[float, GenerationRequest]], sessions: int
+) -> List[List[Tuple[float, GenerationRequest]]]:
+    """Split one seeded trace into ``sessions`` contiguous SESSION
+    segments (arrival offsets re-based to each segment's start). The
+    driver runs each segment through a FRESH scheduler over the SAME
+    backend — the scheduler-restart shape the ISSUE-14 prefix store
+    must survive: requests in segment k+1 can only hit prefixes via
+    the engine store, never via session state."""
+    if sessions <= 1 or not workload:
+        return [workload]
+    per = -(-len(workload) // sessions)
+    out = []
+    for i in range(0, len(workload), per):
+        chunk = workload[i : i + per]
+        base = chunk[0][0]
+        out.append([(off - base, req) for off, req in chunk])
+    return out
+
+
+def prefix_store_counters() -> Dict[str, float]:
+    """Snapshot of the prefix-store metric families (the driver reports
+    the before/after DELTA as the summary's ``prefix_store`` block)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.prefix import (
+        PREFIX_HIT_TOKENS_C,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.radix_store import (
+        STORE_EVICTIONS_C,
+        STORE_HITS_C,
+        STORE_RESTORES_C,
+        STORE_SPILLS_C,
+    )
+
+    return {
+        "hit_tokens": PREFIX_HIT_TOKENS_C.labels().value,
+        "hits": STORE_HITS_C.labels().value,
+        "spills": STORE_SPILLS_C.labels().value,
+        "restores": STORE_RESTORES_C.labels().value,
+        "evictions": STORE_EVICTIONS_C.labels().value,
+    }
+
+
 def percentile(values: Sequence[float], p: float) -> float:
     """Nearest-rank percentile (deterministic, no interpolation)."""
     if not values:
@@ -647,6 +689,23 @@ def main() -> int:
         "instead of a live server (hermetic demo/CI)",
     )
     ap.add_argument(
+        "--sessions", type=int, default=1,
+        help="split the trace into N contiguous segments, each driven "
+        "through a FRESH scheduler over the same backend (scheduler "
+        "restart between segments — the ISSUE-14 prefix store must "
+        "carry hits across them); --fake only",
+    )
+    ap.add_argument(
+        "--prefix-share", action="store_true",
+        help="--fake: enable the fake backend's cross-session prefix "
+        "store; the summary gains a prefix_store hit/spill breakdown",
+    )
+    ap.add_argument(
+        "--prefix-store-hbm-bytes", type=int, default=None,
+        help="--fake: the fake store's device-byte budget (small values "
+        "force spills so the breakdown shows restore traffic)",
+    )
+    ap.add_argument(
         "--cancel-frac", type=float, default=0.0,
         help="fraction of requests that stream and hang up mid-flight "
         "(seeded; exercises disconnect-driven retirement)",
@@ -690,6 +749,7 @@ def main() -> int:
             after_tokens=(int(lo), int(hi or lo)),
             seed=args.seed,
         )
+    prefix_counters0 = None
     if args.fake:
         from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
             FakeBackend,
@@ -698,22 +758,43 @@ def main() -> int:
             ContinuousScheduler,
         )
 
-        sched = ContinuousScheduler(
-            FakeBackend(tokens_per_s=500.0, simulate_delay=True)
+        backend = FakeBackend(
+            tokens_per_s=500.0,
+            simulate_delay=True,
+            prefix_share=args.prefix_share,
+            prefix_store_hbm_bytes=args.prefix_store_hbm_bytes,
         )
-        sched.start()
-        try:
-            records = run_load(
-                sched.submit,
-                workload,
-                stream_submit=lambda req: channel_chunks(
-                    sched.submit_stream(req)
-                ),
-                cancellations=cancellations,
-            )
-        finally:
-            sched.stop()
-        target = "fake-continuous"
+        if args.prefix_share:
+            prefix_counters0 = prefix_store_counters()
+        records = []
+        # one scheduler per session segment over the SAME backend: a
+        # restart mid-trace is exactly what the engine store survives
+        for segment in session_segments(workload, max(1, args.sessions)):
+            if not segment:
+                continue
+            sched = ContinuousScheduler(backend)
+            sched.start()
+            try:
+                seg_cancellations = cancellations
+                if cancellations is not None and args.sessions > 1:
+                    seg_cancellations = None  # plans index the full trace
+                records.extend(
+                    run_load(
+                        sched.submit,
+                        segment,
+                        stream_submit=lambda req: channel_chunks(
+                            sched.submit_stream(req)
+                        ),
+                        cancellations=seg_cancellations,
+                    )
+                )
+            finally:
+                sched.stop()
+        target = (
+            f"fake-continuous×{args.sessions}"
+            if args.sessions > 1
+            else "fake-continuous"
+        )
     elif args.targets:
         from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.client import (
             RemoteHTTPBackend,
@@ -783,6 +864,14 @@ def main() -> int:
         ap.error("one of --url, --targets or --fake is required")
         return 2
     summary = summarize(records)
+    if prefix_counters0 is not None:
+        after = prefix_store_counters()
+        summary["prefix_store"] = {
+            key: round(after[key] - prefix_counters0[key], 2)
+            for key in after
+        }
+        if args.sessions > 1:
+            summary["prefix_store"]["sessions"] = args.sessions
     print(json.dumps({"load": "poisson", "target": target, **summary}))
     return 0 if summary["errors"] == 0 else 1
 

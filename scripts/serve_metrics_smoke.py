@@ -1273,6 +1273,126 @@ def main() -> int:
         server11_a.stop()
         server11_b.stop()
 
+    # -- phase 12: persistent cross-session prefix store (ISSUE 14) -----------
+    # Two SEQUENTIAL fake-server sessions: the first publishes a long
+    # system prompt and fully drains (its session closes); the second
+    # session's JOINER hits the backend-owned store — the hit counter
+    # moves and the shared-page gauge rises even though the publishing
+    # session is gone. Then a tightened HBM budget forces a SPILL on
+    # the next publications, and a later prefixed request RESTORES the
+    # spilled entry — spill/restore flight events trace-linked.
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.radix_store import (  # noqa: E501
+        STORE_HITS_C,
+        STORE_RESTORES_C,
+        STORE_SPILLS_C,
+    )
+
+    backend12 = FakeBackend(
+        tokens_per_s=200.0, simulate_delay=True, prefix_share=True
+    )
+    server12 = GenerationServer(
+        backend12, host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous",
+    )
+    server12.start()
+    try:
+        base12 = f"http://127.0.0.1:{server12.port}"
+        sys12 = "cross-session system prompt: " + "p" * 100 + " | "
+        hits0_12 = STORE_HITS_C.labels().value
+        # SESSION 1: publish, run to completion, session closes
+        _post_generate(base12, sys12 + "first question", 24)
+        deadline12 = time.monotonic() + 5.0
+        while time.monotonic() < deadline12:
+            if _get_json(base12, "/healthz").get("inflight_rows", 1) == 0:
+                break
+            time.sleep(0.02)
+        assert STORE_HITS_C.labels().value == hits0_12  # no self-hit
+        state12 = _get_json(base12, "/debug/state")
+        assert state12.get("prefix_store", {}).get("nodes", 0) >= 1, state12
+        # SESSION 2: anchor + staggered joiner; the JOINER's prompt hits
+        # the store cross-session (shared-page gauge rises mid-flight)
+        mid12 = {"shared_peak": 0.0}
+
+        def probe12():
+            end = time.monotonic() + 5.0
+            while time.monotonic() < end:
+                try:
+                    mid12["shared_peak"] = max(
+                        mid12["shared_peak"],
+                        _metric_value(
+                            _scrape(base12), "llm_prefix_shared_pages"
+                        ),
+                    )
+                except AssertionError:
+                    pass
+                time.sleep(0.02)
+
+        threads12 = [
+            threading.Thread(
+                target=lambda: _post_generate(
+                    base12, "an unrelated second-session anchor", 64
+                )
+            ),
+            threading.Thread(
+                target=lambda: (
+                    time.sleep(0.06),
+                    _post_generate(base12, sys12 + "second question", 32),
+                )
+            ),
+            threading.Thread(target=probe12),
+        ]
+        for t in threads12:
+            t.start()
+        for t in threads12:
+            t.join(timeout=30)
+        hits12 = STORE_HITS_C.labels().value - hits0_12
+        assert hits12 >= 1, "second session never hit the store"
+        assert mid12["shared_peak"] > 0, "shared-page gauge never rose"
+        text12 = _scrape(base12)
+        assert _metric_value(text12, "llm_prefix_store_nodes") >= 1
+        # hit event trace-linked to the JOINED ticket
+        hit_events12 = _get_json(
+            base12, "/debug/flight?n=500&type=prefix_hit"
+        )["events"]
+        admits12 = _get_json(
+            base12, "/debug/flight?n=500&type=request_admitted"
+        )["events"]
+        joined12 = {e.get("trace") for e in admits12 if e.get("joined")}
+        assert any(e.get("trace") in joined12 for e in hit_events12), (
+            hit_events12,
+            admits12,
+        )
+        # BUDGET PRESSURE: tighten the HBM budget, publish fresh
+        # prefixes — the LRU-cold entries spill to host
+        spills0_12 = STORE_SPILLS_C.labels().value
+        backend12.prefix_store.hbm_bytes = 4 * 1024  # ~4 fake pages
+        _post_generate(base12, "fresh prefix A " + "a" * 120, 8)
+        _post_generate(base12, "fresh prefix B " + "b" * 120, 8)
+        assert STORE_SPILLS_C.labels().value > spills0_12, "no spill"
+        text12b = _scrape(base12)
+        assert _metric_value(text12b, "llm_prefix_store_host_bytes") > 0
+        spill_events12 = _get_json(
+            base12, "/debug/flight?n=500&type=prefix_spill"
+        )["events"]
+        assert spill_events12 and spill_events12[-1].get("trace") is not None
+        # RESTORE: a later request re-using the ORIGINAL system prompt
+        # hits its (now spilled) entry and swaps it back in
+        restores0_12 = STORE_RESTORES_C.labels().value
+        _post_generate(base12, sys12 + "third question", 8)
+        restores12 = STORE_RESTORES_C.labels().value - restores0_12
+        assert restores12 >= 1, "spilled entry was not restored on hit"
+        restore_events12 = _get_json(
+            base12, "/debug/flight?n=500&type=prefix_restore"
+        )["events"]
+        assert restore_events12, "no prefix_restore flight event"
+        assert restore_events12[-1].get("trace") is not None
+        assert (
+            _metric_value(_scrape(base12), "llm_prefix_store_restores_total")
+            >= 1
+        )
+    finally:
+        server12.stop()
+
     print(
         json.dumps(
             {
@@ -1339,6 +1459,12 @@ def main() -> int:
                     "timeline_events": len(tl11["events"]),
                     "wasted_retry_joules": round(wasted_delta, 6),
                     "fleet_requests_total": fleet_req,
+                },
+                "prefix_store": {
+                    "cross_session_hits": int(hits12),
+                    "shared_pages_mid_flight": mid12["shared_peak"],
+                    "spill_events": len(spill_events12),
+                    "restore_events": len(restore_events12),
                 },
             }
         )

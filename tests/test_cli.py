@@ -441,6 +441,58 @@ def test_serve_prefix_share_knobs(monkeypatch):
         serve_command(["--prefix-index-entries", "0"])
 
 
+def test_serve_prefix_store_budget_knobs(monkeypatch):
+    """--prefix-store-hbm-bytes / --prefix-store-host-bytes reach the
+    ENGINE's persistent prefix store (ISSUE 14); bad budgets fail
+    fast; the fake backend builds a store too (hermetic CI)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured["backend"] = backend
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    cli.serve_command(
+        [
+            "--backend", "jax", "--port", "0",
+            "--prefix-share", "--paged-kv",
+            "--prefix-store-hbm-bytes", "1048576",
+            "--prefix-store-host-bytes", "2097152",
+        ]
+    )
+    store = captured["backend"].prefix_store
+    assert store is not None
+    assert store.hbm_bytes == 1048576
+    assert store.host_bytes == 2097152
+    assert store.scope == "engine"
+
+    captured.clear()
+    cli.serve_command(
+        [
+            "--backend", "fake", "--port", "0",
+            "--prefix-share", "--prefix-store-hbm-bytes", "4096",
+        ]
+    )
+    fake_store = captured["backend"].prefix_store
+    assert fake_store is not None and fake_store.hbm_bytes == 4096
+
+    with pytest.raises(CommandError, match="prefix-store-hbm-bytes"):
+        serve_command(["--prefix-store-hbm-bytes", "-1"])
+    with pytest.raises(CommandError, match="prefix-store-host-bytes"):
+        serve_command(["--prefix-store-host-bytes", "-1"])
+
+
 def test_prepare_cooldown_promise_matches_consumed_channels(monkeypatch, capsys):
     """prepare's policy line must reflect the channels the study's
     profilers actually WIRE (code-review round-4): a live battery/hwmon

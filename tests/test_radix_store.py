@@ -1,0 +1,319 @@
+"""RadixPrefixStore (ISSUE 14): the radix tree over refcounted page
+runs with host-RAM spill — data-structure behavior (longest match, node
+splitting, LRU budgets) and the byte-/free-count-exact spill round-trip
+on bf16 AND int8 pool layouts.
+
+Session-level integration (joiner hits, parity, preemption interplay)
+is pinned in tests/test_prefix.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+    JaxEngine,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.paged_kv import (
+    PagePool,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.radix_store import (
+    RadixPrefixStore,
+    STORE_EVICTIONS_C,
+    STORE_RESTORES_C,
+    STORE_SPILLS_C,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+
+PAGE = 128
+L, HKV, D = 1, 1, 4
+
+
+def _pool(n_pages=16, quantized=False):
+    return PagePool.create(
+        n_layers=L,
+        n_pages=n_pages,
+        n_kv_heads=HKV,
+        d_head=D,
+        page_size=PAGE,
+        quantized=quantized,
+    )
+
+
+def _seed(n_tokens, base=0.0):
+    k = np.arange(n_tokens, dtype=np.float32).reshape(1, 1, n_tokens, 1)
+    k = np.broadcast_to(k + base, (L, HKV, n_tokens, D)).copy()
+    return k, k + 0.5
+
+
+def _publish(store, pool, ids, base=0.0, model="m"):
+    """Publish like a session row would: alloc the prompt's full pages
+    (the 'row's' references), publish (the store adds its own), then
+    retire the row (free its refs) — the store's refs remain."""
+    k, v = _seed(len(ids), base)
+    full = len(ids) // PAGE
+    pages = pool.alloc(full) if full else []
+    store.publish(model, ids, k, v, pages, pool)
+    if pages:
+        pool.free(pages)
+    return pages
+
+
+# -- tree shape ----------------------------------------------------------------
+
+
+def test_longest_match_and_partial_edge():
+    store = RadixPrefixStore()
+    store.attach_pool("m", None)
+    k, v = _seed(4)
+    store.publish("m", [1, 2, 3, 4], k, v)
+    k, v = _seed(3)
+    store.publish("m", [1, 2, 9], k, v)
+    assert store.match_len("m", [1, 2, 3, 5, 6]) == 3
+    assert store.match_len("m", [7, 8]) == 0
+    assert store.match_len("other", [1, 2]) == 0
+    # publishing [1,2,9] split the first path at depth 2
+    state = store.debug_state()
+    assert state["nodes"] == 3 and state["depth"] == 4
+
+
+def test_publish_covered_refreshes_instead_of_inserting():
+    store = RadixPrefixStore()
+    store.attach_pool("m", None)
+    k, v = _seed(4)
+    assert store.publish("m", [1, 2, 3, 4], k, v) is True
+    k, v = _seed(3)
+    assert store.publish("m", [1, 2, 3], k, v) is False  # covered
+    assert store.debug_state()["nodes"] == 1
+
+
+def test_seed_concatenates_across_split_segments():
+    store = RadixPrefixStore()
+    store.attach_pool("m", None)
+    ids_a = list(range(10))
+    k, v = _seed(10)
+    store.publish("m", ids_a, k, v)
+    ids_b = list(range(6)) + [99, 98]
+    kb, vb = _seed(8, base=100.0)
+    store.publish("m", ids_b, kb, vb)  # splits at depth 6
+    assert store.debug_state()["nodes"] == 3
+    got_k, got_v = store.seed("m", ids_a, 10)
+    want_k, want_v = _seed(10)
+    np.testing.assert_array_equal(got_k, want_k)
+    np.testing.assert_array_equal(got_v, want_v)
+    # the diverged branch's tail positions come from ITS slab
+    got_k, _ = store.seed("m", ids_b, 8)
+    np.testing.assert_array_equal(got_k[:, :, 6:], kb[:, :, 6:])
+
+
+def test_node_capacity_evicts_lru_leaves():
+    store = RadixPrefixStore(capacity=2)
+    store.attach_pool("m", None)
+    ev0 = STORE_EVICTIONS_C.labels().value
+    for i, base in ((1, 0.0), (2, 10.0), (3, 20.0)):
+        k, v = _seed(2, base)
+        store.publish("m", [i, i], k, v)
+        store.touch("m", [1, 1])  # keep the first entry hot
+    assert store.debug_state()["nodes"] == 2
+    assert STORE_EVICTIONS_C.labels().value > ev0
+    assert store.match_len("m", [1, 1]) == 2  # the hot path survived
+
+
+# -- page runs, splitting, spill/restore ---------------------------------------
+
+
+@pytest.mark.parametrize("quantized", [False, True], ids=["bf16", "int8"])
+def test_publish_spill_restore_evict_is_pool_exact(quantized):
+    """The ISSUE-14 acceptance invariant at the store level: publish →
+    spill (host gauge rises, HBM pages freed) → restore (fresh pages)
+    → evict returns the pool and the store's byte ledgers exactly to
+    their idle values, with the restored payload BIT-IDENTICAL."""
+    pool = _pool(quantized=quantized)
+    store = RadixPrefixStore()
+    store.attach_pool("m", pool)
+    free_idle = pool.free_pages
+    host_idle = store.host_bytes_held
+    ids = list(range(260))  # 2 full pages + a partial
+    pages = pool.alloc(2)
+    k, v = _seed(260)
+    # write real payload into the publisher's pages so the spill blob
+    # round-trip is checkable bit-for-bit
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.paged_kv import (
+        _paginate,
+        quantize_chunks,
+        scatter_pages,
+    )
+
+    ck = _paginate(jnp.asarray(k), 256, PAGE)
+    cv = _paginate(jnp.asarray(v), 256, PAGE)
+    if quantized:
+        ck, cv = quantize_chunks(ck, cv)
+    pool.k, pool.v = scatter_pages(
+        pool.k, pool.v, jnp.asarray(pages, jnp.int32), ck, cv
+    )
+    want_k = np.asarray(
+        pool.k["q"][:, pages] if quantized else pool.k[:, pages]
+    ).copy()
+    store.publish("m", ids, k, v, pages, pool)
+    pool.free(pages)  # the publisher row retires
+    assert store.hbm_pages_held == 2
+    assert pool.free_pages == free_idle - 2  # store holds them
+    # SPILL (cold): pages leave the device, host bytes rise
+    spills0 = STORE_SPILLS_C.labels().value
+    store.detach_pool("m", pool)
+    assert STORE_SPILLS_C.labels().value == spills0 + 1
+    assert store.hbm_pages_held == 0
+    assert pool.free_pages == free_idle  # swap freed them
+    assert store.host_bytes_held > host_idle
+    # RESTORE on hit (fresh pool attach, fresh pages)
+    restores0 = STORE_RESTORES_C.labels().value
+    store.attach_pool("m", pool)
+    assert store.restore("m", ids, 260)
+    assert STORE_RESTORES_C.labels().value == restores0 + 1
+    run = store.hbm_run("m", ids)
+    assert len(run) == 2
+    assert pool.free_pages == free_idle - 2
+    got_k = np.asarray(
+        pool.k["q"][:, run] if quantized else pool.k[:, run]
+    )
+    np.testing.assert_array_equal(got_k, want_k)  # bit-exact round trip
+    # EVICT: everything returns to idle
+    store.release_all()
+    assert pool.free_pages == free_idle
+    assert store.host_bytes_held == 0
+    assert store.hbm_pages_held == 0
+
+
+def test_split_divides_page_run_between_top_and_bottom():
+    pool = _pool()
+    store = RadixPrefixStore()
+    store.attach_pool("m", pool)
+    ids = list(range(300))  # 2 full pages
+    pages = _publish(store, pool, ids)
+    # diverge at token 200 (inside page 1): top keeps page 0, the old
+    # node keeps page 1, the new leaf owns nothing (tail < 1 page)
+    ids_b = list(range(200)) + [999] * 30
+    _publish(store, pool, ids_b, base=50.0)
+    assert store.debug_state()["nodes"] == 3
+    run_a = store.hbm_run("m", ids)
+    assert run_a == pages  # full original run reassembled across nodes
+    run_b = store.hbm_run("m", ids_b)
+    assert run_b == pages[:1]  # the shared page only
+    store.release_all()
+    assert pool.free_pages == pool.n_pages
+
+
+def test_hbm_budget_spills_cold_nodes():
+    pool = _pool(n_pages=32)
+    page_bytes = pool.payload_nbytes() // pool.n_pages
+    store = RadixPrefixStore(hbm_bytes=2 * page_bytes)
+    store.attach_pool("m", pool)
+    _publish(store, pool, list(range(256)), base=0.0)  # 2 pages, cold
+    spills0 = STORE_SPILLS_C.labels().value
+    _publish(store, pool, [7] + list(range(300, 555)), base=9.0)  # 2 more
+    # over budget → the LRU-cold first node spilled to host
+    assert STORE_SPILLS_C.labels().value > spills0
+    assert store.hbm_pages_held <= 2
+    assert store.host_bytes_held > 0
+    state = store.debug_state()
+    assert state["tiers"]["host"] >= 1
+    store.release_all()
+    assert pool.free_pages == pool.n_pages
+
+
+def test_host_budget_evicts_lru_leaves():
+    store = RadixPrefixStore(host_bytes=1)  # practically nothing fits
+    store.attach_pool("m", None)
+    k, v = _seed(8)
+    store.publish("m", list(range(8)), k, v)
+    # seed bytes alone blow the budget → the leaf is evicted outright
+    assert store.debug_state()["nodes"] == 0
+    assert store.host_bytes_held == 0
+
+
+def test_session_scope_drops_tree_at_detach():
+    pool = _pool()
+    store = RadixPrefixStore(scope="session")
+    store.attach_pool("m", pool)
+    _publish(store, pool, list(range(256)))
+    assert store.debug_state()["nodes"] == 1
+    store.detach_pool("m", pool)
+    assert store.debug_state()["nodes"] == 0
+    assert pool.free_pages == pool.n_pages  # refs released, not spilled
+    assert store.host_bytes_held == 0
+
+
+def test_shared_pages_are_released_not_spilled_at_detach():
+    """A reader still mapping a store page at detach (abnormal close
+    order) blocks the swap — the store demotes to seed tier and drops
+    its reference; the reader's mapping stays valid."""
+    pool = _pool()
+    store = RadixPrefixStore()
+    store.attach_pool("m", pool)
+    _publish(store, pool, list(range(256)))
+    run = store.hbm_run("m", list(range(256)))
+    pool.share(run)  # a live row still reads the pages
+    spills0 = STORE_SPILLS_C.labels().value
+    store.detach_pool("m", pool)
+    assert STORE_SPILLS_C.labels().value == spills0  # swap refused
+    assert all(pool.refcount(p) == 1 for p in run)  # reader keeps its ref
+    pool.free(run)
+    assert pool.free_pages == pool.n_pages
+
+
+# -- engine-session restore path (page rebuild without a blob) -----------------
+
+
+def test_paged_hit_rebuilds_pages_from_seed_when_blob_gone():
+    """A node demoted to seed tier (no blob) still backs a paged hit:
+    the pages rebuild from the seed slab through the same
+    paginate→quantize path that wrote the originals — joiner parity
+    holds (the real-session end-to-end check)."""
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny(max_seq_len=512)}
+    eng = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, paged_kv=True,
+        prefix_share=True,
+    )
+    plain = JaxEngine(
+        registry=dict(registry), dtype=jnp.float32, paged_kv=True
+    )
+    shared = "s" * 140
+    anchor = GenerationRequest(
+        "tiny", shared + " anchor", max_new_tokens=16,
+        stop_at_eos=False, seed=1,
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    while sess.active:
+        sess.step(8)
+    sess.close()
+    # strip the blobs: every host node degrades to seed tier
+    for model in list(eng.prefix_store._trees):
+        for node in eng.prefix_store._nodes_of(model):
+            if node.blob is not None:
+                eng.prefix_store._host_bytes_used -= int(node.blob.nbytes)
+                node.blob = None
+    a2 = GenerationRequest(
+        "tiny", "x" * 170 + " fresh", max_new_tokens=16,
+        stop_at_eos=False, seed=2,
+    )
+    sess2 = eng.decode_open([a2], reserve_rows=4)
+    sess2.step(2)
+    joiner = GenerationRequest(
+        "tiny", shared + " rebuilt tail", max_new_tokens=10, seed=7
+    )
+    pj = sess2.join_begin(joiner, chunk_tokens=32)
+    assert pj.hit_tokens > 0 and pj.shared_pages >= 1
+    while not sess2.join_step(pj):
+        pass
+    sess2.join_commit(pj)
+    results = {}
+    while sess2.active:
+        for res in sess2.step(8):
+            results[id(res.request)] = res
+    assert results[id(joiner)].tokens == plain.generate(joiner).tokens
+    sess2.close()

@@ -534,3 +534,48 @@ def test_metrics_scrape_parser():
 def test_route_policy_validation():
     with pytest.raises(ValueError, match="route policy"):
         Router([], policy="fastest")
+
+
+def test_least_pages_discounts_store_held_prefix_pages():
+    """ISSUE 14 satellite: a replica fat with REUSABLE prefix pages
+    (llm_prefix_store_hbm_pages) is not penalized like one fat with
+    live traffic — least-pages discounts the store's holdings from the
+    occupancy figure, and falls back to raw occupancy when the store
+    gauges are absent."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router import (
+        Replica,
+    )
+
+    router = Router([], policy="least-pages")
+    hot_cache = Replica("hot_cache")
+    # 60% occupied, but 8 of its 16 pages are store-held prefixes →
+    # live-traffic load is only 10%
+    hot_cache.last_stats = {
+        "running": True,
+        "pool_occupancy": 0.6,
+        "pool_pages": 16,
+        "prefix_store_hbm_pages": 8,
+    }
+    live_traffic = Replica("live_traffic")
+    live_traffic.last_stats = {
+        "running": True,
+        "pool_occupancy": 0.4,
+        "pool_pages": 16,
+    }
+    assert router._load_key(hot_cache) < router._load_key(live_traffic)
+    # without the store gauge, raw occupancy decides (pre-ISSUE-14 rule)
+    hot_cache.last_stats.pop("prefix_store_hbm_pages")
+    assert router._load_key(hot_cache) > router._load_key(live_traffic)
+
+
+def test_local_replica_probe_reports_store_pages():
+    """LocalReplica.probe surfaces the backend store's device-resident
+    page count so the policy above has its figure in-process."""
+    backend = FakeBackend(prefix_share=True)
+    replica = LocalReplica("store_probe", backend)
+    try:
+        backend.prefix_store.probe(b"a shared system prompt " * 4)
+        stats = replica.probe()
+        assert stats.get("prefix_store_hbm_pages", 0) > 0
+    finally:
+        replica.close()
