@@ -1310,6 +1310,437 @@ def radix_prefix_bench() -> int:
     return 0
 
 
+def model_fleet_bench() -> int:
+    """A/B of ISSUE-15 multi-model fleet serving on ONE seeded mixed
+    trace (two tiny models — "small" and a 3×-deeper "big" — arrivals
+    and per-request model assignment drawn once by the
+    ``poisson_load --model-mix`` machinery, then shaped per phase).
+
+    TTFT phase (head-of-line blocking; big-anchor shaping — request 0
+    is a LONG big-model decode, the rest keep their seeded models and
+    gaps):
+    - ``small_solo``: only the trace's small-model requests, their own
+      scheduler — the small model's UNCONTENDED TTFT reference;
+    - ``serialized``: the full mixed trace through ONE model-affine
+      ContinuousScheduler (the pre-ISSUE-15 shape) — small tickets
+      queue behind the big model's whole session;
+    - ``fleet``: the same trace through the ModelFleetScheduler —
+      per-model lanes interleave decode slices under one backend lock,
+      so small TTFT p99 stays within ~1.2× of solo while the
+      serialized baseline blows up by multiples.
+
+    Energy phase (the paper's headline restated ONLINE; throughput
+    shaping — same arrivals/models, moderate budgets — at matched
+    token output across arms):
+    - ``always_big``: every request pinned to the big model (the
+      "serve everything from the flagship" default);
+    - ``auto_cheapest``: every request ``model:"auto"`` under
+      cheapest-joules;
+    - ``auto_small_first``: every request ``model:"auto"`` under the
+      small-first cascade — long-budget length-cut answers ESCALATE,
+      and the abandoned small-model work is COUNTED in the arm's J.
+
+    Fleet J is accounted at the FLEET level: one chip's idle power for
+    the arm's wall clock (concurrent rows share the idle window —
+    summing per-row solo estimates would bill it once per row and
+    penalise exactly the concurrency under test) plus each served
+    token's marginal compute/HBM energy at the SERVING model's config,
+    plus the escalated attempts' abandoned marginal work. Every arm
+    checks per-model token parity vs solo ``generate()`` and exact
+    per-model pool free-count restoration. CPU-functional; RELATIVE
+    positions are the result (docs/PERF.md "Multi-model fleet
+    serving"). Prints ONE JSON line.
+    """
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(
+        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "scripts")
+    )
+    import jax
+    import jax.numpy as jnp
+    from poisson_load import (
+        build_workload,
+        percentile,
+        run_load,
+        summarize,
+        synth_prompt,
+    )
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+        GenerationRequest,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import (
+        energy as obs_energy,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.model_fleet import (
+        ModelFleetScheduler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    on_accelerator = jax.default_backend() in ("tpu", "axon")
+    dtype = jnp.bfloat16 if on_accelerator else jnp.float32
+    tiny = get_model_config("qwen2:1.5b").tiny(max_seq_len=1024)
+    SMALL, BIG = "tiny-small", "tiny-big"
+    small_cfg = dataclasses.replace(tiny, name=SMALL)
+    # the "big" model: 3× the depth and twice the FFN — ~4× the weight
+    # stream, so its J/token is measurably higher and size ordering is
+    # unambiguous
+    big_cfg = dataclasses.replace(tiny, name=BIG, n_layers=6, d_ff=256)
+    registry = {SMALL: small_cfg, BIG: big_cfg}
+
+    n = int(_os.environ.get("BENCH_MF_REQUESTS", "7"))
+    mean_ms = float(_os.environ.get("BENCH_MF_INTERARRIVAL_MS", "250"))
+    small_budget = int(_os.environ.get("BENCH_MF_SMALL_BUDGET", "6"))
+    anchor_budget = int(_os.environ.get("BENCH_MF_ANCHOR_BUDGET", "400"))
+    small_prompt = int(_os.environ.get("BENCH_MF_SMALL_PROMPT", "256"))
+    escalate_floor = int(_os.environ.get("BENCH_MF_ESCALATE_TOKENS", "32"))
+    slice_steps = int(_os.environ.get("BENCH_MF_SLICE_STEPS", "1"))
+    chunk_tokens = int(_os.environ.get("BENCH_MF_CHUNK_TOKENS", "32"))
+    mix = {SMALL: 0.8, BIG: 0.2}
+    base_trace = build_workload(
+        n,
+        mean_ms / 1e3,
+        seed=11,
+        model=SMALL,
+        stop_at_eos=False,  # deterministic length-cut (the escalation
+        # trigger) — no dependence on tiny random weights sampling EOS
+        model_mix=mix,
+    )
+
+    def shape(budgets: "dict") -> list:
+        """Shape the ONE seeded trace for a phase: request 0 becomes
+        the BIG anchor (arriving 350 ms early), everyone else keeps
+        their seeded model and arrival gap; smalls carry a real prefill
+        (small_prompt tokens). ``budgets`` maps anchor/small/big/open
+        to token budgets — the last small request is the OPEN-ENDED one
+        (budget past the escalation floor) so the small-first cascade
+        escalates a FRACTION of auto traffic, not all of it."""
+        shaped = []
+        for i, (off, req) in enumerate(base_trace):
+            if i == 0:
+                shaped.append(
+                    (
+                        0.0,
+                        dataclasses.replace(
+                            req,
+                            model=BIG,
+                            prompt=synth_prompt(128),
+                            max_new_tokens=budgets["anchor"],
+                        ),
+                    )
+                )
+                continue
+            if req.model == BIG:
+                entry = dataclasses.replace(
+                    req, max_new_tokens=budgets["big"]
+                )
+            else:
+                entry = dataclasses.replace(
+                    req,
+                    prompt=synth_prompt(small_prompt) + f" q{i}",
+                    max_new_tokens=budgets["small"],
+                )
+            shaped.append((0.35 + off, entry))
+        for i in range(len(shaped) - 1, 0, -1):
+            off, req = shaped[i]
+            if req.model == SMALL:
+                shaped[i] = (
+                    off,
+                    dataclasses.replace(req, max_new_tokens=budgets["open"]),
+                )
+                break
+        return shaped
+
+    hol_trace = shape(
+        {
+            "anchor": anchor_budget,
+            "small": small_budget,
+            "big": 24,
+            "open": small_budget,
+        }
+    )
+    # throughput shaping for the energy arms: moderate budgets so no
+    # single request dominates the token mass
+    energy_trace = shape(
+        {"anchor": 64, "small": 24, "big": 24, "open": 48}
+    )
+    if not any(req.model == SMALL for _, req in hol_trace):
+        raise RuntimeError("seeded mix drew no small-model requests")
+
+    def fresh_engine() -> JaxEngine:
+        return JaxEngine(
+            registry=dict(registry),
+            dtype=dtype,
+            decode_attention="auto" if on_accelerator else None,
+            paged_kv=True,
+        )
+
+    # solo references: token-parity target + the marginal-energy source
+    # for abandoned (escalated) small attempts — one solo generate()
+    # per (model, request shape)
+    solo_eng = fresh_engine()
+    solo_results: dict = {}
+
+    def solo_for(model: str, req):
+        key = (model, req.prompt, req.seed, req.max_new_tokens)
+        if key not in solo_results:
+            solo_results[key] = solo_eng.generate(
+                dataclasses.replace(req, model=model)
+            )
+        return solo_results[key]
+
+    # Energy accounting, V5E-MODELLED (the repo's roofline convention —
+    # tp_continuous/spec_continuous record honest CPU walls NEXT TO the
+    # v5e prediction): a depth-reduced model's CPU wall is dispatch-
+    # dominated and cannot tell a 2-layer model from a 6-layer one, so
+    # each request is priced by the SAME run-table energy model the
+    # study uses, at the serving model's flops/bytes, over the v5e
+    # bandwidth-bound duration (decode is HBM-bound: t = bytes / BW).
+    # One chip serializes the fleet's compute, so per-request modelled
+    # windows sum without double-counting the idle power.
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.experiments.llm_energy import (  # noqa: E501
+        generation_stats_from,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.parallel.roofline import (  # noqa: E501
+        V5E_SUSTAINED_HBM_GBPS,
+    )
+
+    def modelled_j(model: str, result) -> float:
+        stats = generation_stats_from(registry[model], result)
+        if not stats or not stats.get("bytes"):
+            return 0.0
+        stats = {
+            **stats,
+            "duration_s": stats["bytes"] / (V5E_SUSTAINED_HBM_GBPS * 1e9),
+        }
+        est = obs_energy.estimate_from_stats(stats, n_chips=1)
+        return float(est["J"]) if est and est.get("J") else 0.0
+
+    def pool_restored(engine, model: str) -> bool:
+        """Exact per-model pool free-count restoration: open a session,
+        run every row to retirement — all row pages must be back on the
+        free list (only the session's parking page stays held)."""
+        sess = engine.decode_open(
+            [GenerationRequest(model, "restore probe", max_new_tokens=8)]
+        )
+        try:
+            while sess.active:
+                sess.step(8)
+            return sess.pool.free_pages == sess.pool.n_pages - 1
+        finally:
+            sess.close()
+
+    def run_arm(
+        name: str,
+        arm_trace,
+        policy: "str | None" = None,
+        resolved_model=None,
+    ):
+        """One arm: a fresh engine + scheduler, the seeded trace, TTFT/
+        throughput records, fleet-level Joules and the parity/
+        restoration checks. ``resolved_model(req)`` maps each request
+        to the model expected to SERVE it (parity target); None = the
+        request's own model."""
+        engine = fresh_engine()
+        if policy is not None:
+            sched = ModelFleetScheduler(
+                engine,
+                models=[SMALL, BIG],
+                model_policy=policy,
+                escalate_max_tokens=escalate_floor,
+                slice_steps=slice_steps,
+                prefill_chunk_tokens=chunk_tokens,
+            )
+        else:
+            sched = ContinuousScheduler(
+                engine,
+                slice_steps=slice_steps,
+                prefill_chunk_tokens=chunk_tokens,
+            )
+        results: dict = {}
+
+        def submit(req, _s=sched):
+            res = _s.submit(req)
+            results[id(req)] = res
+            return res
+
+        sched.start()
+        t_arm0 = time.monotonic()
+        try:
+            records = run_load(submit, arm_trace)
+        finally:
+            arm_wall_s = time.monotonic() - t_arm0
+            sched.stop()
+        served_j = 0.0
+        abandoned_j = 0.0
+        tokens = 0
+        parity = True
+        for _off, req in arm_trace:
+            res = results.get(id(req))
+            if res is None:
+                parity = False
+                continue
+            served = res.request.model
+            expect = resolved_model(req) if resolved_model else req.model
+            if served != expect:
+                parity = False
+            if res.tokens != solo_for(served, req).tokens:
+                parity = False
+            tokens += res.generated_tokens
+            served_j += modelled_j(served, res)
+            fleet_extras = (res.extras or {}).get("fleet", {})
+            if fleet_extras.get("escalated"):
+                # the abandoned small attempt decoded exactly what a
+                # solo small run of this request decodes — its modelled
+                # window is charged to the arm too
+                frm = fleet_extras["escalated_from"]
+                abandoned_j += modelled_j(frm, solo_for(frm, req))
+        fleet_j = served_j + abandoned_j
+        small_ttfts = [
+            r["ttft_s"]
+            for r in records
+            if r.get("model") == SMALL and r.get("ttft_s") is not None
+        ]
+        out = {
+            **summarize(records),
+            "small_ttft_p99_s": (
+                round(percentile(small_ttfts, 99), 4)
+                if small_ttfts
+                else None
+            ),
+            "wall_s": round(arm_wall_s, 3),
+            "v5e_served_J": round(served_j, 6),
+            "v5e_abandoned_escalation_J": round(abandoned_j, 6),
+            "fleet_J": round(fleet_j, 6),
+            "fleet_J_per_token": (
+                round(fleet_j / tokens, 9) if tokens else None
+            ),
+            "parity_vs_solo": parity,
+            "pool_restored": {
+                m: pool_restored(engine, m) for m in (SMALL, BIG)
+            },
+        }
+        return out
+
+    small_only = [
+        (off, req) for off, req in hol_trace if req.model == SMALL
+    ]
+    # energy arms: EVERYTHING asks for model:"auto" (vs the always-big
+    # single-model default) — the acceptance A/B at matched budgets
+    auto_energy = [
+        (off, dataclasses.replace(req, model="auto"))
+        for off, req in energy_trace
+    ]
+    big_energy = [
+        (off, dataclasses.replace(req, model=BIG))
+        for off, req in energy_trace
+    ]
+
+    def small_first_resolved(req):
+        # deterministic cascade outcome: every answer is length-cut
+        # (stop_at_eos=False), so auto requests at/above the floor
+        # escalate; named requests serve where they asked
+        if req.model != "auto":
+            return req.model
+        return BIG if req.max_new_tokens >= escalate_floor else SMALL
+
+    def cheapest_resolved(req):
+        return SMALL if req.model == "auto" else req.model
+
+    # compile every shape outside the measured arms
+    run_arm("warm_fleet", hol_trace, policy="small-first")
+    run_arm("warm_serialized", hol_trace)
+    run_arm(
+        "warm_auto",
+        auto_energy,
+        policy="small-first",
+        resolved_model=small_first_resolved,
+    )
+    run_arm("warm_big", big_energy)
+    arms = {
+        "small_solo": run_arm("small_solo", small_only),
+        "serialized": run_arm("serialized", hol_trace),
+        "fleet": run_arm("fleet", hol_trace, policy="small-first"),
+        "always_big": run_arm("always_big", big_energy),
+        "auto_cheapest": run_arm(
+            "auto_cheapest",
+            auto_energy,
+            policy="cheapest-joules",
+            resolved_model=cheapest_resolved,
+        ),
+        "auto_small_first": run_arm(
+            "auto_small_first",
+            auto_energy,
+            policy="small-first",
+            resolved_model=small_first_resolved,
+        ),
+    }
+    solo_p99 = arms["small_solo"]["small_ttft_p99_s"]
+
+    def ratio(a, b):
+        return (
+            round(a / b, 3)
+            if a is not None and b not in (None, 0)
+            else None
+        )
+
+    fleet_vs_solo = ratio(arms["fleet"]["small_ttft_p99_s"], solo_p99)
+    line = {
+        "metric": "model_fleet",
+        "unit": "latency_seconds",
+        "models": {SMALL: "2L/d64", BIG: "6L/d64/ff256"},
+        "backend": jax.default_backend(),
+        "requests": n,
+        "model_mix": mix,
+        "escalate_max_tokens": escalate_floor,
+        **arms,
+        # (a) head-of-line blocking: fleet small TTFT p99 vs its solo
+        # figure (target ≤ ~1.2×) next to the serialized baseline's
+        # multiple-× blowup on the SAME trace
+        "small_ttft_p99_fleet_vs_solo": fleet_vs_solo,
+        "small_ttft_p99_serialized_vs_solo": ratio(
+            arms["serialized"]["small_ttft_p99_s"], solo_p99
+        ),
+        "no_hol_blocking": bool(
+            fleet_vs_solo is not None
+            and fleet_vs_solo
+            <= float(_os.environ.get("BENCH_MF_HOL_FACTOR", "1.2"))
+        ),
+        # (b) the paper's headline online: auto-routing fleet J/token
+        # vs always-big single-model at matched token output
+        # (escalation's abandoned work INCLUDED in the auto arms' J)
+        "j_per_token_cheapest_vs_always_big": ratio(
+            arms["auto_cheapest"]["fleet_J_per_token"],
+            arms["always_big"]["fleet_J_per_token"],
+        ),
+        "j_per_token_small_first_vs_always_big": ratio(
+            arms["auto_small_first"]["fleet_J_per_token"],
+            arms["always_big"]["fleet_J_per_token"],
+        ),
+        "escalations": arms["auto_small_first"].get("escalations", 0),
+        "parity_all_arms": all(a["parity_vs_solo"] for a in arms.values()),
+        "pools_restored_all_arms": all(
+            all(a["pool_restored"].values()) for a in arms.values()
+        ),
+    }
+    _attach_obs(line)
+    print(json.dumps(line))
+    return 0
+
+
 def _tp_continuous_arm(n_devices: int) -> int:
     """ONE arm of the tp_continuous A/B, run in its own process (the
     parent pins ``xla_force_host_platform_device_count`` in XLA_FLAGS —
@@ -2008,6 +2439,8 @@ def main() -> int:
         return shared_prefix_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "radix_prefix":
         return radix_prefix_bench()
+    if len(sys.argv) > 1 and sys.argv[1] == "model_fleet":
+        return model_fleet_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "preemption_overload":
         return preemption_overload_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "spec_continuous":

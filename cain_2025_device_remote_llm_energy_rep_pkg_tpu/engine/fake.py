@@ -662,6 +662,8 @@ class FakeBackend(GenerationBackend):
         spec_accept_floor: "Optional[float]" = None,
         max_rows: int = 64,
         joules_per_token: float = 0.0,
+        model_joules: "Optional[Dict[str, float]]" = None,
+        model_bytes: "Optional[Dict[str, int]]" = None,
     ):
         self.tokens_per_s = tokens_per_s
         self.simulate_delay = simulate_delay
@@ -677,6 +679,13 @@ class FakeBackend(GenerationBackend):
         self.last_joules_per_token: "Optional[float]" = (
             self.joules_per_token or None
         )
+        # Multi-model twins (ISSUE 15): per-model synthetic J/token (the
+        # fleet's cheapest-joules policy ranks on the live by-model
+        # split) and per-model simulated weight bytes (the small-first
+        # policy's size ordering and the llm_model_weight_bytes gauge).
+        self.model_joules: Dict[str, float] = dict(model_joules or {})
+        self.model_bytes: Dict[str, int] = dict(model_bytes or {})
+        self.last_joules_per_token_by_model: Dict[str, float] = {}
         # Failure injection for router/failure-path tests (ISSUE 12) —
         # both MUTABLE so a test can kill a live replica mid-trace:
         # fail_decode_open makes every session open raise (a replica
@@ -713,10 +722,83 @@ class FakeBackend(GenerationBackend):
         self.loaded: Dict[str, bool] = {}
 
     def load_model(self, model: str) -> None:
+        fresh = model not in self.loaded
         self.loaded[model] = True
+        if fresh:
+            try:
+                from ..obs.flight import EV_MODEL_LOADED, FLIGHT, trace_attrs
+                from ..obs.metrics import enabled as _enabled
+                from ..obs.metrics import observe_model_loaded
+                from ..obs.trace import TRACER
+
+                if _enabled():
+                    nbytes = self.model_bytes.get(model, 0)
+                    observe_model_loaded(model, nbytes)
+                    FLIGHT.emit(
+                        EV_MODEL_LOADED,
+                        model=model,
+                        weight_bytes=nbytes,
+                        **trace_attrs(TRACER.current()),
+                    )
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+
+    def evict_model(self, model: str) -> bool:
+        """Drop a simulated model's weights (the hermetic twin of the
+        engine's LRU `_evict_weights` — CI forces an eviction through
+        this and asserts `/api/ps` + the weight-lifecycle families
+        reflect it). Returns False when the model was not loaded."""
+        if self.loaded.pop(model, None) is None:
+            return False
+        try:
+            from ..obs.flight import EV_MODEL_EVICTED, FLIGHT, trace_attrs
+            from ..obs.metrics import enabled as _enabled
+            from ..obs.metrics import observe_model_evicted
+            from ..obs.trace import TRACER
+
+            if _enabled():
+                observe_model_evicted(model, "lru")
+                FLIGHT.emit(
+                    EV_MODEL_EVICTED,
+                    model=model,
+                    reason="lru",
+                    **trace_attrs(TRACER.current()),
+                )
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+        return True
+
+    def model_weight_bytes(self, model: str) -> int:
+        """Simulated weight bytes (ctor ``model_bytes``). An
+        UNCONFIGURED name raises — a constant default would make the
+        fleet's size ordering silently alphabetical; raising makes it
+        fall back to the fleet's configured order instead (first
+        ``--models`` entry = smallest), which is the documented
+        contract for backends that cannot estimate."""
+        if model not in self.model_bytes:
+            raise KeyError(f"no simulated weight bytes for {model!r}")
+        return int(self.model_bytes[model])
 
     def loaded_models(self):
         return sorted(self.loaded)
+
+    def models_debug_state(self) -> dict:
+        """The weight-lifecycle `/debug/state` block, hermetic twin of
+        the engine's (simulated bytes, no live-session refcounts — the
+        fake has no weight LRU to guard)."""
+        return {
+            "loaded": {
+                name: {
+                    "weight_bytes": self.model_bytes.get(name),
+                    "live_sessions": 0,
+                    "joules_per_token": (
+                        self.last_joules_per_token_by_model.get(name)
+                    ),
+                }
+                for name in self.loaded_models()
+            },
+            "pinned": [],
+        }
 
     def _result(self, request: GenerationRequest) -> GenerationResult:
         """The deterministic result, with no simulated wall time spent —
@@ -743,17 +825,22 @@ class FakeBackend(GenerationBackend):
             total_s=prefill_s + decode_s,
         )
 
+    def _jpt_for(self, model: str) -> float:
+        """This model's synthetic J/token: the per-model figure when
+        configured (multi-model fleets), else the backend-wide one."""
+        return float(self.model_joules.get(model, self.joules_per_token))
+
     def _observe_energy(self, result: GenerationResult) -> None:
         """Record the configured synthetic J/token for one served result
         (no-op at the 0.0 default) — the fake twin of the real engine's
         ``_observe_result`` energy attribution, so llm_request_* energy
         families and extras["energy_model"] are CI-testable."""
-        if not self.joules_per_token:
+        jpt = self._jpt_for(result.request.model)
+        if not jpt:
             return
         try:
             from ..obs import energy as obs_energy
 
-            jpt = self.joules_per_token
             est = {
                 "J": jpt * result.generated_tokens,
                 "J_per_token": jpt,
@@ -764,6 +851,7 @@ class FakeBackend(GenerationBackend):
                 "energy_model": dict(est),
             }
             self.last_joules_per_token = jpt
+            self.last_joules_per_token_by_model[result.request.model] = jpt
         except Exception:  # noqa: BLE001 — telemetry only
             pass
 

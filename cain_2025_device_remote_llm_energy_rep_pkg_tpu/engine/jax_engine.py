@@ -450,6 +450,16 @@ class JaxEngine(GenerationBackend):
         # registry-init or checkpoint source to reload from): never LRU
         # victims, or a later load would silently re-randomise them.
         self._pinned: set = set()
+        # Live stepped-session refcount per model (ISSUE 15): a model
+        # with live decode rows must never be an LRU eviction victim —
+        # its carry references the weights the eviction would drop.
+        # SteppedDecodeSession.open/close pair _session_opened/_closed.
+        self._live_sessions: Dict[str, int] = {}
+        # Live energy attribution (ISSUE 13/15): the engine-wide figure
+        # router probes read, plus the PER-MODEL split the multi-model
+        # fleet's cheapest-joules policy ranks on.
+        self.last_joules_per_token: Optional[float] = None
+        self.last_joules_per_token_by_model: Dict[str, float] = {}
         self._prefill_cache: Dict[Tuple, Callable] = {}
         self._decode_cache: Dict[Tuple, Callable] = {}
         self._warmed: set = set()
@@ -605,6 +615,7 @@ class JaxEngine(GenerationBackend):
         jax.block_until_ready(tf.params)
         self._load_s = time.monotonic() - t0
         self._models[model] = tf
+        self._observe_model_loaded(model, load_s=self._load_s)
 
     def _check_memory_budget(self, model: str, cfg: ModelConfig) -> None:
         """Fail fast — with the estimated bytes, the probed budget, and the
@@ -677,6 +688,7 @@ class JaxEngine(GenerationBackend):
         self.registry[model] = cfg
         self._models[model] = Transformer(cfg=cfg, params=params)
         self._pinned.add(model)
+        self._observe_model_loaded(model)
 
     def _ensure_allocation_capacity(self, model: str, cfg: ModelConfig) -> None:
         """Ollama-style LRU model eviction: total HBM holds only a few
@@ -726,11 +738,37 @@ class JaxEngine(GenerationBackend):
                     continue
                 prefix_resident = 0
             # oldest (LRU) un-pinned model; installed-only weights have no
-            # source to reload from and are never victims
+            # source to reload from and are never victims. Models with
+            # LIVE stepped rows are never victims either (ISSUE 15):
+            # their session carries reference the weights, so eviction
+            # is DEFERRED until the session drains — the next load's
+            # capacity pass retries, and _check_memory_budget (when a
+            # budget is known) turns an unservable load into a clean
+            # refusal instead of undefined decode behavior.
             victim = next(
-                (n for n in self._models if n not in self._pinned), None
+                (
+                    n
+                    for n in self._models
+                    if n not in self._pinned and not self._live_sessions.get(n)
+                ),
+                None,
             )
             if victim is None:
+                live = [
+                    n
+                    for n in self._models
+                    if n not in self._pinned and self._live_sessions.get(n)
+                ]
+                if live:
+                    from ..obs.metrics import MODEL_EVICT_DEFERRED_C
+                    from ..obs.metrics import enabled as _enabled
+
+                    if _enabled():
+                        MODEL_EVICT_DEFERRED_C.inc()
+                    term.log(
+                        f"deferring weight eviction for {model}: "
+                        f"{', '.join(live)} hold(s) live stepped rows"
+                    )
                 break
             freed = resident.pop(victim)
             self._evict_weights(victim)
@@ -739,12 +777,14 @@ class JaxEngine(GenerationBackend):
                 f"fit {model}; compiled state kept, reload is cheap"
             )
 
-    def _evict_weights(self, model: str) -> None:
+    def _evict_weights(self, model: str, reason: str = "lru") -> None:
         """Drop a model's weights (and its prefix-cache K/V — device
         arrays) but KEEP compiled fns/warm markers/tokenizer: the config
         is unchanged, so a reload serves them unmodified."""
-        self._models.pop(model, None)
+        evicted = self._models.pop(model, None) is not None
         self._prefix_cache.pop(model, None)
+        if evicted:
+            self._observe_model_evicted(model, reason)
 
     def _evict_model_state(self, model: str) -> None:
         """Drop every per-model derivative: compiled prefill/decode fns
@@ -753,7 +793,7 @@ class JaxEngine(GenerationBackend):
         itself. Keys are tuples whose elements include the model name
         (plain, 'batch'- and 'spec'-prefixed; spec entries also name the
         draft)."""
-        self._models.pop(model, None)
+        evicted = self._models.pop(model, None) is not None
         self._pinned.discard(model)
         self._tokenizers.pop(model, None)
         self._prefix_cache.pop(model, None)
@@ -761,8 +801,12 @@ class JaxEngine(GenerationBackend):
             for key in [k for k in cache if model in k]:
                 del cache[key]
         self._warmed = {k for k in self._warmed if model not in k}
+        if evicted:
+            self._observe_model_evicted(model, "reinstall")
 
     def unload_all(self) -> None:
+        for model in list(self._models):
+            self._observe_model_evicted(model, "unload")
         self._models.clear()
         self._pinned.clear()
         self._prefill_cache.clear()
@@ -770,6 +814,103 @@ class JaxEngine(GenerationBackend):
         self._tokenizers.clear()
         self._prefix_cache.clear()
         self._warmed.clear()  # a fresh load must re-warm outside the window
+
+    # -- weight-lifecycle observability + session guards (ISSUE 15) ------------
+    def model_weight_bytes(self, model: str) -> int:
+        """Estimated resident weight bytes of ``model`` under this
+        engine's quantization rules — a pure estimate off the config
+        (loaded or not); the multi-model fleet's size ordering (its
+        small-first policy and cheapest-joules fallback) ranks on it."""
+        from ..utils.memory import estimate_weight_bytes
+
+        if model in self._models:
+            cfg = self._models[model].cfg
+        elif model in self.registry:
+            cfg = self.registry[model]
+        else:
+            cfg = get_model_config(model)
+        return estimate_weight_bytes(
+            cfg, self._quant_mode(model), jnp.dtype(self.dtype).itemsize
+        )
+
+    def _observe_model_loaded(
+        self, model: str, load_s: Optional[float] = None
+    ) -> None:
+        """Weight-lifecycle telemetry for one load/install: residency
+        gauges + the ``model_loaded`` flight event, trace-linked to the
+        request that triggered the load when one is current. Telemetry
+        must never fail a load."""
+        if not _obs_enabled():
+            return
+        try:
+            from ..obs.flight import EV_MODEL_LOADED, FLIGHT, trace_attrs
+            from ..obs.metrics import observe_model_loaded
+            from ..obs.trace import TRACER
+
+            nbytes = self.model_weight_bytes(model)
+            observe_model_loaded(model, nbytes)
+            FLIGHT.emit(
+                EV_MODEL_LOADED,
+                model=model,
+                weight_bytes=nbytes,
+                **({"load_s": round(load_s, 4)} if load_s is not None else {}),
+                **trace_attrs(TRACER.current()),
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    def _observe_model_evicted(self, model: str, reason: str) -> None:
+        if not _obs_enabled():
+            return
+        try:
+            from ..obs.flight import EV_MODEL_EVICTED, FLIGHT, trace_attrs
+            from ..obs.metrics import observe_model_evicted
+            from ..obs.trace import TRACER
+
+            observe_model_evicted(model, reason)
+            FLIGHT.emit(
+                EV_MODEL_EVICTED,
+                model=model,
+                reason=reason,
+                **trace_attrs(TRACER.current()),
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    def _session_opened(self, model: str) -> None:
+        """A stepped session holds live rows of ``model``: pin its
+        weights against LRU eviction until :meth:`_session_closed`."""
+        self._live_sessions[model] = self._live_sessions.get(model, 0) + 1
+
+    def _session_closed(self, model: str) -> None:
+        n = self._live_sessions.get(model, 0) - 1
+        if n > 0:
+            self._live_sessions[model] = n
+        else:
+            self._live_sessions.pop(model, None)
+
+    def live_sessions(self, model: str) -> int:
+        """Open stepped sessions currently holding rows of ``model``
+        (the eviction-guard refcount — 0 means eviction is allowed)."""
+        return self._live_sessions.get(model, 0)
+
+    def models_debug_state(self) -> "Dict[str, Any]":
+        """The weight-lifecycle block of ``GET /debug/state``: resident
+        models with their estimated bytes and live-session refcounts."""
+        out: Dict[str, Any] = {"loaded": {}, "pinned": sorted(self._pinned)}
+        for name in self.loaded_models():
+            try:
+                nbytes = self.model_weight_bytes(name)
+            except Exception:  # noqa: BLE001 — estimate only
+                nbytes = None
+            out["loaded"][name] = {
+                "weight_bytes": nbytes,
+                "live_sessions": self._live_sessions.get(name, 0),
+                "joules_per_token": self.last_joules_per_token_by_model.get(
+                    name
+                ),
+            }
+        return out
 
     def loaded_models(self) -> "list[str]":
         # dict.copy() is C-atomic under the GIL: a safe snapshot even while
@@ -1585,9 +1726,14 @@ class JaxEngine(GenerationBackend):
                 obs_energy.observe_estimate(est)
                 # live figure for router probes (ISSUE 13): LocalReplica
                 # reads this attribute so least-joules routing works on
-                # real engines without a loopback /metrics scrape
+                # real engines without a loopback /metrics scrape; the
+                # per-model split feeds the multi-model fleet's
+                # cheapest-joules policy (ISSUE 15)
                 if est.get("J_per_token") is not None:
                     self.last_joules_per_token = est["J_per_token"]
+                    self.last_joules_per_token_by_model[model] = est[
+                        "J_per_token"
+                    ]
         except Exception:  # noqa: BLE001 — telemetry only
             pass
 
@@ -1630,6 +1776,9 @@ class JaxEngine(GenerationBackend):
             obs_energy.observe_estimate(est)
             if est.get("J_per_token") is not None:
                 self.last_joules_per_token = est["J_per_token"]
+                self.last_joules_per_token_by_model[model] = est[
+                    "J_per_token"
+                ]
             for r in results:
                 if not r.generated_tokens:
                     continue

@@ -293,6 +293,9 @@ class SteppedDecodeSession:
         self.model = model
         self.top_k = top_k
         self.closed = False
+        # weight-LRU eviction pins held by this session (set at the END
+        # of a successful open; released exactly once by close)
+        self._session_pins: List[str] = []
         self.paged = bool(engine.paged_kv)
         self.carry: Dict[str, Any] = {}
         self.rows: List[Optional[_Row]] = []
@@ -408,6 +411,17 @@ class SteppedDecodeSession:
             if self.paged:
                 self.pool.k = self.carry["pool_k"]
                 self.pool.v = self.carry["pool_v"]
+        # Eviction guard (ISSUE 15): the open SUCCEEDED — pin this
+        # session's weights (target + live draft) against the weight
+        # LRU until close(). Registered last so a failed open never
+        # leaks a pin that would immortalise the model.
+        self._session_pins = [self.model]
+        if self.spec is not None:
+            self._session_pins.append(self.spec["draft"])
+        opened = getattr(engine, "_session_opened", None)
+        if opened is not None:
+            for name in self._session_pins:
+                opened(name)
         return self
 
     # -- speculative draft-verify mode (ISSUE 9) -------------------------------
@@ -2432,3 +2446,11 @@ class SteppedDecodeSession:
             swap_host_adjust(-self._swap_bytes, rows=-self._swap_rows)
             self._swap_bytes = 0
             self._swap_rows = 0
+        # release the eviction-guard pins LAST: the weight LRU may now
+        # evict this session's models (a deferred eviction retries on
+        # the next load's capacity pass)
+        closed_hook = getattr(self.engine, "_session_closed", None)
+        if closed_hook is not None:
+            for name in self._session_pins:
+                closed_hook(name)
+        self._session_pins = []

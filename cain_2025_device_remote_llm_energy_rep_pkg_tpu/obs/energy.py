@@ -196,7 +196,9 @@ WASTED_J = REGISTRY.counter(
     "(retry: burned on a replica that died before the ticket's first "
     "streamed token; recompute: a preemption victim's re-prefill of "
     "prompt + generated tokens under --preempt-policy recompute; swap: "
-    "KV payload moved device<->host by a swap preemption)",
+    "KV payload moved device<->host by a swap preemption; escalation: "
+    "a small-first model cascade abandoned the small model's answer — "
+    "its prefill + generated tokens — and re-ran on the big model)",
     labels=("cause",),
 )
 WASTED_TOKENS = REGISTRY.counter(

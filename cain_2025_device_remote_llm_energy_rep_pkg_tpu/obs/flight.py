@@ -66,6 +66,16 @@ EV_REPLICA_DOWN = "replica_down"  # a replica turned unhealthy (probe
 #   failure or a dispatch-observed death; error attr says which)
 EV_REPLICA_DRAINED = "replica_drained"  # drain() completed: in-flight
 #   rows finished and the replica detached from the fleet
+# Multi-model serving (ISSUE 15, serve/model_fleet.py + the engines'
+# weight LRU):
+EV_MODEL_LOADED = "model_loaded"  # a model's weights became resident
+#   (trace-linked to the request that triggered the load when one did)
+EV_MODEL_EVICTED = "model_evicted"  # a model's weights left the device
+#   (reason = lru|reinstall|unload; deferred evictions emit nothing —
+#   they count on llm_model_evict_deferred_total instead)
+EV_MODEL_ESCALATED = "model_escalated"  # a small-first cascade abandoned
+#   the small model's answer and re-ran on the big one (trace = the
+#   request; from/to models + the wasted-Joules charge ride along)
 EV_POOL_EXHAUSTED = "pool_exhausted"  # PagePool refused an allocation
 EV_PREFIX_HIT = "prefix_hit"  # a joiner reused cached shared-prefix KV
 EV_PREFIX_EVICT = "prefix_evict"  # a prefix-store node was evicted (LRU)

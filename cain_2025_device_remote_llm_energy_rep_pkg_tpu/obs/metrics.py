@@ -700,6 +700,59 @@ def swap_host_adjust(nbytes: float, rows: int = 0) -> None:
         )
 
 
+# -- model weight lifecycle (ISSUE 15) -----------------------------------------
+# Declared here because TWO producers share them: the real engines'
+# Ollama-style weight LRU (engine/jax_engine.py load/evict/unload) and
+# the hermetic fake's load_model/evict_model — multi-model serving reads
+# one scrape to see WHICH models are resident and what eviction traffic
+# the shared HBM envelope is paying.
+MODEL_LOADED_G = REGISTRY.gauge(
+    "llm_model_loaded",
+    "1 while this model's weights are resident in accelerator memory "
+    "(0 after eviction/unload) — the /api/ps surface as a gauge",
+    labels=("model",),
+)
+MODEL_WEIGHT_BYTES_G = REGISTRY.gauge(
+    "llm_model_weight_bytes",
+    "Estimated resident weight bytes of this model (0 when not loaded) "
+    "— what the model charges the shared HBM envelope next to the "
+    "session pools and the prefix store",
+    labels=("model",),
+)
+MODEL_EVICTIONS_C = REGISTRY.counter(
+    "llm_model_evictions_total",
+    "Model weights dropped from accelerator memory, by reason (lru: "
+    "the allocation-budget LRU made room for another load; reinstall: "
+    "install_model replaced the weights under the same name; unload: "
+    "explicit unload_all between treatments)",
+    labels=("reason",),
+)
+MODEL_EVICT_DEFERRED_C = REGISTRY.counter(
+    "llm_model_evict_deferred_total",
+    "LRU evictions REFUSED because the victim model had live stepped "
+    "rows (ISSUE 15: evicting under a live session would be undefined "
+    "— the eviction re-runs once the model's sessions drain)",
+)
+
+
+def observe_model_loaded(model: str, weight_bytes: float) -> None:
+    """Flip one model's residency gauges on (idempotent — a refresh of
+    an already-loaded model re-sets the same values)."""
+    if not _enabled:
+        return
+    MODEL_LOADED_G.labels(model=model).set(1.0)
+    MODEL_WEIGHT_BYTES_G.labels(model=model).set(max(0.0, weight_bytes))
+
+
+def observe_model_evicted(model: str, reason: str) -> None:
+    """Flip one model's residency gauges off and count the eviction."""
+    if not _enabled:
+        return
+    MODEL_LOADED_G.labels(model=model).set(0.0)
+    MODEL_WEIGHT_BYTES_G.labels(model=model).set(0.0)
+    MODEL_EVICTIONS_C.labels(reason=reason).inc()
+
+
 def observe_spec(rounds: float, accepted: float, drafted: float) -> None:
     """One speculative window's counters + the acceptance gauge (no-op
     when telemetry is off — the instruments gate themselves, but the

@@ -273,7 +273,24 @@ commands:
                        GET /metrics additionally exposes llm_fleet_*
                        rollups (counters summed, histograms merged
                        bucket-wise, gauges re-labelled {replica=...})
-                       federated from the replicas' scrapes
+                       federated from the replicas' scrapes.
+                       Multi-model serving: --model-policy small-first|
+                       cheapest-joules hosts one continuous lane per
+                       --models entry over ONE engine (decode slices of
+                       different models interleave — no cross-model
+                       head-of-line blocking; the KV envelope splits
+                       across lanes; evicting a model with live rows is
+                       deferred) and resolves model:"auto" requests by
+                       the policy: cheapest-joules routes to the lowest
+                       live J/token, small-first runs the smallest
+                       model and ESCALATES to the biggest when the
+                       answer is length-cut after at least
+                       --escalate-max-tokens tokens (default 32; the
+                       abandoned tokens charge llm_request_wasted_
+                       joules_total{cause="escalation"}); the fleet's
+                       merged loaded-models view serves on /api/ps and
+                       the router's dispatch prefers replicas holding a
+                       request's model warm
   serve-fleet --targets host:port[,host:port...] [--route-policy P]
                        [--port N] [--models a,b] [--probe-interval-ms M]
                        the front-door router over ALREADY-RUNNING
@@ -321,6 +338,8 @@ def serve_command(args: List[str]) -> None:
     replicas = 1  # >1: a replica fleet behind the front-door router
     route_policy = None  # router default ("least-queue")
     probe_interval_ms = None  # router default (1000 ms)
+    model_policy = None  # multi-model fleet: small-first|cheapest-joules
+    escalate_max_tokens = None  # small-first cascade length-cut floor
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -515,6 +534,33 @@ def serve_command(args: List[str]) -> None:
                 raise CommandError(
                     "serve: --probe-interval-ms expects a positive number"
                 )
+        elif arg == "--model-policy":
+            # Multi-model serving (ISSUE 15): host one continuous lane
+            # per --models entry over ONE engine (shared HBM envelope)
+            # and resolve model:"auto" by this policy.
+            from ..serve.model_fleet import MODEL_POLICIES
+
+            model_policy = next(it, "")
+            if model_policy not in MODEL_POLICIES:
+                raise CommandError(
+                    "serve: --model-policy expects one of "
+                    + "|".join(MODEL_POLICIES)
+                )
+        elif arg == "--escalate-max-tokens":
+            # small-first cascade: a budget-cut answer escalates to the
+            # big model only after at least this many tokens
+            try:
+                escalate_max_tokens = int(next(it, ""))
+            except ValueError:
+                raise CommandError(
+                    "serve: --escalate-max-tokens expects a positive "
+                    "integer"
+                )
+            if escalate_max_tokens < 1:
+                raise CommandError(
+                    "serve: --escalate-max-tokens expects a positive "
+                    "integer"
+                )
         elif arg == "--access-log":
             access_log = True
         elif arg == "--no-telemetry":
@@ -647,10 +693,28 @@ def serve_command(args: List[str]) -> None:
         }
         if batch_window_ms > 0:
             sched_kwargs["window_s"] = batch_window_ms / 1e3
-        fleet = [
-            LocalReplica(f"r{i}", build_backend(), **sched_kwargs)
-            for i in range(replicas)
-        ]
+        def build_replica(i: int) -> LocalReplica:
+            backend = build_backend()
+            if model_policy is not None:
+                # each replica hosts its OWN multi-model fleet (ISSUE
+                # 15): per-model lanes over that replica's engine; the
+                # router treats the whole fleet as one replica
+                from ..serve.model_fleet import ModelFleetScheduler
+
+                return LocalReplica(
+                    f"r{i}",
+                    backend,
+                    scheduler=ModelFleetScheduler(
+                        backend,
+                        models=models,
+                        model_policy=model_policy,
+                        escalate_max_tokens=escalate_max_tokens,
+                        **sched_kwargs,
+                    ),
+                )
+            return LocalReplica(f"r{i}", backend, **sched_kwargs)
+
+        fleet = [build_replica(i) for i in range(replicas)]
         router = Router(
             fleet,
             policy=route_policy or "least-queue",
@@ -685,6 +749,8 @@ def serve_command(args: List[str]) -> None:
         default_priority=default_priority,
         preempt_policy=preempt_policy,
         preempt_max_wait_s=preempt_max_wait_s,
+        model_policy=model_policy,
+        escalate_max_tokens=escalate_max_tokens,
     )
     server.serve_forever()
 

@@ -11,6 +11,7 @@ machine boundary exactly as the reference's did.
 """
 
 from .client import RemoteHTTPBackend
+from .model_fleet import ModelFleetScheduler
 from .protocol import DEFAULT_PORT
 from .router import (
     LocalReplica,
@@ -24,6 +25,7 @@ __all__ = [
     "GenerationServer",
     "RemoteHTTPBackend",
     "DEFAULT_PORT",
+    "ModelFleetScheduler",
     "Router",
     "RouterServer",
     "LocalReplica",

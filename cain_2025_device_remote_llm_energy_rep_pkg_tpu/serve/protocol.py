@@ -94,6 +94,15 @@ def ensure_trace(request: GenerationRequest) -> GenerationRequest:
 
 SERVER_VERSION = "0.1.0"
 
+# Multi-model serving (ISSUE 15): a request whose ``model`` is this
+# sentinel asks the server to PICK the model — resolved by the fleet
+# scheduler's ``--model-policy`` (serve/model_fleet.py: small-first
+# cascade with big-model escalation, or cheapest-joules on the live
+# per-model J/token attribution). The final wire record names the model
+# that actually answered; a server with no fleet treats "auto" as an
+# unknown model (404).
+AUTO_MODEL = "auto"
+
 # SLO tiers (ISSUE 11): the canonical named priority tiers of the wire
 # field ``x_priority``. Requests may send the name or any non-negative
 # integer; absent means the server's ``--default-priority`` (which

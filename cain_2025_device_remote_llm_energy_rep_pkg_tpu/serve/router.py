@@ -328,6 +328,13 @@ class LocalReplica(Replica):
         jpt = getattr(self.backend, "last_joules_per_token", None)
         if jpt:
             stats["joules_per_token"] = float(jpt)
+        # loaded-model set (ISSUE 15): the placement dimension —
+        # dispatch prefers replicas already holding a request's weights
+        # warm over ones that would pay a load + LRU eviction
+        try:
+            stats["loaded_models"] = list(self.backend.loaded_models())
+        except Exception:  # noqa: BLE001 — probe only
+            pass
         return stats
 
     def close(self) -> None:
@@ -392,6 +399,22 @@ class RemoteReplica(Replica):
             if jpt is not None:
                 stats["joules_per_token"] = jpt
         except Exception:  # noqa: BLE001 — telemetry may be off (404)
+            pass
+        # loaded-model set via /api/ps (ISSUE 15): answers under the
+        # replica's telemetry kill switch too — model residency is
+        # protocol, not observability
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}{protocol.PS_PATH}",
+                timeout=self.probe_timeout_s,
+            ) as resp:
+                body = json.loads(resp.read().decode("utf-8"))
+            stats["loaded_models"] = [
+                str(m.get("name"))
+                for m in body.get("models") or []
+                if m.get("name")
+            ]
+        except Exception:  # noqa: BLE001 — probe only
             pass
         return stats
 
@@ -602,7 +625,9 @@ class Router:
                 return float(jpt) * 1e6 + queue_load
         return queue_load
 
-    def _pick(self, exclude: "tuple" = ()) -> Optional[Replica]:
+    def _pick(
+        self, exclude: "tuple" = (), model: Optional[str] = None
+    ) -> Optional[Replica]:
         with self._lock:
             candidates = [
                 r
@@ -611,6 +636,22 @@ class Router:
             ]
             if not candidates:
                 return None
+            # Model placement (ISSUE 15): when the ticket names a model
+            # and SOME candidate already holds its weights warm, prefer
+            # the warm set — a cold replica would pay a load (and
+            # possibly an LRU eviction) before the first prefill. A
+            # model nobody holds (or probes that don't report the set)
+            # leaves the candidate set untouched: placement is a
+            # preference, never a reachability constraint.
+            if model is not None:
+                warm = [
+                    r
+                    for r in candidates
+                    if model
+                    in ((r.last_stats or {}).get("loaded_models") or ())
+                ]
+                if warm:
+                    candidates = warm
             if self.policy == "round-robin":
                 return candidates[next(self._rr) % len(candidates)]
             return min(
@@ -713,8 +754,11 @@ class Router:
         retried: Optional[str] = None
         wasted_j = 0.0
         attempt = 0
+        model = (
+            request.model if request.model != protocol.AUTO_MODEL else None
+        )
         while True:
-            replica = self._pick(exclude=tried)
+            replica = self._pick(exclude=tried, model=model)
             if replica is None:
                 raise RuntimeError(
                     "no healthy replica available"
@@ -756,8 +800,11 @@ class Router:
         retried: Optional[str] = None
         wasted_j = 0.0
         attempt = 0
+        model = (
+            request.model if request.model != protocol.AUTO_MODEL else None
+        )
         while True:
-            replica = self._pick(exclude=tried)
+            replica = self._pick(exclude=tried, model=model)
             if replica is None:
                 raise RuntimeError(
                     "no healthy replica available"
@@ -820,6 +867,31 @@ class Router:
             "policy": self.policy,
             "probe_interval_s": self.probe_interval_s,
             "replicas": [r.debug_state() for r in self.replicas()],
+        }
+
+    def ps_state(self) -> Dict[str, object]:
+        """The fleet's merged loaded-models view (``GET /api/ps`` on the
+        front door, ISSUE 15): every model any replica holds warm, with
+        the replicas holding it — the data behind the placement-aware
+        dispatch, federated the way /metrics federates the gauges.
+        Reads the PROBE-fed sets (refreshed every probe tick); a
+        replica that never reported one simply contributes nothing."""
+        by_model: Dict[str, List[str]] = {}
+        per_replica: Dict[str, List[str]] = {}
+        for replica in self.replicas():
+            loaded = (replica.last_stats or {}).get("loaded_models")
+            if loaded is None:
+                continue
+            names = [str(m) for m in loaded]
+            per_replica[replica.name] = names
+            for m in names:
+                by_model.setdefault(m, []).append(replica.name)
+        return {
+            "models": [
+                {"name": m, "x_replicas": sorted(by_model[m])}
+                for m in sorted(by_model)
+            ],
+            "x_replicas": per_replica,
         }
 
     # -- metrics federation (ISSUE 13) -----------------------------------------
@@ -1103,6 +1175,13 @@ class RouterServer:
                         200,
                         {"models": [{"name": m} for m in server.models]},
                     )
+                elif path == protocol.PS_PATH:
+                    # merged per-replica loaded-models view (ISSUE 15):
+                    # the single server answers /api/ps from its own
+                    # backend; the front door federates every replica's
+                    # probe-fed set, so one call shows WHERE each
+                    # model's weights are warm
+                    self._send_json(200, server.router.ps_state())
                 elif path == protocol.VERSION_PATH:
                     self._send_json(
                         200, {"version": protocol.SERVER_VERSION}

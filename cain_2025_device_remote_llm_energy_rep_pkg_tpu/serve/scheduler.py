@@ -416,6 +416,13 @@ class _SchedulerBase:
         self.budget_aware = bool(
             budget_aware and hasattr(backend, "max_admission_rows")
         )
+        # HBM-envelope split (ISSUE 15): the fraction of the engine's
+        # KV budget THIS scheduler's sessions may claim. 1.0 = the
+        # whole envelope (single-model serving); a multi-model fleet
+        # (serve/model_fleet.py) divides it across its live per-model
+        # lanes so N concurrent sessions' pools bill the same device
+        # memory the single session used to own alone.
+        self.kv_budget_frac = 1.0
         self.window_s = window_s
         # Shared with the server's streaming path so batched and streamed
         # generations never run concurrently on one accelerator.
@@ -560,6 +567,7 @@ class _SchedulerBase:
             "queue_tiers": self._queue.depths(),
             "max_batch": self.max_batch,
             "budget_aware": self.budget_aware,
+            "kv_budget_frac": self.kv_budget_frac,
             "window_s": self.window_s,
             "ttft_slo_ms": self.ttft_slo_ms,
         }
@@ -575,20 +583,28 @@ class _SchedulerBase:
         estimate when it can provide one (see the class docstring). A
         probe failure (unknown model, bad prompt) falls back to the
         static cap — admission must never fail a request the backend
-        would serve."""
+        would serve. Under a multi-model fleet the cap is additionally
+        scaled by ``kv_budget_frac`` (this lane's share of the engine's
+        KV envelope), floored at one row so a lane can always serve."""
         if not self.budget_aware:
             _BUDGET_ADMISSION_C.labels(outcome="static").inc()
-            return self.max_batch
+            return self._split_cap(self.max_batch)
         try:
             estimated = self.backend.max_admission_rows(first.request)
         except Exception:  # noqa: BLE001 — estimate only, never fatal
             _BUDGET_ADMISSION_C.labels(outcome="error").inc()
-            return self.max_batch
+            return self._split_cap(self.max_batch)
         raised = int(estimated) > self.max_batch
         _BUDGET_ADMISSION_C.labels(
             outcome="raised" if raised else "static"
         ).inc()
-        return max(self.max_batch, int(estimated))
+        return self._split_cap(max(self.max_batch, int(estimated)))
+
+    def _split_cap(self, cap: int) -> int:
+        frac = self.kv_budget_frac
+        if frac >= 1.0:
+            return cap
+        return max(1, int(cap * frac))
 
     def _preadmit_reject(
         self, ticket: _Ticket, now: Optional[float] = None

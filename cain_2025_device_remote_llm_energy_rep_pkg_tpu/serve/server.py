@@ -89,6 +89,8 @@ class GenerationServer:
         default_priority: Optional[int] = None,  # tier for bare requests
         preempt_policy: Optional[str] = None,  # off|swap|recompute
         preempt_max_wait_s: Optional[float] = None,  # victim aging clock
+        model_policy: Optional[str] = None,  # fleet: small-first|cheapest-joules
+        escalate_max_tokens: Optional[int] = None,  # cascade length cut
     ) -> None:
         """``batch_window_ms > 0`` or an explicit ``scheduler`` enables
         batching: concurrent non-streaming generate requests coalesce
@@ -148,7 +150,19 @@ class GenerationServer:
         "off" restores shed-at-the-edge-only overload handling.
         ``preempt_max_wait_s`` (CLI ``--preempt-max-wait-s``) is the
         starvation clock: a parked victim ages up one tier per full
-        wait."""
+        wait.
+
+        Multi-model serving (ISSUE 15): ``model_policy`` (CLI
+        ``--model-policy``, ``small-first`` or ``cheapest-joules``)
+        replaces the single scheduler with a
+        :class:`~.model_fleet.ModelFleetScheduler` — one continuous
+        lane per served model over this backend, decode slices
+        interleaving under the shared lock, the KV envelope split
+        across lanes — and resolves ``model: "auto"`` requests through
+        the named policy. ``escalate_max_tokens`` tunes the
+        small-first cascade's length-cut confidence proxy (CLI
+        ``--escalate-max-tokens``). Requires a stepped backend; the
+        continuous-only tuning knobs apply to every lane."""
         self.backend = backend
         self.default_priority = (
             int(default_priority)
@@ -166,7 +180,39 @@ class GenerationServer:
                 f"got {scheduler!r}"
             )
         self.scheduler_mode = "off"
-        if batch_window_ms > 0 or scheduler is not None:
+        if model_policy is not None:
+            # Multi-model fleet (ISSUE 15): one continuous lane per
+            # served model, model:"auto" resolved by the policy. The
+            # fleet subsumes the single scheduler — the explicit
+            # --scheduler knob keeps its meaning for single-model
+            # serving only.
+            from .model_fleet import ModelFleetScheduler
+
+            self._scheduler = ModelFleetScheduler(
+                backend,
+                models=self.models,
+                model_policy=model_policy,
+                escalate_max_tokens=escalate_max_tokens,
+                lock=self._generate_lock,
+                max_batch=max_batch,
+                budget_aware=budget_aware,
+                slice_steps=slice_steps,
+                prefill_chunk_tokens=prefill_chunk_tokens,
+                ttft_slo_ms=ttft_slo_ms,
+                spec_accept_floor=spec_accept_floor,
+                **(
+                    {"preempt_policy": preempt_policy}
+                    if preempt_policy is not None
+                    else {}
+                ),
+                **(
+                    {"preempt_max_wait_s": preempt_max_wait_s}
+                    if preempt_max_wait_s is not None
+                    else {}
+                ),
+            )
+            self.scheduler_mode = "fleet"
+        elif batch_window_ms > 0 or scheduler is not None:
             from .scheduler import BatchScheduler, ContinuousScheduler
 
             mode = scheduler
@@ -320,6 +366,18 @@ class GenerationServer:
                         state["prefix_store"] = store.debug_state()
                 except Exception:  # noqa: BLE001 — probe only
                     pass
+                # weight lifecycle (ISSUE 15): which models are
+                # resident, their estimated bytes, and which hold live
+                # stepped rows (the eviction-guard refcounts) — the
+                # backend-owned view, present whatever scheduler runs
+                try:
+                    models_state = getattr(
+                        server.backend, "models_debug_state", None
+                    )
+                    if models_state is not None:
+                        state["models"] = models_state()
+                except Exception:  # noqa: BLE001 — probe only
+                    pass
                 try:
                     if server._scheduler is not None:
                         state["scheduler"] = server._scheduler.debug_state()
@@ -462,7 +520,14 @@ class GenerationServer:
                 except ValueError as exc:
                     self._send_json(400, {"error": str(exc)})
                     return
-                if server.models and request.model not in server.models:
+                if (
+                    server.models
+                    and request.model not in server.models
+                    and not (
+                        request.model == protocol.AUTO_MODEL
+                        and server.scheduler_mode == "fleet"
+                    )
+                ):
                     self._send_json(
                         404, {"error": f"model {request.model!r} not found"}
                     )
@@ -576,7 +641,7 @@ class GenerationServer:
                 generate_stream under the serial lock."""
                 if (
                     server._scheduler is not None
-                    and server.scheduler_mode == "continuous"
+                    and server.scheduler_mode in ("continuous", "fleet")
                 ):
                     self._stream_via_scheduler(request)
                 else:

@@ -135,6 +135,55 @@ def draw_tiers(
     return tiers
 
 
+def parse_model_mix(spec: str) -> Dict[str, float]:
+    """``"small:1b=0.7,big:7b=0.3"`` → {"small:1b": 0.7, "big:7b": 0.3}.
+    Model names may contain '=' -free colons (qwen2:1.5b); the LAST '='
+    separates name from fraction. Fractions need not sum to 1 — the
+    remainder draws the workload's default model (or "auto")."""
+    out: Dict[str, float] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, frac = entry.rpartition("=")
+        if not eq or not name:
+            raise ValueError(
+                f"model mix entry {entry!r} is not model=fraction"
+            )
+        out[name.strip()] = float(frac)
+    if sum(out.values()) > 1.0 + 1e-9:
+        raise ValueError(f"model mix fractions sum past 1: {spec!r}")
+    return out
+
+
+def draw_models(
+    n: int,
+    model_mix: Optional[Dict[str, float]],
+    default_model: str,
+    seed: int = 0,
+) -> List[str]:
+    """``n`` seeded per-request model names drawn from ``model_mix``
+    (uncovered fraction mass draws ``default_model``). Uses its own
+    derived seed — INDEPENDENT of the arrival/length/tier streams, so
+    turning the mix on replays the SAME trace: the property the
+    multi-model fleet bench's A/B arms (fleet vs serialized vs
+    always-big) depend on."""
+    if not model_mix:
+        return [default_model] * n
+    rng = random.Random((seed << 16) ^ 0x30DE1)
+    names = sorted(model_mix)
+    models = []
+    for _ in range(n):
+        u, acc, drawn = rng.random(), 0.0, default_model
+        for name in names:
+            acc += model_mix[name]
+            if u < acc:
+                drawn = name
+                break
+        models.append(drawn)
+    return models
+
+
 def build_cancellations(
     n: int,
     cancel_frac: float,
@@ -194,6 +243,7 @@ def build_workload(
     shared_prefix_tokens: int = 192,
     anchor_shared_prefix: bool = False,
     tier_mix: Optional[Dict[str, float]] = None,
+    model_mix: Optional[Dict[str, float]] = None,
 ) -> List[Tuple[float, GenerationRequest]]:
     """``[(arrival_offset_s, request), ...]`` — Poisson arrivals (seeded
     exponential inter-arrival; the first request arrives at t=0) over a
@@ -223,6 +273,15 @@ def build_workload(
     preemption bench A/Bs; the tier stream is independent of arrivals/
     lengths, so the same trace replays across policy arms.
 
+    ``model_mix`` (ISSUE 15, :func:`parse_model_mix`'s shape) assigns
+    each request a seeded MODEL — the mixed-model traffic the
+    multi-model fleet serves concurrently (uncovered fraction mass
+    draws the ``model`` default, which may be "auto" for policy-routed
+    traffic). The model stream is independent of arrivals/lengths/
+    tiers, so the same trace replays across fleet-vs-serialized arms;
+    the summary gains a per-model percentile breakdown + escalation
+    counts.
+
     Every request additionally carries a CALLER-MINTED ``x_trace``
     (ISSUE 13): the summary prints the trace ids of failed / retried /
     SLO-missed requests, so a bad run is immediately queryable via the
@@ -230,6 +289,7 @@ def build_workload(
     ``/debug/flight?trace=``) without re-running anything."""
     rng = random.Random(seed)
     tiers = draw_tiers(n, tier_mix, seed=seed)
+    models = draw_models(n, model_mix, model, seed=seed)
     share_rng = random.Random((seed << 16) ^ 0x5F1C)
     prefixes = (
         shared_prefix_texts(max(1, prefix_pool), shared_prefix_tokens)
@@ -283,7 +343,7 @@ def build_workload(
             (
                 t,
                 GenerationRequest(
-                    model,
+                    models[i],
                     prompt,
                     max_new_tokens=budgets[i % len(budgets)],
                     seed=i,
@@ -328,6 +388,10 @@ def run_load(
             "offset_s": offset,
             "t_submit": t_submit - start,
             "tier": getattr(request, "priority", None),
+            # the model the CALLER asked for ("auto" included); the
+            # fleet's resolved model overwrites this at completion so
+            # the per-model breakdown attributes to who actually ran
+            "model": request.model,
             # the caller-minted wire trace (ISSUE 13): carried on every
             # record so the summary can name WHICH requests went wrong
             "trace": (
@@ -380,6 +444,12 @@ def run_load(
 def _record_result(rec, result, t_submit, t_done, start) -> None:
     sched = (result.extras or {}).get("sched", {})
     router = (result.extras or {}).get("router", {})
+    fleet = (result.extras or {}).get("fleet", {})
+    # multi-model fleet attribution (ISSUE 15): the RESOLVED model (an
+    # "auto" request's policy pick, or the cascade's escalation target)
+    rec["model"] = result.request.model
+    if fleet.get("escalated"):
+        rec["escalated_from"] = fleet.get("escalated_from")
     rec.update(
         tokens=result.generated_tokens,
         completion_s=t_done - t_submit,
@@ -590,6 +660,37 @@ def summarize(records: List[Dict]) -> Dict:
     retried_traces = _traces([r for r in ok if r.get("retried")])
     if retried_traces:
         out["retried_traces"] = retried_traces
+    # per-model breakdown (ISSUE 15): mixed-model traffic's percentiles
+    # split by the model that ACTUALLY answered (an auto request counts
+    # on its resolved model), plus the small-first cascade's escalation
+    # count — the summary shape the model_fleet bench A/Bs read
+    models = sorted(
+        {r.get("model") for r in ok if r.get("model") is not None}
+    )
+    if len(models) > 1:
+        by_model = {}
+        for name in models:
+            m_recs = [r for r in ok if r.get("model") == name]
+            m_done = [r for r in m_recs if not r.get("cancelled")]
+            m_ttfts = [
+                r["ttft_s"] for r in m_recs if r.get("ttft_s") is not None
+            ]
+            m_comps = [r["completion_s"] for r in m_done]
+            entry = {
+                "requests": len(m_recs),
+                "tokens": sum(r["tokens"] for r in m_recs),
+                "completion_p50_s": round(percentile(m_comps, 50), 4),
+                "completion_p95_s": round(percentile(m_comps, 95), 4),
+            }
+            if m_ttfts:
+                entry["ttft_p50_s"] = round(percentile(m_ttfts, 50), 4)
+                entry["ttft_p95_s"] = round(percentile(m_ttfts, 95), 4)
+                entry["ttft_p99_s"] = round(percentile(m_ttfts, 99), 4)
+            by_model[name] = entry
+        out["models"] = by_model
+    escalated = sum(1 for r in ok if r.get("escalated_from"))
+    if escalated:
+        out["escalations"] = escalated
     # per-tier breakdown (ISSUE 11): the high-tier TTFT tail under
     # overload is THE number the preemption A/B trades for — reported
     # per tier so one summary line carries both sides of the trade
@@ -684,6 +785,16 @@ def main() -> int:
         "percentile breakdown",
     )
     ap.add_argument(
+        "--model-mix", default=None,
+        help="seeded per-request model assignment, e.g. "
+        "'small:1b=0.7,big:7b=0.3' (ISSUE 15; the last '=' separates "
+        "name from fraction — model names may contain colons; "
+        "uncovered fraction mass draws --model, which may be 'auto' "
+        "for policy-routed traffic); independent of the arrival/"
+        "length/tier streams, and the summary gains a per-model "
+        "percentile breakdown + escalation counts",
+    )
+    ap.add_argument(
         "--fake", action="store_true",
         help="drive an in-process fake-backend continuous scheduler "
         "instead of a live server (hermetic demo/CI)",
@@ -739,6 +850,9 @@ def main() -> int:
         prefix_pool=args.prefix_pool,
         shared_prefix_tokens=args.shared_prefix_tokens,
         tier_mix=parse_tier_mix(args.tier_mix) if args.tier_mix else None,
+        model_mix=(
+            parse_model_mix(args.model_mix) if args.model_mix else None
+        ),
     )
     cancellations = None
     if args.cancel_frac > 0:
@@ -767,12 +881,28 @@ def main() -> int:
         if args.prefix_share:
             prefix_counters0 = prefix_store_counters()
         records = []
+
+        def _build_sched():
+            # mixed-model traffic drives the multi-model fleet (ISSUE
+            # 15): one continuous lane per model, so the fake demo
+            # exercises the same concurrency the real fleet serves
+            if args.model_mix:
+                from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.model_fleet import (  # noqa: E501
+                    ModelFleetScheduler,
+                )
+
+                return ModelFleetScheduler(
+                    backend,
+                    models=sorted(parse_model_mix(args.model_mix)),
+                )
+            return ContinuousScheduler(backend)
+
         # one scheduler per session segment over the SAME backend: a
         # restart mid-trace is exactly what the engine store survives
         for segment in session_segments(workload, max(1, args.sessions)):
             if not segment:
                 continue
-            sched = ContinuousScheduler(backend)
+            sched = _build_sched()
             sched.start()
             try:
                 seg_cancellations = cancellations

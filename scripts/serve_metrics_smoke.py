@@ -108,6 +108,23 @@ Three phases, all over the deterministic fake backend:
     ``llm_request_wasted_joules_total{cause="retry"}`` moved, with the
     same figure riding the retried ticket's ``x_extras.energy``.
 
+12. PERSISTENT CROSS-SESSION PREFIX STORE (ISSUE 14): two SEQUENTIAL
+    fake-server sessions — the second session's joiner hits the
+    backend-owned store, a tightened HBM budget forces spills, and a
+    later request restores the spilled entry (all events trace-linked).
+
+13. MULTI-MODEL FLEET SERVING (ISSUE 15): two fake models behind ONE
+    server in fleet mode (``--model-policy small-first``). A long
+    big-model decode anchors its lane while two small-model requests
+    retire CONCURRENTLY on theirs (no cross-model head-of-line
+    blocking; ``llm_sched_batch_fallback_total`` stays flat on the
+    mixed trace); a ``model: "auto"`` request runs the small-first
+    cascade and ESCALATES — ``llm_request_wasted_joules_total
+    {cause="escalation"}`` moves with the same figure riding
+    ``x_extras.energy`` and the ``model_escalated`` flight event
+    fires; a FORCED weight eviction shows up on ``/api/ps`` and as a
+    ``model_evicted`` flight event.
+
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
 """
@@ -135,10 +152,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _post_generate(
-    base: str, prompt: str, num_predict: int, priority=None
+    base: str, prompt: str, num_predict: int, priority=None,
+    model: str = "smoke:1b",
 ):
     body = {
-        "model": "smoke:1b",
+        "model": model,
         "prompt": prompt,
         "options": {"num_predict": num_predict},
     }
@@ -1393,6 +1411,118 @@ def main() -> int:
     finally:
         server12.stop()
 
+    # -- phase 13: multi-model fleet serving (ISSUE 15) ------------------------
+    # TWO fake models behind ONE server in fleet mode (--model-policy):
+    # a long big-model decode anchors its lane while two small-model
+    # requests admit, step and retire CONCURRENTLY on theirs — both
+    # complete strictly before the big one (no cross-model head-of-line
+    # blocking) and the window-batch incompatibility fallback counter
+    # stays flat on the mixed trace. Then a model:"auto" request runs
+    # the small-first cascade: the small answer is length-cut, the
+    # request ESCALATES to the big model, the abandoned tokens charge
+    # llm_request_wasted_joules_total{cause="escalation"} with the same
+    # figure riding x_extras.energy, and the model_escalated flight
+    # event fires. Finally a FORCED eviction of the big model's weights
+    # shows up on /api/ps and as a model_evicted flight event.
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.energy import (
+        WASTED_J,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        _BATCH_FALLBACK_C,
+    )
+
+    backend13 = FakeBackend(
+        tokens_per_s=250.0,
+        simulate_delay=True,
+        model_bytes={"small:1b": 1024, "big:7b": 8192},
+        model_joules={"small:1b": 0.1, "big:7b": 0.9},
+    )
+    server13 = GenerationServer(
+        backend13,
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        models=["small:1b", "big:7b"],
+        model_policy="small-first",
+        escalate_max_tokens=16,
+    )
+    server13.start()
+    try:
+        base13 = f"http://127.0.0.1:{server13.port}"
+        fallback0_13 = _BATCH_FALLBACK_C.labels().value
+        done13 = {}
+
+        def client13(name, model, num_predict, delay_s):
+            time.sleep(delay_s)
+            body = _post_generate(base13, name, num_predict, model=model)
+            assert body.get("done"), body
+            done13[name] = time.monotonic()
+
+        threads13 = [
+            threading.Thread(
+                target=client13, args=("big-anchor", "big:7b", 128, 0.0)
+            ),
+            threading.Thread(
+                target=client13, args=("small-a", "small:1b", 8, 0.08)
+            ),
+            threading.Thread(
+                target=client13, args=("small-b", "small:1b", 8, 0.14)
+            ),
+        ]
+        for t in threads13:
+            t.start()
+        for t in threads13:
+            t.join(timeout=30)
+        assert set(done13) == {"big-anchor", "small-a", "small-b"}, done13
+        # concurrent retirement interleaving across models: the small
+        # lane's rows retired while the big lane was still decoding
+        assert done13["small-a"] < done13["big-anchor"], done13
+        assert done13["small-b"] < done13["big-anchor"], done13
+        # mixed-model traffic never trips the incompatibility fallback
+        assert _BATCH_FALLBACK_C.labels().value == fallback0_13
+        # auto → small-first cascade → escalation with the wasted charge
+        wasted0_13 = WASTED_J.labels(cause="escalation").value
+        auto13 = _post_generate(
+            base13, "an open-ended question", 32, model="auto"
+        )
+        assert auto13.get("model") == "big:7b", auto13
+        fleet13 = auto13.get("x_extras", {}).get("fleet", {})
+        assert fleet13.get("escalated") is True, auto13
+        assert fleet13.get("escalated_from") == "small:1b", auto13
+        wire_wasted13 = (
+            auto13["x_extras"]["energy"]["wasted_J"]["escalation"]
+        )
+        wasted_delta13 = (
+            WASTED_J.labels(cause="escalation").value - wasted0_13
+        )
+        assert wasted_delta13 > 0, "escalation never charged the ledger"
+        assert abs(wire_wasted13 - wasted_delta13) < 1e-6, (
+            wire_wasted13,
+            wasted_delta13,
+        )
+        escalated_events13 = _get_json(
+            base13, "/debug/flight?n=500&type=model_escalated"
+        )["events"]
+        assert escalated_events13, "no model_escalated flight event"
+        text13 = _scrape(base13)
+        assert _metric_value(text13, "llm_model_escalations_total") >= 1
+        assert _metric_value(text13, "llm_model_fleet_lanes") == 2
+        # /api/ps reflects a FORCED weight eviction
+        ps13 = _get_json(base13, "/api/ps")
+        names13 = {m["name"] for m in ps13["models"]}
+        assert {"small:1b", "big:7b"} <= names13, ps13
+        assert backend13.evict_model("big:7b") is True
+        ps13b = _get_json(base13, "/api/ps")
+        names13b = {m["name"] for m in ps13b["models"]}
+        assert "big:7b" not in names13b, ps13b
+        assert "small:1b" in names13b, ps13b
+        evicted13 = _get_json(
+            base13, "/debug/flight?n=500&type=model_evicted"
+        )["events"]
+        assert evicted13 and evicted13[-1].get("model") == "big:7b"
+    finally:
+        server13.stop()
+
     print(
         json.dumps(
             {
@@ -1465,6 +1595,12 @@ def main() -> int:
                     "shared_pages_mid_flight": mid12["shared_peak"],
                     "spill_events": len(spill_events12),
                     "restore_events": len(restore_events12),
+                },
+                "model_fleet": {
+                    "small_retired_before_big": True,
+                    "escalation_wasted_joules": round(wasted_delta13, 6),
+                    "escalated_events": len(escalated_events13),
+                    "ps_after_eviction": sorted(names13b),
                 },
             }
         )

@@ -600,6 +600,94 @@ def test_serve_replica_fleet_knobs(monkeypatch):
         serve_command(["--probe-interval-ms", "-5"])
 
 
+def test_serve_model_policy_knobs(monkeypatch):
+    """--model-policy / --escalate-max-tokens reach the server (ISSUE
+    15: the multi-model fleet scheduler); bad values fail fast and
+    omitting the flags keeps single-model serving."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner.cli import (
+        CommandError,
+        serve_command,
+    )
+
+    captured = {}
+
+    class FakeServer:
+        def __init__(self, backend, **kw):
+            captured.update(kw)
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server as srv
+
+    monkeypatch.setattr(srv, "GenerationServer", FakeServer)
+    cli.serve_command(
+        [
+            "--backend", "fake", "--port", "0",
+            "--models", "small:1b,big:7b",
+            "--model-policy", "cheapest-joules",
+            "--escalate-max-tokens", "16",
+        ]
+    )
+    assert captured["model_policy"] == "cheapest-joules"
+    assert captured["escalate_max_tokens"] == 16
+    assert captured["models"] == ["small:1b", "big:7b"]
+
+    captured.clear()
+    cli.serve_command(["--backend", "fake", "--port", "0"])
+    assert captured["model_policy"] is None  # single-model serving
+    assert captured["escalate_max_tokens"] is None
+
+    with pytest.raises(CommandError, match="model-policy"):
+        serve_command(["--model-policy", "biggest-first"])
+    with pytest.raises(CommandError, match="escalate-max-tokens"):
+        serve_command(["--escalate-max-tokens", "0"])
+    with pytest.raises(CommandError, match="escalate-max-tokens"):
+        serve_command(["--escalate-max-tokens", "lots"])
+
+
+def test_serve_replicas_with_model_policy_builds_fleet_lanes(monkeypatch):
+    """--replicas N + --model-policy: each replica hosts its OWN
+    multi-model fleet scheduler over its own backend."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.runner import cli
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.model_fleet import (  # noqa: E501
+        ModelFleetScheduler,
+    )
+
+    captured = {}
+
+    class FakeRouterServer:
+        def __init__(self, router, **kw):
+            captured["router"] = router
+
+        def serve_forever(self):
+            return None
+
+    import cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router as rt
+
+    monkeypatch.setattr(rt, "RouterServer", FakeRouterServer)
+    cli.serve_command(
+        [
+            "--backend", "fake", "--port", "0",
+            "--replicas", "2",
+            "--models", "small:1b,big:7b",
+            "--model-policy", "small-first",
+        ]
+    )
+    router = captured["router"]
+    try:
+        for replica in router.replicas():
+            assert isinstance(replica.scheduler, ModelFleetScheduler)
+            assert replica.scheduler.model_policy == "small-first"
+            assert set(replica.scheduler._lanes) == {
+                "small:1b",
+                "big:7b",
+            }
+    finally:
+        router.stop()
+
+
 def test_serve_fleet_command_knobs(monkeypatch):
     """serve-fleet attaches RemoteReplicas for each --targets entry;
     missing targets / bad policy fail fast."""

@@ -1,0 +1,481 @@
+"""Multi-model serving (ISSUE 15): per-model fleet lanes, energy-aware
+model routing, the weight-LRU eviction guard, and the router's model
+placement dimension."""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+    FakeBackend,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.energy import (
+    WASTED_J,
+    WASTED_TOKENS,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.model_fleet import (
+    ModelFleetScheduler,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.protocol import (
+    AUTO_MODEL,
+)
+
+SMALL, BIG = "small:1b", "big:7b"
+
+
+def _fleet(backend=None, policy="small-first", **kw):
+    backend = backend or FakeBackend(
+        model_bytes={SMALL: 100, BIG: 1000},
+        model_joules={SMALL: 0.1, BIG: 0.9},
+    )
+    fleet = ModelFleetScheduler(
+        backend, models=[BIG, SMALL], model_policy=policy, **kw
+    )
+    fleet.start()
+    return backend, fleet
+
+
+def _req(model, prompt="hello", n=8, **kw):
+    return GenerationRequest(model, prompt, max_new_tokens=n, **kw)
+
+
+# -- lanes + head-of-line blocking ---------------------------------------------
+
+
+def test_lanes_route_by_model_and_fallback_counter_stays_flat():
+    """Mixed-model traffic runs per-model lanes — no ticket ever hits
+    another model's session, so the window-batch incompatibility
+    fallback counter stays flat (the ISSUE-15 satellite pin)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import (
+        scheduler as sched_mod,
+    )
+
+    fallback0 = sched_mod._BATCH_FALLBACK_C.labels().value
+    _backend, fleet = _fleet()
+    try:
+        results = [
+            fleet.submit(_req(m, f"p{i}", n=4))
+            for i, m in enumerate([SMALL, BIG, SMALL, BIG])
+        ]
+        assert [r.request.model for r in results] == [SMALL, BIG, SMALL, BIG]
+        state = fleet.debug_state()
+        assert state["mode"] == "fleet"
+        assert set(state["lanes"]) == {SMALL, BIG}
+        assert state["kv_budget_frac"] == 0.5
+    finally:
+        fleet.stop()
+    assert sched_mod._BATCH_FALLBACK_C.labels().value == fallback0
+
+
+def test_no_cross_model_head_of_line_blocking():
+    """A long big-model decode in flight must not delay a small-model
+    request: the small lane admits/steps/retires concurrently (slices
+    interleave under the shared backend lock) instead of queueing for
+    the big session to drain."""
+    backend = FakeBackend(
+        tokens_per_s=200.0,
+        simulate_delay=True,
+        model_bytes={SMALL: 100, BIG: 1000},
+    )
+    _b, fleet = _fleet(backend)
+    done_at = {}
+
+    def client(name, model, n, delay_s):
+        time.sleep(delay_s)
+        fleet.submit(_req(model, name, n=n))
+        done_at[name] = time.monotonic()
+
+    try:
+        threads = [
+            threading.Thread(target=client, args=("big", BIG, 128, 0.0)),
+            threading.Thread(target=client, args=("s1", SMALL, 8, 0.08)),
+            threading.Thread(target=client, args=("s2", SMALL, 8, 0.12)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert set(done_at) == {"big", "s1", "s2"}
+        # the small requests finished strictly before the big decode —
+        # under the serialized (model-affine single scheduler) baseline
+        # they would wait its whole session out
+        assert done_at["s1"] < done_at["big"]
+        assert done_at["s2"] < done_at["big"]
+    finally:
+        fleet.stop()
+
+
+def test_kv_budget_frac_splits_admission_cap():
+    """The HBM envelope split: every live lane's admission cap scales
+    to its 1/N share, re-evaluated as lanes appear."""
+
+    class Probe(FakeBackend):
+        def max_admission_rows(self, request):
+            return 64
+
+    backend = Probe()
+    fleet = ModelFleetScheduler(backend, models=[SMALL])
+    lane = fleet._lanes[SMALL]
+    assert lane.kv_budget_frac == 1.0
+    fleet._ensure_lane(BIG)
+    assert lane.kv_budget_frac == 0.5
+    assert fleet._lanes[BIG].kv_budget_frac == 0.5
+
+    class Ticket:
+        request = _req(SMALL)
+
+    assert lane._admission_cap(Ticket()) == 32  # 64-row estimate halved
+
+
+# -- model: "auto" resolution --------------------------------------------------
+
+
+def test_auto_resolution_deterministic_under_pinned_registry():
+    """small-first always picks the smallest model by weight bytes;
+    cheapest-joules prefers the lowest LIVE J/token and falls back to
+    weight bytes before any attribution exists. Repeated resolution is
+    stable (ties break by name)."""
+    backend = FakeBackend(model_bytes={SMALL: 100, BIG: 1000})
+    fleet = ModelFleetScheduler(
+        backend, models=[BIG, SMALL], model_policy="small-first"
+    )
+    assert fleet.models_by_size() == [SMALL, BIG]
+    assert [fleet._choose()[0] for _ in range(3)] == [SMALL] * 3
+
+    # cheapest-joules, no live attribution: weight-bytes fallback
+    cheap = ModelFleetScheduler(
+        backend, models=[BIG, SMALL], model_policy="cheapest-joules"
+    )
+    assert cheap._choose() == (SMALL, False)
+    # live figures flip the ranking: big becomes the cheap one
+    backend.last_joules_per_token_by_model = {SMALL: 0.9, BIG: 0.1}
+    assert cheap._choose() == (BIG, False)
+    # a model WITH attribution outranks one without
+    backend.last_joules_per_token_by_model = {BIG: 0.5}
+    assert cheap._choose() == (BIG, False)
+
+    # pinned-registry determinism on a REAL engine: equal-size tiny
+    # models order by name (the weight-bytes estimate ties), repeatably
+    eng = _tiny_two_model_engine()
+    real = ModelFleetScheduler(
+        eng, models=["tiny-b", "tiny-a"], model_policy="small-first"
+    )
+    assert real.models_by_size() == ["tiny-a", "tiny-b"]
+    assert [real._choose() for _ in range(3)] == [("tiny-a", True)] * 3
+
+
+def test_auto_resolves_and_stamps_fleet_extras():
+    _backend, fleet = _fleet(policy="cheapest-joules")
+    try:
+        result = fleet.submit(_req(AUTO_MODEL, "route me", n=8))
+        assert result.request.model == SMALL
+        assert result.extras["fleet"] == {
+            "model": SMALL,
+            "policy": "cheapest-joules",
+        }
+    finally:
+        fleet.stop()
+
+
+# -- small-first cascade + escalation ------------------------------------------
+
+
+def test_escalation_on_length_cut_charges_wasted_ledger():
+    """An auto request whose small-model answer burns its whole budget
+    without EOS (the fake always budget-cuts) escalates to the big
+    model; the abandoned small tokens charge cause="escalation" and the
+    figure rides x_extras.energy next to the fleet attribution."""
+    wasted0 = WASTED_J.labels(cause="escalation").value
+    tokens0 = WASTED_TOKENS.labels(cause="escalation").value
+    _backend, fleet = _fleet()
+    try:
+        result = fleet.submit(_req(AUTO_MODEL, "long question", n=64))
+        assert result.request.model == BIG
+        assert result.extras["fleet"]["escalated"] is True
+        assert result.extras["fleet"]["escalated_from"] == SMALL
+        wire_j = result.extras["energy"]["wasted_J"]["escalation"]
+        ledger_j = WASTED_J.labels(cause="escalation").value - wasted0
+        assert wire_j > 0 and abs(wire_j - ledger_j) < 1e-6
+        # abandoned = small prompt prefill + its generated budget,
+        # priced at the small model's live J/token (0.1)
+        abandoned = (
+            WASTED_TOKENS.labels(cause="escalation").value - tokens0
+        )
+        assert abandoned == len(b"long question") + 1 + 64
+        assert abs(ledger_j - 0.1 * abandoned) < 1e-6
+        assert fleet.escalations == 1
+    finally:
+        fleet.stop()
+
+
+def test_no_escalation_below_length_floor():
+    """A tightly-capped answer is not evidence of low confidence: below
+    escalate_max_tokens the small result stands."""
+    _backend, fleet = _fleet(escalate_max_tokens=32)
+    try:
+        result = fleet.submit(_req(AUTO_MODEL, "short", n=8))
+        assert result.request.model == SMALL
+        assert "escalated" not in result.extras.get("fleet", {})
+        assert fleet.escalations == 0
+    finally:
+        fleet.stop()
+
+
+def test_streamed_auto_resolves_but_never_cascades():
+    backend = FakeBackend(
+        tokens_per_s=500.0,
+        simulate_delay=True,
+        model_bytes={SMALL: 100, BIG: 1000},
+    )
+    _b, fleet = _fleet(backend)
+    try:
+        channel = fleet.submit_stream(_req(AUTO_MODEL, "stream me", n=64))
+        final = None
+        for event in channel.events():
+            if event.kind == "done":
+                final = event.result
+            elif event.kind == "error":
+                raise event.error
+        # resolved small and STAYED small despite the budget cut —
+        # streamed tokens cannot be replaced by a bigger model's answer
+        assert final is not None and final.request.model == SMALL
+        assert fleet.escalations == 0
+    finally:
+        fleet.stop()
+
+
+def test_fleet_rejects_bad_config():
+    backend = FakeBackend()
+    with pytest.raises(ValueError, match="model policy"):
+        ModelFleetScheduler(backend, models=[SMALL], model_policy="best")
+    with pytest.raises(ValueError, match="escalate_max_tokens"):
+        ModelFleetScheduler(
+            backend, models=[SMALL], escalate_max_tokens=0
+        )
+
+    class NoStep:
+        pass
+
+    with pytest.raises(ValueError, match="stepped-decode"):
+        ModelFleetScheduler(NoStep(), models=[SMALL])
+
+
+# -- weight-LRU eviction guard (engine side) -----------------------------------
+
+
+def _tiny_two_model_engine():
+    import jax.numpy as jnp
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (  # noqa: E501
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+
+    tiny = get_model_config("qwen2:1.5b").tiny(max_seq_len=256)
+    a = dataclasses.replace(tiny, name="tiny-a")
+    b = dataclasses.replace(tiny, name="tiny-b")
+    return JaxEngine(
+        registry={"tiny-a": a, "tiny-b": b}, dtype=jnp.float32
+    )
+
+
+def test_eviction_deferred_until_live_session_drains(monkeypatch):
+    """The ISSUE-15 sharp edge: an LRU eviction whose victim holds live
+    stepped rows is DEFERRED (the deferral counter moves, the weights
+    stay) and runs only once the session drains and closes."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        MODEL_EVICT_DEFERRED_C,
+        MODEL_EVICTIONS_C,
+        MODEL_LOADED_G,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils import (
+        memory as mem,
+    )
+
+    eng = _tiny_two_model_engine()
+    budget = int(eng.model_weight_bytes("tiny-a") * 1.5)
+    monkeypatch.setattr(
+        mem, "device_allocation_budget", lambda device=None: budget
+    )
+    monkeypatch.setattr(mem, "LOAD_TRANSIENT_HEADROOM_BYTES", 0)
+
+    eng.load_model("tiny-a")
+    assert MODEL_LOADED_G.labels(model="tiny-a").value == 1.0
+    session = eng.decode_open(
+        [GenerationRequest("tiny-a", "hello", max_new_tokens=4)]
+    )
+    assert eng.live_sessions("tiny-a") == 1
+    deferred0 = MODEL_EVICT_DEFERRED_C.labels().value
+    evicted0 = MODEL_EVICTIONS_C.labels(reason="lru").value
+
+    eng.load_model("tiny-b")  # over budget — but tiny-a holds live rows
+    assert "tiny-a" in eng.loaded_models()  # deferred, not evicted
+    assert MODEL_EVICT_DEFERRED_C.labels().value == deferred0 + 1
+    assert MODEL_EVICTIONS_C.labels(reason="lru").value == evicted0
+    # the engine still answers for the live session — token stream
+    # unbroken by the deferral
+    while session.active:
+        session.step(4)
+    session.close()
+    assert eng.live_sessions("tiny-a") == 0
+
+    # with the session drained, the NEXT load's capacity pass evicts
+    eng._evict_weights("tiny-b", reason="lru")
+    eng.load_model("tiny-b")
+    assert "tiny-a" not in eng.loaded_models()
+    assert MODEL_LOADED_G.labels(model="tiny-a").value == 0.0
+    assert MODEL_EVICTIONS_C.labels(reason="lru").value > evicted0
+
+
+def test_session_pins_release_on_close_even_for_draft(monkeypatch):
+    """A failed open leaks no pin; a successful one pins exactly its
+    models and close() releases them exactly once."""
+    eng = _tiny_two_model_engine()
+    session = eng.decode_open(
+        [GenerationRequest("tiny-a", "x", max_new_tokens=2)]
+    )
+    assert eng.live_sessions("tiny-a") == 1
+    session.close()
+    session.close()  # idempotent
+    assert eng.live_sessions("tiny-a") == 0
+    with pytest.raises(ValueError):
+        eng.decode_open([])  # failed open: no pins
+    assert eng._live_sessions == {}
+
+
+# -- weight-lifecycle observability --------------------------------------------
+
+
+def test_fake_weight_lifecycle_gauges_and_events():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.flight import (
+        EV_MODEL_EVICTED,
+        EV_MODEL_LOADED,
+        FLIGHT,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        MODEL_LOADED_G,
+        MODEL_WEIGHT_BYTES_G,
+    )
+
+    backend = FakeBackend(model_bytes={SMALL: 4096})
+    backend.load_model(SMALL)
+    assert MODEL_LOADED_G.labels(model=SMALL).value == 1.0
+    assert MODEL_WEIGHT_BYTES_G.labels(model=SMALL).value == 4096
+    loaded_events = [
+        e
+        for e in FLIGHT.events(type_=EV_MODEL_LOADED)
+        if e.get("model") == SMALL
+    ]
+    assert loaded_events
+
+    assert backend.evict_model(SMALL) is True
+    assert backend.evict_model(SMALL) is False  # already gone
+    assert MODEL_LOADED_G.labels(model=SMALL).value == 0.0
+    assert SMALL not in backend.loaded_models()
+    evict_events = [
+        e
+        for e in FLIGHT.events(type_=EV_MODEL_EVICTED)
+        if e.get("model") == SMALL
+    ]
+    assert evict_events and evict_events[-1]["reason"] == "lru"
+
+
+# -- router: /api/ps federation + model placement ------------------------------
+
+
+def test_router_ps_federation_and_placement_preference():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router import (
+        LocalReplica,
+        Router,
+    )
+
+    warm = FakeBackend()
+    warm.load_model(BIG)
+    cold = FakeBackend()
+    cold.load_model(SMALL)
+    router = Router(
+        [
+            LocalReplica("warm", warm),
+            LocalReplica("cold", cold),
+        ],
+        policy="least-queue",
+    )
+    try:
+        router.probe_now()
+        ps = router.ps_state()
+        assert ps["x_replicas"] == {
+            "warm": [BIG],
+            "cold": [SMALL],
+        }
+        assert {m["name"]: m["x_replicas"] for m in ps["models"]} == {
+            BIG: ["warm"],
+            SMALL: ["cold"],
+        }
+        # placement: a BIG ticket prefers the replica holding it warm,
+        # repeatedly — even though least-queue alone would alternate
+        for _ in range(4):
+            assert router._pick(model=BIG).name == "warm"
+            assert router._pick(model=SMALL).name == "cold"
+        # a model nobody holds leaves the candidate set untouched
+        assert router._pick(model="stranger:13b") is not None
+        # dispatch routes by the request's model end-to-end
+        result = router.dispatch(_req(BIG, "placed", n=4))
+        assert result.extras["router"]["replica"] == "warm"
+    finally:
+        router.stop()
+
+
+# -- poisson_load model mix ----------------------------------------------------
+
+
+def test_model_mix_draws_seeded_and_summary_splits():
+    import os
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ),
+    )
+    from poisson_load import (
+        build_workload,
+        draw_models,
+        parse_model_mix,
+        run_load,
+        summarize,
+    )
+
+    mix = parse_model_mix(f"{SMALL}=0.5,{BIG}=0.5")
+    assert mix == {SMALL: 0.5, BIG: 0.5}
+    with pytest.raises(ValueError, match="sum past 1"):
+        parse_model_mix(f"{SMALL}=0.9,{BIG}=0.9")
+    draws = draw_models(32, mix, "auto", seed=3)
+    assert draws == draw_models(32, mix, "auto", seed=3)  # seeded
+    assert {SMALL, BIG} <= set(draws)
+    # the model stream is independent of arrivals: same seed, mix on or
+    # off, identical arrival offsets
+    base = build_workload(8, 0.001, seed=5, model=SMALL)
+    mixed = build_workload(8, 0.001, seed=5, model=SMALL, model_mix=mix)
+    assert [t for t, _ in base] == [t for t, _ in mixed]
+
+    _backend, fleet = _fleet()
+    try:
+        records = run_load(fleet.submit, mixed)
+    finally:
+        fleet.stop()
+    summary = summarize(records)
+    assert summary["errors"] == 0
+    assert set(summary["models"]) <= {SMALL, BIG}
+    assert (
+        sum(m["requests"] for m in summary["models"].values())
+        == summary["requests"]
+    )
