@@ -202,13 +202,28 @@ class _FakeStepSession:
         self._rows: List[dict] = []
         self._pending: List[dict] = []  # chunked joiners mid-prefill
         # Speculative simulation (the hermetic twin of the stepped
-        # sessions' draft-verify mode, ISSUE 9): with backend.spec_k > 0
-        # each step() slice runs ROUNDS, every live row advancing by
-        # 1 + round(spec_acceptance · k) tokens per round, the llm_spec_*
-        # families move, and a measured acceptance below the floor flips
-        # the session to plain advancement (llm_spec_fallback_total).
+        # sessions' draft-verify mode, ISSUE 9 + 16): with
+        # backend.spec_k > 0 each step() slice runs ROUNDS, every live
+        # row advancing by 1 + round(acceptance · k) tokens per round
+        # (SAMPLED rows — temperature > 0 — use the separate synthetic
+        # spec_sampled_acceptance), the llm_spec_* families move with
+        # the configured draft-source label, a cross-source session
+        # bills fully-rejected rounds' draft tokens into the
+        # wasted-energy ledger, and a measured acceptance below the
+        # floor flips the session to plain advancement
+        # (llm_spec_fallback_total{source}).
         self.spec_k = int(backend.spec_k)
+        self.spec_source = str(getattr(backend, "spec_source", "model"))
+        self.spec_draft = (
+            None
+            if self.spec_source == "ngram"
+            else str(getattr(backend, "spec_draft", "fake-draft"))
+        )
         self.spec_acceptance = float(backend.spec_acceptance)
+        sampled_acc = getattr(backend, "spec_sampled_acceptance", None)
+        self.spec_sampled_acceptance = (
+            self.spec_acceptance if sampled_acc is None else float(sampled_acc)
+        )
         self.spec_accept_floor = (
             backend.spec_accept_floor
             if spec_accept_floor is None
@@ -277,6 +292,8 @@ class _FakeStepSession:
                 "spec_rounds": 0,
                 "spec_accepted": 0,
                 "spec_drafted": 0,
+                "spec_rejected": 0,
+                "draft_wasted_J": 0.0,
                 **self._prefix_probe(request),
             }
         )
@@ -492,7 +509,8 @@ class _FakeStepSession:
         if self.spec_k > 0:
             state["spec"] = {
                 "active": self.spec_active,
-                "draft_model": "fake-draft",
+                "source": self.spec_source,
+                "draft_model": self.spec_draft,
                 "k": self.spec_k,
                 "fallback": self.spec_fallback,
                 "accept_floor": self.spec_accept_floor,
@@ -521,26 +539,68 @@ class _FakeStepSession:
             time.sleep(max_steps / self.backend.tokens_per_s)
         # speculative simulation: a slice is ROUNDS — each live row
         # advances by 1 + accepted-per-round tokens per round, mirroring
-        # the real session's per-row variable stride
-        advance = max_steps
+        # the real session's per-row variable stride. Sampled rows
+        # (temperature > 0) advance at the separate synthetic
+        # spec_sampled_acceptance — the hermetic stand-in for rejection
+        # resampling's acceptance rate (ISSUE 16).
         if self.spec_active and self._rows:
-            per_round = 1 + max(
-                0, min(self.spec_k, round(self.spec_acceptance * self.spec_k))
-            )
-            advance = max_steps * per_round
-            accepted = (per_round - 1) * max_steps
-            drafted = self.spec_k * max_steps
+            tot_accepted = tot_drafted = tot_rejected = 0
             for row in self._rows:
+                sampled = row["request"].temperature > 0
+                acc = (
+                    self.spec_sampled_acceptance
+                    if sampled
+                    else self.spec_acceptance
+                )
+                per_round = 1 + max(
+                    0, min(self.spec_k, round(acc * self.spec_k))
+                )
+                accepted = (per_round - 1) * max_steps
+                drafted = self.spec_k * max_steps
                 row["spec_rounds"] += max_steps
                 row["spec_accepted"] += accepted
                 row["spec_drafted"] += drafted
+                row["advance"] = max_steps * per_round
+                tot_accepted += accepted
+                tot_drafted += drafted
+                if per_round == 1:
+                    # every drafted token rejected all slice long: a
+                    # cross-model source bills the draft lane's burned
+                    # tokens to the wasted-energy ledger, priced at the
+                    # draft model's live J/token when the fleet hook
+                    # knows it (serve/model_fleet.py)
+                    row["spec_rejected"] += max_steps
+                    tot_rejected += max_steps * self.spec_k
+                    if self.spec_source == "cross":
+                        try:
+                            from ..obs.energy import charge_wasted
+
+                            hook = getattr(
+                                self.backend, "spec_draft_jpt", None
+                            )
+                            jpt = (
+                                hook(self.spec_draft)
+                                if hook is not None
+                                else None
+                            ) or self.backend._jpt_for(
+                                self.spec_draft
+                            ) or None
+                            row["draft_wasted_J"] += charge_wasted(
+                                "draft",
+                                tokens=float(max_steps * self.spec_k),
+                                jpt=jpt,
+                            )
+                        except Exception:  # noqa: BLE001 — telemetry only
+                            pass
             try:
                 from ..obs.metrics import SPEC_VERIFY_NATIVE_C, observe_spec
 
                 observe_spec(
                     max_steps,
-                    accepted * len(self._rows),
-                    drafted * len(self._rows),
+                    tot_accepted,
+                    tot_drafted,
+                    source=self.spec_source,
+                    rejected=tot_rejected,
                 )
                 # the fake simulates the ISSUE-10 native verify (its
                 # rows bill no slack anywhere), so the migration
@@ -550,25 +610,26 @@ class _FakeStepSession:
             except Exception:  # noqa: BLE001 — telemetry only
                 pass
             floor = self.spec_accept_floor
-            if floor and drafted and (accepted / drafted) < floor:
+            if floor and tot_drafted and (tot_accepted / tot_drafted) < floor:
                 self.spec_active = False
                 self.spec_fallback = True
                 try:
                     from ..obs.flight import EV_SPEC_FALLBACK, FLIGHT
                     from ..obs.metrics import SPEC_FALLBACK_C
 
-                    SPEC_FALLBACK_C.inc()
+                    SPEC_FALLBACK_C.labels(source=self.spec_source).inc()
                     FLIGHT.emit(
                         EV_SPEC_FALLBACK,
                         model=self.model,
-                        acceptance=round(accepted / drafted, 4),
+                        source=self.spec_source,
+                        acceptance=round(tot_accepted / tot_drafted, 4),
                         floor=floor,
                     )
                 except Exception:  # noqa: BLE001 — telemetry only
                     pass
         retired, keep = [], []
         for row in self._rows:
-            row["cursor"] += advance
+            row["cursor"] += row.pop("advance", max_steps)
             if row["cursor"] >= row["result"].generated_tokens:
                 res = row["result"]
                 self.backend._observe_energy(res)
@@ -582,10 +643,16 @@ class _FakeStepSession:
                         "rounds": row["spec_rounds"],
                         "accepted": row["spec_accepted"],
                         "drafted": row["spec_drafted"],
+                        "rejected": row["spec_rejected"],
                         "k": self.spec_k,
-                        "draft_model": "fake-draft",
+                        "source": self.spec_source,
+                        "draft_model": self.spec_draft,
                         "fallback": self.spec_fallback,
                     }
+                    if row["draft_wasted_J"]:
+                        res.extras["spec"]["draft_wasted_J"] = round(
+                            row["draft_wasted_J"], 6
+                        )
                 if self.stream_tokens and row["streamed"] < len(res.tokens):
                     tail = res.tokens[row["streamed"] :]
                     self._stream_tail.append(
@@ -659,7 +726,10 @@ class FakeBackend(GenerationBackend):
         prefix_store_host_bytes: "Optional[int]" = None,
         spec_k: int = 0,
         spec_acceptance: float = 1.0,
+        spec_sampled_acceptance: "Optional[float]" = None,
         spec_accept_floor: "Optional[float]" = None,
+        spec_source: str = "model",
+        spec_draft: str = "fake-draft",
         max_rows: int = 64,
         joules_per_token: float = 0.0,
         model_joules: "Optional[Dict[str, float]]" = None,
@@ -715,10 +785,25 @@ class FakeBackend(GenerationBackend):
         # spec_k > 0 makes stepped sessions speak the draft-verify
         # protocol with CONFIGURABLE synthetic acceptance — llm_spec_*
         # families, per-row spec debug fields and the auto-fallback are
-        # CI-testable with no accelerator (see _FakeStepSession.step)
+        # CI-testable with no accelerator (see _FakeStepSession.step).
+        # ISSUE 16 twins: spec_source labels the metric families
+        # ("model" | "ngram" | "cross"), spec_sampled_acceptance is the
+        # separate synthetic acceptance sampled rows (temperature > 0)
+        # advance at (default: same as greedy), and a cross source
+        # bills fully-rejected rounds' draft tokens as wasted Joules —
+        # priced by the spec_draft_jpt fleet hook when wired, exactly
+        # like the real engine.
         self.spec_k = int(spec_k)
+        self.spec_source = str(spec_source)
+        self.spec_draft = str(spec_draft)
         self.spec_acceptance = float(spec_acceptance)
+        self.spec_sampled_acceptance = (
+            float(spec_sampled_acceptance)
+            if spec_sampled_acceptance is not None
+            else None
+        )
         self.spec_accept_floor = spec_accept_floor
+        self.spec_draft_jpt = None
         self.loaded: Dict[str, bool] = {}
 
     def load_model(self, model: str) -> None:

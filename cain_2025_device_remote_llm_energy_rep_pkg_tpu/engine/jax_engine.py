@@ -293,6 +293,7 @@ class JaxEngine(GenerationBackend):
         prefill_attention: "str | PrefillAttentionFn | None" = "auto",
         speculative: "Optional[Dict[str, Tuple[str, int]]]" = None,
         spec_accept_floor: float = 0.0,  # stepped-session auto-fallback
+        spec_temperature_max: float = 2.0,  # sampled-spec eligibility cap
         prefix_cache_size: int = 0,  # cached prompt-KV entries per model
         prefix_cache_bytes: Optional[int] = None,  # total KV bytes cap
         kv_quantize: Optional[str] = None,  # None | "int8" (decode path)
@@ -399,12 +400,33 @@ class JaxEngine(GenerationBackend):
                 scope=prefix_store_scope,
             )
         self.quantize = quantize
-        # target model → (draft model, k): greedy requests for the target
-        # route through speculative decoding (engine/speculative.py). A
-        # "default" key applies one draft to EVERY served target (the
-        # `serve --speculative <draft>[:k]` draft-only form); a model
-        # never self-drafts through the default (pure overhead).
-        self.speculative = dict(speculative or {})
+        # target model → DraftSpec(source, draft, k): eligible requests
+        # for the target route through speculative decoding
+        # (engine/speculative.py). Accepted value forms per target:
+        # ("small", 4) — small-model autoregressive draft; ("ngram", 4)
+        # — prompt-lookup drafting, zero extra weights;
+        # ("cross:small", 4) — cross-model drafting on another serving
+        # lane's resident model (ISSUE 16). A "default" key applies one
+        # spec to EVERY served target (the `serve --speculative
+        # <draft>[:k]` draft-only form); a model never self-drafts
+        # through the default (pure overhead; ngram has no draft model
+        # so it applies everywhere).
+        from .speculative import DraftSpec
+
+        def _norm_spec(value) -> DraftSpec:
+            if isinstance(value, DraftSpec):
+                return value
+            draft, k = value
+            if draft == "ngram":
+                return DraftSpec("ngram", None, int(k))
+            if isinstance(draft, str) and draft.startswith("cross:"):
+                return DraftSpec("cross", draft.split(":", 1)[1], int(k))
+            return DraftSpec("model", draft, int(k))
+
+        self.speculative = {
+            name: _norm_spec(value)
+            for name, value in (speculative or {}).items()
+        }
         # Stepped-session adaptive policy (engine/stepped.py): when the
         # rolling measured acceptance of a speculating session drops
         # below this fraction, the session falls back to plain decode
@@ -415,6 +437,30 @@ class JaxEngine(GenerationBackend):
                 f"spec_accept_floor must be in [0, 1), got {spec_accept_floor}"
             )
         self.spec_accept_floor = float(spec_accept_floor)
+        # Sampled-spec eligibility cap (ISSUE 16): requests with
+        # temperature in (0, spec_temperature_max] speculate via the
+        # rejection-resampling lane; hotter requests serve plain (the
+        # modified distributions flatten toward uniform there and
+        # acceptance collapses — pure overhead). 0 restores the PR-9
+        # greedy-only gate.
+        if float(spec_temperature_max) < 0.0:
+            raise ValueError(
+                f"spec_temperature_max must be >= 0, got "
+                f"{spec_temperature_max}"
+            )
+        self.spec_temperature_max = float(spec_temperature_max)
+        # Per-SOURCE acceptance memory (ISSUE 16): recent fallback
+        # acceptances keyed "source:draft". n-gram acceptance collapses
+        # on non-repetitive text; learning the window per source keys
+        # lets ngram sessions stop re-arming speculation without
+        # dragging model-draft sessions down with them. Sessions append
+        # on fallback (engine/stepped.py::_spec_fall_back) and clear on
+        # healthy close; _init_spec consults it before arming.
+        self._spec_source_health: Dict[str, list] = {}
+        # Optional fleet hook (serve/model_fleet.py): maps a DRAFT model
+        # name to its live J/token so fully-rejected cross-model rounds
+        # bill honest draft Joules into the wasted-energy ledger.
+        self.spec_draft_jpt: Optional[Callable[[str], Optional[float]]] = None
         # model name → local HF checkpoint dir; load_model converts the
         # trained weights (models/convert.py) instead of random-initialising
         # (the analogue of Ollama's pulled model store, README.md:29-31).
@@ -1825,27 +1871,80 @@ class JaxEngine(GenerationBackend):
         self._observe_result(result, st, t2)
         return result
 
-    def _resolve_spec(self, model: str) -> "Optional[Tuple[str, int]]":
-        """The (draft model, k) speculative config that applies to
+    def _resolve_spec(self, model: str):
+        """The :class:`~.speculative.DraftSpec` that applies to
         ``model``: an exact entry wins, else the ``"default"`` entry
         (the draft-only CLI form). A model never drafts for itself via
         the default — that would pay k+1 forwards of the SAME weights
-        per round for zero amortization."""
+        per round for zero amortization (the ngram source has no draft
+        model, so the rule never blocks it)."""
         spec = self.speculative.get(model)
         if spec is None:
             spec = self.speculative.get("default")
-            if spec is not None and spec[0] == model:
+            if spec is not None and spec.draft == model:
                 return None
         return spec
 
-    @staticmethod
-    def _spec_eligible(request: GenerationRequest) -> bool:
-        """Greedy-only, like the solo path: accepted drafts are exactly
-        target-argmax tokens, so temperature must be 0 and the presence
-        penalty off (it would perturb the argmax per emitted token)."""
+    def _spec_eligible(self, request: GenerationRequest) -> bool:
+        """Speculation eligibility per request (ISSUE 16): greedy rows
+        verify by argmax match (bit-parity), sampled rows by rejection
+        resampling — any temperature up to ``spec_temperature_max``
+        qualifies. The presence penalty stays excluded: it perturbs the
+        modified distribution per EMITTED token, which the k-wide
+        proposal step cannot replicate mid-round."""
         return (
-            request.temperature == 0.0 and request.repeat_penalty == 1.0
+            request.repeat_penalty == 1.0
+            and (
+                request.temperature == 0.0
+                or request.temperature <= self.spec_temperature_max
+            )
         )
+
+    # -- per-source acceptance memory (ISSUE 16) ----------------------------
+    @staticmethod
+    def _spec_source_key(source: str, draft: "Optional[str]") -> str:
+        return f"{source}:{draft or ''}"
+
+    def _spec_source_feedback(
+        self, source: str, draft: "Optional[str]", acceptance: float
+    ) -> None:
+        """Record one session's fallback acceptance under its source
+        key (bounded window — only the recent past should gate)."""
+        window = self._spec_source_health.setdefault(
+            self._spec_source_key(source, draft), []
+        )
+        window.append(float(acceptance))
+        del window[:-8]
+
+    def _spec_source_clear(
+        self, source: str, draft: "Optional[str]"
+    ) -> None:
+        """A session speculated to healthy completion: forget the
+        source's fallback history so it re-arms immediately."""
+        self._spec_source_health.pop(
+            self._spec_source_key(source, draft), None
+        )
+
+    def _spec_source_blocked(
+        self, source: str, draft: "Optional[str]", floor: float
+    ) -> bool:
+        """Whether new sessions should skip arming this source: ≥2
+        recent fallbacks whose mean acceptance sits under the floor.
+        Consulting pops the OLDEST entry, so a blocked source decays
+        back to armed after a few skipped sessions — a cheap re-probe
+        rather than a permanent ban. Keyed per source (ngram collapse
+        on non-repetitive text must not gate model-draft sessions)."""
+        if floor <= 0.0:
+            return False
+        window = self._spec_source_health.get(
+            self._spec_source_key(source, draft)
+        )
+        if window is None or len(window) < 2:
+            return False
+        blocked = sum(window) / len(window) < floor
+        if blocked:
+            window.pop(0)
+        return blocked
 
     def generate(self, request: GenerationRequest) -> GenerationResult:
         if request.stop:
@@ -1860,19 +1959,21 @@ class JaxEngine(GenerationBackend):
             raise RuntimeError("stream ended without a final chunk")
         spec = self._resolve_spec(request.model)
         if spec is not None and self._spec_eligible(request):
-            # Same tokens as plain greedy decode, just faster (the accepted
-            # tokens ARE the greedy tokens); sampled requests fall through
-            # to the plain loop, as do requests whose speculative cache
-            # margin wouldn't fit max_seq_len (plain decode still serves
-            # them — configuring a draft must never reject a request).
+            # Greedy rows get the same tokens as plain greedy decode,
+            # just faster (the accepted tokens ARE the greedy tokens);
+            # sampled rows get exactly target-distributed tokens via
+            # rejection resampling (ISSUE 16). Requests whose
+            # speculative cache margin wouldn't fit max_seq_len serve
+            # plain — configuring a draft must never reject a request.
             self.load_model(request.model)
             cfg = self._models[request.model].cfg
             ids = self._tokenizer_for(request.model).encode(request.prompt)
             s_b = _prompt_alloc(len(ids))
             g_b = _bucket(request.max_new_tokens, GEN_BUCKETS)
-            if s_b + g_b + _spec_margin(spec[1]) <= cfg.max_seq_len:
+            if s_b + g_b + _spec_margin(spec.k) <= cfg.max_seq_len:
                 return self.generate_speculative(
-                    request, spec[0], spec[1], prompt_ids=ids
+                    request, spec.draft, spec.k, prompt_ids=ids,
+                    source=spec.source,
                 )
             return self._generate_plain(request, prompt_ids=ids)
         return self._generate_plain(request)
@@ -1924,24 +2025,66 @@ class JaxEngine(GenerationBackend):
     def generate_speculative(
         self,
         request: GenerationRequest,
-        draft_model: str,
+        draft_model: "Optional[str]" = None,
         k: int = 4,
         prompt_ids: "Optional[list[int]]" = None,
+        source: str = "model",
     ) -> GenerationResult:
-        """Greedy decode via draft-and-verify (engine/speculative.py): the
-        draft proposes ``k`` tokens per round, the target verifies them in
-        one forward. Output tokens are bit-identical to plain greedy
-        :meth:`generate`; ``result.extras`` reports rounds/accepted.
+        """Decode via draft-and-verify (engine/speculative.py): the
+        draft source proposes ``k`` tokens per round, the target
+        verifies them in one forward. Greedy requests produce tokens
+        bit-identical to plain greedy :meth:`generate`; sampled
+        requests (ISSUE 16) produce exactly target-distributed tokens
+        via rejection resampling. ``result.extras`` reports
+        rounds/accepted.
 
-        The draft must share the target's vocabulary (same tokenizer); the
-        KV caches carry a ``2k+2``-slot margin beyond the usual buckets, so
-        requests near ``max_seq_len`` may need a smaller budget.
+        A model draft must share the target's vocabulary (same
+        tokenizer); the KV caches carry a ``2k+2``-slot margin beyond
+        the usual buckets, so requests near ``max_seq_len`` may need a
+        smaller budget. ``source`` picks the draft lane: ``"model"`` /
+        ``"cross"`` need ``draft_model``, ``"ngram"`` drafts from the
+        request's own prompt+generated history (zero extra weights).
+
+        Greedy model/cross requests keep the monolithic solo loop
+        (``build_spec_fn`` — the whole budget in one compiled call);
+        everything else (any sampled request, every ngram request)
+        drains a one-row stepped session so the rejection-resampling
+        lane and the n-gram matcher live in ONE compiled step — the
+        temperature guard this method used to raise is now the sampled
+        path.
         """
-        if request.temperature != 0.0 or request.repeat_penalty != 1.0:
+        if request.repeat_penalty != 1.0:
             raise ValueError(
-                "speculative decoding is greedy-only (temperature=0, "
-                "repeat_penalty=1)"
+                "speculative decoding requires repeat_penalty=1 (the "
+                "presence penalty perturbs the modified distribution "
+                "per emitted token, which a k-wide proposal step "
+                "cannot replicate)"
             )
+        if request.temperature != 0.0 or source == "ngram":
+            from .speculative import DraftSpec
+
+            override = DraftSpec(
+                source, None if source == "ngram" else draft_model, k
+            )
+            session = self.decode_open([request], spec_override=override)
+            try:
+                results: "list[GenerationResult]" = []
+                while session.active:
+                    results.extend(session.step())
+            finally:
+                session.close()
+            result = results[0]
+            spec_x = (result.extras or {}).get("spec")
+            if spec_x is not None:
+                # legacy flat keys, for wire parity with the greedy
+                # solo path's extras shape
+                result.extras.update(
+                    spec_rounds=spec_x["rounds"],
+                    spec_accepted=spec_x["accepted"],
+                    draft_model=spec_x["draft_model"],
+                    k=spec_x["k"],
+                )
+            return result
         model = request.model
         self.load_model(model)
         self.load_model(draft_model)
@@ -2045,13 +2188,14 @@ class JaxEngine(GenerationBackend):
                 "drafted": rounds * k,
                 "k": k,
                 "draft_model": draft_model,
+                "source": source,
             },
         }
         if _obs_enabled():
             try:
                 from ..obs.metrics import observe_spec
 
-                observe_spec(rounds, acc, rounds * k)
+                observe_spec(rounds, acc, rounds * k, source=source)
                 from ..obs.flight import EV_SPEC_ROUND, FLIGHT, trace_of
 
                 FLIGHT.emit(
@@ -2059,6 +2203,7 @@ class JaxEngine(GenerationBackend):
                     trace=trace_of(_TRACER.current()),
                     model=request.model,
                     draft=draft_model,
+                    source=source,
                     k=k,
                     rounds=rounds,
                     accepted=acc,
@@ -2679,13 +2824,16 @@ class JaxEngine(GenerationBackend):
     def _spec_batch_decode_step_fn(
         self,
         model: str,
-        draft_model: str,
+        draft_model: "Optional[str]",
         k: int,
         n_steps: int,
         paged: bool,
         quantized: bool,
         stacked: bool = False,
         carry=None,
+        source: str = "model",
+        top_k: int = 0,
+        use_top_p: bool = False,
     ) -> Callable:
         """Speculative twin of the stepped decode fns (ISSUE 9): per
         slice, ``n_steps`` draft-verify ROUNDS instead of single-token
@@ -2706,15 +2854,26 @@ class JaxEngine(GenerationBackend):
         (kernel-less fallback) verifies against the gathered pool with
         candidates in the scratch carry leaves and commits the block
         through the table after acceptance. Either way no slack pages
-        exist to bill."""
+        exist to bill.
+
+        ``source``/``top_k``/``use_top_p`` (ISSUE 16) are compile-time
+        statics like the layout flags: the source picks the draft lane
+        (``ngram`` has no draft model — ``draft_model`` is None and the
+        params pair carries None in the draft slot), and the sampling
+        statics shape the sampled rejection-resampling lane exactly
+        like the plain stepped twin's cache key does."""
         key = (
             "spec-step", model, draft_model, k, n_steps, paged,
-            quantized, stacked,
+            quantized, stacked, source, top_k, use_top_p,
         )
         if key in self._decode_cache:
             return self._decode_cache[key]
         tcfg = self._models[model].cfg
-        dcfg = self._models[draft_model].cfg
+        dcfg = (
+            self._models[draft_model].cfg
+            if draft_model is not None
+            else None
+        )
         eos = self._tokenizer_for(model).eos_id
         from .speculative import build_spec_step_fn
 
@@ -2728,6 +2887,9 @@ class JaxEngine(GenerationBackend):
             decode_attention=(
                 self._paged_decode_attention(tcfg) if stacked else None
             ),
+            source=source,
+            top_k=top_k,
+            use_top_p=use_top_p,
         )
         decode = self._stepped_jit(tcfg, carry, fn, draft_cfg=dcfg)
         self._decode_cache[key] = decode
@@ -2739,6 +2901,7 @@ class JaxEngine(GenerationBackend):
         reserve_rows: Optional[int] = None,
         slice_steps: Optional[int] = None,
         spec_accept_floor: Optional[float] = None,
+        spec_override=None,
     ):
         """Open an iteration-level decode session over ``requests`` (the
         stepped-decode protocol the continuous scheduler drives —
@@ -2755,18 +2918,24 @@ class JaxEngine(GenerationBackend):
 
         When this engine has a speculative config for the model
         (ctor ``speculative=``, CLI ``--speculative``) and every opening
-        request is greedy, the session runs in DRAFT-VERIFY mode:
-        slices are rounds, rows advance by their accepted-prefix length,
-        and the session's rolling acceptance drives the auto-fallback
-        policy — ``spec_accept_floor`` (default: the engine's ctor
-        value; the ``serve --spec-accept-floor`` knob lands here through
-        the continuous scheduler)."""
+        request is eligible (repeat_penalty 1 and temperature ≤
+        ``spec_temperature_max`` — greedy AND sampled rows since ISSUE
+        16), the session runs in DRAFT-VERIFY mode: slices are rounds,
+        rows advance by their accepted-prefix length, and the session's
+        rolling acceptance drives the per-source auto-fallback policy —
+        ``spec_accept_floor`` (default: the engine's ctor value; the
+        ``serve --spec-accept-floor`` knob lands here through the
+        continuous scheduler). ``spec_override`` forces a specific
+        :class:`~.speculative.DraftSpec` instead of the engine's
+        resolved config (the solo sampled path uses it to drain one
+        request through a private session)."""
         from .stepped import SteppedDecodeSession
 
         return SteppedDecodeSession.open(
             self, requests, reserve_rows=reserve_rows,
             slice_steps=slice_steps,
             spec_accept_floor=spec_accept_floor,
+            spec_override=spec_override,
         )
 
     def _paged_decode_attention(self, cfg: Optional[ModelConfig] = None):
@@ -3347,24 +3516,28 @@ class JaxEngine(GenerationBackend):
         if spec is not None and not self.paged_kv:
             g_bucket = _bucket(request.max_new_tokens, GEN_BUCKETS)
             s_bucket = _prompt_alloc(max(len(ids), 1))
-            margin = _spec_margin(spec[1])
+            margin = _spec_margin(spec.k)
             bytes_per_row = self._contiguous_row_bytes(
                 cfg, s_bucket + margin, g_bucket
             )
-            try:
-                dcfg = (
-                    self.registry[spec[0]]
-                    if spec[0] in self.registry
-                    else get_model_config(spec[0])
-                )
-                itemsize = jnp.dtype(self.dtype).itemsize
-                bytes_per_row += (
-                    2 * dcfg.n_layers * dcfg.n_kv_heads
-                    * (s_bucket + g_bucket + margin)
-                    * dcfg.d_head * itemsize
-                )
-            except Exception:  # noqa: BLE001 — estimate only
-                pass
+            if spec.draft is not None:
+                # model/cross sources add the draft's own (tiny,
+                # unquantized) batch cache; ngram adds only an int32
+                # history row — negligible next to the KV payload
+                try:
+                    dcfg = (
+                        self.registry[spec.draft]
+                        if spec.draft in self.registry
+                        else get_model_config(spec.draft)
+                    )
+                    itemsize = jnp.dtype(self.dtype).itemsize
+                    bytes_per_row += (
+                        2 * dcfg.n_layers * dcfg.n_kv_heads
+                        * (s_bucket + g_bucket + margin)
+                        * dcfg.d_head * itemsize
+                    )
+                except Exception:  # noqa: BLE001 — estimate only
+                    pass
             max_rows = BATCH_MIN_SPLIT_ROWS
             for b_ in BATCH_BUCKETS:
                 if (

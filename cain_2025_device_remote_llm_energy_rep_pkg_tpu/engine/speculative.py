@@ -1,58 +1,310 @@
-"""Speculative decoding: a small draft model proposes, the target verifies.
+"""Speculative decoding: a draft source proposes, the target verifies.
 
-Plain greedy decode is HBM-bandwidth-bound: every generated token streams
-the target's full weights once. Speculative decoding lets a cheap draft
-model run ``k`` sequential steps, then the target scores all ``k`` drafts
-*in one forward* (k+1 positions — reading its weights once for up to k+1
-tokens). Accepted drafts are exactly the tokens target-greedy would have
-produced, so the output is **bit-identical to plain greedy decode under
-matching kernel numerics** — only latency changes. With a well-matched
-draft, tokens per target-weight-read approaches k+1.
+Plain decode is HBM-bandwidth-bound: every generated token streams the
+target's full weights once. Speculative decoding lets a cheap draft
+source run ``k`` sequential proposals, then the target scores all ``k``
+drafts *in one forward* (k+1 positions — reading its weights once for up
+to k+1 tokens). With a well-matched draft, tokens per target-weight-read
+approaches k+1.
 
-Numerics caveat: the verify forward scores k+1 positions in one pass while
-the plain loop scores one position per pass; when the two run different
-attention kernels (Pallas decode vs XLA-fused verify) at bf16, a near-tied
-argmax can resolve differently. With trained weights argmax is decisive
-and this is negligible (the standard situation for every speculative
-implementation); with random flat-logit test weights it shows up, so the
-parity tests pin float32.
+Two acceptance regimes share one compiled step (ISSUE 16):
 
-The reference's Ollama backend (experiment/RunnerConfig.py:128-131) has no
-speculative path; this is a capability the TPU rebuild adds on top of
-parity. Greedy-only by design: sampled speculative decoding needs the
-rejection-resampling scheme and is not needed for the energy study's
-deterministic workloads.
+- **Greedy rows** (temperature < 1e-6): accepted drafts are exactly the
+  tokens target-greedy would have produced, so the output is
+  **bit-identical to plain greedy decode under matching kernel
+  numerics** — only latency changes.
+- **Sampled rows**: Leviathan et al. 2023 rejection resampling. Each
+  candidate ``x_j ~ q_j`` is accepted with probability
+  ``min(1, p_j(x_j)/q_j(x_j))`` where ``p``/``q`` are the target's and
+  draft's *modified* distributions (the full sampler chain — top-k →
+  nucleus → temperature; ops/sampling.py::modified_probs). At the first
+  rejection the emitted token is resampled from the normalized residual
+  ``max(p − q, 0)``; at full acceptance the bonus token is the target's
+  own sample (the residual formula with ``q ≡ 0``, so one code path
+  serves both cases). The emitted stream's marginals are *provably
+  identical* to plain ancestral sampling from the target chain — pinned
+  statistically by the chi-squared/TV suite at temperature 0.7, while
+  the temperature-0 parity suite proves greedy is the special case.
+  Per-row rng keys thread through the carry (``k+3`` splits per round:
+  next-carry key, k draft-proposal keys, one accept-uniform key, one
+  residual/bonus key), so the compiled step stays deterministic per
+  seed and bit-exact across preempt/resume round-trips.
 
-The whole multi-round loop is one compiled ``lax.while_loop``: draft scan,
-verify forward, accept/emit arithmetic — no host round-trips between
-rounds.
+Numerics caveat: the verify forward scores k+1 positions in one pass
+while the plain loop scores one position per pass; when the two run
+different attention kernels (Pallas decode vs XLA-fused verify) at bf16,
+a near-tied argmax can resolve differently. With trained weights argmax
+is decisive and this is negligible; with random flat-logit test weights
+it shows up, so the parity tests pin float32.
+
+**DraftSource protocol** — the draft side is factored behind three
+interchangeable sources (the verify/accept lane never knows which one
+ran):
+
+- :class:`ModelDraftSource` — a small autoregressive draft model with
+  its own contiguous KV cache (``draft_k``/``draft_v``/
+  ``draft_offsets`` carry leaves). ``q`` = the draft's modified
+  distribution.
+- :class:`NgramDraftSource` — prompt-lookup drafting (Saxena 2023):
+  longest-suffix match of the row's recent tokens against its own
+  prompt+generated history (``ngram_hist``/``ngram_len`` carry leaves,
+  pure int32 ops, zero extra weights). The proposal is deterministic
+  given the history, so ``q`` is the degenerate one-hot distribution:
+  the accept test collapses to ``u < p(x_j)`` and the residual zeroes
+  the proposed token's mass — still exactly target-distributed.
+- :class:`CrossModelDraftSource` — mechanically a ModelDraftSource, but
+  the draft weights belong to ANOTHER serving lane's resident model
+  (ISSUE 15 fleet): tagged separately so the fleet can pin the draft
+  model against eviction and bill fully-rejected rounds' draft Joules
+  into the wasted-energy ledger.
+
+The whole multi-round loop is one compiled ``lax.while_loop``: draft
+proposals, verify forward, accept/emit arithmetic — no host round-trips
+between rounds.
 
 Two builders live here:
 
-- :func:`build_spec_fn` — the SOLO path (one request, contiguous caches,
-  runs the whole budget in one compiled call);
-- :func:`build_spec_step_fn` — the BATCHED slice step for stepped decode
-  sessions (engine/stepped.py): per slice it runs ``n_real`` rounds where
-  every live row drafts ``k`` tokens sequentially (cheap), then ONE
+- :func:`build_spec_fn` — the SOLO path (one request, contiguous
+  caches, greedy fast-path; sampled solo requests route through a
+  one-row stepped session instead);
+- :func:`build_spec_step_fn` — the BATCHED slice step for stepped
+  decode sessions (engine/stepped.py): per slice it runs ``n_real``
+  rounds where every live row drafts ``k`` tokens (cheap), then ONE
   target forward scores each row's ``k+1`` candidate positions
   (models/transformer.py's per-row-offset block verify), and each row
-  advances by its own longest-accepted-prefix length ``m ∈ [1, k+1]`` —
-  SpecInfer's observation (Miao et al. 2024) that batched draft-verify is
-  where speculation must live to matter for serving. Rows' offsets,
+  advances by its own accepted-prefix length ``m ∈ [1, k+1]`` —
+  SpecInfer's observation (Miao et al. 2024) that batched draft-verify
+  is where speculation must live to matter for serving. Rows' offsets,
   budgets and done-masks therefore move at PER-ROW variable stride; the
   function has the stepped-decode contract (``(params, carry, n_real) →
-  (out, n_row, carry)``) so the session/scheduler machinery — retirement,
-  joins, cancellation, TP shardings, carry donation — is unchanged.
+  (out, n_row, carry)``) so the session/scheduler machinery —
+  retirement, joins, cancellation, TP shardings, carry donation — is
+  unchanged.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..models.transformer import forward, logits_for
+from ..ops.sampling import modified_probs, sample_token_per_row
+
+
+class DraftSpec(NamedTuple):
+    """A resolved speculative configuration: which draft source proposes
+    for a target model, and how many tokens per round. ``draft`` is the
+    draft model name for model/cross sources and ``None`` for ngram."""
+
+    source: str  # "model" | "ngram" | "cross"
+    draft: Optional[str]
+    k: int
+
+
+#: Longest suffix the n-gram matcher tries to match (it degrades to
+#: shorter suffixes automatically — the score prefers longer matches).
+NGRAM_MAX = 3
+
+
+def ngram_propose(
+    hist: jnp.ndarray,  # [B, H] int32 prompt+generated history
+    hlen: jnp.ndarray,  # [B] int32 valid length
+    k: int,
+    nmax: int = NGRAM_MAX,
+) -> jnp.ndarray:
+    """Prompt-lookup draft proposals: for each row, find the latest,
+    longest (≤ ``nmax``) earlier occurrence of the history's current
+    suffix and propose the ``k`` tokens that followed it. Rows with no
+    match propose their last token repeated — the verify rejects per
+    the target's own distribution, so a bad proposal costs acceptance,
+    never correctness. Pure int32 gather/compare ops, vectorized over
+    rows; jit/while-loop safe."""
+    b, h = hist.shape
+    pos = jnp.arange(h)
+    # tail[j] = hist[hlen-1-j] — the suffix, newest token first
+    tail = jnp.stack(
+        [
+            jnp.take_along_axis(
+                hist, jnp.maximum(hlen[:, None] - 1 - j, 0), axis=1
+            )[:, 0]
+            for j in range(nmax)
+        ],
+        axis=1,
+    )  # [B, nmax]
+    # mlen[p] = longest match of the suffix ending at position p
+    run = jnp.ones((b, h), dtype=bool)
+    mlen = jnp.zeros((b, h), jnp.int32)
+    for j in range(nmax):
+        shifted = jnp.roll(hist, j, axis=1)  # shifted[p] = hist[p-j]
+        ok = (
+            (shifted == tail[:, j][:, None])
+            & (pos[None, :] >= j)
+            & (hlen[:, None] > j)
+        )
+        run = run & ok
+        mlen = mlen + run.astype(jnp.int32)
+    # exclude the trivial match at the current end (p == hlen-1) and
+    # garbage past the valid length; prefer longer matches, then later
+    # positions
+    valid = (pos[None, :] <= hlen[:, None] - 2) & (mlen > 0)
+    score = jnp.where(valid, mlen * (h + 1) + pos[None, :], -1)
+    best = jnp.argmax(score, axis=1).astype(jnp.int32)
+    found = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0] >= 0
+    gidx = jnp.clip(
+        best[:, None] + 1 + jnp.arange(k)[None, :],
+        0,
+        jnp.maximum(hlen - 1, 0)[:, None],
+    )
+    cand = jnp.take_along_axis(hist, gidx, axis=1)  # [B, k]
+    last = jnp.take_along_axis(
+        hist, jnp.maximum(hlen[:, None] - 1, 0), axis=1
+    )
+    return jnp.where(found[:, None], cand, last)
+
+
+class ModelDraftSource:
+    """DraftSource: a small autoregressive draft model (the PR-9
+    source). State = the draft's contiguous KV cache + per-row offsets;
+    ``q`` = the draft's modified distribution at each proposal, which is
+    exactly what :func:`~..ops.sampling.sample_token_per_row` drew from,
+    so the accept ratio ``p/q`` is well-defined per construction."""
+
+    name = "model"
+
+    def __init__(
+        self,
+        dcfg,
+        k: int,
+        decode_attention=None,
+        top_k: int = 0,
+        use_top_p: bool = False,
+    ):
+        self.dcfg = dcfg
+        self.k = k
+        self.decode_attention = decode_attention
+        self.top_k = top_k
+        self.use_top_p = use_top_p
+
+    def init_state(self, carry) -> Tuple[Any, ...]:
+        return (
+            carry["draft_offsets"],
+            carry["draft_k"],
+            carry["draft_v"],
+        )
+
+    def carry_updates(self, state) -> dict:
+        doffs, dk, dv = state
+        return {"draft_offsets": doffs, "draft_k": dk, "draft_v": dv}
+
+    def propose(self, dparams, state, last, temps, top_ps, dkeys):
+        """k sequential draft steps + one forward seating d_k's K/V (a
+        fully-accepted round leaves no hole in the draft cache).
+        Greedy rows argmax (bit-parity with the PR-9 path); sampled
+        rows draw from the draft's own modified distribution with
+        their per-round proposal keys."""
+        dcfg, k = self.dcfg, self.k
+        doffs, dk, dv = state
+
+        def dstep(dc, keys_row):
+            tok, do_, dk_, dv_ = dc
+            hidden, dk_, dv_ = forward(
+                dparams, dcfg, tok[:, None], do_, dk_, dv_,
+                self.decode_attention,
+            )
+            lg = logits_for(dparams, dcfg, hidden[:, 0])  # [B, V]
+            nxt = sample_token_per_row(
+                lg, keys_row, temps, self.top_k, top_ps
+            )
+            return (nxt, do_ + 1, dk_, dv_), (nxt, lg)
+
+        (dlast, do_, dk, dv), (drafts, dlogits) = jax.lax.scan(
+            dstep, (last, doffs, dk, dv), dkeys, length=k
+        )
+        drafts = drafts.T  # [k, B] -> [B, k]
+        dlogits = jnp.swapaxes(dlogits, 0, 1)  # [B, k, V]
+        _, dk, dv = forward(
+            dparams, dcfg, dlast[:, None], do_, dk, dv,
+            self.decode_attention,
+        )
+        q = modified_probs(
+            dlogits,
+            temps[:, None, None],
+            self.top_k,
+            top_ps[:, None, None] if top_ps is not None else None,
+        )
+        return drafts, q, (doffs, dk, dv)
+
+    def advance(self, state, emit, m_eff, rows):
+        doffs, dk, dv = state
+        return (doffs + m_eff, dk, dv)
+
+
+class CrossModelDraftSource(ModelDraftSource):
+    """DraftSource: same mechanics as :class:`ModelDraftSource`, but
+    the draft weights are ANOTHER lane's resident model in a
+    multi-model fleet (ISSUE 15). The distinct name is what routes the
+    per-source metrics label, the eviction pin on the draft model, and
+    the wasted-energy billing of fully-rejected rounds."""
+
+    name = "cross"
+
+
+class NgramDraftSource:
+    """DraftSource: prompt-lookup drafting over the row's own history
+    (``q = 1`` degenerate accept test; zero extra weights, zero extra
+    forwards). State = the int32 history buffer + valid lengths."""
+
+    name = "ngram"
+
+    def __init__(self, k: int, nmax: int = NGRAM_MAX):
+        self.k = k
+        self.nmax = nmax
+
+    def init_state(self, carry) -> Tuple[Any, ...]:
+        return (carry["ngram_hist"], carry["ngram_len"])
+
+    def carry_updates(self, state) -> dict:
+        hist, hlen = state
+        return {"ngram_hist": hist, "ngram_len": hlen}
+
+    def propose(self, dparams, state, last, temps, top_ps, dkeys):
+        hist, hlen = state
+        drafts = ngram_propose(hist, hlen, self.k, self.nmax)
+        return drafts, None, state  # q=None → degenerate one-hot
+
+    def advance(self, state, emit, m_eff, rows):
+        """Append each row's emitted tokens to its history (masked
+        scatter with OOB-drop sentinel positions — done rows and the
+        rejected tail write nowhere)."""
+        hist, hlen = state
+        h = hist.shape[1]
+        width = emit.shape[1]
+        idx = jnp.arange(width)
+        wpos = jnp.where(
+            idx[None, :] < m_eff[:, None], hlen[:, None] + idx[None, :], h
+        )
+        hist = hist.at[rows[:, None], wpos].set(emit, mode="drop")
+        return (hist, hlen + m_eff)
+
+
+def make_draft_source(
+    source: str,
+    dcfg,
+    k: int,
+    draft_decode_attention=None,
+    top_k: int = 0,
+    use_top_p: bool = False,
+):
+    """Instantiate the DraftSource implementation for a resolved spec
+    (build-time static — the compiled step bakes the source in)."""
+    if source == "ngram":
+        return NgramDraftSource(k)
+    cls = CrossModelDraftSource if source == "cross" else ModelDraftSource
+    return cls(
+        dcfg, k, draft_decode_attention, top_k=top_k, use_top_p=use_top_p
+    )
 
 
 def build_spec_fn(
@@ -66,7 +318,11 @@ def build_spec_fn(
 ) -> Callable:
     """Compile the speculative decode loop for (target cfg, draft cfg, k).
 
-    Returned fn signature::
+    The solo GREEDY fast-path (one request, contiguous caches, runs the
+    whole budget in one compiled call). Sampled solo requests route
+    through a one-row stepped session instead (engine/jax_engine.py::
+    generate_speculative) so the rejection-resampling lane lives in ONE
+    place. Returned fn signature::
 
         spec(tparams, dparams, first_token[1], start_offset, tkc, tvc,
              dkc, dvc, n_real) -> (out[n_steps+k+1], n_emitted, rounds,
@@ -193,6 +449,9 @@ def build_spec_step_fn(
     stacked: bool = False,
     draft_decode_attention=None,
     decode_attention=None,
+    source: str = "model",
+    top_k: int = 0,
+    use_top_p: bool = False,
 ) -> Callable:
     """Build the BATCHED speculative slice step (see the module
     docstring). Stepped-decode contract::
@@ -201,13 +460,24 @@ def build_spec_step_fn(
             -> (out [B, n_steps*(k+1)], n_row [B], new_carry)
 
     ``carry`` is a stepped-session carry (engine/stepped.py) grown with
-    the draft state: ``draft_k``/``draft_v`` (a contiguous batch cache —
-    the draft is tiny, it never pages) and ``draft_offsets``, plus the
-    cumulative per-row counters ``spec_rounds``/``spec_accepted``/
-    ``spec_drafted`` the session reads back for telemetry and the
-    adaptive fallback policy. The target KV travels in the usual leaves
+    the draft source's state: ``draft_k``/``draft_v``/``draft_offsets``
+    for model/cross sources (a contiguous batch cache — the draft is
+    tiny, it never pages), or ``ngram_hist``/``ngram_len`` for the
+    prompt-lookup source; plus the cumulative per-row counters
+    ``spec_rounds``/``spec_accepted``/``spec_drafted``/``spec_rejected``
+    the session reads back for telemetry, the adaptive fallback policy
+    and the cross-model draft-waste billing. The per-row ``rngs`` leaf
+    (the same leaf the plain step advances once per token) advances once
+    per ROUND here — ``k+3`` subkeys per round: next-carry key, k draft
+    proposal keys, one accept-uniform key, one residual/bonus key — so
+    a preempt/resume of the raw key reproduces the remaining stream
+    bit-exactly. The target KV travels in the usual leaves
     (``k_cache``/``v_cache``, or ``pool_k``/``pool_v``+``table``+side/
     scratch on paged sessions).
+
+    ``source``/``top_k``/``use_top_p`` are compile-time statics (they
+    change the computation's lattice) and belong in the engine's
+    compiled-fn cache key alongside the layout flags.
 
     Paged sessions verify NATIVELY (ISSUE 10) — the pool stays
     page-resident during verify, candidates never stream through the
@@ -233,13 +503,14 @@ def build_spec_step_fn(
       offset (never attended) and are overwritten by the next round's
       commit, which always covers them.
 
-    Per-round mechanics per live row (vectorized over B): k sequential
-    draft steps + one cache-seating draft forward, ONE target forward
-    over the ``[last, d_1..d_k]`` block, longest-accepted-prefix + the
-    target's own next token, EOS clipping inside the round, and a
-    ``remaining``-budget cut — all per-row, so done-masking, offsets and
-    emission cursors advance by variable ``m``. Rows that are done ride
-    along re-writing garbage at frozen positions that no mask ever
+    Per-round mechanics per live row (vectorized over B): k draft
+    proposals from the source, ONE target forward over the
+    ``[last, d_1..d_k]`` block, the per-row accept rule (greedy
+    longest-prefix match, or sampled rejection resampling — selected
+    per row by its temperature), EOS clipping inside the round, and a
+    ``remaining``-budget cut — all per-row, so done-masking, offsets
+    and emission cursors advance by variable ``m``. Rows that are done
+    ride along re-writing garbage at frozen positions that no mask ever
     attends (the padding-row convention of every batched loop here).
 
     Contiguous verifies run the XLA-fused attention paths (the
@@ -249,11 +520,17 @@ def build_spec_step_fn(
     """
     idx = jnp.arange(k + 1)
     out_w = n_steps * (k + 1)
+    src = make_draft_source(
+        source, dcfg, k, draft_decode_attention, top_k=top_k,
+        use_top_p=use_top_p,
+    )
 
     def decode(params, carry, n_real):
         tparams, dparams = params
         b = carry["tokens"].shape[0]
         rows = jnp.arange(b)
+        temps = carry["temps"]
+        top_ps = carry["top_ps"] if use_top_p else None
         scr_k0 = scr_v0 = jnp.int32(0)  # non-scratch modes: inert slots
         if paged and stacked:
             table = carry["table"]
@@ -298,37 +575,33 @@ def build_spec_step_fn(
             tk0, tv0 = carry["k_cache"], carry["v_cache"]
 
         def cond(c):
-            done, i = c[9], c[10]
+            done, i = c[8], c[9]
             return (i < n_real) & ~jnp.all(done)
 
         def body(c):
             (
-                last, offs, doffs, tk, tv, scr_k, scr_v, dk, dv, done, i,
-                out, n_row, rem, rnds, acc, drafted,
+                last, offs, tk, tv, scr_k, scr_v, sstate, rngs, done, i,
+                out, n_row, rem, rnds, acc, drafted, rejected,
             ) = c
             live = ~done
 
-            # k sequential draft proposals + one forward seating d_k's
-            # K/V (a fully-accepted round leaves no hole in the draft
-            # cache — the solo path's convention, per row here)
-            def dstep(dc, _):
-                tok, do_, dk_, dv_ = dc
-                hidden, dk_, dv_ = forward(
-                    dparams, dcfg, tok[:, None], do_, dk_, dv_,
-                    draft_decode_attention,
-                )
-                nxt = jnp.argmax(
-                    logits_for(dparams, dcfg, hidden[:, 0]), axis=-1
-                ).astype(jnp.int32)
-                return (nxt, do_ + 1, dk_, dv_), nxt
-
-            (dlast, do_, dk, dv), drafts = jax.lax.scan(
-                dstep, (last, doffs, dk, dv), None, length=k
+            # one rng fan-out per round per row: carry key + k proposal
+            # keys + accept-uniform key + residual/bonus key. Greedy
+            # rows burn the same splits (their draws are discarded by
+            # the per-row select below) — uniform key traffic is what
+            # keeps the compiled step shape-identical across mixes.
+            allk = jax.vmap(lambda key_: jax.random.split(key_, k + 3))(
+                rngs
             )
-            drafts = drafts.T  # [k, B] -> [B, k]
-            _, dk, dv = forward(
-                dparams, dcfg, dlast[:, None], do_, dk, dv,
-                draft_decode_attention,
+            rngs = allk[:, 0]
+            dkeys = jnp.swapaxes(allk[:, 1 : k + 1], 0, 1)  # [k, B]
+            akeys = allk[:, k + 1]
+            fkeys = allk[:, k + 2]
+
+            # draft proposals from the source (model scan / n-gram
+            # lookup); q is the proposal distribution (None = one-hot)
+            drafts, qdist, sstate = src.propose(
+                dparams, sstate, last, temps, top_ps, dkeys
             )
 
             # ONE target forward scores every row's k+1 candidate
@@ -369,13 +642,13 @@ def build_spec_step_fn(
                 hidden, tk, tv = forward(
                     tparams, tcfg, ver, offs, tk, tv, None, None
                 )
-            tnext = jnp.argmax(
-                logits_for(tparams, tcfg, hidden), axis=-1
-            ).astype(jnp.int32)  # [B, k+1]
+            tlogits = logits_for(tparams, tcfg, hidden)  # [B, k+1, V]
+            tnext = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)
 
-            # longest accepted prefix, then the target's own next token
+            # GREEDY lane: longest accepted prefix, then the target's
+            # own next token (bit-identical to the PR-9 path)
             match = drafts == tnext[:, :k]
-            n_acc = jnp.argmin(
+            n_acc_g = jnp.argmin(
                 jnp.concatenate(
                     [match, jnp.zeros((b, 1), dtype=bool)], axis=1
                 ),
@@ -384,14 +657,79 @@ def build_spec_step_fn(
             drafts_pad = jnp.concatenate(
                 [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1
             )
-            t_at = jnp.take_along_axis(tnext, n_acc[:, None], axis=1)
-            emit = jnp.where(
-                idx[None, :] < n_acc[:, None],
+            t_at = jnp.take_along_axis(tnext, n_acc_g[:, None], axis=1)
+            emit_g = jnp.where(
+                idx[None, :] < n_acc_g[:, None],
                 drafts_pad,
                 jnp.where(
-                    idx[None, :] == n_acc[:, None], t_at, jnp.int32(eos)
+                    idx[None, :] == n_acc_g[:, None], t_at, jnp.int32(eos)
                 ),
             )
+
+            # SAMPLED lane: rejection resampling over the MODIFIED
+            # distributions (Leviathan et al. 2023). Accept candidate j
+            # with prob min(1, p_j(x_j)/q_j(x_j)); at the first
+            # rejection resample from the normalized residual
+            # max(p−q, 0); at full acceptance q≡0 pads the k-th slot so
+            # the SAME residual formula yields the target's own sample.
+            vocab = tlogits.shape[-1]
+            p_mod = modified_probs(
+                tlogits,
+                temps[:, None, None],
+                top_k,
+                top_ps[:, None, None] if top_ps is not None else None,
+            )  # [B, k+1, V]
+            if qdist is None:  # degenerate (deterministic) proposal
+                qdist = jax.nn.one_hot(drafts, vocab, dtype=jnp.float32)
+            p_d = jnp.take_along_axis(
+                p_mod[:, :k, :], drafts[..., None], axis=2
+            )[..., 0]  # [B, k]
+            q_d = jnp.take_along_axis(qdist, drafts[..., None], axis=2)[
+                ..., 0
+            ]
+            ratio = p_d / jnp.maximum(q_d, 1e-20)
+            u = jax.vmap(lambda key_: jax.random.uniform(key_, (k,)))(
+                akeys
+            )  # [B, k]
+            accept = u < jnp.minimum(ratio, 1.0)
+            n_acc_s = jnp.argmin(
+                jnp.concatenate(
+                    [accept, jnp.zeros((b, 1), dtype=bool)], axis=1
+                ),
+                axis=1,
+            ).astype(jnp.int32)
+            q_pad = jnp.concatenate(
+                [qdist, jnp.zeros((b, 1, vocab), jnp.float32)], axis=1
+            )
+            p_at = jnp.take_along_axis(
+                p_mod, n_acc_s[:, None, None], axis=1
+            )[:, 0]  # [B, V]
+            q_at = jnp.take_along_axis(
+                q_pad, n_acc_s[:, None, None], axis=1
+            )[:, 0]
+            res = jnp.maximum(p_at - q_at, 0.0)
+            rsum = jnp.sum(res, axis=-1, keepdims=True)
+            res = jnp.where(rsum > 1e-9, res, p_at)
+            chosen = jax.vmap(jax.random.categorical)(
+                fkeys, jnp.log(res)
+            ).astype(jnp.int32)
+            emit_s = jnp.where(
+                idx[None, :] < n_acc_s[:, None],
+                drafts_pad,
+                jnp.where(
+                    idx[None, :] == n_acc_s[:, None],
+                    chosen[:, None],
+                    jnp.int32(eos),
+                ),
+            )
+
+            # per-row lane select: a row's temperature picks its regime
+            # (greedy is the temperature→0 limit of the sampled rule;
+            # keeping the exact argmax lane preserves bit-parity)
+            srow = temps >= 1e-6
+            n_acc = jnp.where(srow, n_acc_s, n_acc_g)
+            emit = jnp.where(srow[:, None], emit_s, emit_g)
+
             m = n_acc + 1
             # clip each row's round at its first EOS (inclusive — the
             # plain loop records the EOS then stops)
@@ -420,29 +758,31 @@ def build_spec_step_fn(
             rem = rem - m_eff
             done = done | eos_in | (rem <= 0)
             offs = offs + m_eff
-            doffs = doffs + m_eff
+            sstate = src.advance(sstate, emit, m_eff, rows)
             # accepted-AND-extracted drafts only (EOS clips and budget
             # cuts discard the tail — counting it would inflate the
             # acceptance the fallback policy reads)
             rnds = rnds + live.astype(jnp.int32)
             acc = acc + jnp.minimum(n_acc, m_eff)
             drafted = drafted + jnp.where(live, jnp.int32(k), 0)
+            # fully-rejected rounds: every drafted token wasted — the
+            # figure cross-model billing charges to the energy ledger
+            rejected = rejected + (live & (n_acc == 0)).astype(jnp.int32)
             return (
-                last, offs, doffs, tk, tv, scr_k, scr_v, dk, dv, done,
-                i + 1, out, n_row, rem, rnds, acc, drafted,
+                last, offs, tk, tv, scr_k, scr_v, sstate, rngs, done,
+                i + 1, out, n_row, rem, rnds, acc, drafted, rejected,
             )
 
         out0 = jnp.full((b, out_w), jnp.int32(eos))
         init = (
             carry["tokens"],
             carry["offsets"],
-            carry["draft_offsets"],
             tk0,
             tv0,
             scr_k0,
             scr_v0,
-            carry["draft_k"],
-            carry["draft_v"],
+            src.init_state(carry),
+            carry["rngs"],
             carry["done"],
             jnp.int32(0),
             out0,
@@ -451,10 +791,11 @@ def build_spec_step_fn(
             carry["spec_rounds"],
             carry["spec_accepted"],
             carry["spec_drafted"],
+            carry["spec_rejected"],
         )
         (
-            last, offs, doffs, tk, tv, scr_k, scr_v, dk, dv, done, _,
-            out, n_row, rem, rnds, acc, drafted,
+            last, offs, tk, tv, scr_k, scr_v, sstate, rngs, done, _,
+            out, n_row, rem, rnds, acc, drafted, rejected,
         ) = jax.lax.while_loop(cond, body, init)
         if paged and stacked:
             # side caches threaded; the pool never changed hands
@@ -470,15 +811,15 @@ def build_spec_step_fn(
             carry,
             tokens=last,
             offsets=offs,
-            draft_offsets=doffs,
-            draft_k=dk,
-            draft_v=dv,
+            rngs=rngs,
             done=done,
             remaining=rem,
             spec_rounds=rnds,
             spec_accepted=acc,
             spec_drafted=drafted,
+            spec_rejected=rejected,
             **threaded,
+            **src.carry_updates(sstate),
         )
         return out, n_row, new_carry
 

@@ -114,7 +114,7 @@ class _PendingJoin:
         "request", "slot", "ids", "chunks", "next_chunk", "cache_len",
         "k_cache", "v_cache", "presence", "logits", "pages",
         "prefill_s", "t0", "hit_tokens", "shared_pages",
-        "draft_k", "draft_v", "draft_chunks", "draft_next",
+        "draft_k", "draft_v", "draft_chunks", "draft_next", "draft_ids",
         "resume", "resume_mode",
     )
 
@@ -147,6 +147,10 @@ class _PendingJoin:
         self.draft_v = None
         self.draft_chunks: List[tuple] = []
         self.draft_next = 0
+        # the token ids the draft chunks prefill over — the prompt for
+        # a fresh joiner, prompt + generated-so-far for a recompute
+        # resume (None: fall back to ``ids``)
+        self.draft_ids: Optional[List[int]] = None
         # Preemption resume (ISSUE 11): when set, this pending is a
         # RESUME riding the chunked-join machinery — ``resume`` is the
         # PreemptedRow and ``resume_mode`` how commit restores the KV
@@ -175,7 +179,7 @@ class PreemptedRow:
         "request", "ids", "generated", "prompt_len", "offsets",
         "remaining", "rng", "presence", "use_top_p", "use_rp",
         "streamed", "t0", "t1", "policy", "paged", "stacked",
-        "blob", "side_blob", "cache_blob",
+        "blob", "side_blob", "cache_blob", "draft_blob", "draft_offset",
         "shared_pages", "n_own_pages", "host_bytes", "discharged",
     )
 
@@ -199,6 +203,11 @@ class PreemptedRow:
         self.blob = None  # paged_kv.PageSwapBlob of the OWN pages
         self.side_blob = None  # stacked side-cache row (k, v) host slabs
         self.cache_blob = None  # contiguous row slab (k, v) host slabs
+        # speculative row (ISSUE 16): the draft cache's row slabs +
+        # draft offset under swap policy (model/cross sources; ngram
+        # rebuilds its history from ids+generated instead)
+        self.draft_blob = None
+        self.draft_offset = 0
         self.shared_pages: List[int] = []  # leading shared page indices
         self.n_own_pages = 0
         self.host_bytes = 0
@@ -317,6 +326,8 @@ class SteppedDecodeSession:
         # window the fallback policy reads
         self._spec_host: Dict[str, List[int]] = {}
         self._spec_recent: "List[tuple]" = []
+        # per-row cross-model draft Joules already billed as wasted
+        self._spec_draft_wasted: List[float] = []
         # slot -> _PendingJoin: chunked joiners mid-prefill. A reserved
         # slot is not free (free_slots/can_join account for it) and not
         # live (the decode loop's done-mask still marks it done).
@@ -354,6 +365,7 @@ class SteppedDecodeSession:
         reserve_rows: Optional[int] = None,
         slice_steps: Optional[int] = None,
         spec_accept_floor: Optional[float] = None,
+        spec_override=None,
     ) -> "SteppedDecodeSession":
         from .jax_engine import (
             BATCH_BUCKETS,
@@ -388,7 +400,9 @@ class SteppedDecodeSession:
         # target cache carries the rounds-overshoot margin (and a
         # stacked paged session its side-column overshoot) only when
         # the session will actually speculate.
-        self._init_spec(requests, all_ids, spec_accept_floor)
+        self._init_spec(
+            requests, all_ids, spec_accept_floor, spec_override
+        )
         # the engine's stepped-compute context covers every compile/run
         # in the open (TP: the int4 Pallas kernel has no GSPMD rule —
         # same guard its generate paths apply)
@@ -398,7 +412,10 @@ class SteppedDecodeSession:
             else:
                 self._open_contiguous(requests, all_ids)
             if self.spec is not None:
-                self._open_draft(all_ids)
+                if self.spec["draft"] is not None:
+                    self._open_draft(all_ids)
+                else:
+                    self._open_ngram(all_ids)
             # one explicit placement for the assembled carry: identity on
             # a single device; on a mesh every leaf is device_put to the
             # sharding the jitted slice step declares (heads-sharded KV
@@ -416,7 +433,10 @@ class SteppedDecodeSession:
         # LRU until close(). Registered last so a failed open never
         # leaks a pin that would immortalise the model.
         self._session_pins = [self.model]
-        if self.spec is not None:
+        if self.spec is not None and self.spec["draft"] is not None:
+            # model/cross sources pin the DRAFT weights too — for a
+            # cross-model source this is the eviction guard that keeps
+            # another lane's resident model alive while it drafts here
             self._session_pins.append(self.spec["draft"])
         opened = getattr(engine, "_session_opened", None)
         if opened is not None:
@@ -433,39 +453,44 @@ class SteppedDecodeSession:
         requests: "list[GenerationRequest]",
         all_ids: "list[list[int]]",
         spec_accept_floor: Optional[float],
+        spec_override=None,
     ) -> None:
         """Decide whether this session runs draft-verify: the engine has
-        a (draft, k) for the model, every opening row is greedy, the
-        draft is co-resident with a matching vocabulary, and the draft's
-        contiguous cache fits its max_seq_len. Any miss serves the
-        session PLAIN — configuring a draft must never fail a request
-        plain decode would serve (the solo path's rule)."""
+        a :class:`~.speculative.DraftSpec` for the model (or the caller
+        forced one via ``spec_override``), every opening row is eligible
+        (greedy or sampled within ``spec_temperature_max`` — ISSUE 16),
+        the source isn't blocked by its recent-acceptance memory, and —
+        model/cross sources — the draft is co-resident with a matching
+        vocabulary and its contiguous cache fits its max_seq_len. The
+        ngram source has no draft model: its "cache" is an int32
+        history buffer sized like the draft cache would be. Any miss
+        serves the session PLAIN — configuring a draft must never fail
+        a request plain decode would serve (the solo path's rule)."""
         from ..runner import term
         from .jax_engine import _prompt_alloc, _spec_margin
 
         eng = self.engine
-        spec = eng._resolve_spec(self.model)
+        spec = (
+            spec_override
+            if spec_override is not None
+            else eng._resolve_spec(self.model)
+        )
         if spec is None:
             return
         if not all(eng._spec_eligible(r) for r in requests):
             return
-        draft, k = spec
-        eng.load_model(draft)
-        if self.model not in eng._models:
-            eng.load_model(self.model)  # the draft's load may have evicted it
-        if self.model not in eng._models or draft not in eng._models:
-            term.log_warn(
-                f"speculative session: {self.model} and {draft} cannot be "
-                "co-resident; serving the session without the draft"
-            )
-            return
-        dcfg = eng._models[draft].cfg
-        if dcfg.vocab_size != self.cfg.vocab_size:
-            term.log_warn(
-                f"speculative session: draft {draft} vocab "
-                f"{dcfg.vocab_size} != target vocab "
-                f"{self.cfg.vocab_size}; serving plain"
-            )
+        source, draft, k = spec.source, spec.draft, spec.k
+        floor = (
+            eng.spec_accept_floor
+            if spec_accept_floor is None
+            else float(spec_accept_floor)
+        )
+        if spec_override is None and eng._spec_source_blocked(
+            source, draft, floor
+        ):
+            # the source's recent sessions all fell back under the
+            # floor — skip arming (the consult decays the memory, so a
+            # later session re-probes)
             return
         margin = _spec_margin(k)
         draft_len = (
@@ -473,15 +498,34 @@ class SteppedDecodeSession:
             + self.g_bucket
             + margin
         )
-        if draft_len > dcfg.max_seq_len:
-            return
-        floor = (
-            eng.spec_accept_floor
-            if spec_accept_floor is None
-            else float(spec_accept_floor)
-        )
-        self.spec = {"draft": draft, "k": k, "dcfg": dcfg, "floor": floor}
-        self.spec_info = {"draft_model": draft, "k": k}
+        dcfg = None
+        if draft is not None:
+            eng.load_model(draft)
+            if self.model not in eng._models:
+                # the draft's load may have evicted the target
+                eng.load_model(self.model)
+            if self.model not in eng._models or draft not in eng._models:
+                term.log_warn(
+                    f"speculative session: {self.model} and {draft} "
+                    "cannot be co-resident; serving the session without "
+                    "the draft"
+                )
+                return
+            dcfg = eng._models[draft].cfg
+            if dcfg.vocab_size != self.cfg.vocab_size:
+                term.log_warn(
+                    f"speculative session: draft {draft} vocab "
+                    f"{dcfg.vocab_size} != target vocab "
+                    f"{self.cfg.vocab_size}; serving plain"
+                )
+                return
+            if draft_len > dcfg.max_seq_len:
+                return
+        self.spec = {
+            "source": source, "draft": draft, "k": k, "dcfg": dcfg,
+            "floor": floor,
+        }
+        self.spec_info = {"draft_model": draft, "k": k, "source": source}
         self.spec_draft_len = draft_len
         self.spec_margin = margin
 
@@ -514,12 +558,62 @@ class SteppedDecodeSession:
         )
         offs = [len(i) for i in all_ids] + [len(all_ids[0])] * pad
         self.carry["draft_offsets"] = jnp.asarray(offs, dtype=jnp.int32)
+        self._open_spec_counters()
+
+    def _open_ngram(self, all_ids: "list[list[int]]") -> None:
+        """Assemble the prompt-lookup source's carry state (ISSUE 16):
+        one int32 history row per slot — prompt ids followed by the
+        row's first sampled token, capacity ``spec_draft_len`` (the
+        prompt bucket + generation budget + rounds-overshoot margin, so
+        every append the accept lane can produce fits). Padding rows
+        replicate row 0 like everywhere else. Zero extra weights, zero
+        extra forwards — this is the whole open cost."""
+        import numpy as np
+
+        h = self.spec_draft_len
+        hist = np.zeros((self.b_bucket, h), dtype=np.int32)
+        hlen = np.zeros((self.b_bucket,), dtype=np.int32)
+        rows = [
+            ids + [row.generated[0]]
+            for ids, row in zip(all_ids, self.rows)
+        ]
+        rows += [rows[0]] * (self.b_bucket - len(all_ids))
+        for r, full in enumerate(rows):
+            hist[r, : len(full)] = full
+            hlen[r] = len(full)
+        self.carry["ngram_hist"] = jnp.asarray(hist)
+        self.carry["ngram_len"] = jnp.asarray(hlen)
+        self._open_spec_counters()
+
+    def _open_spec_counters(self) -> None:
         b = self.b_bucket
-        for key in ("spec_rounds", "spec_accepted", "spec_drafted"):
+        for key in (
+            "spec_rounds", "spec_accepted", "spec_drafted",
+            "spec_rejected",
+        ):
             self.carry[key] = jnp.zeros((b,), jnp.int32)
         self._spec_host = {
             "rounds": [0] * b, "accepted": [0] * b, "drafted": [0] * b,
+            "rejected": [0] * b,
         }
+        # per-row cross-model draft Joules billed to the wasted-energy
+        # ledger so far (host-side; retiring rows report theirs)
+        self._spec_draft_wasted = [0.0] * b
+
+    def _set_ngram_row(self, r: int, full: "List[int]") -> None:
+        """(Re)build one slot's n-gram history row from its known token
+        stream (join commit, preemption resume) — the host always knows
+        prompt + generated exactly, so the matcher's state needs no
+        device capture to survive a round trip."""
+        h = int(self.carry["ngram_hist"].shape[1])
+        full = full[:h]
+        row = jnp.zeros((h,), jnp.int32).at[: len(full)].set(
+            jnp.asarray(full, jnp.int32)
+        )
+        self.carry["ngram_hist"] = self.carry["ngram_hist"].at[r].set(row)
+        self.carry["ngram_len"] = (
+            self.carry["ngram_len"].at[r].set(len(full))
+        )
 
     def _open_common(self, requests, states, pad: int) -> None:
         """Assemble the per-row device arrays shared by both cache
@@ -946,6 +1040,7 @@ class SteppedDecodeSession:
             state["spec"] = {
                 "active": self.spec is not None,
                 "draft_model": self.spec_info["draft_model"],
+                "source": self.spec_info.get("source", "model"),
                 "k": self.spec_info["k"],
                 "fallback": self.spec_fallback,
                 "verify_mode": self._verify_mode(),
@@ -961,6 +1056,7 @@ class SteppedDecodeSession:
                 "rounds_total": sum(self._spec_host.get("rounds", [])),
                 "accepted_total": sum(self._spec_host.get("accepted", [])),
                 "drafted_total": sum(self._spec_host.get("drafted", [])),
+                "rejected_total": sum(self._spec_host.get("rejected", [])),
             }
         # preemption swap accounting (ISSUE 11): what THIS session has
         # parked in host memory right now — returns to zeros once every
@@ -1097,10 +1193,17 @@ class SteppedDecodeSession:
                     self.paged and self.quantized,
                     stacked=self.paged and self.stacked,
                     carry=self.carry,
+                    source=self.spec["source"],
+                    top_k=self.top_k,
+                    use_top_p=self.use_top_p,
+                )
+                dparams = (
+                    eng._models[self.spec["draft"]].params
+                    if self.spec["draft"] is not None
+                    else None
                 )
                 out, n_row, self.carry = decode(
-                    (params, eng._models[self.spec["draft"]].params),
-                    self.carry, jnp.int32(n_real),
+                    (params, dparams), self.carry, jnp.int32(n_real)
                 )
             elif self.paged:
                 decode = eng._paged_batch_decode_step_fn(
@@ -1179,13 +1282,40 @@ class SteppedDecodeSession:
         rounds = _to_host_list(self.carry["spec_rounds"])
         accepted = _to_host_list(self.carry["spec_accepted"])
         drafted = _to_host_list(self.carry["spec_drafted"])
+        rejected = _to_host_list(self.carry["spec_rejected"])
         prev = self._spec_host
         rounds_delta = [a - b for a, b in zip(rounds, prev["rounds"])]
+        rej_delta = [a - b for a, b in zip(rejected, prev["rejected"])]
         acc_delta = sum(accepted) - sum(prev["accepted"])
         drafted_delta = sum(drafted) - sum(prev["drafted"])
         self._spec_host = {
             "rounds": rounds, "accepted": accepted, "drafted": drafted,
+            "rejected": rejected,
         }
+        source = self.spec["source"]
+        if source == "cross" and any(rej_delta):
+            # Cross-model draft-waste billing (ISSUE 16): a FULLY
+            # rejected round burned k draft forwards of ANOTHER lane's
+            # model for zero emitted tokens — escalation-style, those
+            # Joules land in the wasted-energy ledger under their own
+            # cause, priced at the DRAFT model's live J/token when the
+            # fleet hook provides it. Partially-accepted rounds bill
+            # nothing: their draft work amortized into emitted tokens.
+            try:
+                from ..obs.energy import charge_wasted
+
+                jpt_hook = getattr(self.engine, "spec_draft_jpt", None)
+                jpt = jpt_hook(self.spec["draft"]) if jpt_hook else None
+                for r, d in enumerate(rej_delta):
+                    if d > 0:
+                        joules = charge_wasted(
+                            "draft",
+                            tokens=float(d * self.spec["k"]),
+                            jpt=jpt,
+                        )
+                        self._spec_draft_wasted[r] += joules
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         slice_rounds = max(
             [rounds_delta[r] for r in live] or [0]
         )
@@ -1195,7 +1325,10 @@ class SteppedDecodeSession:
                 from ..obs.metrics import observe_spec
                 from ..obs.trace import TRACER
 
-                observe_spec(slice_rounds, acc_delta, drafted_delta)
+                observe_spec(
+                    slice_rounds, acc_delta, drafted_delta, source=source,
+                    rejected=sum(rej_delta) * self.spec["k"],
+                )
                 if self.paged:
                     # paged rounds verify NATIVELY (ISSUE 10): the
                     # counter makes the slack-free migration observable
@@ -1210,6 +1343,7 @@ class SteppedDecodeSession:
                     **trace_attrs(TRACER.current()),
                     model=self.model,
                     draft=self.spec["draft"],
+                    source=source,
                     k=self.spec["k"],
                     rounds=slice_rounds,
                     accepted=acc_delta,
@@ -1253,29 +1387,40 @@ class SteppedDecodeSession:
 
         for key in (
             "draft_k", "draft_v", "draft_offsets",
+            "ngram_hist", "ngram_len",
             "spec_rounds", "spec_accepted", "spec_drafted",
-            "scratch_k", "scratch_v",
+            "spec_rejected", "scratch_k", "scratch_v",
         ):
             self.carry.pop(key, None)
         floor = self.spec["floor"]
+        source = self.spec["source"]
+        draft = self.spec["draft"]
         self.spec = None
         self.spec_fallback = True
         self._spec_recent = []
         self._recommit_carry()
+        # feed the engine's per-source acceptance memory: enough
+        # below-floor sessions and _init_spec stops arming this source
+        # for a while (the adaptive window, learned per source — ngram
+        # collapse must not gate model-draft sessions)
+        feedback = getattr(self.engine, "_spec_source_feedback", None)
+        if feedback is not None:
+            feedback(source, draft, measured_acceptance)
         term.log_warn(
-            f"speculative session [{self.model}]: measured acceptance "
-            f"{measured_acceptance:.2f} < floor {floor:g}; falling back "
-            "to plain decode"
+            f"speculative session [{self.model}]: source {source} "
+            f"measured acceptance {measured_acceptance:.2f} < floor "
+            f"{floor:g}; falling back to plain decode"
         )
         if _obs_enabled():
             try:
                 from ..obs.flight import EV_SPEC_FALLBACK, FLIGHT
                 from ..obs.metrics import SPEC_FALLBACK_C
 
-                SPEC_FALLBACK_C.inc()
+                SPEC_FALLBACK_C.labels(source=source).inc()
                 FLIGHT.emit(
                     EV_SPEC_FALLBACK,
                     model=self.model,
+                    source=source,
                     acceptance=round(measured_acceptance, 4),
                     floor=floor,
                 )
@@ -1307,10 +1452,21 @@ class SteppedDecodeSession:
                 "rounds": int(self._spec_host["rounds"][r]),
                 "accepted": int(self._spec_host["accepted"][r]),
                 "drafted": int(self._spec_host["drafted"][r]),
+                "rejected": int(
+                    self._spec_host.get("rejected", [0] * len(self.rows))[r]
+                ),
                 "k": self.spec_info["k"],
                 "draft_model": self.spec_info["draft_model"],
+                "source": self.spec_info.get("source", "model"),
                 "fallback": self.spec_fallback,
             }
+            if self._spec_draft_wasted and self._spec_draft_wasted[r]:
+                # cross-model drafting: Joules of ANOTHER lane's model
+                # this row burned in fully-rejected rounds (already in
+                # the wasted-energy ledger under cause="draft")
+                extras["spec"]["draft_wasted_J"] = round(
+                    self._spec_draft_wasted[r], 6
+                )
         result = GenerationResult(
             request=req,
             tokens=generated,
@@ -1428,14 +1584,22 @@ class SteppedDecodeSession:
         store. ``policy="recompute"`` captures no payload (the KV is
         re-prefilled from prompt + generated tokens at resume).
 
+        Speculating rows round-trip too (ISSUE 16): a model/cross row's
+        draft-cache row and draft offset are captured under ``swap``
+        (and re-prefilled via the resume's draft chunks under
+        ``recompute``); an ngram row's history is rebuilt host-side
+        from prompt + generated at resume. The rng key capture is the
+        same one the plain path does — in spec mode the key advances
+        once per ROUND, so the resumed row's remaining sampled stream
+        is bit-exact either way.
+
         Returns None — and leaves the row running — when the row cannot
-        be preempted safely: no live row for ``request``, an actively
-        speculating session (draft-cache state does not survive the
-        round trip), or a recompute whose re-prefill could not fit this
-        session's static shapes."""
+        be preempted safely: no live row for ``request``, or a
+        recompute whose re-prefill could not fit this session's static
+        shapes."""
         from .jax_engine import _prompt_alloc
 
-        if self.closed or self.spec is not None:
+        if self.closed:
             return None
         slot = None
         for r, row in enumerate(self.rows):
@@ -1454,6 +1618,12 @@ class SteppedDecodeSession:
             total = self.s_prefilled(row)
             if not self.paged and _prompt_alloc(total) > self.cache_len:
                 return None  # re-prefill would not fit the session cache
+            if (
+                self.spec is not None
+                and self.spec["draft"] is not None
+                and _prompt_alloc(total) > self.spec_draft_len
+            ):
+                return None  # draft re-prefill would not fit its cache
         ids = self.tok.encode(request.prompt)
         pr = PreemptedRow(request, ids, row.generated, row.s_real)
         pr.policy = policy
@@ -1469,6 +1639,25 @@ class SteppedDecodeSession:
         pr.streamed = row.streamed
         pr.t0, pr.t1 = row.t0, row.t1
         host_bytes = 0
+        if (
+            self.spec is not None
+            and self.spec["draft"] is not None
+            and policy == "swap"
+        ):
+            # the draft cache's row travels with the victim (it is tiny
+            # — a few prompt+budget positions of a small model); ngram
+            # rows need nothing captured, their history rebuilds from
+            # prompt + generated
+            pr.draft_blob = (
+                self._row_slab(self.carry["draft_k"], r),
+                self._row_slab(self.carry["draft_v"], r),
+            )
+            pr.draft_offset = int(
+                jax.device_get(self.carry["draft_offsets"][r])
+            )
+            host_bytes += _slab_bytes(pr.draft_blob[0]) + _slab_bytes(
+                pr.draft_blob[1]
+            )
         if self.paged:
             pages = list(row.pages)
             shared_n = 0
@@ -1515,10 +1704,11 @@ class SteppedDecodeSession:
                 self._row_slab(self.k_cache, r),
                 self._row_slab(self.v_cache, r),
             )
-            host_bytes = _slab_bytes(pr.cache_blob[0]) + _slab_bytes(
+            cache_bytes = _slab_bytes(pr.cache_blob[0]) + _slab_bytes(
                 pr.cache_blob[1]
             )
-            observe_swap("out", host_bytes)
+            host_bytes += cache_bytes
+            observe_swap("out", cache_bytes)
         pr.host_bytes = host_bytes
         self._swap_account(host_bytes, 1 if host_bytes else 0)
         # device-side retirement, exactly as cancel(): the slot rides
@@ -1546,6 +1736,14 @@ class SteppedDecodeSession:
         longer fits). Side-effect free; ``can_resume`` probes it."""
         if pr.request.model != self.model:
             return None
+        if self.spec is not None:
+            # the resumed row inherits this session's spec config: its
+            # prefilled history + remaining budget must fit the fixed
+            # draft cache / ngram history alongside the rounds-
+            # overshoot margin
+            need_len = self.s_prefilled(pr) + pr.remaining + 1
+            if need_len + self.spec_margin > self.spec_draft_len:
+                return None
         if not self.paged:
             if pr.policy == "swap" and pr.cache_blob is not None:
                 return {"mode": "swap", "need": 0, "reshare": False}
@@ -1679,6 +1877,30 @@ class SteppedDecodeSession:
         )
         pending.resume = pr
         pending.resume_mode = mode
+        if (
+            self.spec is not None
+            and self.spec["draft"] is not None
+            and not (mode == "swap" and pr.draft_blob is not None)
+        ):
+            # the resumed row needs a draft cache but no blob survived
+            # (recompute policy, or a victim captured by a non-
+            # speculating session): re-prefill the draft over the FULL
+            # history — prompt + generated-so-far — in chunks that
+            # interleave exactly like a joiner's
+            eng = self.engine
+            tf_d = eng._models[self.spec["draft"]]
+            dk, dv = tf_d.init_cache(1, self.spec_draft_len, dtype=eng.dtype)
+            pending.draft_k, pending.draft_v = eng._place_cache(
+                dk, dv, self.spec["dcfg"]
+            )
+            pending.draft_ids = pr.ids + pr.generated[:-1]
+            chunk_w = _floor_bucket(
+                int(chunk_tokens or JOIN_PREFILL_CHUNK_TOKENS),
+                PROMPT_BUCKETS,
+            )
+            pending.draft_chunks = _prompt_chunks(
+                len(pending.draft_ids), chunk_w
+            )
         self._pending[r] = pending
         return pending
 
@@ -1765,6 +1987,39 @@ class SteppedDecodeSession:
                     kc_row, vc_row = quantize_kv_cache(kc_row, vc_row)
                 self.k_cache = _set_row(self.k_cache, r, kc_row)
                 self.v_cache = _set_row(self.v_cache, r, vc_row)
+        if self.spec is not None:
+            # re-install the row's draft-source state (ISSUE 16): the
+            # captured draft-cache row (swap) or the freshly
+            # re-prefilled one (recompute); ngram rebuilds its history
+            # from the token stream the host already holds. Round
+            # counters restart at zero — this slot's prior occupant
+            # stats must not leak into the resumed row's attribution.
+            if self.spec["draft"] is not None:
+                if pending.draft_k is not None:
+                    dk_row, dv_row = pending.draft_k, pending.draft_v
+                    doff = len(pending.draft_ids or pending.ids)
+                else:
+                    dkb, dvb = pr.draft_blob
+                    dk_row = jax.tree.map(jnp.asarray, dkb)
+                    dv_row = jax.tree.map(jnp.asarray, dvb)
+                    doff = pr.draft_offset
+                self.carry["draft_k"] = _set_row(
+                    self.carry["draft_k"], r, dk_row
+                )
+                self.carry["draft_v"] = _set_row(
+                    self.carry["draft_v"], r, dv_row
+                )
+                self.carry["draft_offsets"] = (
+                    self.carry["draft_offsets"].at[r].set(doff)
+                )
+            else:
+                self._set_ngram_row(r, pr.ids + pr.generated)
+            for ckey in (
+                "spec_rounds", "spec_accepted", "spec_drafted",
+                "spec_rejected",
+            ):
+                self.carry[ckey] = self.carry[ckey].at[r].set(0)
+            self._spec_draft_wasted[r] = 0.0
         # settle the ledger: the victim's KV left host memory (swap) or
         # its blob is obsolete (recompute degraded from swap)
         if pr.host_bytes:
@@ -1827,11 +2082,15 @@ class SteppedDecodeSession:
         if ids_len + request.max_new_tokens > self.cfg.max_seq_len:
             return False
         if self.spec is not None:
-            # A speculating session admits GREEDY joiners only (accepted
-            # drafts are target-argmax tokens); a sampled request defers
-            # to its own session. The joiner also inherits the session's
-            # spec config, so its prompt + budget must fit the fixed
-            # draft cache alongside the rounds-overshoot margin.
+            # A speculating session admits any ELIGIBLE joiner — greedy
+            # rows verify by argmax match, sampled rows (ISSUE 16) by
+            # rejection resampling, selected per row inside the one
+            # compiled step; only repeat-penalty rows and
+            # hotter-than-spec_temperature_max rows defer to their own
+            # session. The joiner inherits the session's spec config,
+            # so its prompt + budget must fit the fixed draft cache (or
+            # ngram history buffer) alongside the rounds-overshoot
+            # margin.
             if not self.engine._spec_eligible(request):
                 return False
             if (
@@ -2038,11 +2297,13 @@ class SteppedDecodeSession:
             presence, pages,
             hit_tokens=common, shared_pages=shared,
         )
-        if self.spec is not None:
+        if self.spec is not None and self.spec["draft"] is not None:
             # the joiner inherits the session's spec config: a private
             # draft cache prefills over the FULL prompt (a prefix hit
             # seeds the TARGET only — the draft is cheap to recompute)
-            # in chunks that interleave exactly like the target's
+            # in chunks that interleave exactly like the target's. The
+            # ngram source needs neither cache nor chunks — its history
+            # row installs host-side at commit.
             tf_d = eng._models[self.spec["draft"]]
             dk, dv = tf_d.init_cache(1, self.spec_draft_len, dtype=eng.dtype)
             pending.draft_k, pending.draft_v = eng._place_cache(
@@ -2096,7 +2357,8 @@ class SteppedDecodeSession:
             tf_d = eng._models[draft]
             t0 = time.monotonic()
             start, bucket = pending.draft_chunks[pending.draft_next]
-            ids = pending.ids[start : start + bucket]
+            draft_ids = pending.draft_ids or pending.ids
+            ids = draft_ids[start : start + bucket]
             real = len(ids)
             tokens = jnp.asarray(
                 [ids + [self.tok.pad_id] * (bucket - real)], dtype=jnp.int32
@@ -2136,10 +2398,15 @@ class SteppedDecodeSession:
         if pending.resume is not None:
             # a preemption resume riding the same machinery: no first
             # token is sampled — the captured one continues the stream
-            if pending.next_chunk < len(pending.chunks):
+            if pending.next_chunk < len(pending.chunks) or (
+                self.spec is not None
+                and pending.draft_next < len(pending.draft_chunks)
+            ):
                 raise RuntimeError(
                     f"resume not fully re-prefilled: chunk "
-                    f"{pending.next_chunk} of {len(pending.chunks)}"
+                    f"{pending.next_chunk} of {len(pending.chunks)} "
+                    f"(+draft {pending.draft_next} of "
+                    f"{len(pending.draft_chunks)})"
                 )
             return self._commit_resume(pending)
         if pending.next_chunk < len(pending.chunks):
@@ -2180,24 +2447,32 @@ class SteppedDecodeSession:
         r = pending.slot
         del self._pending[r]
         if self.spec is not None:
-            # install the joiner's draft row BEFORE _install_row so its
-            # closing _recommit_carry re-pins every mutated leaf at once
-            self.carry["draft_k"] = _set_row(
-                self.carry["draft_k"], r, pending.draft_k
-            )
-            self.carry["draft_v"] = _set_row(
-                self.carry["draft_v"], r, pending.draft_v
-            )
-            self.carry["draft_offsets"] = (
-                self.carry["draft_offsets"].at[r].set(len(pending.ids))
-            )
+            # install the joiner's draft-source row BEFORE _install_row
+            # so its closing _recommit_carry re-pins every mutated leaf
+            # at once
+            if self.spec["draft"] is not None:
+                self.carry["draft_k"] = _set_row(
+                    self.carry["draft_k"], r, pending.draft_k
+                )
+                self.carry["draft_v"] = _set_row(
+                    self.carry["draft_v"], r, pending.draft_v
+                )
+                self.carry["draft_offsets"] = (
+                    self.carry["draft_offsets"].at[r].set(len(pending.ids))
+                )
+            else:
+                # ngram: the joiner's history row is its prompt + the
+                # first token just sampled — a host-side int32 write
+                self._set_ngram_row(r, pending.ids + [int(first[0])])
             for ckey, hkey in (
                 ("spec_rounds", "rounds"),
                 ("spec_accepted", "accepted"),
                 ("spec_drafted", "drafted"),
+                ("spec_rejected", "rejected"),
             ):
                 self.carry[ckey] = self.carry[ckey].at[r].set(0)
                 self._spec_host[hkey][r] = 0
+            self._spec_draft_wasted[r] = 0.0
         self._install_row(
             request,
             r,
@@ -2418,6 +2693,13 @@ class SteppedDecodeSession:
         if self.closed:
             return
         self.closed = True
+        if self.spec is not None:
+            # the session made it to close without falling back: this
+            # source earned its keep — clear any lingering low-acceptance
+            # strikes so the next admission doesn't inherit stale blame
+            clear = getattr(self.engine, "_spec_source_clear", None)
+            if clear is not None:
+                clear(self.spec["source"], self.spec["draft"])
         if self.paged:
             for row in self.rows:
                 if row is not None and row.pages:

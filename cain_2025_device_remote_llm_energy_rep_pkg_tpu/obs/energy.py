@@ -198,7 +198,9 @@ WASTED_J = REGISTRY.counter(
     "prompt + generated tokens under --preempt-policy recompute; swap: "
     "KV payload moved device<->host by a swap preemption; escalation: "
     "a small-first model cascade abandoned the small model's answer — "
-    "its prefill + generated tokens — and re-ran on the big model)",
+    "its prefill + generated tokens — and re-ran on the big model; "
+    "draft: a cross-model speculative round whose drafted tokens were "
+    "ALL rejected — the draft lane's Joules bought nothing)",
     labels=("cause",),
 )
 WASTED_TOKENS = REGISTRY.counter(

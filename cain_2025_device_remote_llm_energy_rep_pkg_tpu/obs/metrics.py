@@ -615,35 +615,55 @@ def merge_expositions(
     return out._render()
 
 
-# -- speculative decoding (ISSUE 9) --------------------------------------------
+# -- speculative decoding (ISSUE 9, sampled + sources ISSUE 16) -----------------
 # Declared here — not in the engine — because THREE producers share them:
 # the solo path (engine/jax_engine.generate_speculative), the batched
 # stepped sessions (engine/stepped.py) and the hermetic fake
 # (engine/fake.py), and a shared definition is what keeps one scrape
-# comparable across all three.
+# comparable across all three. Every per-round instrument carries a
+# ``source`` label ("model" | "ngram" | "cross") so the per-source
+# fallback policy and the cross-model energy split stay separable in
+# one scrape (ISSUE 16).
 SPEC_ROUNDS_C = REGISTRY.counter(
     "llm_spec_rounds_total",
-    "Draft-verify rounds executed (one round = k draft steps + ONE "
-    "target forward over the k+1 candidate positions)",
+    "Draft-verify rounds executed (one round = k draft proposals + ONE "
+    "target forward over the k+1 candidate positions), by draft source",
+    labels=("source",),
 )
 SPEC_ACCEPTED_C = REGISTRY.counter(
     "llm_spec_tokens_accepted_total",
     "Draft tokens accepted AND emitted by the target's verify (EOS "
-    "clips and budget cuts excluded — same rule as extras['spec'])",
+    "clips and budget cuts excluded — same rule as extras['spec']), "
+    "by draft source",
+    labels=("source",),
 )
 SPEC_DRAFTED_C = REGISTRY.counter(
     "llm_spec_tokens_drafted_total",
-    "Draft tokens proposed (k per live row per round)",
+    "Draft tokens proposed (k per live row per round), by draft source",
+    labels=("source",),
+)
+SPEC_REJECTED_C = REGISTRY.counter(
+    "llm_spec_tokens_rejected_total",
+    "Draft tokens burned in FULLY-rejected rounds (k per such round — "
+    "the rounds whose draft work amortized into nothing; cross-model "
+    "sources bill these same tokens to the wasted-energy ledger under "
+    'cause="draft"), by draft source',
+    labels=("source",),
 )
 SPEC_ACCEPTANCE_G = REGISTRY.gauge(
     "llm_spec_acceptance_rate",
-    "Most recent window's accepted/drafted fraction (0..1) — the "
-    "signal the stepped sessions' auto-fallback policy reads",
+    "Most recent window's accepted/drafted fraction (0..1) per draft "
+    "source — the signal the stepped sessions' auto-fallback policy "
+    "reads",
+    labels=("source",),
 )
 SPEC_FALLBACK_C = REGISTRY.counter(
     "llm_spec_fallback_total",
     "Speculating sessions that fell back to plain decode because their "
-    "rolling acceptance dropped below --spec-accept-floor",
+    "rolling acceptance dropped below --spec-accept-floor, by draft "
+    "source (n-gram collapse on non-repetitive text must not read as "
+    "model-draft failure)",
+    labels=("source",),
 )
 SPEC_VERIFY_NATIVE_C = REGISTRY.counter(
     "llm_spec_verify_native_total",
@@ -753,14 +773,24 @@ def observe_model_evicted(model: str, reason: str) -> None:
     MODEL_EVICTIONS_C.labels(reason=reason).inc()
 
 
-def observe_spec(rounds: float, accepted: float, drafted: float) -> None:
+def observe_spec(
+    rounds: float,
+    accepted: float,
+    drafted: float,
+    source: str = "model",
+    rejected: float = 0.0,
+) -> None:
     """One speculative window's counters + the acceptance gauge (no-op
     when telemetry is off — the instruments gate themselves, but the
-    gauge division is worth skipping too)."""
+    gauge division is worth skipping too). ``source`` names the draft
+    source that proposed the tokens ("model" | "ngram" | "cross");
+    ``rejected`` is the tokens burned in FULLY-rejected rounds."""
     if not _enabled or rounds <= 0:
         return
-    SPEC_ROUNDS_C.inc(rounds)
-    SPEC_ACCEPTED_C.inc(accepted)
-    SPEC_DRAFTED_C.inc(drafted)
+    SPEC_ROUNDS_C.labels(source=source).inc(rounds)
+    SPEC_ACCEPTED_C.labels(source=source).inc(accepted)
+    SPEC_DRAFTED_C.labels(source=source).inc(drafted)
+    if rejected > 0:
+        SPEC_REJECTED_C.labels(source=source).inc(rejected)
     if drafted > 0:
-        SPEC_ACCEPTANCE_G.set(accepted / drafted)
+        SPEC_ACCEPTANCE_G.labels(source=source).set(accepted / drafted)

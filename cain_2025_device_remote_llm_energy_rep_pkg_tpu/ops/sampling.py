@@ -63,6 +63,43 @@ def top_p_filter(
     return jnp.where(probs >= threshold, logits, -jnp.inf)
 
 
+def modified_probs(
+    logits: jnp.ndarray,
+    temperature: "jnp.ndarray | float",
+    top_k: int = 0,
+    top_p: "Optional[jnp.ndarray | float]" = None,
+) -> jnp.ndarray:
+    """The *modified* distribution :func:`sample_token` draws from, as
+    explicit probabilities [..., vocab].
+
+    Replicates the sampler chain exactly — top-k mask, then nucleus
+    filter on the unscaled logits, then temperature scaling — and
+    softmaxes the result. Speculative rejection resampling (ISSUE 16)
+    needs both the target's and the draft's modified distributions in
+    closed form: the accept test is ``u < min(1, p(x)/q(x))`` and the
+    residual is ``max(p − q, 0)``, both over THESE probabilities, which
+    is what makes the speculative stream's marginals provably identical
+    to plain ancestral sampling from the same chain (Leviathan et al.
+    2023, app. A).
+
+    ``temperature``/``top_p`` may be traced arrays but must already be
+    shaped to broadcast against ``logits[..., :1]`` (callers with
+    per-row knobs and [B, S, V] logits pass ``t[:, None, None]``).
+    Temperature is clamped at 1e-6 like the sampler; greedy rows are
+    expected to take the argmax lane instead of reading this tensor.
+    """
+    logits = logits.astype(jnp.float32)
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        logits = top_p_filter(logits, top_p)
+    safe_t = jnp.maximum(
+        jnp.asarray(temperature, dtype=jnp.float32), 1e-6
+    )
+    return jax.nn.softmax(logits / safe_t, axis=-1)
+
+
 def sample_token(
     logits: jnp.ndarray,
     key: jax.Array,

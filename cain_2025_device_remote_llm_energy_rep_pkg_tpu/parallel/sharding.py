@@ -167,9 +167,11 @@ def stepped_carry_shardings(
     - Everything row-control — tokens, offsets, prompt_lens, remaining,
       done, rngs, presence, sampling knobs, the page table, and the
       speculative per-row state (``draft_offsets``, ``spec_rounds``,
-      ``spec_accepted``, ``spec_drafted``) — replicates (tiny per-row
-      metadata every device reads each step; the host mutates it
-      between slices with O(B) scatters).
+      ``spec_accepted``, ``spec_drafted``, ``spec_rejected``, and the
+      n-gram draft source's token history ``ngram_hist``/``ngram_len``
+      — ISSUE 16) — replicates (tiny per-row metadata every device
+      reads each step; the host mutates it between slices with O(B)
+      scatters).
 
     The returned dict matches ``carry`` leaf-for-leaf, so it is valid as
     both a ``jax.jit`` in/out_shardings subtree and a ``device_put``

@@ -209,17 +209,27 @@ commands:
                        for HBM fit), --kv-quantize int8 (halve the decode
                        KV stream), --speculative target=draft[:k] or the
                        draft-only form --speculative draft[:k] (one draft
-                       for every served target): greedy requests decode
+                       for every served target): eligible requests decode
                        via draft-verify — solo AND batched: continuous
                        sessions run per-row draft-verify rounds where
-                       rows advance by their accepted-prefix length,
-                       composing with joins, streaming cancellation,
-                       shared-prefix CoW, --kv-quantize int8 (the target
-                       cache is int8, the tiny draft cache stays bf16)
-                       and --backend jax-tp; --spec-accept-floor F makes
-                       a session whose rolling measured acceptance drops
-                       below F fall back to plain decode
-                       (llm_spec_fallback_total; default: never),
+                       rows advance by their accepted-prefix length.
+                       Greedy rows verify exactly; SAMPLED rows (0 <
+                       temperature <= --spec-temperature-max, default 2)
+                       use rejection resampling, provably matching plain
+                       sampling's marginals. `draft` is a model name,
+                       `ngram` (prompt-lookup drafting, zero extra
+                       weights) or `cross:<model>` (draft on another
+                       serving lane's resident model; fully-rejected
+                       rounds bill draft Joules to the wasted-energy
+                       ledger). Composes with joins, streaming
+                       cancellation, shared-prefix CoW, --kv-quantize
+                       int8 (the target cache is int8, the tiny draft
+                       cache stays bf16) and --backend jax-tp;
+                       --spec-accept-floor F makes a session whose
+                       rolling measured acceptance drops below F fall
+                       back to plain decode (llm_spec_fallback_total
+                       {source}; per-source strikes park a losing
+                       source until it re-arms; default: never),
                        --prefix-cache N (prompt-prefix KV
                        LRU), --paged-kv (batched decode over a paged KV
                        pool: mixed-length batches stop paying the widest
@@ -329,6 +339,7 @@ def serve_command(args: List[str]) -> None:
     paged_kv = False
     speculative = {}
     spec_accept_floor = None  # speculative auto-fallback threshold
+    spec_temperature_max = None  # sampled-spec eligibility cap (ISSUE 16)
     prefix_cache = 0
     prefix_share = False
     prefix_index_entries = None
@@ -437,18 +448,22 @@ def serve_command(args: List[str]) -> None:
             else:
                 quantize = None if spec == "none" else spec
         elif arg == "--speculative":
-            # --speculative target=draft[:k] (repeatable): greedy requests
-            # for `target` decode via draft-and-verify with k proposals.
-            # The DRAFT-ONLY form `--speculative draft[:k]` (no '=')
-            # applies one draft to EVERY served target (stored under the
-            # "default" key; a model never self-drafts through it).
-            # Model names may contain colons (qwen2:1.5b), so only a
-            # trailing :<int> is treated as k.
+            # --speculative target=draft[:k] (repeatable): eligible
+            # requests for `target` decode via draft-and-verify with k
+            # proposals (greedy verifies exactly; sampled rows use
+            # rejection resampling — ISSUE 16). The DRAFT-ONLY form
+            # `--speculative draft[:k]` (no '=') applies one draft to
+            # EVERY served target (stored under the "default" key; a
+            # model never self-drafts through it). Besides a model
+            # name, `draft` may be `ngram` (prompt-lookup drafting,
+            # zero extra weights) or `cross:<model>` (draft on another
+            # lane's resident model). Model names may contain colons
+            # (qwen2:1.5b), so only a trailing :<int> is treated as k.
             spec = next(it, "")
             if not spec:
                 raise CommandError(
                     "serve: --speculative expects target=draft[:k] or "
-                    "draft[:k]"
+                    "draft[:k] (draft: model name, ngram, cross:<model>)"
                 )
             name, eq, rest = spec.partition("=")
             if not eq:
@@ -477,6 +492,20 @@ def serve_command(args: List[str]) -> None:
             if not 0.0 <= spec_accept_floor < 1.0:
                 raise CommandError(
                     "serve: --spec-accept-floor expects a fraction in [0, 1)"
+                )
+        elif arg == "--spec-temperature-max":
+            # sampled-spec eligibility cap: requests with temperature in
+            # (0, T] speculate via rejection resampling; hotter requests
+            # serve plain. 0 restores the greedy-only gate.
+            try:
+                spec_temperature_max = float(next(it, ""))
+            except ValueError:
+                raise CommandError(
+                    "serve: --spec-temperature-max expects a float >= 0"
+                )
+            if spec_temperature_max < 0.0:
+                raise CommandError(
+                    "serve: --spec-temperature-max expects a float >= 0"
                 )
         elif arg == "--prefix-cache":
             prefix_cache = int(next(it, "4"))
@@ -588,16 +617,38 @@ def serve_command(args: List[str]) -> None:
             from ..engine.fake import FakeBackend
 
             # --speculative on the fake backend runs the synthetic spec
-            # protocol (k from the first configured entry; acceptance
-            # via env FAKE_SPEC_ACCEPTANCE, default 1.0) so the serving
-            # surface is demo-able with no accelerator
+            # protocol (k + draft source from the first configured
+            # entry; acceptance via env FAKE_SPEC_ACCEPTANCE, default
+            # 1.0) so the serving surface is demo-able with no
+            # accelerator
             spec_k = (
                 next(iter(speculative.values()))[1] if speculative else 0
             )
+            spec_draft = (
+                next(iter(speculative.values()))[0] if speculative else ""
+            )
+            if spec_draft == "ngram":
+                spec_source = "ngram"
+            elif spec_draft.startswith("cross:"):
+                spec_source = "cross"
+                spec_draft = spec_draft.split(":", 1)[1]
+            else:
+                spec_source = "model"
             return FakeBackend(
                 spec_k=spec_k,
+                spec_source=spec_source,
+                **(
+                    {"spec_draft": spec_draft}
+                    if spec_draft and spec_source != "ngram"
+                    else {}
+                ),
                 spec_acceptance=float(
                     os.environ.get("FAKE_SPEC_ACCEPTANCE", "1.0")
+                ),
+                spec_sampled_acceptance=(
+                    float(os.environ["FAKE_SPEC_SAMPLED_ACCEPTANCE"])
+                    if "FAKE_SPEC_SAMPLED_ACCEPTANCE" in os.environ
+                    else None
                 ),
                 spec_accept_floor=spec_accept_floor,
                 prefix_share=prefix_share,
@@ -617,6 +668,11 @@ def serve_command(args: List[str]) -> None:
                 paged_kv=paged_kv,
                 speculative=speculative or None,
                 spec_accept_floor=spec_accept_floor or 0.0,
+                **(
+                    {"spec_temperature_max": spec_temperature_max}
+                    if spec_temperature_max is not None
+                    else {}
+                ),
                 prefix_cache_size=prefix_cache,
                 prefix_share=prefix_share,
                 **(
@@ -646,6 +702,11 @@ def serve_command(args: List[str]) -> None:
                 paged_kv=paged_kv,
                 speculative=speculative or None,
                 spec_accept_floor=spec_accept_floor or 0.0,
+                **(
+                    {"spec_temperature_max": spec_temperature_max}
+                    if spec_temperature_max is not None
+                    else {}
+                ),
                 prefix_cache_size=prefix_cache,
                 prefix_share=prefix_share,
                 **(

@@ -141,6 +141,12 @@ class ModelFleetScheduler:
                 "(decode_open); the model fleet needs continuous lanes"
             )
         self.backend = backend
+        # price cross-model draft waste at the DRAFT model's own live
+        # J/token (ISSUE 16): a fully-rejected speculative round burns
+        # the draft lane's energy, and the fleet is the one place that
+        # knows each model's attributed figure
+        if hasattr(backend, "spec_draft_jpt"):
+            backend.spec_draft_jpt = self._live_jpt
         self.model_policy = model_policy
         self.escalate_max_tokens = (
             int(escalate_max_tokens)

@@ -184,6 +184,54 @@ def draw_models(
     return models
 
 
+def parse_temperature_dist(spec: str) -> Dict[float, float]:
+    """``"0.7=0.6,1.0=0.2"`` → {0.7: 0.6, 1.0: 0.2}. Each entry is
+    temperature=fraction; fractions need not sum to 1 — the remainder
+    draws temperature 0.0 (greedy)."""
+    out: Dict[float, float] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        temp, eq, frac = entry.partition("=")
+        if not eq:
+            raise ValueError(
+                f"temperature dist entry {entry!r} is not temp=fraction"
+            )
+        out[float(temp)] = float(frac)
+    if sum(out.values()) > 1.0 + 1e-9:
+        raise ValueError(f"temperature fractions sum past 1: {spec!r}")
+    if any(t < 0 for t in out):
+        raise ValueError(f"negative temperature in {spec!r}")
+    return out
+
+
+def draw_temperatures(
+    n: int, dist: Optional[Dict[float, float]], seed: int = 0
+) -> List[float]:
+    """``n`` seeded per-request temperatures drawn from ``dist``
+    (uncovered fraction mass draws 0.0 — greedy). Uses its own derived
+    seed, INDEPENDENT of the arrival/length/tier/model streams, so
+    turning sampling on replays the SAME trace — the property the
+    sampled-speculation A/B arms (ISSUE 16) depend on: the spec-on and
+    spec-off runs see identical arrivals and identical sampled/greedy
+    row mixes."""
+    if not dist:
+        return [0.0] * n
+    rng = random.Random((seed << 16) ^ 0x7E39)
+    temps_sorted = sorted(dist)
+    temps = []
+    for _ in range(n):
+        u, acc, drawn = rng.random(), 0.0, 0.0
+        for t in temps_sorted:
+            acc += dist[t]
+            if u < acc:
+                drawn = t
+                break
+        temps.append(drawn)
+    return temps
+
+
 def build_cancellations(
     n: int,
     cancel_frac: float,
@@ -244,6 +292,7 @@ def build_workload(
     anchor_shared_prefix: bool = False,
     tier_mix: Optional[Dict[str, float]] = None,
     model_mix: Optional[Dict[str, float]] = None,
+    temperature_dist: Optional[Dict[float, float]] = None,
 ) -> List[Tuple[float, GenerationRequest]]:
     """``[(arrival_offset_s, request), ...]`` — Poisson arrivals (seeded
     exponential inter-arrival; the first request arrives at t=0) over a
@@ -282,6 +331,14 @@ def build_workload(
     the summary gains a per-model percentile breakdown + escalation
     counts.
 
+    ``temperature_dist`` (ISSUE 16, :func:`parse_temperature_dist`'s
+    shape) stamps each request with a seeded TEMPERATURE — the
+    sampled/greedy traffic mix the sampled-speculation path serves
+    (uncovered fraction mass draws 0.0, greedy). The temperature stream
+    is independent of every other stream, so the same trace replays
+    across spec-on/spec-off arms; the summary gains a sampled/greedy
+    split.
+
     Every request additionally carries a CALLER-MINTED ``x_trace``
     (ISSUE 13): the summary prints the trace ids of failed / retried /
     SLO-missed requests, so a bad run is immediately queryable via the
@@ -290,6 +347,7 @@ def build_workload(
     rng = random.Random(seed)
     tiers = draw_tiers(n, tier_mix, seed=seed)
     models = draw_models(n, model_mix, model, seed=seed)
+    temps = draw_temperatures(n, temperature_dist, seed=seed)
     share_rng = random.Random((seed << 16) ^ 0x5F1C)
     prefixes = (
         shared_prefix_texts(max(1, prefix_pool), shared_prefix_tokens)
@@ -346,6 +404,7 @@ def build_workload(
                     models[i],
                     prompt,
                     max_new_tokens=budgets[i % len(budgets)],
+                    temperature=temps[i],
                     seed=i,
                     stop_at_eos=stop_at_eos,
                     deadline_ms=deadline_ms,
@@ -388,6 +447,9 @@ def run_load(
             "offset_s": offset,
             "t_submit": t_submit - start,
             "tier": getattr(request, "priority", None),
+            # sampled/greedy attribution (ISSUE 16): the summary splits
+            # figures by whether the row decoded at temperature > 0
+            "temperature": getattr(request, "temperature", 0.0),
             # the model the CALLER asked for ("auto" included); the
             # fleet's resolved model overwrites this at completion so
             # the per-model breakdown attributes to who actually ran
@@ -691,6 +753,31 @@ def summarize(records: List[Dict]) -> Dict:
     escalated = sum(1 for r in ok if r.get("escalated_from"))
     if escalated:
         out["escalations"] = escalated
+    # sampled/greedy split (ISSUE 16): mixed-temperature traffic is the
+    # workload sampled speculation serves — the split shows whether the
+    # sampled rows' latency kept pace with the greedy rows' under one
+    # continuous session (the rejection-resampling lane's whole point)
+    sampled = [r for r in ok if (r.get("temperature") or 0.0) > 0]
+    greedy = [r for r in ok if not (r.get("temperature") or 0.0) > 0]
+    if sampled and greedy:
+        sampling = {}
+        for name, recs in (("sampled", sampled), ("greedy", greedy)):
+            s_done = [r for r in recs if not r.get("cancelled")]
+            s_ttfts = [
+                r["ttft_s"] for r in recs if r.get("ttft_s") is not None
+            ]
+            s_comps = [r["completion_s"] for r in s_done]
+            entry = {
+                "requests": len(recs),
+                "tokens": sum(r["tokens"] for r in recs),
+                "completion_p50_s": round(percentile(s_comps, 50), 4),
+                "completion_p95_s": round(percentile(s_comps, 95), 4),
+            }
+            if s_ttfts:
+                entry["ttft_p50_s"] = round(percentile(s_ttfts, 50), 4)
+                entry["ttft_p95_s"] = round(percentile(s_ttfts, 95), 4)
+            sampling[name] = entry
+        out["sampling"] = sampling
     # per-tier breakdown (ISSUE 11): the high-tier TTFT tail under
     # overload is THE number the preemption A/B trades for — reported
     # per tier so one summary line carries both sides of the trade
@@ -795,6 +882,15 @@ def main() -> int:
         "percentile breakdown + escalation counts",
     )
     ap.add_argument(
+        "--temperature-dist", default=None,
+        help="seeded per-request temperature assignment, e.g. "
+        "'0.7=0.6,1.0=0.2' (ISSUE 16; each entry is temp=fraction, "
+        "uncovered fraction mass draws 0.0 — greedy); independent of "
+        "the arrival/length/tier/model streams, so the same trace "
+        "replays across spec-on/spec-off arms, and the summary gains "
+        "a sampled/greedy split",
+    )
+    ap.add_argument(
         "--fake", action="store_true",
         help="drive an in-process fake-backend continuous scheduler "
         "instead of a live server (hermetic demo/CI)",
@@ -852,6 +948,11 @@ def main() -> int:
         tier_mix=parse_tier_mix(args.tier_mix) if args.tier_mix else None,
         model_mix=(
             parse_model_mix(args.model_mix) if args.model_mix else None
+        ),
+        temperature_dist=(
+            parse_temperature_dist(args.temperature_dist)
+            if args.temperature_dist
+            else None
         ),
     )
     cancellations = None

@@ -125,6 +125,22 @@ Three phases, all over the deterministic fake backend:
     fires; a FORCED weight eviction shows up on ``/api/ps`` and as a
     ``model_evicted`` flight event.
 
+14. SAMPLED SPECULATION + DRAFT SOURCES (ISSUE 16): the fake backend
+    speaks the ISSUE-16 spec protocol extensions — a separate synthetic
+    acceptance for SAMPLED rows (``spec_sampled_acceptance``) and a
+    configurable draft source labelling every ``llm_spec_*`` family.
+    One cross-source server (``spec_source="cross"``,
+    ``spec_draft="small:1b"``) serves a healthy greedy row (labelled
+    counters move under ``source="cross"``) then a sampled row at
+    acceptance 0 under a floor: the per-source fallback fires
+    (``llm_spec_fallback_total{source="cross"}`` + the flight event
+    carrying the source), the fully-rejected rounds' draft tokens are
+    billed to ``llm_request_wasted_joules_total{cause="draft"}`` at the
+    draft model's J/token, and the SAME figure rides the wire as
+    ``x_extras.spec.draft_wasted_J``. A second ngram-source server pins
+    the zero-weight label (``source="ngram"``, no draft model on the
+    wire).
+
 Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
 Exit 0 on success; prints one JSON status line either way.
 """
@@ -1523,6 +1539,144 @@ def main() -> int:
     finally:
         server13.stop()
 
+    # -- phase 14: sampled speculation + draft sources (ISSUE 16) --------------
+    # Cross-source spec server: a GREEDY row at healthy acceptance moves
+    # the source-labelled spec counters; a SAMPLED row (temperature >
+    # 0) at synthetic acceptance 0 fully rejects every round — the
+    # per-source fallback fires under the floor AND the rejected draft
+    # tokens are billed to the wasted-energy ledger at the draft
+    # model's J/token, the wire figure agreeing with the counter delta.
+    def _labeled_value(text_now, name, label_frag):
+        total, seen = 0.0, False
+        for line in text_now.splitlines():
+            if line.startswith(name + "{") and label_frag in line:
+                total += float(line.rsplit(" ", 1)[1])
+                seen = True
+        return total if seen else None
+
+    def _post14(base, prompt, num_predict, temperature=None):
+        options = {"num_predict": num_predict}
+        if temperature is not None:
+            options["temperature"] = temperature
+        req = urllib.request.Request(
+            f"{base}/api/generate",
+            data=json.dumps(
+                {"model": "smoke:1b", "prompt": prompt, "options": options}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    backend14 = FakeBackend(
+        tokens_per_s=400.0,
+        simulate_delay=True,
+        spec_k=4,
+        spec_acceptance=0.75,
+        spec_sampled_acceptance=0.0,
+        spec_source="cross",
+        spec_draft="small:1b",
+        model_joules={"smoke:1b": 0.5, "small:1b": 0.1},
+    )
+    server14 = GenerationServer(
+        backend14,
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+        spec_accept_floor=0.25,
+    )
+    server14.start()
+    try:
+        base14 = f"http://127.0.0.1:{server14.port}"
+        pre14 = _scrape(base14)
+
+        def delta14(text_now, name, frag):
+            before = _labeled_value(pre14, name, frag) or 0.0
+            now = _labeled_value(text_now, name, frag)
+            assert now is not None, f"{name}{{{frag}}} absent from /metrics"
+            return now - before
+
+        wasted_draft0 = WASTED_J.labels(cause="draft").value
+        # greedy row: healthy cross-source speculation, no billing
+        body14g = _post14(base14, "greedy cross row", 64)
+        assert body14g.get("done"), body14g
+        spec14g = body14g["x_extras"]["spec"]
+        assert spec14g["source"] == "cross", spec14g
+        assert spec14g["draft_model"] == "small:1b", spec14g
+        assert spec14g["rejected"] == 0 and not spec14g["fallback"], spec14g
+        assert "draft_wasted_J" not in spec14g, spec14g
+        assert WASTED_J.labels(cause="draft").value == wasted_draft0
+
+        # sampled row: synthetic sampled-acceptance 0 — every round
+        # fully rejects, the floor flips the session to plain decode,
+        # and the rejected draft tokens charge the ledger
+        body14s = _post14(base14, "hopeless sampled row", 64, temperature=0.8)
+        assert body14s.get("done"), body14s
+        spec14s = body14s["x_extras"]["spec"]
+        assert spec14s["source"] == "cross", spec14s
+        assert spec14s["rejected"] >= 1, spec14s
+        assert spec14s["fallback"] is True, spec14s
+        wire_draft14 = spec14s.get("draft_wasted_J", 0.0)
+        assert wire_draft14 > 0, spec14s
+        wasted_draft14 = WASTED_J.labels(cause="draft").value - wasted_draft0
+        assert abs(wasted_draft14 - wire_draft14) < 1e-6, (
+            wasted_draft14,
+            wire_draft14,
+        )
+        # rejected tokens priced at the DRAFT model's J/token (0.1)
+        assert abs(
+            wire_draft14 - 0.1 * (spec14s["rejected"] * spec14s["k"])
+        ) < 1e-6, spec14s
+
+        text14 = _scrape(base14)
+        frag14 = 'source="cross"'
+        rounds14 = delta14(text14, "llm_spec_rounds_total", frag14)
+        rejected14 = delta14(
+            text14, "llm_spec_tokens_rejected_total", frag14
+        )
+        fallbacks14 = delta14(text14, "llm_spec_fallback_total", frag14)
+        assert rounds14 >= 1 and rejected14 >= 4, (rounds14, rejected14)
+        assert fallbacks14 >= 1, "cross-source fallback never fired"
+        assert _labeled_value(
+            text14, "llm_request_wasted_joules_total", 'cause="draft"'
+        ), "draft waste missing from /metrics"
+        fb_events14 = [
+            e
+            for e in _get_json(base14, "/debug/flight?type=spec_fallback")[
+                "events"
+            ]
+            if e.get("source") == "cross"
+        ]
+        assert fb_events14, "no cross-source spec_fallback flight event"
+        assert fb_events14[-1]["floor"] == 0.25, fb_events14[-1]
+    finally:
+        server14.stop()
+
+    # ngram source: zero extra weights — the label moves and the wire
+    # carries no draft model
+    server14b = GenerationServer(
+        FakeBackend(spec_k=4, spec_acceptance=0.5, spec_source="ngram"),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    server14b.start()
+    try:
+        base14b = f"http://127.0.0.1:{server14b.port}"
+        body14b = _post14(base14b, "ngram row", 32)
+        assert body14b.get("done"), body14b
+        spec14b = body14b["x_extras"]["spec"]
+        assert spec14b["source"] == "ngram", spec14b
+        assert spec14b["draft_model"] is None, spec14b
+        text14b = _scrape(base14b)
+        assert _labeled_value(
+            text14b, "llm_spec_rounds_total", 'source="ngram"'
+        ), "ngram-labelled spec rounds never moved"
+    finally:
+        server14b.stop()
+
     print(
         json.dumps(
             {
@@ -1601,6 +1755,13 @@ def main() -> int:
                     "escalation_wasted_joules": round(wasted_delta13, 6),
                     "escalated_events": len(escalated_events13),
                     "ps_after_eviction": sorted(names13b),
+                },
+                "spec_sampled": {
+                    "cross_rounds": rounds14,
+                    "cross_rejected_tokens": rejected14,
+                    "cross_fallbacks": fallbacks14,
+                    "draft_wasted_joules": round(wasted_draft14, 6),
+                    "wire_agrees": True,
                 },
             }
         )

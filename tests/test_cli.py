@@ -134,9 +134,13 @@ def test_serve_speculative_knobs_reach_engine_and_server(monkeypatch):
             "--spec-accept-floor", "0.4",
         ]
     )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.speculative import (
+        DraftSpec,
+    )
+
     be = captured["backend"]
-    assert be.speculative == {"default": ("qwen2:0.5b", 3)}
-    assert be._resolve_spec("qwen2:1.5b") == ("qwen2:0.5b", 3)
+    assert be.speculative == {"default": DraftSpec("model", "qwen2:0.5b", 3)}
+    assert be._resolve_spec("qwen2:1.5b") == DraftSpec("model", "qwen2:0.5b", 3)
     assert be._resolve_spec("qwen2:0.5b") is None  # never self-drafts
     assert be.spec_accept_floor == 0.4
     assert captured["spec_accept_floor"] == 0.4
@@ -149,7 +153,7 @@ def test_serve_speculative_knobs_reach_engine_and_server(monkeypatch):
         ]
     )
     be = captured["backend"]
-    assert be.speculative == {"qwen2:1.5b": ("qwen2:0.5b", 5)}
+    assert be.speculative == {"qwen2:1.5b": DraftSpec("model", "qwen2:0.5b", 5)}
     assert captured["spec_accept_floor"] is None
 
     with pytest.raises(CommandError, match="spec-accept-floor"):

@@ -128,7 +128,13 @@ def test_kv_quantize_guards():
         kv_quantize="int8",
         speculative={"a": ("b", 4)},
     )
-    assert eng.kv_quantize == "int8" and eng.speculative == {"a": ("b", 4)}
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.speculative import (
+        DraftSpec,
+    )
+
+    # ctor tuples normalize to DraftSpec entries (ISSUE 16)
+    assert eng.kv_quantize == "int8"
+    assert eng.speculative == {"a": DraftSpec("model", "b", 4)}
 
 
 def test_kv_quantize_composes_with_speculative_decoding():

@@ -771,7 +771,9 @@ def test_fake_backend_speaks_spec_protocol_with_fallback():
     )
 
     def counter(name):
-        return REGISTRY.snapshot().get(name, {}).get("_", 0)
+        # llm_spec_* families carry a {source} label (ISSUE 16): sum
+        # every child so the pre-label arithmetic still pins exactly.
+        return sum(REGISTRY.snapshot().get(name, {}).values())
 
     fb = FakeBackend(spec_k=4, spec_acceptance=0.75)
     sess = fb.decode_open(

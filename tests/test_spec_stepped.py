@@ -317,17 +317,27 @@ def test_spec_stacked_vs_scratch_modes_agree(registry):
     assert r1.tokens == r2.tokens
 
 
-def test_spec_session_rejects_sampled_rows_and_joiners(registry):
-    """Greedy-only: sampled anchors open a PLAIN session; a sampled
-    joiner is refused by a speculating session's can_join (it defers to
-    its own session instead)."""
+def test_spec_session_admits_sampled_rows_and_joiners(registry):
+    """ISSUE 16 retires the greedy-only gate: sampled anchors SPECULATE
+    (rejection resampling), a speculating session's can_join admits a
+    sampled joiner, and only hotter-than-spec_temperature_max rows
+    still defer to a plain session."""
     eng = _spec_engine(registry)
     sampled = GenerationRequest(
-        "tiny", "sampled anchor", max_new_tokens=8, temperature=0.9
+        "tiny", "sampled anchor", max_new_tokens=8, temperature=0.9, seed=5
     )
     sess = eng.decode_open([sampled])
-    assert sess.spec is None
-    _drain(sess)
+    assert sess.spec is not None
+    res = _drain(sess)[0]
+    assert res.extras["spec"]["rounds"] >= 1
+    assert res.extras["spec"]["source"] == "model"
+
+    hot = GenerationRequest(
+        "tiny", "too hot to draft", max_new_tokens=8, temperature=5.0, seed=6
+    )
+    hot_sess = eng.decode_open([hot])
+    assert hot_sess.spec is None  # above the default 2.0 cap: plain
+    _drain(hot_sess)
 
     greedy = GenerationRequest(
         "tiny", "greedy anchor", max_new_tokens=24, stop_at_eos=False
@@ -335,12 +345,16 @@ def test_spec_session_rejects_sampled_rows_and_joiners(registry):
     sess2 = eng.decode_open([greedy], reserve_rows=4)
     assert sess2.spec is not None
     sampled_joiner = GenerationRequest(
-        "tiny", "sampled joiner", max_new_tokens=8, temperature=0.7
+        "tiny", "sampled joiner", max_new_tokens=8, temperature=0.7, seed=7
     )
-    assert not sess2.can_join(sampled_joiner)
-    greedy_joiner = GenerationRequest("tiny", "ok joiner", max_new_tokens=8)
-    assert sess2.can_join(greedy_joiner)
-    _drain(sess2)
+    assert sess2.can_join(sampled_joiner)
+    sess2.join(sampled_joiner)
+    hot_joiner = GenerationRequest(
+        "tiny", "hot joiner", max_new_tokens=8, temperature=5.0
+    )
+    assert not sess2.can_join(hot_joiner)
+    results = {id(r.request): r for r in _drain(sess2)}
+    assert results[id(sampled_joiner)].extras["spec"]["rounds"] >= 1
 
 
 def test_spec_adaptive_fallback_preserves_parity(registry):
@@ -358,7 +372,9 @@ def test_spec_adaptive_fallback_preserves_parity(registry):
         "tiny", "long fallback run", max_new_tokens=120, stop_at_eos=False
     )
     before = (
-        REGISTRY.snapshot().get("llm_spec_fallback_total", {}).get("_", 0)
+        REGISTRY.snapshot()
+        .get("llm_spec_fallback_total", {})
+        .get("source=model", 0)
     )
     sess = eng.decode_open([req])
     assert sess.spec is not None
@@ -367,7 +383,9 @@ def test_spec_adaptive_fallback_preserves_parity(registry):
     assert res.extras["spec"]["fallback"] is True
     assert res.tokens == plain_eng._generate_plain(req).tokens
     after = (
-        REGISTRY.snapshot().get("llm_spec_fallback_total", {}).get("_", 0)
+        REGISTRY.snapshot()
+        .get("llm_spec_fallback_total", {})
+        .get("source=model", 0)
     )
     assert after == before + 1
 
@@ -459,7 +477,11 @@ def test_solo_spec_emits_obs_and_nested_extras(registry):
     )
 
     eng = _spec_engine(registry, draft="tiny-same", k=4)
-    before = REGISTRY.snapshot().get("llm_spec_rounds_total", {}).get("_", 0)
+    before = (
+        REGISTRY.snapshot()
+        .get("llm_spec_rounds_total", {})
+        .get("source=model", 0)
+    )
     res = eng.generate(
         GenerationRequest(
             "tiny", "solo obs", max_new_tokens=17, stop_at_eos=False
@@ -469,5 +491,9 @@ def test_solo_spec_emits_obs_and_nested_extras(registry):
     assert spec["rounds"] == res.extras["spec_rounds"]
     assert spec["accepted"] == res.extras["spec_accepted"]
     assert spec["drafted"] == spec["rounds"] * 4
-    after = REGISTRY.snapshot().get("llm_spec_rounds_total", {}).get("_", 0)
+    after = (
+        REGISTRY.snapshot()
+        .get("llm_spec_rounds_total", {})
+        .get("source=model", 0)
+    )
     assert after >= before + spec["rounds"]

@@ -70,14 +70,18 @@ def test_speculative_routing_via_generate(registry):
         GenerationRequest("target", "routed", max_new_tokens=12)
     )
     assert greedy.extras is not None and greedy.extras["k"] == 3
-    # sampled requests fall through to the plain loop
+    # sampled requests speculate too (ISSUE 16): the rejection-resampling
+    # lane serves them through the same configured draft
     sampled = engine.generate(
         GenerationRequest(
             "target", "routed", max_new_tokens=12, temperature=0.9, seed=1
         )
     )
-    # plain path: no speculative counters (obs may attach energy extras)
-    assert "spec_rounds" not in (sampled.extras or {})
+    spec_x = (sampled.extras or {}).get("spec")
+    assert spec_x is not None and spec_x["source"] == "model"
+    assert spec_x["draft_model"] == "draft"
+    assert sampled.extras["spec_rounds"] >= 1
+    assert sampled.generated_tokens <= 12
 
 
 def test_speculative_respects_eos_and_budget(engine):
@@ -140,10 +144,20 @@ def test_non_coresident_pair_falls_back_to_plain_decode(registry, monkeypatch):
     assert result.tokens == plain.tokens
 
 
-def test_speculative_rejects_sampling(engine):
-    with pytest.raises(ValueError, match="greedy-only"):
+def test_speculative_rejects_repeat_penalty(engine):
+    # Sampling no longer raises (ISSUE 16: rejection resampling serves
+    # it); the presence penalty remains excluded — it perturbs the
+    # modified distribution per EMITTED token, which a k-wide proposal
+    # step cannot replicate mid-round.
+    with pytest.raises(ValueError, match="repeat_penalty"):
         engine.generate_speculative(
-            GenerationRequest("target", "x", max_new_tokens=4, temperature=0.5),
+            GenerationRequest(
+                "target",
+                "x",
+                max_new_tokens=4,
+                temperature=0.5,
+                repeat_penalty=1.3,
+            ),
             "draft",
         )
 
