@@ -32,11 +32,12 @@ BATCH_STAT = "best"  # max over the timed windows (relay sessions land low)
 
 
 def _attach_obs(line: dict) -> None:
-    """Attach the obs registry snapshot (`obs_metrics`) and the flight-
+    """Attach the obs registry snapshot (`obs_metrics`), the flight-
     recorder summary (`obs_flight`: event counts by type + drop count)
-    to a bench JSON line — EVERY bench entry carries both, so a
-    BENCH_*.json row records not just the figures but the scheduler/
-    engine decisions (slices, joins, retirements, fallbacks) behind
+    and — when any SLO engine is live — the per-objective attainment/
+    burn state (`obs_slo`) to a bench JSON line, so a BENCH_*.json row
+    records not just the figures but the scheduler/engine decisions
+    (slices, joins, retirements, fallbacks) and contract state behind
     them. Guarded: the perf line must never die on telemetry."""
     try:
         from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.flight import (
@@ -45,6 +46,9 @@ def _attach_obs(line: dict) -> None:
         from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
             REGISTRY,
         )
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.slo import (
+            active_snapshot,
+        )
 
         snap = REGISTRY.snapshot()
         if snap:
@@ -52,6 +56,9 @@ def _attach_obs(line: dict) -> None:
         flight = FLIGHT.summary()
         if flight.get("events_total"):
             line["obs_flight"] = flight
+        slo = active_snapshot()
+        if slo:
+            line["obs_slo"] = slo
     except Exception:
         pass
 
@@ -2620,6 +2627,142 @@ def router_fleet_bench() -> int:
     return 0
 
 
+def slo_overhead_bench() -> int:
+    """Overhead micro-arm for ISSUE 17's windowed telemetry: the SAME
+    tiny-CPU stepped-decode workload (real JaxEngine, continuous
+    scheduler, seeded Poisson arrivals) run three ways —
+
+    - ``telemetry``: obs on, no ring/SLO (the pre-ISSUE baseline);
+    - ``slo``: obs on + a TimeSeriesRing sampler at 10 Hz (10x the
+      shipped 1 s cadence — a deliberate worst case) + an SLOEngine
+      evaluating two objectives every tick;
+    - ``off``: kill switch on WITH the ring/SLO still configured — the
+      sampler must refuse to start, restoring full parity.
+
+    Budget: the ``slo`` arm's aggregate tokens/s within 2% of the
+    ``telemetry`` arm's (recorded in docs/PERF.md). Each arm runs twice
+    and keeps its best window (BATCH_STAT), like the decode bench.
+    Prints ONE JSON line."""
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(
+        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "scripts")
+    )
+    import jax
+    import jax.numpy as jnp
+    from poisson_load import build_workload, run_load, summarize
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu import obs
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+        JaxEngine,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+        get_model_config,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import slo as obs_slo
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import (
+        timeseries as obs_ts,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    cfg = get_model_config("qwen2:1.5b").tiny()
+    engine = JaxEngine(registry={cfg.name: cfg}, dtype=jnp.float32)
+
+    n = int(_os.environ.get("BENCH_SLO_REQUESTS", "16"))
+    mean_ms = float(_os.environ.get("BENCH_SLO_INTERARRIVAL_MS", "30"))
+    workload = build_workload(
+        n, mean_ms / 1e3, seed=11, model=cfg.name, budgets=(8, 16, 32),
+        prompts=("alpha beta", "gamma delta epsilon", "zeta eta"),
+        stop_at_eos=False,  # fixed lengths: every arm does equal work
+    )
+
+    was_enabled = obs.enabled()
+    interval_s = 0.1  # 10x the shipped cadence: overhead upper bound
+    spec = "ttft_p99_ms<=250,completion_p95_s<=4"
+
+    def run_arm(enable: bool, with_slo: bool) -> dict:
+        (obs.enable if enable else obs.disable)()
+        sampler = None
+        if with_slo:
+            ring = obs_ts.TimeSeriesRing(interval_s=interval_s)
+            slo_engine = obs_slo.SLOEngine(
+                obs_slo.parse_slo_spec(spec), ring, name="bench"
+            )
+
+            def _tick():
+                ring.sample_once()
+                slo_engine.evaluate()
+
+            sampler = obs_ts.SamplerThread(
+                _tick, interval_s=interval_s, name="bench-ts-sampler"
+            )
+            started = sampler.start()
+            assert started is enable  # kill switch: never starts when off
+        sched = ContinuousScheduler(engine)
+        sched.start()
+        try:
+            records = run_load(sched.submit, workload)
+        finally:
+            sched.stop()
+            if sampler is not None:
+                sampler.stop()
+        return summarize(records)
+
+    arms = {}
+    try:
+        # warm-up: one full throwaway pass through the measured path so
+        # every XLA shape (prefill buckets, stepped decode, admission
+        # resizes) compiles BEFORE any arm is timed — arm order must
+        # not decide the comparison
+        run_arm(True, False)
+        for name, enable, with_slo in (
+            ("telemetry", True, False),
+            ("slo", True, True),
+            ("off", False, True),
+        ):
+            runs = [run_arm(enable, with_slo) for _ in range(BATCH_TIMED_RUNS)]
+            arms[name] = max(
+                runs, key=lambda s: s.get("agg_tokens_per_s") or 0.0
+            )
+    finally:
+        (obs.enable if was_enabled else obs.disable)()
+
+    def tps(name):
+        return arms[name].get("agg_tokens_per_s") or 0.0
+
+    overhead_pct = (
+        round((tps("telemetry") - tps("slo")) / tps("telemetry") * 100.0, 2)
+        if tps("telemetry")
+        else None
+    )
+    line = {
+        "metric": "slo_overhead",
+        "unit": "tokens_per_s",
+        "model": cfg.name,
+        "backend": jax.default_backend(),
+        "requests": n,
+        "mean_interarrival_ms": mean_ms,
+        "sampler_interval_s": interval_s,
+        "slo_spec": spec,
+        "timed_runs": BATCH_TIMED_RUNS,
+        "stat": BATCH_STAT,
+        "arms": arms,
+        "slo_overhead_pct": overhead_pct,
+        "overhead_budget_pct": 2.0,
+        "kill_switch_tokens_per_s": tps("off"),
+    }
+    _attach_obs(line)
+    print(json.dumps(line))
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "continuous_batching":
         return continuous_batching_bench()
@@ -2645,6 +2788,8 @@ def main() -> int:
         return spec_continuous_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "spec_sampled":
         return spec_sampled_bench()
+    if len(sys.argv) > 1 and sys.argv[1] == "slo_overhead":
+        return slo_overhead_bench()
     import jax
 
     backend = jax.default_backend()

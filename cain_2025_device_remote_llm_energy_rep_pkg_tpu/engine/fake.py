@@ -734,9 +734,15 @@ class FakeBackend(GenerationBackend):
         joules_per_token: float = 0.0,
         model_joules: "Optional[Dict[str, float]]" = None,
         model_bytes: "Optional[Dict[str, int]]" = None,
+        clock=None,
     ):
         self.tokens_per_s = tokens_per_s
         self.simulate_delay = simulate_delay
+        # Deterministic clock hook (ISSUE 17): tests hand ONE hand-driven
+        # clock to this backend, the time-series ring and the SLO engine
+        # so window math over a fake fleet is hermetic — no sleeps, no
+        # wall-clock jitter. None = time.monotonic (production).
+        self.clock = clock if clock is not None else time.monotonic
         # Synthetic energy attribution (ISSUE 13): a non-zero value makes
         # this fake report that J/token for every served request — into
         # the shared llm_request_joules_per_token family (so a remote

@@ -25,6 +25,14 @@ and default-on with a shared kill switch (env ``TPU_LLM_OBS=0`` or
 - :mod:`.detect` — streaming anomaly detection (per-cell run CV against
   ROADMAP #1's <=5% target, rolling-median step-time spikes) and
   goodput accounting for the stepped decode path.
+- :mod:`.timeseries` — a fixed-capacity in-process ring of registry
+  snapshots taken on a background cadence, serving WINDOWED rollups
+  (counter rates/deltas, gauge min/mean/max, histogram quantiles from
+  bucket deltas) at ``GET /debug/timeseries`` (ISSUE 17).
+- :mod:`.slo` — SLO objectives (``serve --slo 'ttft_p99_ms<=250,...'``)
+  evaluated over the ring: windowed attainment, multi-window burn-rate
+  alerting (``slo_alert`` flight events, ``llm_slo_*`` families), fleet
+  rollups at the router (ISSUE 17).
 
 Instrumented layers: ``serve/server.py`` (HTTP timings, request root
 spans, ``/metrics``), ``serve/scheduler.py`` (queue wait, window
@@ -45,12 +53,16 @@ from .flight import FLIGHT, FlightRecorder
 from .metrics import (
     REGISTRY,
     MetricsRegistry,
+    bucket_fraction_below,
     disable,
     enable,
     enabled,
     merge_expositions,
     parse_exposition,
+    quantile_from_buckets,
 )
+from .slo import Objective, SLOEngine, parse_slo_spec
+from .timeseries import SamplerThread, TimeSeriesRing
 from .trace import TRACER, Span, SpanTracer, TraceContext, mint_trace_id
 
 __all__ = [
@@ -68,4 +80,11 @@ __all__ = [
     "disable",
     "merge_expositions",
     "parse_exposition",
+    "quantile_from_buckets",
+    "bucket_fraction_below",
+    "TimeSeriesRing",
+    "SamplerThread",
+    "SLOEngine",
+    "Objective",
+    "parse_slo_spec",
 ]

@@ -90,6 +90,9 @@ EV_STREAM_CHUNK = "stream_chunk"  # one egress push of a streaming row's
 #   token delivery — the "stream chunks" phase of a /debug/timeline)
 EV_DECODE_WINDOW = "decode_window"  # engine fence-timed decode window
 EV_ANOMALY = "anomaly"  # detector fired (obs/detect.py)
+EV_SLO_ALERT = "slo_alert"  # an SLO burn-rate alert transitioned
+#   (state = firing|resolved; one synthetic trace id per episode links
+#   the firing to its resolution — ISSUE 17, obs/slo.py)
 EV_CRASH_DUMP = "crash_dump"  # a crash dump was written
 
 # Ring capacity: ~1 MB worst case, hours of serving at typical event

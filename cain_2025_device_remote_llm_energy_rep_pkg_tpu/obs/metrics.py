@@ -510,6 +510,84 @@ def histogram_mean(
     return total / count
 
 
+def quantile_from_buckets(
+    bounds: Sequence[float],
+    counts: Sequence[float],
+    q: float,
+) -> Optional[float]:
+    """Estimate the ``q``-quantile of a fixed-bucket histogram from its
+    PER-BUCKET counts (ISSUE 17 — shared by the time-series ring, the
+    SLO engine and ``scripts/poisson_load.py``).
+
+    ``bounds`` are the finite upper bucket bounds (ascending, the
+    registry's ``_Histogram.bounds``); ``counts`` has one extra entry —
+    the ``+Inf`` overflow bucket — exactly like ``_Histogram.counts``.
+    The estimate interpolates linearly inside the containing bucket
+    (Prometheus ``histogram_quantile`` convention): the first bucket
+    interpolates from 0, and any quantile landing in the ``+Inf``
+    bucket clamps to the last finite bound (an unbounded bucket has no
+    defensible upper edge). Returns None on empty histograms. Works on
+    DELTAS between two ring snapshots as well as on cumulative counts —
+    the math only needs non-negative per-bucket mass."""
+    if len(counts) != len(bounds) + 1:
+        raise ValueError(
+            f"counts must have len(bounds)+1 entries "
+            f"(+Inf last), got {len(counts)} for {len(bounds)} bounds"
+        )
+    total = float(sum(counts))
+    if total <= 0:
+        return None
+    q = min(1.0, max(0.0, q))
+    target = q * total
+    cum = 0.0
+    for i, n in enumerate(counts[: len(bounds)]):
+        prev = cum
+        cum += n
+        if cum >= target:
+            hi = float(bounds[i])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            if n <= 0:
+                return hi
+            return lo + (hi - lo) * (target - prev) / n
+    return float(bounds[-1]) if bounds else None
+
+
+def bucket_fraction_below(
+    bounds: Sequence[float],
+    counts: Sequence[float],
+    threshold: float,
+) -> Optional[float]:
+    """The inverse of :func:`quantile_from_buckets`: the estimated
+    fraction of observations at or below ``threshold``, linearly
+    interpolated inside the containing bucket. This is the SLO engine's
+    "good events / total events" estimator — and it is ADDITIVE across
+    histograms with identical bounds: the interpolation term is linear
+    in the bucket count, so the fraction computed on a bucket-wise
+    merged fleet histogram equals the count-weighted combination of the
+    per-replica fractions (the fleet-attainment consistency the smoke
+    asserts). Returns None on empty histograms; mass in the ``+Inf``
+    bucket counts as above every finite threshold."""
+    if len(counts) != len(bounds) + 1:
+        raise ValueError(
+            f"counts must have len(bounds)+1 entries "
+            f"(+Inf last), got {len(counts)} for {len(bounds)} bounds"
+        )
+    total = float(sum(counts))
+    if total <= 0:
+        return None
+    good = 0.0
+    for i, n in enumerate(counts[: len(bounds)]):
+        hi = float(bounds[i])
+        lo = float(bounds[i - 1]) if i > 0 else 0.0
+        if threshold >= hi:
+            good += n
+        elif threshold > lo:
+            good += n * (threshold - lo) / (hi - lo)
+        else:
+            break
+    return min(1.0, good / total)
+
+
 # Families the federation NEVER rolls up: the router's own surface (a
 # replica scrape can only contain these in the degenerate in-process
 # fleet, where the registry is shared) and already-federated output.

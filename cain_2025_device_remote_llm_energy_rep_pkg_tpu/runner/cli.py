@@ -253,10 +253,24 @@ commands:
                        --access-log (structured per-request log line:
                        method/path/status/duration; default off),
                        --no-telemetry (kill switch for /metrics, the
-                       /debug/state + /debug/flight introspection
-                       endpoints, spans, the flight recorder and
+                       /debug/state + /debug/flight + /debug/timeseries
+                       introspection endpoints, spans, the flight
+                       recorder, the time-series sampler and
                        per-request energy attribution — default on;
-                       env twin: TPU_LLM_OBS=0);
+                       env twin: TPU_LLM_OBS=0),
+                       --slo 'ttft_p99_ms<=250,completion_p95_s<=4,
+                       joules_per_token<=0.35' (SLO objectives over the
+                       in-process time-series ring: ttft|completion|
+                       queue_wait_pNN_{ms,s} target the NNth percentile
+                       of the matching latency histogram,
+                       joules_per_token the energy contract at a 0.95
+                       default target; each objective's windowed
+                       attainment + multi-window burn-rate alerts
+                       publish as llm_slo_* families and slo_alert
+                       flight events, windowed rollups serve on GET
+                       /debug/timeseries?family=&window=&step=; ring
+                       cadence/depth via env TPU_LLM_TS_INTERVAL_S /
+                       TPU_LLM_TS_CAPACITY);
                        Replica fleets: --replicas N runs N fully
                        INDEPENDENT backend+scheduler replicas in this
                        process behind the front-door router
@@ -303,6 +317,11 @@ commands:
                        request's model warm
   serve-fleet --targets host:port[,host:port...] [--route-policy P]
                        [--port N] [--models a,b] [--probe-interval-ms M]
+                       [--slo 'ttft_p99_ms<=250,...'] (fleet-wide SLOs:
+                       the router's ring samples the federated
+                       llm_fleet_* merge, so attainment and burn-rate
+                       alerts are computed fleet-wide; per-replica
+                       attainment rides /debug/state)
                        the front-door router over ALREADY-RUNNING
                        `serve` processes (one per host/chip) — the
                        multi-host twin of `serve --replicas N`; probes
@@ -351,6 +370,7 @@ def serve_command(args: List[str]) -> None:
     probe_interval_ms = None  # router default (1000 ms)
     model_policy = None  # multi-model fleet: small-first|cheapest-joules
     escalate_max_tokens = None  # small-first cascade length-cut floor
+    slo = None  # SLO objectives spec (ISSUE 17)
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -590,6 +610,16 @@ def serve_command(args: List[str]) -> None:
                     "serve: --escalate-max-tokens expects a positive "
                     "integer"
                 )
+        elif arg == "--slo":
+            # SLO objectives (ISSUE 17): attainment + multi-window
+            # burn-rate alerting over the in-process time-series ring.
+            from ..obs.slo import parse_slo_spec
+
+            slo = next(it, "")
+            try:
+                parse_slo_spec(slo)  # validate at the CLI edge
+            except ValueError as exc:
+                raise CommandError(f"serve: --slo: {exc}")
         elif arg == "--access-log":
             access_log = True
         elif arg == "--no-telemetry":
@@ -791,6 +821,7 @@ def serve_command(args: List[str]) -> None:
             port=DEFAULT_PORT if port is None else port,
             models=models,
             default_priority=default_priority,
+            slo=slo,
         ).serve_forever()
         return
     server = GenerationServer(
@@ -812,6 +843,7 @@ def serve_command(args: List[str]) -> None:
         preempt_max_wait_s=preempt_max_wait_s,
         model_policy=model_policy,
         escalate_max_tokens=escalate_max_tokens,
+        slo=slo,
     )
     server.serve_forever()
 
@@ -828,6 +860,7 @@ def serve_fleet_command(args: List[str]) -> None:
     route_policy = None
     probe_interval_ms = None
     default_priority = None
+    slo = None
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -836,6 +869,14 @@ def serve_fleet_command(args: List[str]) -> None:
             host = next(it, "0.0.0.0")
         elif arg == "--targets":
             targets = [t for t in next(it, "").split(",") if t]
+        elif arg == "--slo":
+            from ..obs.slo import parse_slo_spec
+
+            slo = next(it, "")
+            try:
+                parse_slo_spec(slo)
+            except ValueError as exc:
+                raise CommandError(f"serve-fleet: --slo: {exc}")
         elif arg == "--models":
             models = [m for m in next(it, "").split(",") if m]
         elif arg == "--route-policy":
@@ -884,6 +925,7 @@ def serve_fleet_command(args: List[str]) -> None:
         port=DEFAULT_PORT if port is None else port,
         models=models,
         default_priority=default_priority,
+        slo=slo,
     ).serve_forever()
 
 

@@ -42,6 +42,8 @@ DEBUG_STATE_PATH = "/debug/state"  # live scheduler/session/pool snapshot
 DEBUG_FLIGHT_PATH = "/debug/flight"  # flight events (?n=, ?type=, ?trace=)
 DEBUG_TIMELINE_PATH = "/debug/timeline"  # router: one request's full
 #   cross-process lifecycle, reassembled per trace id (?trace=, ISSUE 13)
+DEBUG_TIMESERIES_PATH = "/debug/timeseries"  # windowed rollups from the
+#   in-process time-series ring (?family=, ?window=, ?step=; ISSUE 17)
 
 
 def trace_to_wire(trace: "TraceContext | None") -> "Dict[str, Any] | None":

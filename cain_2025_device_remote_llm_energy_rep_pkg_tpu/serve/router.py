@@ -79,6 +79,8 @@ from ..engine.backend import (
 )
 from ..obs import energy as obs_energy
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
+from ..obs import timeseries as obs_ts
 from ..obs.flight import (
     EV_DISPATCHED,
     EV_REPLICA_DOWN,
@@ -1027,6 +1029,10 @@ class RouterServer:
         models: Optional[List[str]] = None,
         quiet: bool = False,
         default_priority: Optional[int] = None,
+        slo: Optional[str] = None,  # SLO objectives ('ttft_p99_ms<=250,...')
+        slo_pairs=None,  # burn-rate window pairs override (tests/smoke)
+        ts_interval_s: Optional[float] = None,  # time-series ring cadence
+        ts_capacity: Optional[int] = None,  # time-series ring depth
     ) -> None:
         self.router = router
         self.models = list(models) if models else []
@@ -1036,6 +1042,45 @@ class RouterServer:
             if default_priority is not None
             else protocol.DEFAULT_PRIORITY
         )
+        # Windowed fleet telemetry + SLOs (ISSUE 17): ONE sampler tick
+        # scrapes the federation sources, feeds each replica's text
+        # into its own per-replica ring AND the merged llm_fleet_*
+        # rollup (plus this process's own llm_router_* families) into
+        # the fleet ring — every ring stamped with the SAME tick clock,
+        # so fleet attainment is exactly recomputable from the
+        # per-replica rollups. The SLO engine evaluates against the
+        # fleet ring (the llm_fleet_ spelling wins there).
+        interval = (
+            float(ts_interval_s)
+            if ts_interval_s is not None
+            else obs_ts.DEFAULT_INTERVAL_S
+        )
+        capacity = (
+            int(ts_capacity)
+            if ts_capacity is not None
+            else obs_ts.DEFAULT_CAPACITY
+        )
+        self.ts_ring = obs_ts.TimeSeriesRing(
+            capacity=capacity, interval_s=interval
+        )
+        self._replica_rings: Dict[str, obs_ts.TimeSeriesRing] = {}
+        self._rings_lock = threading.Lock()
+        objectives = obs_slo.parse_slo_spec(slo) if slo else []
+        self.slo_engine = (
+            obs_slo.SLOEngine(
+                objectives,
+                self.ts_ring,
+                pairs=slo_pairs or obs_slo.DEFAULT_BURN_PAIRS,
+                name="router",
+            )
+            if objectives
+            else None
+        )
+        self._sampler = obs_ts.SamplerThread(
+            self._telemetry_tick,
+            interval_s=interval,
+            name="router-ts-sampler",
+        )
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
         self._serving = threading.Event()
@@ -1043,6 +1088,48 @@ class RouterServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    def replica_rings(self) -> Dict[str, obs_ts.TimeSeriesRing]:
+        """Per-replica time-series rings keyed by federation source
+        name (``local`` covers every in-process replica — they share
+        one registry, so they share one ring)."""
+        with self._rings_lock:
+            return dict(self._replica_rings)
+
+    def _telemetry_tick(self) -> None:
+        """One sampler-cadence tick (see ``__init__``): per-replica
+        scrapes → per-replica rings; fleet merge + own registry → the
+        fleet ring; then SLO evaluation. Every ingest is stamped with
+        one shared ``now`` so per-replica and fleet windows align."""
+        if not obs_metrics.enabled():
+            return
+        try:
+            sources = self.router.federation_sources()
+        except Exception:  # noqa: BLE001 — telemetry must not kill serving
+            return
+        now = self.ts_ring.clock()
+        for name, text in sources:
+            with self._rings_lock:
+                ring = self._replica_rings.get(name)
+                if ring is None:
+                    ring = obs_ts.TimeSeriesRing(
+                        capacity=self.ts_ring.capacity,
+                        interval_s=self.ts_ring.interval_s,
+                        clock=self.ts_ring.clock,
+                    )
+                    self._replica_rings[name] = ring
+            ring.ingest_text(text, now=now)
+        families = obs_ts.registry_families()
+        try:
+            merged = merge_expositions(sources)
+            families.update(
+                obs_ts.families_from_parsed(parse_exposition(merged))
+            )
+        except Exception:  # noqa: BLE001 — rollup is additive
+            pass
+        self.ts_ring.ingest(families, now=now)
+        if self.slo_engine is not None:
+            self.slo_engine.evaluate(now=now)
 
     @staticmethod
     def _with_parent(request: GenerationRequest, root) -> GenerationRequest:
@@ -1115,7 +1202,78 @@ class RouterServer:
                         "flight": FLIGHT.summary(),
                         **server.router.debug_state(),
                     }
+                    # SLO attainment (ISSUE 17): fleet-level snapshot
+                    # plus per-replica attainment from the per-replica
+                    # rings — the signal a future autoscaler's
+                    # drain()/add_replica() policy consumes
+                    if server.slo_engine is not None:
+                        try:
+                            state["slo"] = server.slo_engine.snapshot()
+                            by_replica = (
+                                server.slo_engine.attainment_by_replica(
+                                    server.replica_rings()
+                                )
+                            )
+                            state["slo_attainment_by_replica"] = by_replica
+                            for entry in state.get("replicas", []):
+                                name = entry.get("name")
+                                key = (
+                                    name
+                                    if name in by_replica
+                                    else (
+                                        "local"
+                                        if entry.get("kind") == "local"
+                                        else None
+                                    )
+                                )
+                                if key is not None:
+                                    entry["slo_attainment"] = by_replica[
+                                        key
+                                    ]
+                        except Exception:  # noqa: BLE001 — probe only
+                            pass
                     self._send_json(200, state)
+                elif path == protocol.DEBUG_TIMESERIES_PATH:
+                    if not obs_metrics.enabled():
+                        self._send_json(
+                            404,
+                            {"error": "telemetry disabled (TPU_LLM_OBS=0)"},
+                        )
+                        return
+                    from urllib.parse import parse_qs
+
+                    query = parse_qs(self.path.partition("?")[2])
+                    family = query.get("family", [None])[0]
+                    replica = query.get("replica", [None])[0]
+                    try:
+                        window_s = float(query.get("window", ["60"])[0])
+                        step_raw = query.get("step", [None])[0]
+                        step_s = float(step_raw) if step_raw else None
+                    except ValueError:
+                        self._send_json(
+                            400, {"error": "window/step must be numbers"}
+                        )
+                        return
+                    ring = server.ts_ring
+                    if replica is not None:
+                        ring = server.replica_rings().get(replica)
+                        if ring is None:
+                            self._send_json(
+                                404,
+                                {
+                                    "error": (
+                                        f"no ring for replica {replica!r}"
+                                    )
+                                },
+                            )
+                            return
+                    payload = ring.debug_payload(
+                        family=family, window_s=window_s, step_s=step_s
+                    )
+                    payload["ring_scope"] = replica or "fleet"
+                    if server.slo_engine is not None:
+                        payload["slo"] = server.slo_engine.snapshot()
+                    self._send_json(200, payload)
                 elif path == protocol.DEBUG_FLIGHT_PATH:
                     if not obs_metrics.enabled():
                         self._send_json(
@@ -1366,6 +1524,7 @@ class RouterServer:
 
     def start(self) -> None:
         self.router.start()
+        self._sampler.start()  # refuses under the telemetry kill switch
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name="router-server",
@@ -1382,6 +1541,7 @@ class RouterServer:
                 f"policy {self.router.policy})"
             )
         self.router.start()
+        self._sampler.start()  # refuses under the telemetry kill switch
         self._serving.set()
         try:
             self._httpd.serve_forever()
@@ -1389,10 +1549,12 @@ class RouterServer:
             pass
         finally:
             self._serving.clear()
+            self._sampler.stop()
             self._httpd.server_close()
             self.router.stop()
 
     def stop(self) -> None:
+        self._sampler.stop()
         self.router.stop()
         if self._serving.is_set():
             self._httpd.shutdown()

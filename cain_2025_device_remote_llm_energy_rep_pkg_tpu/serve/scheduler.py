@@ -206,6 +206,17 @@ _PARKED_G = REGISTRY.gauge(
     "llm_sched_parked_rows",
     "Preempted rows currently parked on the resume queue (0 when idle)",
 )
+# Sampled (not just histogram-observed) queue depth: the time-series
+# ring (ISSUE 17, obs/timeseries.py) snapshots gauges on a cadence, so
+# a live depth gauge gives the SLO/autoscaler loops a windowed
+# min/mean/max — llm_sched_queue_wait_seconds only shows waits of
+# requests that already LEFT the queue.
+_QUEUE_DEPTH_G = REGISTRY.gauge(
+    "llm_sched_queue_depth",
+    "Tickets currently waiting in the scheduler queue (set at submit "
+    "and at every dispatch-loop pull, so cadence samplers see depth "
+    "between scrapes)",
+)
 
 
 class _Ticket:
@@ -514,6 +525,7 @@ class _SchedulerBase:
             if not self._running:
                 raise RuntimeError("scheduler is not running")
             self._queue.put(ticket)
+        _QUEUE_DEPTH_G.set(self._queue.qsize())
         ticket.event.wait()
         if ticket.error is not None:
             raise ticket.error
@@ -538,6 +550,7 @@ class _SchedulerBase:
             if not self._running:
                 raise RuntimeError("scheduler is not running")
             self._queue.put(ticket)
+        _QUEUE_DEPTH_G.set(self._queue.qsize())
         return ticket.stream
 
     # -- introspection --------------------------------------------------------
@@ -813,9 +826,11 @@ class BatchScheduler(_SchedulerBase):
             try:
                 first = self._queue.get(timeout=0.2)
             except queue.Empty:
+                _QUEUE_DEPTH_G.set(self._queue.qsize())
                 continue
             if first is None:
                 break
+            _QUEUE_DEPTH_G.set(self._queue.qsize())
             batch = self._collect(first)
             # Deadline/SLO gate at the dispatch edge: tickets that can
             # no longer meet their bound fail here instead of burning a
@@ -1129,9 +1144,11 @@ class ContinuousScheduler(_SchedulerBase):
             try:
                 first = self._queue.get(timeout=0.2)
             except queue.Empty:
+                _QUEUE_DEPTH_G.set(self._queue.qsize())
                 continue
             if first is None:
                 break
+            _QUEUE_DEPTH_G.set(self._queue.qsize())
             if self._preadmit_reject(first):
                 continue
             self._run_session(first)

@@ -24,6 +24,8 @@ from urllib.parse import parse_qs
 
 from ..engine.backend import GenerationBackend
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
+from ..obs import timeseries as obs_ts
 from ..obs.flight import FLIGHT
 from ..obs.trace import TRACER
 from ..runner import term
@@ -91,6 +93,10 @@ class GenerationServer:
         preempt_max_wait_s: Optional[float] = None,  # victim aging clock
         model_policy: Optional[str] = None,  # fleet: small-first|cheapest-joules
         escalate_max_tokens: Optional[int] = None,  # cascade length cut
+        slo: Optional[str] = None,  # SLO objectives ('ttft_p99_ms<=250,...')
+        slo_pairs=None,  # burn-rate window pairs override (tests/smoke)
+        ts_interval_s: Optional[float] = None,  # time-series ring cadence
+        ts_capacity: Optional[int] = None,  # time-series ring depth
     ) -> None:
         """``batch_window_ms > 0`` or an explicit ``scheduler`` enables
         batching: concurrent non-streaming generate requests coalesce
@@ -162,7 +168,21 @@ class GenerationServer:
         the named policy. ``escalate_max_tokens`` tunes the
         small-first cascade's length-cut confidence proxy (CLI
         ``--escalate-max-tokens``). Requires a stepped backend; the
-        continuous-only tuning knobs apply to every lane."""
+        continuous-only tuning knobs apply to every lane.
+
+        Windowed telemetry + SLOs (ISSUE 17): the server always owns a
+        :class:`~..obs.timeseries.TimeSeriesRing`; a background sampler
+        (started only while telemetry is ON) snapshots the ``llm_*``
+        registry families every ``ts_interval_s`` (default 1 s, env
+        ``TPU_LLM_TS_INTERVAL_S``) into ``ts_capacity`` slots (env
+        ``TPU_LLM_TS_CAPACITY``) and serves windowed rollups on
+        ``GET /debug/timeseries?family=&window=&step=``. ``slo`` (CLI
+        ``--slo``) declares objectives — e.g.
+        ``'ttft_p99_ms<=250,completion_p95_s<=4,joules_per_token<=0.35'``
+        — evaluated on every sampler tick with multi-window burn-rate
+        alerting (``slo_pairs`` overrides the (short, long, threshold)
+        window pairs; tests/smoke use tiny ones). Under the kill switch
+        the sampler never starts and the endpoint 404s."""
         self.backend = backend
         self.default_priority = (
             int(default_priority)
@@ -257,6 +277,37 @@ class GenerationServer:
                     ttft_slo_ms=ttft_slo_ms,
                 )
             self.scheduler_mode = mode
+        # Windowed telemetry + SLOs (ISSUE 17). Ring and engine are
+        # constructed unconditionally (cheap, a few objects); only the
+        # SAMPLER is gated on the kill switch — see start()/stop().
+        self.ts_ring = obs_ts.TimeSeriesRing(
+            capacity=(
+                int(ts_capacity)
+                if ts_capacity is not None
+                else obs_ts.DEFAULT_CAPACITY
+            ),
+            interval_s=(
+                float(ts_interval_s)
+                if ts_interval_s is not None
+                else obs_ts.DEFAULT_INTERVAL_S
+            ),
+        )
+        objectives = obs_slo.parse_slo_spec(slo) if slo else []
+        self.slo_engine = (
+            obs_slo.SLOEngine(
+                objectives,
+                self.ts_ring,
+                pairs=slo_pairs or obs_slo.DEFAULT_BURN_PAIRS,
+                name="server",
+            )
+            if objectives
+            else None
+        )
+        self._sampler = obs_ts.SamplerThread(
+            self._telemetry_tick,
+            interval_s=self.ts_ring.interval_s,
+            name="serve-ts-sampler",
+        )
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
         # Set whenever a serve loop is live (threaded start() OR blocking
@@ -266,6 +317,14 @@ class GenerationServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    def _telemetry_tick(self) -> None:
+        """One sampler-cadence tick: snapshot the registry into the
+        ring, then re-evaluate the SLO objectives against it. No-op
+        end to end while telemetry is disabled."""
+        self.ts_ring.sample_once()
+        if self.slo_engine is not None:
+            self.slo_engine.evaluate()
 
     def _make_handler(self):
         server = self
@@ -383,7 +442,47 @@ class GenerationServer:
                         state["scheduler"] = server._scheduler.debug_state()
                 except Exception as exc:  # noqa: BLE001 — probe only
                     state["scheduler_error"] = f"{type(exc).__name__}: {exc}"
+                # SLO attainment (ISSUE 17): the last evaluation's
+                # per-objective attainment/burn/alert state rides the
+                # forensic snapshot
+                try:
+                    if server.slo_engine is not None:
+                        state["slo"] = server.slo_engine.snapshot()
+                except Exception:  # noqa: BLE001 — probe only
+                    pass
                 self._send_json(200, state)
+
+            def _send_debug_timeseries(self) -> None:
+                """Windowed rollups from the in-process time-series
+                ring (ISSUE 17): ``?family=`` selects one family (the
+                payload then includes its strided point series),
+                ``?window=`` the rollup window in seconds (default 60),
+                ``?step=`` the point stride. 404 while telemetry is
+                off — same contract as /metrics."""
+                if not obs_metrics.enabled():
+                    self._send_json(
+                        404, {"error": "telemetry disabled (TPU_LLM_OBS=0)"}
+                    )
+                    return
+                query = parse_qs(
+                    self.path.partition("?")[2], keep_blank_values=False
+                )
+                family = query.get("family", [None])[0]
+                try:
+                    window_s = float(query.get("window", ["60"])[0])
+                    step_raw = query.get("step", [None])[0]
+                    step_s = float(step_raw) if step_raw else None
+                except ValueError:
+                    self._send_json(
+                        400, {"error": "window/step must be numbers"}
+                    )
+                    return
+                payload = server.ts_ring.debug_payload(
+                    family=family, window_s=window_s, step_s=step_s
+                )
+                if server.slo_engine is not None:
+                    payload["slo"] = server.slo_engine.snapshot()
+                self._send_json(200, payload)
 
             def _send_debug_flight(self) -> None:
                 """Flight-recorder tail: ``?n=`` bounds the event count
@@ -473,6 +572,11 @@ class GenerationServer:
                     self._send_debug_state()
                 elif self.path.split("?", 1)[0] == protocol.DEBUG_FLIGHT_PATH:
                     self._send_debug_flight()
+                elif (
+                    self.path.split("?", 1)[0]
+                    == protocol.DEBUG_TIMESERIES_PATH
+                ):
+                    self._send_debug_timeseries()
                 elif self.path == protocol.HEALTH_PATH:
                     self._send_healthz()
                 elif self.path == protocol.TAGS_PATH:
@@ -831,6 +935,7 @@ class GenerationServer:
         """Serve on a daemon thread; returns once the socket is listening."""
         if self._scheduler is not None:
             self._scheduler.start()
+        self._sampler.start()  # refuses under the telemetry kill switch
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="generation-server", daemon=True
         )
@@ -845,6 +950,7 @@ class GenerationServer:
             term.log_ok(f"generation server listening on :{self.port}")
         if self._scheduler is not None:
             self._scheduler.start()
+        self._sampler.start()  # refuses under the telemetry kill switch
         self._serving.set()
         try:
             self._httpd.serve_forever()
@@ -852,9 +958,11 @@ class GenerationServer:
             pass
         finally:
             self._serving.clear()
+            self._sampler.stop()
             self._httpd.server_close()
 
     def stop(self) -> None:
+        self._sampler.stop()
         if self._scheduler is not None:
             self._scheduler.stop()
         # shutdown() blocks on an event only serve_forever() sets; skip it

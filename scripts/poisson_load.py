@@ -21,7 +21,10 @@ that workload shape:
   the server's disconnect-driven retirement; per-request deadlines
   (``deadline_ms``) ride the workload the same seeded way;
 - :func:`summarize` — p50/p95 TTFT & completion, aggregate tokens/s,
-  plus cancelled / deadline-exceeded counts next to the percentiles.
+  plus cancelled / deadline-exceeded counts next to the percentiles;
+  with ``--slo 'ttft_p99_ms<=250,...'`` (the serve ``--slo`` grammar)
+  the summary gains per-objective EXACT client-side attainment (ISSUE
+  17), split per tier / per model when a mix is active.
 
 Used by ``bench.py continuous_batching`` (in-process A/B of the two
 schedulers) and ``scripts/serve_metrics_smoke.py`` (staggered arrivals
@@ -530,6 +533,12 @@ def _record_result(rec, result, t_submit, t_done, start) -> None:
         rec["replica"] = router["replica"]
         if router.get("retried"):
             rec["retried"] = router["retried"]
+    # per-request energy attribution when the serving path computed one
+    # (window/solo scheduling): the client-side joules_per_token SLO
+    # check (ISSUE 17) reads this
+    energy = (result.extras or {}).get("energy_model") or {}
+    if energy.get("J_per_token") is not None:
+        rec["j_per_token"] = energy["J_per_token"]
 
 
 def _consume_stream(chunks, cancel_after: int):
@@ -639,7 +648,57 @@ def percentile(values: Sequence[float], p: float) -> float:
     return ordered[k]
 
 
-def summarize(records: List[Dict]) -> Dict:
+def _objective_values(obj, recs: List[Dict]) -> Optional[List[float]]:
+    """The client-side observations matching one SLO objective's family
+    (None when the objective is not observable from the client — e.g.
+    queue_wait lives inside the scheduler)."""
+    if obj.family == "llm_request_ttft_seconds":
+        return [r["ttft_s"] for r in recs if r.get("ttft_s") is not None]
+    if obj.family == "llm_request_completion_seconds":
+        return [
+            r["completion_s"]
+            for r in recs
+            if r.get("completion_s") is not None and not r.get("cancelled")
+        ]
+    if obj.family == "llm_request_joules_per_token":
+        return [
+            r["j_per_token"] for r in recs if r.get("j_per_token") is not None
+        ]
+    return None
+
+
+def slo_block(objectives, recs: List[Dict]) -> Dict:
+    """Per-objective EXACT attainment over a record subset (ISSUE 17):
+    the client-side cross-check of the server's bucket-interpolated
+    estimate, from the same ``--slo`` grammar (``obs.slo``)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.slo import (
+        exact_attainment,
+    )
+
+    ok = [r for r in recs if "error" not in r]
+    block = {}
+    for obj in objectives:
+        values = _objective_values(obj, ok)
+        if values is None:
+            block[obj.name] = {
+                "spec": obj.raw,
+                "attainment": None,
+                "note": "not client-observable",
+            }
+            continue
+        att = exact_attainment(obj, values)
+        entry: Dict = {
+            "spec": obj.raw,
+            "requests": len(values),
+            "attainment": None if att is None else round(att, 6),
+        }
+        if att is not None:
+            entry["met"] = att >= obj.target
+        block[obj.name] = entry
+    return block
+
+
+def summarize(records: List[Dict], slo=None) -> Dict:
     ok = [r for r in records if "error" not in r]
     completed = [r for r in ok if not r.get("cancelled")]
     cancelled = [r for r in ok if r.get("cancelled")]
@@ -748,6 +807,8 @@ def summarize(records: List[Dict]) -> Dict:
                 entry["ttft_p50_s"] = round(percentile(m_ttfts, 50), 4)
                 entry["ttft_p95_s"] = round(percentile(m_ttfts, 95), 4)
                 entry["ttft_p99_s"] = round(percentile(m_ttfts, 99), 4)
+            if slo:
+                entry["slo"] = slo_block(slo, m_recs)
             by_model[name] = entry
         out["models"] = by_model
     escalated = sum(1 for r in ok if r.get("escalated_from"))
@@ -805,8 +866,15 @@ def summarize(records: List[Dict]) -> Dict:
             t_pre = [r for r in t_ok if r.get("preempted")]
             if t_pre:
                 entry["preempted"] = len(t_pre)
+            if slo:
+                entry["slo"] = slo_block(slo, t_recs)
             by_tier[str(tier)] = entry
         out["tiers"] = by_tier
+    # client-side SLO attainment (ISSUE 17): EXACT per-objective
+    # fractions over the raw records — the cross-check against the
+    # server's /debug/timeseries bucket estimate
+    if slo:
+        out["slo"] = slo_block(slo, records)
     return out
 
 
@@ -927,7 +995,26 @@ def main() -> int:
         help="per-request deadline stamped on every request "
         "(x_deadline_ms; scheduler-enforced pre-admission + mid-flight)",
     )
+    ap.add_argument(
+        "--slo", default=None,
+        help="SLO objectives in the serve --slo grammar, e.g. "
+        "'ttft_p99_ms<=250,completion_p95_s<=4' (ISSUE 17): the summary "
+        "gains per-objective EXACT attainment computed client-side from "
+        "the raw records (plus per-tier/per-model splits when a mix is "
+        "active) — the cross-check for the server's bucket-interpolated "
+        "/debug/timeseries estimate",
+    )
     args = ap.parse_args()
+    slo_objectives = None
+    if args.slo:
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.slo import (
+            parse_slo_spec,
+        )
+
+        try:
+            slo_objectives = parse_slo_spec(args.slo)
+        except ValueError as exc:
+            ap.error(str(exc))
     budgets = [int(b) for b in args.budgets.split(",") if b]
     workload = build_workload(
         args.n,
@@ -1094,7 +1181,7 @@ def main() -> int:
     else:
         ap.error("one of --url, --targets or --fake is required")
         return 2
-    summary = summarize(records)
+    summary = summarize(records, slo=slo_objectives)
     if prefix_counters0 is not None:
         after = prefix_store_counters()
         summary["prefix_store"] = {

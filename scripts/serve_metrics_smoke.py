@@ -141,7 +141,21 @@ Three phases, all over the deterministic fake backend:
     the zero-weight label (``source="ngram"``, no draft model on the
     wire).
 
-Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json] [flight_out.json]``
+15. WINDOWED TELEMETRY + SLO ALERTING (ISSUE 17): a 2-replica local
+    fake fleet behind the router with ``--slo`` objectives and
+    compressed burn windows. Asserts the ``/debug/timeseries`` fleet
+    rollup's counter delta equals the hand-computed difference of two
+    ``/metrics`` scrapes; a mixed workload breaches the completion
+    contract and the burn-rate alert FIRES within one fast window
+    (``slo_alert{state=firing}`` flight event, episode trace id);
+    the router's ``llm_slo_attainment`` gauge is BYTE-consistent with
+    recomputing attainment from the per-replica ``/debug/timeseries``
+    bucket deltas; idling past the slow window RESOLVES the alert on
+    the same trace id; the ring dump lands as a CI artifact
+    (``serve_timeseries.json``).
+
+Usage: ``python scripts/serve_metrics_smoke.py [trace_out.json]
+[flight_out.json] [timeseries_out.json]``
 Exit 0 on success; prints one JSON status line either way.
 """
 
@@ -213,6 +227,7 @@ def _get_json(base: str, path: str):
 def main() -> int:
     trace_out = sys.argv[1] if len(sys.argv) > 1 else "serve_trace.json"
     flight_out = sys.argv[2] if len(sys.argv) > 2 else "serve_flight.json"
+    ts_out = sys.argv[3] if len(sys.argv) > 3 else "serve_timeseries.json"
 
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
         FakeBackend,
@@ -1677,6 +1692,158 @@ def main() -> int:
     finally:
         server14b.stop()
 
+    # -- phase 15: windowed telemetry + SLO burn-rate alerting (ISSUE 17) ------
+    # A 2-replica local fake fleet behind the front-door router with an
+    # SLO contract and COMPRESSED burn windows (fast 1 s / slow 4 s at
+    # 6x): the /debug/timeseries window math is checked against
+    # hand-computed counter deltas from two /metrics scrapes; a mixed
+    # workload (half the completions blow the threshold) FIRES the
+    # burn-rate alert within one fast window; the router's
+    # llm_slo_attainment gauge must equal — bit for bit — attainment
+    # recomputed from the per-replica /debug/timeseries bucket deltas;
+    # idling past the slow window RESOLVES the alert on the same
+    # episode trace id; the ring dump is written as a CI artifact.
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        bucket_fraction_below,
+    )
+
+    backend15_a = FakeBackend(tokens_per_s=400.0, simulate_delay=True)
+    backend15_b = FakeBackend(tokens_per_s=400.0, simulate_delay=True)
+    router15 = Router(
+        [
+            LocalReplica("s0", backend15_a),
+            LocalReplica("s1", backend15_b),
+        ],
+        policy="round-robin",
+        probe_interval_s=30.0,
+    )
+    server15 = RouterServer(
+        router15,
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        slo="ttft_p99_ms<=60000,completion_p95_s<=0.05",
+        slo_pairs=((1.0, 4.0, 6.0),),
+        ts_interval_s=0.1,
+    )
+    server15.start()
+    try:
+        base15 = f"http://127.0.0.1:{server15.port}"
+        s0_reqs = _metric_value(_scrape(base15), "llm_sched_requests_total")
+
+        # mixed workload: 4-token completions (~10 ms) attain the 50 ms
+        # contract, 48-token ones (~120 ms) blow it
+        for i, budget in enumerate((4, 48, 4, 48)):
+            body15 = _post_generate(base15, f"slo row {i}", budget)
+            assert body15.get("done"), body15
+        s1_reqs = _metric_value(_scrape(base15), "llm_sched_requests_total")
+        expected_delta = s1_reqs - s0_reqs
+        assert expected_delta >= 4, (s0_reqs, s1_reqs)
+
+        # window math vs the hand-computed scrape delta: the fleet ring's
+        # rollup of the federated counter must converge on exactly the
+        # S1 - S0 figure (the 30 s window spans the whole phase, so the
+        # baseline snapshot predates S0)
+        rollup_delta = None
+        for _ in range(100):
+            ts15 = _get_json(
+                base15,
+                "/debug/timeseries"
+                "?family=llm_fleet_sched_requests_total&window=30",
+            )
+            rollup = ts15.get("rollup")
+            if rollup is not None:
+                rollup_delta = sum(
+                    c["delta"] for c in rollup["children"].values()
+                )
+                if rollup_delta >= expected_delta:
+                    break
+            time.sleep(0.05)
+        assert rollup_delta == expected_delta, (rollup_delta, expected_delta)
+        assert ts15["ring_scope"] == "fleet", ts15["ring_scope"]
+        assert ts15["ring"]["samples"] >= 2, ts15["ring"]
+
+        # the breach fires within one fast window (the poll budget is
+        # ~2.5 s; the fast window is 1 s): completion_p95_s burns at
+        # >= 10x budget while the lenient ttft objective stays quiet
+        firing15 = None
+        for _ in range(50):
+            alerts = _get_json(base15, "/debug/flight?type=slo_alert")[
+                "events"
+            ]
+            fired = [e for e in alerts if e.get("state") == "firing"]
+            if fired:
+                firing15 = fired[-1]
+                break
+            time.sleep(0.05)
+        assert firing15 is not None, "SLO breach never fired"
+        assert firing15["objective"] == "completion_p95_s", firing15
+        assert firing15["trace_id"] == "slo-completion_p95_s-1", firing15
+        assert firing15["burn_short"] > 6.0, firing15
+
+        # fleet attainment == per-replica recompute, BYTE-consistent:
+        # the gauge the router published vs bucket_fraction_below over
+        # the per-replica rings' summed bucket deltas (one "local"
+        # source here — in-process replicas share the registry)
+        text15 = _scrape(base15)
+        gauge15 = None
+        for line in text15.splitlines():
+            if line.startswith(
+                'llm_slo_attainment{objective="completion_p95_s"} '
+            ):
+                gauge15 = float(line.rsplit(" ", 1)[1])
+        assert gauge15 is not None, "llm_slo_attainment absent"
+        assert gauge15 < 0.99, gauge15
+        per15 = _get_json(
+            base15,
+            "/debug/timeseries"
+            "?replica=local&family=llm_request_completion_seconds&window=4",
+        )
+        assert per15["ring_scope"] == "local", per15["ring_scope"]
+        bounds15 = tuple(per15["rollup"]["bounds"])
+        summed15 = [0] * (len(bounds15) + 1)
+        for child in per15["rollup"]["children"].values():
+            for i, d in enumerate(child["bucket_deltas"]):
+                summed15[i] += d
+        recomputed15 = bucket_fraction_below(bounds15, summed15, 0.05)
+        assert gauge15 == recomputed15, (gauge15, recomputed15)
+
+        # /debug/state carries the fleet snapshot + per-replica columns
+        state15 = _get_json(base15, "/debug/state")
+        assert state15["slo"]["engine"] == "router", state15["slo"]
+        assert (
+            state15["slo_attainment_by_replica"]["local"][
+                "completion_p95_s"
+            ]
+            is not None
+        ), state15["slo_attainment_by_replica"]
+        for entry in state15["replicas"]:
+            assert "slo_attainment" in entry, entry
+
+        # recovery: idle past the slow window — the alert RESOLVES on
+        # the SAME episode trace id (re-arm)
+        resolved15 = None
+        for _ in range(200):
+            alerts = _get_json(base15, "/debug/flight?type=slo_alert")[
+                "events"
+            ]
+            done15 = [e for e in alerts if e.get("state") == "resolved"]
+            if done15:
+                resolved15 = done15[-1]
+                break
+            time.sleep(0.1)
+        assert resolved15 is not None, "SLO alert never resolved"
+        assert resolved15["trace_id"] == firing15["trace_id"], resolved15
+
+        # the ring dump is the CI artifact: every retained snapshot,
+        # enough to recompute any window offline
+        dump15 = server15.ts_ring.dump()
+        assert dump15["snapshots"], dump15["ring"]
+        with open(ts_out, "w") as fh:
+            json.dump(dump15, fh)
+    finally:
+        server15.stop()
+
     print(
         json.dumps(
             {
@@ -1762,6 +1929,14 @@ def main() -> int:
                     "cross_fallbacks": fallbacks14,
                     "draft_wasted_joules": round(wasted_draft14, 6),
                     "wire_agrees": True,
+                },
+                "slo": {
+                    "window_delta_matches_scrape": True,
+                    "fired": firing15["trace_id"],
+                    "resolved": resolved15["trace_id"],
+                    "attainment": gauge15,
+                    "replica_recompute_agrees": True,
+                    "timeseries_dump": ts_out,
                 },
             }
         )

@@ -309,7 +309,7 @@ def test_kill_switch_covers_flight_and_debug_surface(obs_off):
     srv = GenerationServer(FakeBackend(), host="127.0.0.1", port=0, quiet=True)
     srv.start()
     try:
-        for path in ("/debug/state", "/debug/flight"):
+        for path in ("/debug/state", "/debug/flight", "/debug/timeseries"):
             with pytest.raises(urllib.error.HTTPError) as exc_info:
                 urllib.request.urlopen(
                     f"http://127.0.0.1:{srv.port}{path}", timeout=10
@@ -575,3 +575,169 @@ def test_access_log_default_off(obs_on, capsys):
     finally:
         srv.stop()
     assert "/healthz" not in capsys.readouterr().out
+
+
+# -- bucket quantile estimators (ISSUE 17) ------------------------------------
+
+
+def test_quantile_from_buckets_monotone_in_q():
+    """Property: the estimate is non-decreasing in q for any bucket
+    mass (swept over several shapes including +Inf-heavy ones)."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        quantile_from_buckets,
+    )
+
+    bounds = (0.01, 0.1, 0.5, 2.0)
+    shapes = [
+        (5, 0, 0, 0, 0),
+        (1, 2, 3, 4, 5),
+        (0, 0, 0, 0, 7),  # everything overflowed
+        (10, 0, 0, 0, 3),
+        (1, 1, 1, 1, 1),
+    ]
+    qs = [i / 20 for i in range(21)]
+    for counts in shapes:
+        estimates = [quantile_from_buckets(bounds, counts, q) for q in qs]
+        assert all(e is not None for e in estimates), counts
+        for lo, hi in zip(estimates, estimates[1:]):
+            assert lo <= hi, (counts, estimates)
+
+
+def test_quantile_from_buckets_exact_on_single_bucket_mass():
+    """Property: with ALL mass in one finite bucket, every quantile
+    interpolates inside that bucket's bounds — and q=1.0 hits its upper
+    bound exactly."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        quantile_from_buckets,
+    )
+
+    bounds = (0.01, 0.1, 0.5, 2.0)
+    for i, (lo, hi) in enumerate(zip((0.0,) + bounds, bounds)):
+        counts = [0] * (len(bounds) + 1)
+        counts[i] = 9
+        for q in (0.01, 0.5, 0.99):
+            est = quantile_from_buckets(bounds, counts, q)
+            assert lo < est <= hi, (i, q, est)
+        assert quantile_from_buckets(bounds, counts, 1.0) == hi
+        # linear inside the bucket: q=0.5 is the bucket's midpoint
+        assert quantile_from_buckets(bounds, counts, 0.5) == pytest.approx(
+            lo + (hi - lo) / 2
+        )
+
+
+def test_quantile_from_buckets_inf_and_edge_handling():
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        quantile_from_buckets,
+    )
+
+    bounds = (0.1, 1.0)
+    # mass only in +Inf clamps to the last finite bound
+    assert quantile_from_buckets(bounds, (0, 0, 5), 0.99) == 1.0
+    # empty histogram -> None
+    assert quantile_from_buckets(bounds, (0, 0, 0), 0.5) is None
+    # q outside [0,1] clamps rather than raising
+    assert quantile_from_buckets(bounds, (4, 0, 0), -1.0) is not None
+    assert quantile_from_buckets(bounds, (4, 0, 0), 2.0) == 0.1
+    # counts/bounds length mismatch is a caller bug -> ValueError
+    with pytest.raises(ValueError):
+        quantile_from_buckets(bounds, (1, 2), 0.5)
+
+
+def test_bucket_fraction_below_additive_across_merged_histograms():
+    """Property: the fraction computed on bucket-wise SUMMED counts
+    equals the count-weighted mean of per-histogram fractions — the
+    algebra that makes fleet attainment equal the per-replica merge."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        bucket_fraction_below,
+    )
+
+    bounds = (0.01, 0.1, 0.5, 2.0)
+    a = (30, 5, 0, 0, 0)
+    b = (2, 1, 4, 10, 3)
+    merged = tuple(x + y for x, y in zip(a, b))
+    for threshold in (0.005, 0.01, 0.07, 0.1, 0.3, 2.0, 99.0):
+        fa = bucket_fraction_below(bounds, a, threshold)
+        fb = bucket_fraction_below(bounds, b, threshold)
+        fm = bucket_fraction_below(bounds, merged, threshold)
+        weighted = (fa * sum(a) + fb * sum(b)) / (sum(a) + sum(b))
+        assert fm == pytest.approx(weighted, abs=1e-12), threshold
+    with pytest.raises(ValueError):
+        bucket_fraction_below(bounds, a[:-1], 0.1)
+    assert bucket_fraction_below(bounds, (0,) * 5, 0.1) is None
+
+
+# -- windowed telemetry on the served path (ISSUE 17) -------------------------
+
+
+def test_debug_timeseries_endpoint_after_served_request(obs_on):
+    """/debug/timeseries serves windowed rollups (and the SLO snapshot
+    when --slo is set) after one request through the scheduler."""
+    srv = GenerationServer(
+        FakeBackend(),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        batch_window_ms=20,
+        slo="ttft_p99_ms<=250",
+        ts_interval_s=0.05,
+    )
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        req = urllib.request.Request(
+            f"{base}/api/generate",
+            data=json.dumps(
+                {"model": "m", "prompt": "p", "options": {"num_predict": 4}}
+            ).encode(),
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["done"]
+        # let the sampler take a post-traffic snapshot
+        deadline = threading.Event()
+        for _ in range(100):
+            with urllib.request.urlopen(
+                f"{base}/debug/timeseries?family=llm_sched_requests_total",
+                timeout=10,
+            ) as resp:
+                body = json.loads(resp.read())
+            rollup = body.get("rollup")
+            if rollup and rollup["children"].get("_", {}).get("delta"):
+                break
+            deadline.wait(0.05)
+        assert rollup is not None
+        assert rollup["children"]["_"]["delta"] >= 1.0
+        assert body["slo"]["objectives"][0]["name"] == "ttft_p99_ms"
+        # bad ?window= is a 400, not a 500
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(
+                f"{base}/debug/timeseries?window=bogus", timeout=10
+            )
+        assert exc_info.value.code == 400
+        # the sampled queue-depth gauge exists on the served path
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            assert "llm_sched_queue_depth" in resp.read().decode()
+    finally:
+        srv.stop()
+    assert not srv._sampler.running
+
+
+def test_kill_switch_keeps_sampler_and_slo_engine_off(obs_off):
+    """ISSUE 17 kill-switch completeness: with telemetry off the
+    sampler thread never starts, SLO evaluation is a no-op, and the
+    ring stays empty — even when --slo was configured."""
+    srv = GenerationServer(
+        FakeBackend(),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        slo="ttft_p99_ms<=250",
+        ts_interval_s=0.05,
+    )
+    srv.start()
+    try:
+        assert not srv._sampler.running
+        assert len(srv.ts_ring) == 0
+        assert srv.slo_engine is not None
+        assert srv.slo_engine.evaluate() is None
+    finally:
+        srv.stop()

@@ -1,0 +1,415 @@
+"""SLO objectives + burn-rate alerting (ISSUE 17): the spec grammar,
+attainment math, the firing/resolved state machine under a hand-driven
+clock, and THE acceptance criterion — a hermetic fake fleet whose
+router-side attainment is byte-identical to recomputing it from the
+per-replica ring rollups.
+"""
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu import obs
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import slo as slo_mod
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.flight import (
+    EV_SLO_ALERT,
+    FlightRecorder,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+    MetricsRegistry,
+    bucket_fraction_below,
+    merge_expositions,
+    parse_exposition,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.slo import (
+    SLOEngine,
+    burn_rate,
+    exact_attainment,
+    parse_slo_spec,
+    ring_attainment,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.timeseries import (
+    TimeSeriesRing,
+    families_from_parsed,
+    registry_families,
+)
+
+
+@pytest.fixture
+def obs_on():
+    was = obs.enabled()
+    obs.enable()
+    yield
+    (obs.enable if was else obs.disable)()
+
+
+@pytest.fixture
+def obs_off():
+    was = obs.enabled()
+    obs.disable()
+    yield
+    (obs.enable if was else obs.disable)()
+
+
+TTFT_FAMILY = "llm_request_ttft_seconds"
+TTFT_BUCKETS = (0.05, 0.1, 0.5, 2.0)
+
+
+# -- spec grammar -------------------------------------------------------------
+
+
+def test_parse_slo_spec_full_grammar():
+    objs = parse_slo_spec(
+        "ttft_p99_ms<=250,completion_p95_s<=4,"
+        "queue_wait_p50_ms<=80,joules_per_token<=0.35"
+    )
+    by_name = {o.name: o for o in objs}
+    assert list(by_name) == [
+        "ttft_p99_ms",
+        "completion_p95_s",
+        "queue_wait_p50_ms",
+        "joules_per_token",
+    ]
+    ttft = by_name["ttft_p99_ms"]
+    assert ttft.family == "llm_request_ttft_seconds"
+    assert ttft.threshold == 0.25  # ms -> native seconds
+    assert ttft.target == 0.99
+    comp = by_name["completion_p95_s"]
+    assert comp.family == "llm_request_completion_seconds"
+    assert (comp.threshold, comp.target) == (4.0, 0.95)
+    qw = by_name["queue_wait_p50_ms"]
+    assert qw.family == "llm_sched_queue_wait_seconds"
+    assert (qw.threshold, qw.target) == (0.08, 0.50)
+    jpt = by_name["joules_per_token"]
+    assert jpt.family == "llm_request_joules_per_token"
+    assert jpt.threshold == 0.35
+    assert jpt.target == 0.95  # documented default, no pct spelling
+
+
+def test_parse_slo_spec_tolerates_whitespace_and_blank_parts():
+    objs = parse_slo_spec(" ttft_p99_ms <= 250 , ,completion_p95_s<=4 ")
+    assert [o.name for o in objs] == ["ttft_p99_ms", "completion_p95_s"]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",  # empty spec
+        "ttft_p99_ms=250",  # missing <=
+        "ttft_p99_ms<=abc",  # not a number
+        "ttft_p99_ms<=0",  # non-positive
+        "ttft_p99_ms<=-3",
+        "frobnitz_p99_ms<=250",  # unknown metric
+        "ttft_p0_ms<=250",  # percentile out of 1..99
+        "ttft_p99_ms<=250,ttft_p99_ms<=300",  # duplicate
+    ],
+)
+def test_parse_slo_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_slo_spec(bad)
+
+
+def test_exact_attainment_and_burn_rate():
+    (obj,) = parse_slo_spec("ttft_p99_ms<=100")
+    assert exact_attainment(obj, []) is None
+    assert exact_attainment(obj, [0.05, 0.1, 0.2, 0.3]) == 0.5
+    assert obj.attains(0.1) and not obj.attains(0.11)
+    assert burn_rate(None, 0.99) == 0.0
+    assert burn_rate(1.0, 0.99) == 0.0
+    assert burn_rate(0.99, 0.99) == pytest.approx(1.0)
+    assert burn_rate(0.0, 0.99) == pytest.approx(100.0)
+
+
+# -- the firing/resolved state machine ----------------------------------------
+
+
+def _single_server_rig():
+    """A private registry + hand-clock ring + engine with tiny burn
+    pairs — the single-GenerationServer shape in miniature."""
+    reg = MetricsRegistry()
+    hist = reg.histogram("llm_request_ttft_seconds", "t", buckets=TTFT_BUCKETS)
+    clock = {"t": 0.0}
+    ring = TimeSeriesRing(
+        source=lambda: registry_families(reg, prefixes=("llm_",)),
+        clock=lambda: clock["t"],
+    )
+    rec = FlightRecorder(capacity=64)
+    engine = SLOEngine(
+        parse_slo_spec("ttft_p99_ms<=100"),
+        ring,
+        recorder=rec,
+        pairs=((2.0, 5.0, 14.4),),
+    )
+    return reg, hist, clock, ring, rec, engine
+
+
+def _tick(ring, clock, engine, t):
+    clock["t"] = t
+    ring.sample_once(now=t)
+    return engine.evaluate(now=t)
+
+
+def test_engine_breach_fires_within_one_fast_window_then_rearms(obs_on):
+    _, hist, clock, ring, rec, engine = _single_server_rig()
+
+    # t=0 baseline: no traffic -> attainment None, burn 0, quiet
+    report = _tick(ring, clock, engine, 0.0)
+    r = report["ttft_p99_ms"]
+    assert r["attainment"] is None
+    assert r["burn_rate"] == {"2s": 0.0, "5s": 0.0}
+    assert not r["firing"]
+    # the attainment gauge publishes 1.0 on no-traffic (no false alarms)
+    assert slo_mod._ATTAIN_G.labels(objective="ttft_p99_ms").value == 1.0
+
+    # breach: every request blows the 100 ms threshold
+    for _ in range(5):
+        hist.observe(1.0)
+    report = _tick(ring, clock, engine, 1.0)
+    r = report["ttft_p99_ms"]
+    assert r["attainment"] == 0.0
+    assert r["burn_rate"]["2s"] == 100.0  # (1-0)/(1-0.99)
+    assert r["firing"] and r["episodes"] == 1
+    assert slo_mod._ATTAIN_G.labels(objective="ttft_p99_ms").value == 0.0
+    events = rec.events(type_=EV_SLO_ALERT)
+    assert len(events) == 1
+    firing = events[0]
+    assert firing["state"] == "firing"
+    assert firing["trace_id"] == "slo-ttft_p99_ms-1"
+    assert firing["burn_short"] > 14.4 and firing["burn_long"] > 14.4
+
+    # still breached next tick: no duplicate event while firing
+    report = _tick(ring, clock, engine, 2.0)
+    assert report["ttft_p99_ms"]["firing"]
+    assert len(rec.events(type_=EV_SLO_ALERT)) == 1
+
+    # recovery: the bad minute ages out of both windows -> resolved,
+    # sharing the episode's trace id
+    report = _tick(ring, clock, engine, 10.0)
+    r = report["ttft_p99_ms"]
+    assert not r["firing"]
+    events = rec.events(type_=EV_SLO_ALERT)
+    assert [e["state"] for e in events] == ["firing", "resolved"]
+    assert events[1]["trace_id"] == "slo-ttft_p99_ms-1"
+
+    # re-arm: a second breach opens a NEW episode with a new trace id
+    for _ in range(5):
+        hist.observe(1.0)
+    report = _tick(ring, clock, engine, 11.0)
+    assert report["ttft_p99_ms"]["firing"]
+    assert report["ttft_p99_ms"]["episodes"] == 2
+    assert rec.events(type_=EV_SLO_ALERT)[-1]["trace_id"] == "slo-ttft_p99_ms-2"
+
+    # transition counters kept pace
+    assert slo_mod._ALERTS_C.labels(
+        objective="ttft_p99_ms", state="firing"
+    ).value == 2.0
+    assert slo_mod._ALERTS_C.labels(
+        objective="ttft_p99_ms", state="resolved"
+    ).value == 1.0
+
+
+def test_pair_needs_both_windows_to_trip(obs_on):
+    """A short-window spike whose long window stays healthy must NOT
+    fire (the flap-resistance the multi-window pairs buy)."""
+    _, hist, clock, ring, rec, engine = _single_server_rig()
+    # long window accumulates plenty of healthy traffic first
+    for _ in range(400):
+        hist.observe(0.01)
+    _tick(ring, clock, engine, 0.0)
+    for _ in range(400):
+        hist.observe(0.01)
+    _tick(ring, clock, engine, 3.0)
+    # now a short burst of bad requests: short window burns, but the
+    # long window still holds the 400 good observations
+    for _ in range(4):
+        hist.observe(1.0)
+    report = _tick(ring, clock, engine, 4.0)
+    r = report["ttft_p99_ms"]
+    assert r["burn_rate"]["2s"] > 14.4
+    assert r["burn_rate"]["5s"] < 14.4
+    assert not r["firing"]
+    assert rec.events(type_=EV_SLO_ALERT) == []
+
+
+def test_engine_snapshot_shape(obs_on):
+    _, hist, clock, ring, _, engine = _single_server_rig()
+    hist.observe(0.01)
+    _tick(ring, clock, engine, 0.0)
+    snap = engine.snapshot()
+    assert snap["engine"] == "server"
+    assert snap["objectives"][0]["name"] == "ttft_p99_ms"
+    assert snap["pairs_s"] == [[2.0, 5.0, 14.4]]
+    assert snap["long_window_s"] == 5.0
+    assert "ttft_p99_ms" in snap["report"]
+    assert snap["firing"] == 0
+
+
+def test_active_snapshot_sees_live_engines(obs_on):
+    before = slo_mod.active_snapshot()
+    names = {s["engine"] for s in before} if before else set()
+    ring = TimeSeriesRing(source=dict, clock=lambda: 0.0)
+    engine = SLOEngine(
+        parse_slo_spec("ttft_p99_ms<=100"),
+        ring,
+        recorder=FlightRecorder(capacity=4),
+        pairs=((2.0, 5.0, 14.4),),
+        name="test-active-snap",
+    )
+    snaps = slo_mod.active_snapshot()
+    assert {s["engine"] for s in snaps} >= names | {"test-active-snap"}
+    del engine  # weakly held: drops out once collected
+
+
+def test_engine_noop_when_disabled(obs_off):
+    reg = MetricsRegistry()
+    reg.histogram("llm_request_ttft_seconds", "t", buckets=TTFT_BUCKETS)
+    ring = TimeSeriesRing(
+        source=lambda: registry_families(reg), clock=lambda: 0.0
+    )
+    rec = FlightRecorder(capacity=4)
+    engine = SLOEngine(
+        parse_slo_spec("ttft_p99_ms<=100"),
+        ring,
+        recorder=rec,
+        pairs=((2.0, 5.0, 14.4),),
+    )
+    assert engine.evaluate(now=0.0) is None
+    assert rec.events() == []
+    assert engine.snapshot()["report"] == {}
+
+
+# -- the acceptance criterion: hermetic fake fleet ----------------------------
+
+
+class _FakeFleet:
+    """Two replica registries federated exactly like RouterServer's
+    telemetry tick: per-replica rings ingest each replica's exposition,
+    the fleet ring ingests the ``merge_expositions`` merge — all stamped
+    with ONE shared deterministic ``now`` per tick."""
+
+    def __init__(self):
+        self.clock = {"t": 0.0}
+        self.regs = {}
+        self.hists = {}
+        self.replica_rings = {}
+        for name in ("a", "b"):
+            reg = MetricsRegistry()
+            self.regs[name] = reg
+            self.hists[name] = reg.histogram(
+                TTFT_FAMILY, "t", buckets=TTFT_BUCKETS
+            )
+            self.replica_rings[name] = TimeSeriesRing(
+                source=dict, clock=lambda: self.clock["t"]
+            )
+        self.fleet_ring = TimeSeriesRing(
+            source=dict, clock=lambda: self.clock["t"]
+        )
+        self.recorder = FlightRecorder(capacity=64)
+        self.engine = SLOEngine(
+            parse_slo_spec("ttft_p99_ms<=100"),
+            self.fleet_ring,
+            recorder=self.recorder,
+            pairs=((2.0, 5.0, 14.4),),
+            name="router",
+        )
+
+    def tick(self, t):
+        self.clock["t"] = t
+        sources = [
+            (name, reg.exposition()) for name, reg in self.regs.items()
+        ]
+        for name, text in sources:
+            self.replica_rings[name].ingest_text(text, now=t)
+        merged = merge_expositions(sources)
+        self.fleet_ring.ingest(
+            families_from_parsed(parse_exposition(merged)), now=t
+        )
+        return self.engine.evaluate(now=t)
+
+
+def test_fleet_breach_fires_and_attainment_matches_replica_recompute(obs_on):
+    """ISSUE 17 acceptance: deterministic-clock fake fleet — a breach
+    fires within one fast window and resolves after recovery, and the
+    router's ``llm_slo_attainment`` equals — bit for bit — attainment
+    recomputed from the per-replica ring rollups (additivity of
+    ``bucket_fraction_below`` over bucket-wise merged counts)."""
+    fleet = _FakeFleet()
+    fleet.tick(0.0)  # baseline
+
+    # phase 1: both replicas healthy (everything under 100 ms)
+    for _ in range(20):
+        fleet.hists["a"].observe(0.01)
+        fleet.hists["b"].observe(0.02)
+    report = fleet.tick(1.0)
+    r = report["ttft_p99_ms"]
+    assert r["attainment"] == 1.0
+    assert not r["firing"]
+
+    # phase 2: replica b breaches hard; a stays healthy. The FLEET
+    # attainment is the traffic-weighted mix -> burns the budget.
+    for _ in range(20):
+        fleet.hists["a"].observe(0.01)
+        fleet.hists["b"].observe(1.0)
+    report = fleet.tick(2.0)  # one fast window (2 s) after the breach
+    r = report["ttft_p99_ms"]
+    assert r["firing"], "breach must fire within one fast window"
+    fleet_att = r["attainment"]
+    assert fleet_att is not None and fleet_att < 0.99
+
+    # THE consistency assertion: recompute attainment from the
+    # per-replica rings' bucket deltas over the same window, summed —
+    # must equal the router engine's number exactly (same ints, same
+    # float ops; the shared per-tick `now` makes the windows identical).
+    (obj,) = fleet.engine.objectives
+    window = fleet.engine.long_window_s
+    summed = [0] * (len(TTFT_BUCKETS) + 1)
+    for ring in fleet.replica_rings.values():
+        rollup = ring.window(TTFT_FAMILY, window, now=2.0)
+        assert rollup is not None
+        for child in rollup["children"].values():
+            for i, d in enumerate(child["bucket_deltas"]):
+                summed[i] += d
+    recomputed = bucket_fraction_below(TTFT_BUCKETS, summed, obj.threshold)
+    assert fleet_att == recomputed  # byte-consistent, not approx
+
+    # ... and the per-replica attainment view tells b from a
+    by_replica = fleet.engine.attainment_by_replica(
+        fleet.replica_rings, now=2.0
+    )
+    assert by_replica["a"]["ttft_p99_ms"] == 1.0
+    assert by_replica["b"]["ttft_p99_ms"] < 0.99
+
+    # phase 3: recovery — the breach ages out of every window
+    report = fleet.tick(10.0)
+    assert not report["ttft_p99_ms"]["firing"]
+    states = [
+        e["state"] for e in fleet.recorder.events(type_=EV_SLO_ALERT)
+    ]
+    assert states == ["firing", "resolved"]
+
+
+def test_fleet_engine_prefers_fleet_spelling(obs_on):
+    """The router ring holds BOTH the raw families (its own registry)
+    and the ``llm_fleet_`` merge; only the merge covers remote replicas,
+    so the resolver must pick the fleet spelling when present."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.timeseries import (
+        FamilySample,
+    )
+
+    (obj,) = parse_slo_spec("ttft_p99_ms<=100")
+    ring = TimeSeriesRing(source=dict, clock=lambda: 0.0)
+    # raw family says "all good"; fleet merge says "all bad"
+    good = FamilySample("histogram", {"_": ((5, 0, 0, 0, 0), 0.05, 5)}, TTFT_BUCKETS)
+    bad = FamilySample("histogram", {"_": ((0, 0, 0, 5, 0), 5.0, 5)}, TTFT_BUCKETS)
+    ring.ingest({TTFT_FAMILY: good, "llm_fleet_request_ttft_seconds": bad}, now=0.0)
+    ring.ingest(
+        {
+            TTFT_FAMILY: FamilySample(
+                "histogram", {"_": ((10, 0, 0, 0, 0), 0.1, 10)}, TTFT_BUCKETS
+            ),
+            "llm_fleet_request_ttft_seconds": FamilySample(
+                "histogram", {"_": ((0, 0, 0, 10, 0), 10.0, 10)}, TTFT_BUCKETS
+            ),
+        },
+        now=1.0,
+    )
+    att = ring_attainment([obj], ring, 60.0, now=1.0)
+    assert att["ttft_p99_ms"] == 0.0  # the fleet view won

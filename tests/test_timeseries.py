@@ -1,0 +1,357 @@
+"""Windowed telemetry ring (ISSUE 17): snapshot ingestion, rollup math,
+drop-oldest bounds, the exposition ingestion path, and the kill-switch
+guarantees of :mod:`obs.timeseries`.
+
+Everything here drives a hand-held clock — no sleeps, no wall time in
+any window assertion (the SamplerThread cadence tests use real time
+but only assert "ticked at least once", never durations).
+"""
+
+import threading
+
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu import obs
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+    MetricsRegistry,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.timeseries import (
+    SamplerThread,
+    TimeSeriesRing,
+    families_from_parsed,
+    registry_families,
+)
+
+
+@pytest.fixture
+def obs_on():
+    was = obs.enabled()
+    obs.enable()
+    yield
+    (obs.enable if was else obs.disable)()
+
+
+@pytest.fixture
+def obs_off():
+    was = obs.enabled()
+    obs.disable()
+    yield
+    (obs.enable if was else obs.disable)()
+
+
+def _make_ring(reg, **kw):
+    """Ring sampling a private registry with a hand-driven clock."""
+    clock = {"t": 0.0}
+    ring = TimeSeriesRing(
+        source=lambda: registry_families(reg, prefixes=("llm_",)),
+        clock=lambda: clock["t"],
+        **kw,
+    )
+    return ring, clock
+
+
+# -- snapshot sources ---------------------------------------------------------
+
+
+def test_registry_families_shapes(obs_on):
+    reg = MetricsRegistry()
+    reg.counter("llm_c", "c").inc(3)
+    reg.gauge("llm_g", "g").set(1.5)
+    reg.histogram("llm_h", "h", buckets=(0.1, 1.0)).observe(0.5)
+    reg.counter("other_c", "excluded by prefix").inc()
+    reg.counter("llm_untouched", "no children -> omitted")
+
+    fams = registry_families(reg, prefixes=("llm_",))
+    assert set(fams) == {"llm_c", "llm_g", "llm_h"}
+    assert fams["llm_c"].kind == "counter"
+    assert fams["llm_c"].children["_"] == 3.0
+    assert fams["llm_g"].children["_"] == 1.5
+    h = fams["llm_h"]
+    assert h.bounds == (0.1, 1.0)
+    counts, total, count = h.children["_"]
+    assert counts == (0, 1, 0)  # per-bucket, +Inf overflow last
+    assert (total, count) == (0.5, 1)
+
+
+def test_families_from_parsed_matches_direct_read(obs_on):
+    """The exposition path (the router's fleet ingestion) produces the
+    same snapshot shape as the direct registry read."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        parse_exposition,
+    )
+
+    reg = MetricsRegistry()
+    reg.counter("llm_c", "c", labels=("k",)).labels(k="a").inc(2)
+    h = reg.histogram("llm_h", "h", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+
+    direct = registry_families(reg, prefixes=("llm_",))
+    parsed = families_from_parsed(
+        parse_exposition(reg.exposition()), prefixes=("llm_",)
+    )
+    assert set(parsed) == set(direct)
+    assert parsed["llm_c"].children == direct["llm_c"].children
+    assert parsed["llm_h"].bounds == direct["llm_h"].bounds
+    assert parsed["llm_h"].children["_"][0] == direct["llm_h"].children["_"][0]
+    assert parsed["llm_h"].children["_"][2] == direct["llm_h"].children["_"][2]
+
+
+# -- windowed rollups ---------------------------------------------------------
+
+
+def test_counter_window_delta_and_rate(obs_on):
+    reg = MetricsRegistry()
+    c = reg.counter("llm_reqs_total", "r")
+    ring, clock = _make_ring(reg)
+
+    c.inc(10)
+    ring.sample_once(now=0.0)
+    clock["t"] = 10.0
+    c.inc(5)
+    ring.sample_once(now=10.0)
+
+    roll = ring.window("llm_reqs_total", 60.0, now=10.0)
+    assert roll["kind"] == "counter"
+    assert roll["samples"] == 2
+    assert roll["children"]["_"] == {"delta": 5.0, "rate": 0.5}
+
+
+def test_counter_reset_clamps_to_zero(obs_on):
+    """A counter that went DOWN inside the window (process restart)
+    reports delta 0, not a negative rate."""
+    ring = TimeSeriesRing(source=dict, clock=lambda: 0.0)
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.timeseries import (
+        FamilySample,
+    )
+
+    ring.ingest({"llm_c": FamilySample("counter", {"_": 100.0})}, now=0.0)
+    ring.ingest({"llm_c": FamilySample("counter", {"_": 3.0})}, now=5.0)
+    roll = ring.window("llm_c", 60.0, now=5.0)
+    assert roll["children"]["_"]["delta"] == 0.0
+
+
+def test_absent_family_baseline_is_zero(obs_on):
+    """THE delta-0 trap: untouched families are omitted from snapshots,
+    so a family first touched mid-window must diff against an all-zeros
+    baseline (the window's oldest snapshot), not against its own first
+    appearance — otherwise its initial traffic reports delta 0."""
+    reg = MetricsRegistry()
+    ring, clock = _make_ring(reg)
+
+    ring.sample_once(now=0.0)  # family does not exist yet
+    c = reg.counter("llm_late_total", "first touched after baseline")
+    c.inc(7)
+    clock["t"] = 5.0
+    ring.sample_once(now=5.0)
+
+    roll = ring.window("llm_late_total", 60.0, now=5.0)
+    assert roll["children"]["_"]["delta"] == 7.0
+    assert roll["t0"] == 0.0  # baseline = the window's oldest snapshot
+
+
+def test_gauge_window_min_mean_max_last(obs_on):
+    reg = MetricsRegistry()
+    g = reg.gauge("llm_depth", "d")
+    ring, clock = _make_ring(reg)
+    for t, v in ((0.0, 2.0), (1.0, 8.0), (2.0, 5.0)):
+        g.set(v)
+        clock["t"] = t
+        ring.sample_once(now=t)
+    roll = ring.window("llm_depth", 60.0, now=2.0)
+    assert roll["children"]["_"] == {
+        "min": 2.0,
+        "mean": 5.0,
+        "max": 8.0,
+        "last": 5.0,
+    }
+
+
+def test_histogram_window_quantiles_from_bucket_deltas(obs_on):
+    """Quantiles come from the deltas INSIDE the window: observations
+    before the window's oldest snapshot must not leak in."""
+    reg = MetricsRegistry()
+    h = reg.histogram("llm_lat_seconds", "l", buckets=(0.1, 0.2, 0.4))
+    ring, clock = _make_ring(reg)
+
+    # 100 slow observations BEFORE the window baseline
+    for _ in range(100):
+        h.observe(0.39)
+    ring.sample_once(now=0.0)
+    # 4 fast observations inside the window
+    for _ in range(4):
+        h.observe(0.05)
+    clock["t"] = 10.0
+    ring.sample_once(now=10.0)
+
+    roll = ring.window("llm_lat_seconds", 60.0, now=10.0)
+    child = roll["children"]["_"]
+    assert child["count"] == 4
+    assert child["bucket_deltas"] == [4, 0, 0, 0]
+    # all windowed mass is in [0, 0.1]: every quantile lands there
+    assert 0.0 < child["p99"] <= 0.1
+    # lifetime distribution would put p50 near 0.39 — windowing must not
+    assert child["p50"] <= 0.1
+
+
+def test_window_wider_than_history_reports_actual_span(obs_on):
+    reg = MetricsRegistry()
+    reg.counter("llm_c", "c").inc()
+    ring, clock = _make_ring(reg)
+    ring.sample_once(now=100.0)
+    clock["t"] = 103.0
+    ring.sample_once(now=103.0)
+    roll = ring.window("llm_c", 3600.0, now=103.0)
+    assert roll["window_s"] == 3600.0
+    assert roll["span_s"] == 3.0
+
+
+def test_window_none_for_unknown_family(obs_on):
+    reg = MetricsRegistry()
+    ring, _ = _make_ring(reg)
+    ring.sample_once(now=0.0)
+    assert ring.window("llm_never", 60.0, now=0.0) is None
+
+
+# -- capacity / points / export -----------------------------------------------
+
+
+def test_drop_oldest_bounds_memory(obs_on):
+    reg = MetricsRegistry()
+    c = reg.counter("llm_c", "c")
+    ring, clock = _make_ring(reg, capacity=4)
+    for t in range(10):
+        c.inc()
+        clock["t"] = float(t)
+        ring.sample_once(now=float(t))
+    assert len(ring) == 4
+    s = ring.summary()
+    assert s["capacity"] == 4
+    assert s["samples_total"] == 10
+    assert s["dropped"] == 6
+    assert s["t0"] == 6.0 and s["t1"] == 9.0
+
+
+def test_points_stride_and_always_include_last(obs_on):
+    reg = MetricsRegistry()
+    g = reg.gauge("llm_g", "g")
+    ring, clock = _make_ring(reg)
+    for t in range(11):  # t = 0..10, 1 s apart
+        g.set(float(t))
+        clock["t"] = float(t)
+        ring.sample_once(now=float(t))
+    pts = ring.points("llm_g", 60.0, step_s=4.0, now=10.0)
+    times = [p["t_s"] for p in pts]
+    assert times == [0.0, 4.0, 8.0, 10.0]  # strided, last forced in
+    assert pts[-1]["values"]["_"] == 10.0
+
+
+def test_debug_payload_and_dump_are_jsonable(obs_on):
+    import json
+
+    reg = MetricsRegistry()
+    reg.counter("llm_c", "c").inc()
+    reg.histogram("llm_h", "h", buckets=(1.0,)).observe(0.5)
+    ring, clock = _make_ring(reg)
+    ring.sample_once(now=0.0)
+    clock["t"] = 1.0
+    ring.sample_once(now=1.0)
+
+    one = ring.debug_payload(family="llm_h", window_s=60.0, now=1.0)
+    assert one["rollup"]["kind"] == "histogram"
+    assert one["points"]
+    every = ring.debug_payload(window_s=60.0, now=1.0)
+    assert set(every["families"]) == {"llm_c", "llm_h"}
+    missing = ring.debug_payload(family="llm_nope", window_s=60.0, now=1.0)
+    assert "error" in missing
+    dump = ring.dump()
+    assert len(dump["snapshots"]) == 2
+    json.dumps(one), json.dumps(every), json.dumps(dump)
+
+
+def test_ingest_text_roundtrip_window(obs_on):
+    """Exposition-fed ring (the router's path) computes the same counter
+    delta as the direct path."""
+    reg = MetricsRegistry()
+    c = reg.counter("llm_c", "c")
+    ring = TimeSeriesRing(source=dict, clock=lambda: 0.0)
+    c.inc(2)
+    ring.ingest_text(reg.exposition(), now=0.0)
+    c.inc(3)
+    ring.ingest_text(reg.exposition(), now=10.0)
+    roll = ring.window("llm_c", 60.0, now=10.0)
+    assert roll["children"]["_"] == {"delta": 3.0, "rate": 0.3}
+
+
+def test_ingest_text_tolerates_garbage(obs_on):
+    ring = TimeSeriesRing(source=dict, clock=lambda: 0.0)
+    snap = ring.ingest_text("not { an exposition ]][", now=0.0)
+    assert snap is not None and snap.families == {}
+
+
+# -- kill switch --------------------------------------------------------------
+
+
+def test_ring_is_inert_when_disabled(obs_off):
+    calls = []
+
+    def source():
+        calls.append(1)
+        return {}
+
+    ring = TimeSeriesRing(source=source, clock=lambda: 0.0)
+    assert ring.sample_once() is None
+    assert ring.ingest({}, now=0.0) is None
+    assert ring.ingest_text("llm_c 1", now=0.0) is None
+    assert calls == []  # the source was never even invoked
+    assert len(ring) == 0
+
+
+def test_sampler_refuses_start_when_disabled(obs_off):
+    ticks = []
+    s = SamplerThread(lambda: ticks.append(1), interval_s=0.01)
+    assert s.start() is False
+    assert not s.running
+    s.stop()
+    assert ticks == []
+
+
+def test_sampler_ticks_baseline_immediately_then_on_cadence(obs_on):
+    """start() must produce a baseline tick right away (window deltas
+    subtract the oldest snapshot) and keep ticking until stop()."""
+    first = threading.Event()
+    third = threading.Event()
+    ticks = []
+
+    def tick():
+        ticks.append(1)
+        first.set()
+        if len(ticks) >= 3:
+            third.set()
+
+    s = SamplerThread(tick, interval_s=0.01, name="test-sampler")
+    assert s.start() is True
+    assert s.start() is True  # idempotent
+    assert first.wait(5.0)
+    assert third.wait(5.0)
+    s.stop()
+    assert not s.running
+    n = len(ticks)
+    s.stop()  # idempotent
+    assert len(ticks) == n  # no ticks after stop
+
+
+def test_sampler_tick_exceptions_do_not_kill_the_loop(obs_on):
+    done = threading.Event()
+    ticks = []
+
+    def tick():
+        ticks.append(1)
+        if len(ticks) >= 3:
+            done.set()
+        raise RuntimeError("telemetry must not kill serving")
+
+    s = SamplerThread(tick, interval_s=0.01)
+    s.start()
+    assert done.wait(5.0)
+    s.stop()
