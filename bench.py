@@ -2763,6 +2763,371 @@ def slo_overhead_bench() -> int:
     return 0
 
 
+def pd_disagg_bench() -> int:
+    """Disaggregated prefill/decode A/B (ISSUE 18): in-flight
+    inter-slice gap p99 + TTFT p99 of a 1-prefill + 1-decode role fleet
+    vs 2 MIXED chunked replicas at matched hardware, on one seeded
+    heavy-tailed lognormal trace (scripts/poisson_load.py).
+
+    The mechanism under test: on a mixed replica every newcomer's
+    chunked prefill runs inside the shared decode loop, so a
+    heavy-tailed long prompt STALLS every in-flight stream for its
+    chunk walls (the fake sleeps chunk/(tokens_per_s·8) per join_step —
+    the same interference a real chunked-prefill slice has). The disagg
+    fleet takes prefill on the prefill replica, ships the primed row
+    (swap-policy bundle, zero re-prefill at seat) and decodes on the
+    decode replica — in-flight streams never share a loop with prefill,
+    which is THE inter-slice-gap tail claim of prefill/decode
+    disaggregation. TTFT is client-observed at the decode side's first
+    relayed chunk, so the transfer toll is IN the reported figure.
+
+    Also records: a drain-latency column (evacuating a mid-stream row
+    via live migration vs waiting the row out) and bit-exact token
+    parity of a migrated row on all four real-engine cache layouts
+    (contig/paged × bf16/int8-KV), with exact page free-count
+    restoration on both pools. Prints ONE JSON line."""
+    import os
+    import threading
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from scripts.poisson_load import build_workload, percentile
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+        FakeBackend,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router import (
+        LocalReplica,
+        Router,
+    )
+
+    TOKENS_PER_S = 400.0  # per-replica decode rate (fake, shared window)
+    MAX_ROWS = 8  # per-replica admission ceiling (the HBM stand-in)
+    BUDGETS = (48, 96, 160)
+    N = 64
+    MEAN_INTERARRIVAL_S = 0.08
+    # heavy tail: median 64 prompt tokens, sigma 1.5 → the p99 draw
+    # saturates the 2048 clamp; on a mixed replica each such prompt
+    # stalls the shared decode loop ~chunk/(tokens_per_s·8) s per
+    # 256-token chunk wall — 8 walls of ~80 ms for a clamped draw
+    LOGNORM = dict(
+        prompt_len_dist="lognormal",
+        prompt_len_median=64.0,
+        prompt_len_sigma=1.5,
+        prompt_len_max=2048,
+    )
+
+    def trace():
+        return build_workload(
+            N,
+            MEAN_INTERARRIVAL_S,
+            seed=18,
+            model="bench:pd",
+            budgets=list(BUDGETS),
+            stop_at_eos=False,
+            **LOGNORM,
+        )
+
+    def fresh_backend():
+        return FakeBackend(
+            tokens_per_s=TOKENS_PER_S,
+            simulate_delay=True,
+            max_rows=MAX_ROWS,
+        )
+
+    def run_stream_load(router, workload):
+        """Per-request client threads streaming through the router's
+        front door, recording EVERY chunk arrival — TTFT at first
+        chunk, inter-slice gaps between consecutive chunk walls while
+        the row is in flight (run_load only keeps server-side TTFT;
+        the gap tail is this bench's whole point)."""
+        records = [None] * len(workload)
+        start = time.monotonic()
+
+        def client(i, offset, request):
+            delay = start + offset - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t_submit = time.monotonic()
+            rec = {"gaps": [], "tokens": 0}
+            prev = None
+            final = None
+            try:
+                for ch in router.dispatch_stream(request):
+                    now = time.monotonic()
+                    if ch.done:
+                        final = ch.result
+                        break
+                    if not ch.tokens:
+                        continue
+                    if prev is None:
+                        rec["ttft_s"] = now - t_submit
+                    else:
+                        rec["gaps"].append(now - prev)
+                    prev = now
+                    rec["tokens"] += len(ch.tokens)
+            except BaseException as exc:  # noqa: BLE001
+                rec["error"] = f"{type(exc).__name__}: {exc}"
+            rec["completion_s"] = time.monotonic() - t_submit
+            if final is not None and final.extras:
+                sched = final.extras.get("sched") or {}
+                route = final.extras.get("router") or {}
+                if sched.get("migrated"):
+                    rec["migrated"] = True
+                if route.get("role"):
+                    rec["role"] = route["role"]
+            records[i] = rec
+
+        threads = [
+            threading.Thread(target=client, args=(i, off, req), daemon=True)
+            for i, (off, req) in enumerate(workload)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return [r for r in records if r is not None]
+
+    def arm_summary(records):
+        ok = [r for r in records if "error" not in r]
+        gaps = [g for r in ok for g in r["gaps"]]
+        ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
+        comps = [r["completion_s"] for r in ok]
+        out = {
+            "requests": len(records),
+            "errors": len(records) - len(ok),
+            "tokens": sum(r["tokens"] for r in ok),
+            "migrated": sum(1 for r in ok if r.get("migrated")),
+            "gap_samples": len(gaps),
+            "gap_p50_ms": round(percentile(gaps, 50) * 1e3, 2),
+            "gap_p95_ms": round(percentile(gaps, 95) * 1e3, 2),
+            "gap_p99_ms": round(percentile(gaps, 99) * 1e3, 2),
+            "completion_p95_s": round(percentile(comps, 95), 4),
+        }
+        if ttfts:
+            out["ttft_p50_s"] = round(percentile(ttfts, 50), 4)
+            out["ttft_p99_s"] = round(percentile(ttfts, 99), 4)
+        roles = sorted({r["role"] for r in ok if r.get("role")})
+        if len(roles) > 1 or (roles and roles != ["mixed"]):
+            out["by_role"] = {
+                name: sum(1 for r in ok if r.get("role") == name)
+                for name in roles
+            }
+        return out
+
+    def run_arm(replicas):
+        router = Router(replicas, probe_interval_s=0.25)
+        router.start()
+        try:
+            records = run_stream_load(router, trace())
+        finally:
+            router.stop()
+        return arm_summary(records)
+
+    arms = {
+        "disagg_1p1d": run_arm(
+            [
+                LocalReplica("p", fresh_backend(), role="prefill"),
+                LocalReplica("d", fresh_backend(), role="decode"),
+            ]
+        ),
+        "mixed2": run_arm(
+            [
+                LocalReplica("m1", fresh_backend()),
+                LocalReplica("m2", fresh_backend()),
+            ]
+        ),
+    }
+
+    # -- drain-latency column: evacuate a mid-stream row (live
+    # migration to the survivor) vs wait it out ---------------------------
+    def drain_arm(migrate: bool):
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (  # noqa: E501
+            GenerationRequest,
+        )
+
+        router = Router(
+            [
+                LocalReplica("v", fresh_backend()),
+                LocalReplica("s", fresh_backend()),
+            ],
+            probe_interval_s=0.25,
+        )
+        router.start()
+        req = GenerationRequest(
+            "bench:pd", "drain latency probe", max_new_tokens=600,
+            stop_at_eos=False,
+        )
+        toks = []
+        err = [None]
+
+        def consume():
+            try:
+                for ch in router.dispatch_stream(req):
+                    if not ch.done:
+                        toks.extend(ch.tokens)
+            except BaseException as exc:  # noqa: BLE001
+                err[0] = exc
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while len(toks) < 10 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            victim = next(
+                r.name for r in router.replicas() if r.outstanding > 0
+            )
+            t0 = time.monotonic()
+            drained = router.drain(victim, timeout_s=30.0, migrate=migrate)
+            drain_s = time.monotonic() - t0
+            t.join(timeout=30.0)
+            return {
+                "drained": bool(drained),
+                "drain_s": round(drain_s, 4),
+                "tokens_delivered": len(toks),
+                "complete": len(toks) == 600 and err[0] is None,
+            }
+        finally:
+            router.stop()
+
+    drain = {
+        "evacuate_migrate": drain_arm(True),
+        "wait_out": drain_arm(False),
+    }
+    ev, wo = drain["evacuate_migrate"]["drain_s"], drain["wait_out"]["drain_s"]
+    drain["evacuation_speedup"] = round(wo / ev, 2) if ev else None
+
+    # -- bit-exact migrated-row parity on all four real cache layouts ------
+    def parity_all_layouts():
+        import jax.numpy as jnp
+
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (  # noqa: E501
+            GenerationRequest,
+        )
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (  # noqa: E501
+            JaxEngine,
+        )
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (  # noqa: E501
+            get_model_config,
+        )
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.migrate import (  # noqa: E501
+            export_bundle,
+            import_bundle,
+        )
+
+        registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+        layouts = {
+            "contig-bf16": (False, None),
+            "contig-int8": (False, "int8"),
+            "paged-bf16": (True, None),
+            "paged-int8": (True, "int8"),
+        }
+        out = {}
+        for name, (paged, kvq) in layouts.items():
+            src = JaxEngine(
+                registry=dict(registry), dtype=jnp.float32,
+                paged_kv=paged, kv_quantize=kvq,
+            )
+            dst = JaxEngine(
+                registry=dict(registry), dtype=jnp.float32,
+                paged_kv=paged, kv_quantize=kvq,
+            )
+            anchor_s = GenerationRequest(
+                "tiny", "source anchor", max_new_tokens=16,
+                stop_at_eos=False,
+            )
+            anchor_d = GenerationRequest(
+                "tiny", "destination anchor", max_new_tokens=16,
+                stop_at_eos=False,
+            )
+            victim = GenerationRequest(
+                "tiny", "the migrating row", max_new_tokens=16,
+                stop_at_eos=False, seed=13,
+            )
+            solo = src.generate(victim).tokens
+            s_sess = src.decode_open([anchor_s, victim], reserve_rows=4)
+            d_sess = dst.decode_open([anchor_d], reserve_rows=4)
+            s_idle = s_sess.pool.n_pages - 1 if paged else None
+            d_idle = d_sess.pool.n_pages - 1 if paged else None
+            s_sess.step(4)
+            free_s = s_sess.pool.free_pages if paged else None
+            pr = s_sess.preempt(victim, policy="swap")
+            bundle = json.loads(
+                json.dumps(export_bundle(pr, reason="disagg", streamed=0))
+            )
+            s_sess.resume_discard(pr)
+            src_freed = (
+                s_sess.pool.free_pages == free_s + pr.n_own_pages
+                if paged
+                else None
+            )
+            pr2 = import_bundle(bundle)
+            pend = d_sess.resume_begin(pr2, 64)
+            while not d_sess.join_step(pend):
+                pass
+            d_sess.join_commit(pend)
+            results = {}
+            for sess in (s_sess, d_sess):
+                while sess.active:
+                    for res in sess.step(8):
+                        results[res.request.prompt] = res
+            tokens_equal = results[victim.prompt].tokens == solo
+            s_sess.close()
+            d_sess.close()
+            out[name] = {
+                "tokens_equal": bool(tokens_equal),
+                "src_pages_freed_exact": src_freed,
+                "pools_restored_idle": (
+                    (
+                        s_sess.pool.free_pages == s_idle
+                        and d_sess.pool.free_pages == d_idle
+                    )
+                    if paged
+                    else None
+                ),
+            }
+        return out
+
+    parity = parity_all_layouts()
+
+    d_gap = arms["disagg_1p1d"]["gap_p99_ms"]
+    m_gap = arms["mixed2"]["gap_p99_ms"]
+    line = {
+        "metric": "pd_disagg_interslice_gap_p99_ms",
+        "value": d_gap,
+        "unit": "ms",
+        # >1 = the disagg fleet's in-flight gap tail beats the mixed
+        # fleet's at matched hardware (the acceptance bar)
+        "vs_baseline": round(m_gap / d_gap, 3) if d_gap else None,
+        "replica_model": {
+            "tokens_per_s": TOKENS_PER_S,
+            "max_rows": MAX_ROWS,
+            "replicas_per_arm": 2,
+        },
+        "workload": {
+            "n": N,
+            "mean_interarrival_s": MEAN_INTERARRIVAL_S,
+            "budgets": list(BUDGETS),
+            **LOGNORM,
+        },
+        "arms": arms,
+        "ttft_p99_disagg_vs_mixed": (
+            round(
+                arms["disagg_1p1d"]["ttft_p99_s"]
+                / arms["mixed2"]["ttft_p99_s"],
+                3,
+            )
+            if arms["mixed2"].get("ttft_p99_s")
+            else None
+        ),
+        "drain": drain,
+        "parity": parity,
+    }
+    _attach_obs(line)
+    print(json.dumps(line))
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "continuous_batching":
         return continuous_batching_bench()
@@ -2790,6 +3155,8 @@ def main() -> int:
         return spec_sampled_bench()
     if len(sys.argv) > 1 and sys.argv[1] == "slo_overhead":
         return slo_overhead_bench()
+    if len(sys.argv) > 1 and sys.argv[1] == "pd_disagg":
+        return pd_disagg_bench()
     import jax
 
     backend = jax.default_backend()

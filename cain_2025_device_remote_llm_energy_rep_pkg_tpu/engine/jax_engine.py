@@ -294,6 +294,7 @@ class JaxEngine(GenerationBackend):
         speculative: "Optional[Dict[str, Tuple[str, int]]]" = None,
         spec_accept_floor: float = 0.0,  # stepped-session auto-fallback
         spec_temperature_max: float = 2.0,  # sampled-spec eligibility cap
+        spec_draft_temperature: Optional[float] = None,  # draft-q flatten
         prefix_cache_size: int = 0,  # cached prompt-KV entries per model
         prefix_cache_bytes: Optional[int] = None,  # total KV bytes cap
         kv_quantize: Optional[str] = None,  # None | "int8" (decode path)
@@ -449,6 +450,26 @@ class JaxEngine(GenerationBackend):
                 f"{spec_temperature_max}"
             )
         self.spec_temperature_max = float(spec_temperature_max)
+        # Independent draft proposal temperature (ISSUE 18): sampled
+        # rows' draft sources propose at this temperature instead of
+        # the row's own — the accept math stays exact for any proposal
+        # distribution (q is computed from the same modified chain the
+        # proposals were drawn from), so this is a pure acceptance-rate
+        # tuning knob. None = draft at the row's temperature (classic).
+        # Must be strictly positive when set: a zero draft temperature
+        # would degenerate q at the modified-probs stage.
+        if spec_draft_temperature is not None and not (
+            float(spec_draft_temperature) > 0.0
+        ):
+            raise ValueError(
+                f"spec_draft_temperature must be > 0 when set, got "
+                f"{spec_draft_temperature}"
+            )
+        self.spec_draft_temperature = (
+            float(spec_draft_temperature)
+            if spec_draft_temperature is not None
+            else None
+        )
         # Per-SOURCE acceptance memory (ISSUE 16): recent fallback
         # acceptances keyed "source:draft". n-gram acceptance collapses
         # on non-repetitive text; learning the window per source keys
@@ -2865,6 +2886,7 @@ class JaxEngine(GenerationBackend):
         key = (
             "spec-step", model, draft_model, k, n_steps, paged,
             quantized, stacked, source, top_k, use_top_p,
+            self.spec_draft_temperature,
         )
         if key in self._decode_cache:
             return self._decode_cache[key]
@@ -2890,6 +2912,7 @@ class JaxEngine(GenerationBackend):
             source=source,
             top_k=top_k,
             use_top_p=use_top_p,
+            draft_temperature=self.spec_draft_temperature,
         )
         decode = self._stepped_jit(tcfg, carry, fn, draft_cfg=dcfg)
         self._decode_cache[key] = decode

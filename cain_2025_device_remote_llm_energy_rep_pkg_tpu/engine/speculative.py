@@ -180,12 +180,29 @@ class ModelDraftSource:
         decode_attention=None,
         top_k: int = 0,
         use_top_p: bool = False,
+        draft_temperature: Optional[float] = None,
     ):
         self.dcfg = dcfg
         self.k = k
         self.decode_attention = decode_attention
         self.top_k = top_k
         self.use_top_p = use_top_p
+        # Independent draft proposal temperature (ISSUE 18): when set,
+        # SAMPLED rows draft at this temperature instead of their own
+        # (a flatter q keeps proposal mass where a sharp draft would
+        # starve the accept ratio). ``q`` below is still computed from
+        # the SAME modified chain the proposals were drawn from, so the
+        # rejection-resampling marginals remain exactly the target's —
+        # the chi-squared/TV pin holds for any draft temperature.
+        # Greedy rows keep greedy drafts (bit-parity lane untouched).
+        self.draft_temperature = draft_temperature
+
+    def _draft_temps(self, temps):
+        if self.draft_temperature is None:
+            return temps
+        return jnp.where(
+            temps >= 1e-6, jnp.float32(self.draft_temperature), temps
+        )
 
     def init_state(self, carry) -> Tuple[Any, ...]:
         return (
@@ -206,6 +223,7 @@ class ModelDraftSource:
         their per-round proposal keys."""
         dcfg, k = self.dcfg, self.k
         doffs, dk, dv = state
+        dtemps = self._draft_temps(temps)
 
         def dstep(dc, keys_row):
             tok, do_, dk_, dv_ = dc
@@ -215,7 +233,7 @@ class ModelDraftSource:
             )
             lg = logits_for(dparams, dcfg, hidden[:, 0])  # [B, V]
             nxt = sample_token_per_row(
-                lg, keys_row, temps, self.top_k, top_ps
+                lg, keys_row, dtemps, self.top_k, top_ps
             )
             return (nxt, do_ + 1, dk_, dv_), (nxt, lg)
 
@@ -230,7 +248,7 @@ class ModelDraftSource:
         )
         q = modified_probs(
             dlogits,
-            temps[:, None, None],
+            dtemps[:, None, None],
             self.top_k,
             top_ps[:, None, None] if top_ps is not None else None,
         )
@@ -296,14 +314,18 @@ def make_draft_source(
     draft_decode_attention=None,
     top_k: int = 0,
     use_top_p: bool = False,
+    draft_temperature: Optional[float] = None,
 ):
     """Instantiate the DraftSource implementation for a resolved spec
-    (build-time static — the compiled step bakes the source in)."""
+    (build-time static — the compiled step bakes the source in).
+    ``draft_temperature`` only affects model/cross sources (n-gram
+    proposals are deterministic — there is no q to flatten)."""
     if source == "ngram":
         return NgramDraftSource(k)
     cls = CrossModelDraftSource if source == "cross" else ModelDraftSource
     return cls(
-        dcfg, k, draft_decode_attention, top_k=top_k, use_top_p=use_top_p
+        dcfg, k, draft_decode_attention, top_k=top_k, use_top_p=use_top_p,
+        draft_temperature=draft_temperature,
     )
 
 
@@ -452,6 +474,7 @@ def build_spec_step_fn(
     source: str = "model",
     top_k: int = 0,
     use_top_p: bool = False,
+    draft_temperature: Optional[float] = None,
 ) -> Callable:
     """Build the BATCHED speculative slice step (see the module
     docstring). Stepped-decode contract::
@@ -522,7 +545,7 @@ def build_spec_step_fn(
     out_w = n_steps * (k + 1)
     src = make_draft_source(
         source, dcfg, k, draft_decode_attention, top_k=top_k,
-        use_top_p=use_top_p,
+        use_top_p=use_top_p, draft_temperature=draft_temperature,
     )
 
     def decode(params, carry, n_real):

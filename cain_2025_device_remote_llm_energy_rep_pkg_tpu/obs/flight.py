@@ -58,6 +58,10 @@ EV_ROW_PREEMPTED = "preempted"  # a lower-tier live row was preempted for a
 #   = swap|recompute; swapped pages/bytes ride along)
 EV_ROW_RESUMED = "resumed"  # a preempted row re-entered its session
 #   (trace = victim; parked_s, aged tier, policy actually used)
+EV_ROW_MIGRATED = "row_migrated"  # a live row moved between replicas
+#   (ISSUE 18: trace = the ticket; from/to replica ids, reason =
+#   disagg|drain, blob bytes ride along — emitted by the router on the
+#   trace both replicas' flight rings share)
 EV_BATCH_FALLBACK = "batch_fallback"  # batch/session dispatch failed → bisection
 # Replica-fleet routing (ISSUE 12, serve/router.py):
 EV_DISPATCHED = "dispatched"  # the router sent a ticket to a replica

@@ -776,6 +776,38 @@ SWAP_HOST_ROWS_G = REGISTRY.gauge(
 )
 
 
+# -- live row migration (ISSUE 18) ---------------------------------------------
+# Declared here because TWO producers share them: the scheduler's
+# prime/evacuate export-import pair and the router's disagg/drain
+# transfer pipeline — a fleet scrape must show migrated rows and bytes
+# symmetrically (out on the source, in on the destination) no matter
+# which side did the accounting.
+MIGRATE_ROWS_C = REGISTRY.counter(
+    "llm_migrate_rows_total",
+    "Live rows migrated between replicas, by reason (disagg: a primed "
+    "row shipped from a prefill replica to a decode replica; drain: an "
+    "in-flight row evacuated off a draining replica)",
+    labels=("reason",),
+)
+MIGRATE_BYTES_C = REGISTRY.counter(
+    "llm_migrate_bytes_total",
+    "Serialized row-bundle bytes moved by live migration, by direction "
+    "(out: exported from the source replica; in: seated on the "
+    "destination) — symmetric counters: every completed migration "
+    "moves the same bundle out and in",
+    labels=("direction",),
+)
+
+
+def observe_migrate(direction: str, nbytes: float) -> None:
+    """Account one migration transfer leg (``out`` at export, ``in`` at
+    seat). Counter only, like :func:`observe_swap` — residency during a
+    migration is transient by construction."""
+    if not _enabled or nbytes <= 0:
+        return
+    MIGRATE_BYTES_C.labels(direction=direction).inc(nbytes)
+
+
 def observe_swap(direction: str, nbytes: float) -> None:
     """Account one swap TRANSFER (``direction`` = ``out`` at preempt,
     ``in`` at resume). Counter only — the host-residency gauges are

@@ -230,6 +230,13 @@ commands:
                        back to plain decode (llm_spec_fallback_total
                        {source}; per-source strikes park a losing
                        source until it re-arms; default: never),
+                       --spec-draft-temperature T drafts sampled rows'
+                       proposals at temperature T instead of each row's
+                       own (a flatter q raises acceptance on sharp
+                       rows; the accept math follows the proposal
+                       distribution, so output marginals are provably
+                       unchanged — default: draft at the row's
+                       temperature),
                        --prefix-cache N (prompt-prefix KV
                        LRU), --paged-kv (batched decode over a paged KV
                        pool: mixed-length batches stop paying the widest
@@ -298,6 +305,31 @@ commands:
                        rollups (counters summed, histograms merged
                        bucket-wise, gauges re-labelled {replica=...})
                        federated from the replicas' scrapes.
+                       Disaggregated prefill/decode: --role
+                       mixed|prefill|decode stamps this server's role
+                       (reported on /healthz; default mixed = classic
+                       behavior). A PREFILL replica runs chunked-join
+                       prefill to completion, exports the primed row
+                       (KV pages as swap blobs + control state) and the
+                       router ships it over POST /api/migrate to a
+                       DECODE replica, which seats it via the resume
+                       path and streams — one uninterrupted SSE stream,
+                       TTFT stamped at the decode side's first chunk;
+                       decode replicas never take fresh dispatch. The
+                       transfer is charged to the wasted-energy ledger
+                       (cause="migration", 2x bundle bytes) and counted
+                       by llm_migrate_rows_total{reason}/llm_migrate_
+                       bytes_total{direction}; a receiver failing
+                       mid-transfer falls back to local decode on the
+                       prefill replica (llm_router_retries_total
+                       {reason="migrate_failed"}), never a dropped
+                       ticket. --roles prefill,decode assigns
+                       per-replica roles under --replicas N (cycling);
+                       POST /admin/drain?replica=R&migrate=1 on the
+                       router evacuates a replica's in-flight rows to
+                       survivors before detach (wait-out when
+                       migrate=0), POST /admin/add_replica?target=H:P
+                       attaches a new one.
                        Multi-model serving: --model-policy small-first|
                        cheapest-joules hosts one continuous lane per
                        --models entry over ONE engine (decode slices of
@@ -359,6 +391,7 @@ def serve_command(args: List[str]) -> None:
     speculative = {}
     spec_accept_floor = None  # speculative auto-fallback threshold
     spec_temperature_max = None  # sampled-spec eligibility cap (ISSUE 16)
+    spec_draft_temperature = None  # independent draft-q flatten (ISSUE 18)
     prefix_cache = 0
     prefix_share = False
     prefix_index_entries = None
@@ -371,6 +404,8 @@ def serve_command(args: List[str]) -> None:
     model_policy = None  # multi-model fleet: small-first|cheapest-joules
     escalate_max_tokens = None  # small-first cascade length-cut floor
     slo = None  # SLO objectives spec (ISSUE 17)
+    role = None  # disagg serving role: mixed|prefill|decode (ISSUE 18)
+    roles = None  # per-replica roles for --replicas N fleets
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -527,6 +562,22 @@ def serve_command(args: List[str]) -> None:
                 raise CommandError(
                     "serve: --spec-temperature-max expects a float >= 0"
                 )
+        elif arg == "--spec-draft-temperature":
+            # independent draft proposal temperature: sampled rows'
+            # draft sources propose at this flatter/sharper temperature
+            # instead of the row's own sampler temperature; acceptance
+            # math stays exact (q follows the proposals), so marginals
+            # are unchanged — a pure acceptance-rate tuning knob.
+            try:
+                spec_draft_temperature = float(next(it, ""))
+            except ValueError:
+                raise CommandError(
+                    "serve: --spec-draft-temperature expects a float > 0"
+                )
+            if spec_draft_temperature <= 0.0:
+                raise CommandError(
+                    "serve: --spec-draft-temperature expects a float > 0"
+                )
         elif arg == "--prefix-cache":
             prefix_cache = int(next(it, "4"))
         elif arg == "--prefix-share":
@@ -620,6 +671,30 @@ def serve_command(args: List[str]) -> None:
                 parse_slo_spec(slo)  # validate at the CLI edge
             except ValueError as exc:
                 raise CommandError(f"serve: --slo: {exc}")
+        elif arg == "--role":
+            # Disaggregated prefill/decode serving (ISSUE 18): a
+            # prefill replica primes long-prompt rows and ships them
+            # via /api/migrate; a decode replica seats migrated rows
+            # but never takes fresh dispatch; mixed = today-behavior.
+            from ..serve.protocol import SERVER_ROLES
+
+            role = next(it, "")
+            if role not in SERVER_ROLES:
+                raise CommandError(
+                    "serve: --role expects one of " + "|".join(SERVER_ROLES)
+                )
+        elif arg == "--roles":
+            # Per-replica roles for --replicas N (e.g. --replicas 2
+            # --roles prefill,decode); cycles if shorter than N.
+            from ..serve.protocol import SERVER_ROLES
+
+            roles = [r for r in next(it, "").split(",") if r]
+            bad = [r for r in roles if r not in SERVER_ROLES]
+            if not roles or bad:
+                raise CommandError(
+                    "serve: --roles expects a comma list drawn from "
+                    + "|".join(SERVER_ROLES)
+                )
         elif arg == "--access-log":
             access_log = True
         elif arg == "--no-telemetry":
@@ -703,6 +778,11 @@ def serve_command(args: List[str]) -> None:
                     if spec_temperature_max is not None
                     else {}
                 ),
+                **(
+                    {"spec_draft_temperature": spec_draft_temperature}
+                    if spec_draft_temperature is not None
+                    else {}
+                ),
                 prefix_cache_size=prefix_cache,
                 prefix_share=prefix_share,
                 **(
@@ -735,6 +815,11 @@ def serve_command(args: List[str]) -> None:
                 **(
                     {"spec_temperature_max": spec_temperature_max}
                     if spec_temperature_max is not None
+                    else {}
+                ),
+                **(
+                    {"spec_draft_temperature": spec_draft_temperature}
+                    if spec_draft_temperature is not None
                     else {}
                 ),
                 prefix_cache_size=prefix_cache,
@@ -784,6 +869,11 @@ def serve_command(args: List[str]) -> None:
         }
         if batch_window_ms > 0:
             sched_kwargs["window_s"] = batch_window_ms / 1e3
+        def replica_role(i: int) -> str:
+            if roles:
+                return roles[i % len(roles)]
+            return role or "mixed"
+
         def build_replica(i: int) -> LocalReplica:
             backend = build_backend()
             if model_policy is not None:
@@ -802,8 +892,11 @@ def serve_command(args: List[str]) -> None:
                         escalate_max_tokens=escalate_max_tokens,
                         **sched_kwargs,
                     ),
+                    role=replica_role(i),
                 )
-            return LocalReplica(f"r{i}", backend, **sched_kwargs)
+            return LocalReplica(
+                f"r{i}", backend, role=replica_role(i), **sched_kwargs
+            )
 
         fleet = [build_replica(i) for i in range(replicas)]
         router = Router(
@@ -844,6 +937,7 @@ def serve_command(args: List[str]) -> None:
         model_policy=model_policy,
         escalate_max_tokens=escalate_max_tokens,
         slo=slo,
+        role=role,
     )
     server.serve_forever()
 

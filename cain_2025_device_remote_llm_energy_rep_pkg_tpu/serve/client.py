@@ -159,7 +159,7 @@ class RemoteHTTPBackend(GenerationBackend):
         return result
 
     def generate_stream(
-        self, request: GenerationRequest
+        self, request: GenerationRequest, prime: bool = False
     ) -> Iterator[GenerationChunk]:
         """Stream over the wire: POST with ``stream: true`` and re-yield
         the server's records as :class:`GenerationChunk`s. Our server
@@ -176,18 +176,56 @@ class RemoteHTTPBackend(GenerationBackend):
         server's next SSE write fails and the continuous scheduler
         retires the row mid-flight (``reason="cancelled"``, pages back
         to the pool) — the wire path tests and the load generator's
-        ``--cancel-frac`` exercise exactly this."""
+        ``--cancel-frac`` exercise exactly this.
+
+        ``prime=True`` (ISSUE 18) stamps ``x_prime`` on the wire body:
+        the server runs prefill to completion and exports the row — a
+        successful prime streams NO deltas, just the final record whose
+        ``x_extras["migrate"]`` carries the bundle; a server that
+        cannot prime streams the full answer instead."""
         t0 = time.monotonic()
-        body = json.dumps(
-            protocol.request_to_wire(request, stream=True)
-        ).encode("utf-8")
+        payload = protocol.request_to_wire(request, stream=True)
+        if prime:
+            payload[protocol.PRIME_KEY] = True
+        text_parts = []
+        records = self._stream_records(protocol.GENERATE_PATH, payload)
+        for record in records:
+            if "error" in record:
+                # Mid-stream backend failure, surfaced by the server
+                # as a terminal error record.
+                raise RemoteServerError(500, str(record["error"]))
+            if record.get("done"):
+                result = protocol.result_from_wire(record, request)
+                # x_text is the server's authoritative full decode
+                # (per-chunk deltas can split multi-byte UTF-8);
+                # fall back to the concatenated deltas for plain
+                # Ollama servers that don't send it.
+                result.text = str(
+                    record.get("x_text", "".join(text_parts))
+                )
+                result.total_s = time.monotonic() - t0
+                yield GenerationChunk(
+                    text="", tokens=[], done=True, result=result
+                )
+            else:
+                delta = str(record.get("response", ""))
+                text_parts.append(delta)
+                yield GenerationChunk(
+                    text=delta,
+                    tokens=[int(t) for t in record.get("x_tokens", [])],
+                )
+
+    def _stream_records(self, path: str, payload: dict) -> Iterator[dict]:
+        """POST ``payload`` and yield the response's parsed stream
+        records (SSE by Content-Type, NDJSON fallback) — the shared
+        wire-reader under generate_stream and migrate_stream."""
+        body = json.dumps(payload).encode("utf-8")
         req = urllib.request.Request(
-            f"{self.base_url}{protocol.GENERATE_PATH}",
+            f"{self.base_url}{path}",
             data=body,
             headers={"Content-Type": "application/json"},
             method="POST",
         )
-        text_parts = []
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 content_type = resp.headers.get("Content-Type", "")
@@ -201,36 +239,52 @@ class RemoteHTTPBackend(GenerationBackend):
                         if line
                     )
                 for record in records:
-                    if "error" in record:
-                        # Mid-stream backend failure, surfaced by the server
-                        # as a terminal error record.
-                        raise RemoteServerError(500, str(record["error"]))
-                    if record.get("done"):
-                        result = protocol.result_from_wire(record, request)
-                        # x_text is the server's authoritative full decode
-                        # (per-chunk deltas can split multi-byte UTF-8);
-                        # fall back to the concatenated deltas for plain
-                        # Ollama servers that don't send it.
-                        result.text = str(
-                            record.get("x_text", "".join(text_parts))
-                        )
-                        result.total_s = time.monotonic() - t0
-                        yield GenerationChunk(
-                            text="", tokens=[], done=True, result=result
-                        )
-                    else:
-                        delta = str(record.get("response", ""))
-                        text_parts.append(delta)
-                        yield GenerationChunk(
-                            text=delta,
-                            tokens=[int(t) for t in record.get("x_tokens", [])],
-                        )
+                    yield record
         except urllib.error.HTTPError as exc:
             try:
                 message = json.loads(exc.read().decode("utf-8")).get("error", "")
             except Exception:  # noqa: BLE001
                 message = exc.reason
             raise RemoteServerError(exc.code, str(message)) from exc
+
+    def migrate_stream(self, bundle: dict) -> Iterator[GenerationChunk]:
+        """Ship one primed/evacuated row bundle to ``/api/migrate``
+        (ISSUE 18) and yield the seated row's chunks — the same shapes
+        generate_stream yields, so the router relays either
+        interchangeably. The request the chunks answer is rebuilt from
+        the bundle's embedded wire request."""
+        request = protocol.request_from_wire(dict(bundle["request"]))
+        t0 = time.monotonic()
+        text_parts = []
+        for record in self._stream_records(protocol.MIGRATE_PATH, bundle):
+            if "error" in record:
+                raise RemoteServerError(500, str(record["error"]))
+            if record.get("done"):
+                result = protocol.result_from_wire(record, request)
+                result.text = str(
+                    record.get("x_text", "".join(text_parts))
+                )
+                result.total_s = time.monotonic() - t0
+                yield GenerationChunk(
+                    text="", tokens=[], done=True, result=result
+                )
+            else:
+                delta = str(record.get("response", ""))
+                text_parts.append(delta)
+                yield GenerationChunk(
+                    text=delta,
+                    tokens=[int(t) for t in record.get("x_tokens", [])],
+                )
+
+    def evacuate(self, timeout_s: float = 30.0) -> int:
+        """``POST /admin/evacuate``: ask the replica to export every
+        exportable in-flight row; returns the evacuated-row count."""
+        body = self._post(
+            f"{protocol.ADMIN_EVACUATE_PATH}?timeout={timeout_s:g}",
+            {},
+            timeout_s + 30.0,
+        )
+        return int(body.get("evacuated", 0))
 
     def unload_all(self) -> None:  # nothing held client-side
         return None

@@ -1,0 +1,314 @@
+"""Live row-migration bundle codec (ISSUE 18).
+
+A *migrate bundle* is the JSON-serializable form of one preempted row —
+everything the preemption path already captures (``engine/stepped.py``'s
+``PreemptedRow``: KV pages as ``PagePool.swap_out`` blobs, last tokens,
+rng key, offsets, remaining budget, sampler flags) — so a row primed on
+one replica can be seated on another through the existing
+``resume_begin``/``_seat_row`` machinery. The same bundle rides the
+in-process fast path between ``LocalReplica``s (no copy beyond the
+device→host slabs preemption already made) and ``POST /api/migrate``
+over the wire (numpy leaves base64-framed).
+
+Two kinds, discriminated by ``bundle["kind"]``:
+
+- ``"real"`` — a ``PreemptedRow`` walked slot-by-slot. Array leaves
+  (rng, presence, swap blobs, contiguous/stacked cache slabs) encode as
+  ``{"dtype", "shape", "b64"}``; int8 pool slabs are ``{"q","s"}`` dicts
+  of those. ``bundle["nbytes"]`` totals the payload array bytes — the
+  figure the wasted-energy ledger charges at ``SWAP_J_PER_BYTE`` per
+  direction and the ``llm_migrate_bytes_total`` counters move by.
+- ``"fake"`` — the hermetic twin (``engine/fake.py`` preempts rows as
+  plain dicts). Only control state crosses: the destination backend
+  regenerates the deterministic result stream and the cursor/streamed
+  watermarks carry over, so the spliced stream is byte-identical to an
+  uninterrupted run — which is exactly what the parity tests pin.
+
+Refusals (``MigrateRefused``) happen at EXPORT, while the row is still
+resumable on the source: rows holding shared prefix pages (their pages
+have other live readers on the source pool — shipping them would fork
+the radix store's refcounts) and spec-active rows (draft cache layout is
+a property of the source engine's draft config, not of the row). The
+caller falls back to local decode; the ticket is never dropped.
+
+Ledger discipline: the SOURCE settles the swap gauges via
+``resume_discard(pr)`` after a confirmed transfer. An imported row
+therefore arrives with ``host_bytes == 0`` and ``discharged=True`` so
+the destination's ``_swap_settle``/``_commit_resume`` accounting
+no-ops — host-byte gauges stay correct whether the two pools live in
+one process (net zero) or two (source returns to zero, destination
+never moves). The migration itself is charged separately by the router
+(``cause="migration"``, 2× ``bundle["nbytes"]``).
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .protocol import request_from_wire, request_to_wire
+
+BUNDLE_VERSION = 1
+
+# PreemptedRow slots that are plain JSON scalars/lists — copied verbatim
+# on export and restored verbatim on import (in slot order).
+_PR_PLAIN = (
+    "ids", "generated", "prompt_len", "offsets", "remaining",
+    "use_top_p", "use_rp", "streamed", "policy", "paged", "stacked",
+    "n_own_pages",
+)
+
+
+class MigrateRefused(RuntimeError):
+    """This row cannot leave its replica; resume it locally instead."""
+
+
+# -- numpy leaf codec ----------------------------------------------------------
+#
+# Leaves are numpy arrays (device_get'd slabs) or {"q","s"} dicts of them
+# (int8 pools). Encoded arrays are dicts carrying a "b64" key — slab
+# dicts never do, so decode dispatches on that marker.
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registered with numpy by jax; bfloat16 etc.
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_leaf(x: Any, acc: list) -> Any:
+    if x is None:
+        return None
+    if isinstance(x, dict):
+        return {k: _encode_leaf(v, acc) for k, v in x.items()}
+    a = np.asarray(x)
+    acc[0] += int(a.nbytes)
+    return {
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "b64": base64.b64encode(
+            np.ascontiguousarray(a).tobytes()
+        ).decode("ascii"),
+    }
+
+
+def _decode_leaf(x: Any) -> Any:
+    if x is None:
+        return None
+    if isinstance(x, dict) and "b64" not in x:
+        return {k: _decode_leaf(v) for k, v in x.items()}
+    buf = base64.b64decode(x["b64"])
+    return (
+        np.frombuffer(buf, dtype=_np_dtype(x["dtype"]))
+        .reshape(tuple(x["shape"]))
+        .copy()
+    )
+
+
+def _encode_pair(pair: Any, acc: list) -> Any:
+    """(k_slab, v_slab) tuples — side_blob / cache_blob."""
+    if pair is None:
+        return None
+    k, v = pair
+    return [_encode_leaf(k, acc), _encode_leaf(v, acc)]
+
+
+def _decode_pair(pair: Any) -> Any:
+    if pair is None:
+        return None
+    return (_decode_leaf(pair[0]), _decode_leaf(pair[1]))
+
+
+# -- export --------------------------------------------------------------------
+
+
+def export_bundle(
+    pr: Any, reason: str = "disagg", streamed: "Optional[int]" = None
+) -> Dict[str, Any]:
+    """Serialize one preempted row (real ``PreemptedRow`` or the fake
+    backend's pr dict) into a JSON-able bundle. ``streamed`` overrides
+    the exported stream watermark: a disagg prime passes 0 so the decode
+    replica re-emits every generated token (the client saw none); drain
+    evacuation passes nothing, keeping the live cursor so the spliced
+    stream continues exactly where the source stopped. Raises
+    :class:`MigrateRefused` while the row is still locally resumable."""
+    if isinstance(pr, dict):
+        return _export_fake(pr, reason, streamed)
+    return _export_real(pr, reason, streamed)
+
+
+def _export_fake(
+    pr: Dict[str, Any], reason: str, streamed: "Optional[int]"
+) -> Dict[str, Any]:
+    row = pr["row"]
+    return {
+        "version": BUNDLE_VERSION,
+        "kind": "fake",
+        "reason": reason,
+        "model": pr["request"].model,
+        "request": request_to_wire(pr["request"]),
+        "cursor": len(pr["generated"]),
+        "streamed": int(
+            row["streamed"] if streamed is None else streamed
+        ),
+        "prompt_len": int(pr["prompt_len"]),
+        "policy": pr["policy"],
+        "nbytes": int(pr.get("host_bytes", 0)),
+    }
+
+
+def _export_real(
+    pr: Any, reason: str, streamed: "Optional[int]"
+) -> Dict[str, Any]:
+    if getattr(pr, "shared_pages", None):
+        # shared prefix pages have other live readers on the source
+        # pool; swap_out refused them at preempt and the captured page
+        # list only means anything against the source radix store
+        raise MigrateRefused(
+            "row shares %d prefix pages with the source replica"
+            % len(pr.shared_pages)
+        )
+    if getattr(pr, "draft_blob", None) is not None:
+        raise MigrateRefused(
+            "row carries speculative draft state bound to the source "
+            "engine's draft config"
+        )
+    acc = [0]
+    t0 = float(pr.t0 or 0.0)
+    t1 = float(pr.t1 or t0)
+    bundle: Dict[str, Any] = {
+        "version": BUNDLE_VERSION,
+        "kind": "real",
+        "reason": reason,
+        "model": pr.request.model,
+        "request": request_to_wire(pr.request),
+        "rng": _encode_leaf(pr.rng, acc),
+        "presence": _encode_leaf(pr.presence, acc),
+        "side_blob": _encode_pair(pr.side_blob, acc),
+        "cache_blob": _encode_pair(pr.cache_blob, acc),
+        # wall-clock offsets don't transfer between hosts; ship the
+        # prefill duration and rebase against the receiver's clock
+        "prefill_s": max(0.0, t1 - t0),
+    }
+    for name in _PR_PLAIN:
+        bundle[name] = getattr(pr, name)
+    if streamed is not None:
+        bundle["streamed"] = int(streamed)
+    blob = pr.blob
+    if blob is not None:
+        bundle["blob"] = {
+            "k_chunks": _encode_leaf(blob.k_chunks, acc),
+            "v_chunks": _encode_leaf(blob.v_chunks, acc),
+            "n_pages": int(blob.n_pages),
+            "page_size": int(blob.page_size),
+            "quantized": bool(blob.quantized),
+            "nbytes": int(blob.nbytes),
+        }
+    else:
+        bundle["blob"] = None
+    bundle["nbytes"] = acc[0]
+    return bundle
+
+
+# -- import --------------------------------------------------------------------
+
+
+def import_bundle(bundle: Dict[str, Any], backend: Any = None) -> Any:
+    """Rebuild the preempted-row object a destination session's
+    ``can_resume``/``resume_begin`` accepts. Real bundles need no
+    backend (the ``PreemptedRow`` stands alone until seating); fake
+    bundles need the destination ``FakeBackend`` to regenerate the
+    deterministic result stream. The returned row always carries
+    ``host_bytes=0`` / ``discharged=True`` — the source settled the swap
+    ledger, see the module docstring."""
+    if int(bundle.get("version", 0)) != BUNDLE_VERSION:
+        raise ValueError(
+            "unsupported migrate bundle version %r" % bundle.get("version")
+        )
+    if bundle.get("kind") == "fake":
+        return _import_fake(bundle, backend)
+    return _import_real(bundle)
+
+
+def _import_fake(bundle: Dict[str, Any], backend: Any) -> Dict[str, Any]:
+    if backend is None or not hasattr(backend, "_result"):
+        raise ValueError("fake migrate bundle requires a fake backend")
+    request = request_from_wire(dict(bundle["request"]))
+    result = backend._result(request)
+    cursor = min(int(bundle["cursor"]), result.generated_tokens)
+    row = {
+        "request": request,
+        "result": result,
+        "cursor": cursor,
+        "streamed": min(int(bundle["streamed"]), cursor),
+        "spec_rounds": 0,
+        "spec_accepted": 0,
+        "spec_drafted": 0,
+        "spec_rejected": 0,
+        "draft_wasted_J": 0.0,
+        "hit_tokens": 0,
+        "shared_pages": 0,
+    }
+    return {
+        "request": request,
+        "row": row,
+        "policy": bundle.get("policy", "swap"),
+        "generated": result.tokens[:cursor],
+        "prompt_len": int(bundle["prompt_len"]),
+        "host_bytes": 0,
+        "discharged": True,
+    }
+
+
+def _import_real(bundle: Dict[str, Any]) -> Any:
+    from ..engine.paged_kv import PageSwapBlob
+    from ..engine.stepped import PreemptedRow
+
+    request = request_from_wire(dict(bundle["request"]))
+    pr = PreemptedRow(
+        request,
+        list(bundle["ids"]),
+        list(bundle["generated"]),
+        int(bundle["prompt_len"]),
+    )
+    for name in _PR_PLAIN:
+        if name in ("ids", "generated", "prompt_len"):
+            continue
+        setattr(pr, name, bundle[name])
+    pr.rng = _decode_leaf(bundle["rng"])
+    pr.presence = _decode_leaf(bundle["presence"])
+    pr.side_blob = _decode_pair(bundle["side_blob"])
+    pr.cache_blob = _decode_pair(bundle["cache_blob"])
+    pr.draft_blob = None
+    pr.draft_offset = 0
+    pr.shared_pages = []
+    blob = bundle.get("blob")
+    if blob is not None:
+        pr.blob = PageSwapBlob(
+            k_chunks=_decode_leaf(blob["k_chunks"]),
+            v_chunks=_decode_leaf(blob["v_chunks"]),
+            n_pages=int(blob["n_pages"]),
+            page_size=int(blob["page_size"]),
+            quantized=bool(blob["quantized"]),
+            nbytes=int(blob["nbytes"]),
+        )
+    else:
+        pr.blob = None
+    now = time.monotonic()
+    pr.t1 = now
+    pr.t0 = now - float(bundle.get("prefill_s", 0.0))
+    pr.host_bytes = 0
+    pr.discharged = True
+    return pr
+
+
+def bundle_nbytes(bundle: Dict[str, Any]) -> int:
+    """Serialized payload bytes — what the transfer moved and what the
+    ledger charges (2× per migration: once out, once in)."""
+    return int(bundle.get("nbytes", 0))

@@ -44,6 +44,33 @@ DEBUG_TIMELINE_PATH = "/debug/timeline"  # router: one request's full
 #   cross-process lifecycle, reassembled per trace id (?trace=, ISSUE 13)
 DEBUG_TIMESERIES_PATH = "/debug/timeseries"  # windowed rollups from the
 #   in-process time-series ring (?family=, ?window=, ?step=; ISSUE 17)
+# Live row migration (ISSUE 18 — disaggregated prefill/decode):
+MIGRATE_PATH = "/api/migrate"  # POST a serialized row bundle
+#   (serve/migrate.py); the receiver seats it through resume_begin/
+#   _seat_row and answers with the row's SSE stream (or buffered result)
+ADMIN_EVACUATE_PATH = "/admin/evacuate"  # POST: preempt + export every
+#   live streamed row as a migrate bundle (replica-side drain support)
+ADMIN_DRAIN_PATH = "/admin/drain"  # POST ?replica=<name>[&migrate=1]
+#   on the ROUTER front door: drain one replica (evacuating in-flight
+#   rows to survivors when migrate=1), result in the response body
+ADMIN_ADD_REPLICA_PATH = "/admin/add_replica"  # POST ?target=<base_url>
+#   [&name=]: attach a remote replica to the running router fleet
+
+# Replica roles (ISSUE 18): what work a replica accepts. ``mixed`` is
+# the default and keeps the single-role behavior byte-identical;
+# ``prefill`` replicas prime rows (prefill + first token) and export
+# them as migrate bundles; ``decode`` replicas only accept migrated-in
+# rows (the router never dispatches fresh prefill work to them).
+SERVER_ROLES = ("mixed", "prefill", "decode")
+
+# Wire flag (rides the generate JSON body next to "stream"; unknown keys
+# are ignored by request_from_wire, so plain servers are unaffected): ask
+# the replica to PRIME the request — run prefill to completion, then
+# preempt and export the row as a migrate bundle instead of decoding it
+# locally. The stream's final record carries the bundle under
+# ``x_extras["migrate"]``; a replica that cannot export (spec-active
+# session, shared prefix mid-row) falls back to a normal local stream.
+PRIME_KEY = "x_prime"
 
 
 def trace_to_wire(trace: "TraceContext | None") -> "Dict[str, Any] | None":

@@ -85,10 +85,12 @@ from ..obs.flight import (
     EV_DISPATCHED,
     EV_REPLICA_DOWN,
     EV_REPLICA_DRAINED,
+    EV_ROW_MIGRATED,
     FLIGHT,
     trace_attrs,
 )
 from ..obs.metrics import (
+    MIGRATE_ROWS_C,
     REGISTRY,
     histogram_mean,
     merge_expositions,
@@ -99,6 +101,7 @@ from ..obs.trace import TRACER, TraceContext
 from ..runner import term
 from . import protocol
 from .client import RemoteHTTPBackend, RemoteServerError, fetch_flight
+from .migrate import bundle_nbytes
 from .stream import DeadlineExceeded, StreamCancelled
 
 ROUTE_POLICIES = (
@@ -167,6 +170,14 @@ def _retry_reason(exc: BaseException) -> Optional[str]:
     return None  # KeyboardInterrupt/SystemExit etc: never retried
 
 
+class MigrateDispatchFailed(RuntimeError):
+    """Every candidate seat for a migrated row failed BEFORE relaying
+    any output (ISSUE 18). Nothing reached the client, so the caller
+    may safely re-dispatch the original request from scratch — the
+    distinction this type exists to carry; a post-output death raises
+    the replica's own error instead and is never retried."""
+
+
 class Replica:
     """One fleet member: a name, a dispatch surface (``generate`` /
     ``stream``), a probe, and the router-side bookkeeping (health,
@@ -177,6 +188,7 @@ class Replica:
     def __init__(self, name: str) -> None:
         self.name = name
         self.healthy = True
+        self.role = "mixed"  # disagg fleet role (ISSUE 18)
         self.draining = False
         self.outstanding = 0  # tickets the router currently has on us
         self.dispatched = 0  # attempts routed here (lifetime)
@@ -192,6 +204,25 @@ class Replica:
 
     def stream(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
         raise NotImplementedError
+
+    def prime(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
+        """Disagg prime (ISSUE 18): prefill to completion, export the
+        row, answer with a final chunk whose ``result.extras["migrate"]``
+        carries the bundle. Default: decay to a normal stream — the
+        router reads the missing bundle as "serve it here"."""
+        return self.stream(request)
+
+    def migrate(self, bundle: dict) -> Iterator[GenerationChunk]:
+        """Seat one migrate bundle and stream the row from its cursor."""
+        raise RuntimeError(
+            f"replica {self.name!r} cannot seat migrated rows"
+        )
+
+    def evacuate(self, timeout_s: float = 30.0) -> int:
+        """Export every exportable in-flight row (drain-evacuation);
+        each bundle rides its own stream's final record. Returns the
+        count; 0 for replicas without the machinery."""
+        return 0
 
     def probe(self) -> Dict[str, object]:
         """Liveness + the policy gauges. Raises when the replica is
@@ -222,6 +253,7 @@ class Replica:
             "name": self.name,
             "kind": self.kind,
             "healthy": self.healthy,
+            "role": self.role,
             "draining": self.draining,
             "outstanding": self.outstanding,
             "dispatched": self.dispatched,
@@ -248,9 +280,15 @@ class LocalReplica(Replica):
         backend: GenerationBackend,
         scheduler: Optional[object] = None,
         start: bool = True,
+        role: str = "mixed",
         **scheduler_kwargs,
     ) -> None:
         super().__init__(name)
+        if role not in protocol.SERVER_ROLES:
+            raise ValueError(
+                f"role must be one of {protocol.SERVER_ROLES}, got {role!r}"
+            )
+        self.role = role
         self.backend = backend
         if scheduler is None:
             from .scheduler import BatchScheduler, ContinuousScheduler
@@ -271,9 +309,8 @@ class LocalReplica(Replica):
     def generate(self, request: GenerationRequest) -> GenerationResult:
         return self.scheduler.submit(request)
 
-    def stream(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
-        channel = self.scheduler.submit_stream(request)
-
+    @staticmethod
+    def _channel_chunks(channel) -> Iterator[GenerationChunk]:
         def gen():
             finished = False
             try:
@@ -299,9 +336,32 @@ class LocalReplica(Replica):
 
         return gen()
 
+    def stream(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
+        return self._channel_chunks(self.scheduler.submit_stream(request))
+
+    def prime(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
+        if not hasattr(self.scheduler, "submit_prime"):
+            return self.stream(request)  # window scheduler: decay
+        return self._channel_chunks(self.scheduler.submit_prime(request))
+
+    def migrate(self, bundle: dict) -> Iterator[GenerationChunk]:
+        if not hasattr(self.scheduler, "submit_migrate"):
+            raise RuntimeError(
+                f"replica {self.name!r} scheduler cannot seat migrated "
+                "rows (not running continuous dispatch)"
+            )
+        return self._channel_chunks(self.scheduler.submit_migrate(bundle))
+
+    def evacuate(self, timeout_s: float = 30.0) -> int:
+        evacuate = getattr(self.scheduler, "evacuate", None)
+        if evacuate is None:
+            return 0
+        return int(evacuate(timeout_s=timeout_s))
+
     def probe(self) -> Dict[str, object]:
         stats: Dict[str, object] = dict(self.scheduler.health_state())
         stats["status"] = "ok" if stats.get("running") else "stopping"
+        stats["role"] = self.role
         # pool occupancy (least-pages), best-effort off the live session
         try:
             session = self.scheduler.debug_state().get("session") or {}
@@ -370,6 +430,15 @@ class RemoteReplica(Replica):
     def stream(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
         return self.client.generate_stream(request)
 
+    def prime(self, request: GenerationRequest) -> Iterator[GenerationChunk]:
+        return self.client.generate_stream(request, prime=True)
+
+    def migrate(self, bundle: dict) -> Iterator[GenerationChunk]:
+        return self.client.migrate_stream(bundle)
+
+    def evacuate(self, timeout_s: float = 30.0) -> int:
+        return self.client.evacuate(timeout_s=timeout_s)
+
     def probe(self) -> Dict[str, object]:
         with urllib.request.urlopen(
             f"{self.base_url}{protocol.HEALTH_PATH}",
@@ -377,6 +446,12 @@ class RemoteReplica(Replica):
         ) as resp:
             stats: Dict[str, object] = json.loads(resp.read().decode("utf-8"))
         stats["running"] = stats.get("status") == "ok"
+        # the replica declares its own fleet role on /healthz (ISSUE
+        # 18); the router adopts it on every probe, so a restarted
+        # process coming back under a different role re-classifies
+        role = str(stats.get("role") or "mixed")
+        if role in protocol.SERVER_ROLES:
+            self.role = role
         try:
             text = self.scrape_metrics()
             # the shared v0.0.4 parser (obs/metrics.py) replaces the old
@@ -483,19 +558,43 @@ class Router:
         with self._lock:
             return list(self._replicas.values())
 
-    def drain(self, name: str, timeout_s: float = 30.0) -> bool:
+    def evacuate_replica(self, name: str, timeout_s: float = 30.0) -> int:
+        """Drain-evacuation (ISSUE 18): mark ``name`` draining (no new
+        dispatch) and ask it to EXPORT its in-flight rows as migrate
+        bundles instead of waiting them out. Each exported row's stream
+        carries its bundle to the relaying front-door handler, which
+        re-seats it on a survivor — the client streams never break.
+        Returns the exported-row count (0: nothing exportable)."""
+        with self._lock:
+            replica = self._replicas.get(name)
+        if replica is None:
+            raise KeyError(f"no replica named {name!r}")
+        replica.draining = True
+        try:
+            return int(replica.evacuate(timeout_s=timeout_s))
+        except Exception:  # noqa: BLE001 — evacuation is best-effort;
+            return 0  # whatever stayed put drains by waiting out
+
+    def drain(
+        self, name: str, timeout_s: float = 30.0, migrate: bool = False
+    ) -> bool:
         """Elastic scale-down: stop dispatching to ``name``, wait for
         its in-flight tickets (router-side outstanding AND the
         replica's own queue/in-flight counts) to finish, then DETACH it
         — ``replica_drained`` flight event, healthy gauge to 0, local
         replicas' schedulers stopped. Returns False on timeout: the
         replica stays attached but draining (no new dispatch), so the
-        caller can retry."""
+        caller can retry. ``migrate=True`` (ISSUE 18) first EVACUATES
+        the in-flight rows to surviving replicas (live migration,
+        streams uninterrupted) instead of waiting them out — the
+        drain-latency win ``bench.py pd_disagg`` measures."""
         with self._lock:
             replica = self._replicas.get(name)
         if replica is None:
             raise KeyError(f"no replica named {name!r}")
         replica.draining = True
+        if migrate:
+            self.evacuate_replica(name, timeout_s=timeout_s)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             idle = replica.outstanding == 0
@@ -631,10 +730,18 @@ class Router:
         self, exclude: "tuple" = (), model: Optional[str] = None
     ) -> Optional[Replica]:
         with self._lock:
+            # Role-aware membership (ISSUE 18): a decode-only replica
+            # never takes fresh (prefill-bound) work — it exists to be
+            # seated via /api/migrate. Prefill/mixed replicas take
+            # anything (a prefill replica can always decode locally as
+            # the fallback path).
             candidates = [
                 r
                 for r in self._replicas.values()
-                if r.healthy and not r.draining and r.name not in exclude
+                if r.healthy
+                and not r.draining
+                and r.name not in exclude
+                and r.role != "decode"
             ]
             if not candidates:
                 return None
@@ -659,6 +766,44 @@ class Router:
             return min(
                 candidates, key=lambda r: (self._load_key(r), r.name)
             )
+
+    def _pick_migrate_target(
+        self, exclude: "tuple" = ()
+    ) -> Optional[Replica]:
+        """Where a migrated row should land: decode replicas first
+        (that is what they are for), then mixed, then — port in a
+        storm — a prefill replica (it can decode; better than dropping
+        the ticket). Least-load within the preferred tier."""
+        with self._lock:
+            candidates = [
+                r
+                for r in self._replicas.values()
+                if r.healthy and not r.draining and r.name not in exclude
+            ]
+        for want in ("decode", "mixed", "prefill"):
+            pool = [r for r in candidates if r.role == want]
+            if pool:
+                return min(pool, key=lambda r: (self._load_key(r), r.name))
+        return None
+
+    def _disagg_plan(self) -> Optional[Tuple[Replica, Replica]]:
+        """The disaggregated prefill/decode pipeline engages when the
+        fleet holds at least one healthy prefill AND one healthy decode
+        replica: returns (prefill, decode) picked least-load per role.
+        Any other fleet shape returns None — dispatch stays the plain
+        (byte-identical pre-ISSUE-18) path."""
+        with self._lock:
+            live = [
+                r
+                for r in self._replicas.values()
+                if r.healthy and not r.draining
+            ]
+        prefill = [r for r in live if r.role == "prefill"]
+        decode = [r for r in live if r.role == "decode"]
+        if not prefill or not decode:
+            return None
+        key = lambda r: (self._load_key(r), r.name)  # noqa: E731
+        return min(prefill, key=key), min(decode, key=key)
 
     # -- dispatch --------------------------------------------------------------
     def _begin(
@@ -721,15 +866,19 @@ class Router:
         replica: Replica,
         retried: Optional[str],
         wasted_j: float = 0.0,
+        migrate_j: float = 0.0,
         trace: Optional[TraceContext] = None,
     ) -> None:
         """Route attribution onto the wire: ``extras["router"]`` rides
         ``x_extras`` so load generators and benches can split figures
         per replica without scraping anything; a retried ticket's
         first-attempt waste lands in ``extras["energy"]["wasted_J"]``
-        next to the replica's own energy attribution."""
+        next to the replica's own energy attribution, and a migrated
+        ticket's transfer energy likewise (``"migration"`` key,
+        ISSUE 18 — the same figure the ledger charged)."""
         router_extras: Dict[str, object] = {
             "replica": replica.name,
+            "role": replica.role,
             "policy": self.policy,
         }
         if trace is not None:
@@ -737,12 +886,17 @@ class Router:
         if retried:
             router_extras["retried"] = retried
         result.extras = {**(result.extras or {}), "router": router_extras}
-        if wasted_j > 0:
+        if wasted_j > 0 or migrate_j > 0:
             energy = dict(result.extras.get("energy") or {})
             wasted = dict(energy.get("wasted_J") or {})
-            wasted["retry"] = round(
-                wasted.get("retry", 0.0) + wasted_j, 6
-            )
+            if wasted_j > 0:
+                wasted["retry"] = round(
+                    wasted.get("retry", 0.0) + wasted_j, 6
+                )
+            if migrate_j > 0:
+                wasted["migration"] = round(
+                    wasted.get("migration", 0.0) + migrate_j, 9
+                )
             energy["wasted_J"] = wasted
             result.extras["energy"] = energy
 
@@ -751,7 +905,24 @@ class Router:
         replica's own terminal error (or ``RuntimeError`` when no
         healthy replica is attached). Both attempts of a retried
         ticket carry the SAME fleet-wide trace (the trace rides the
-        request; only the dispatched events' attempt index differs)."""
+        request; only the dispatched events' attempt index differs).
+
+        Disaggregated fleets (ISSUE 18): a membership with at least one
+        healthy prefill AND one healthy decode replica runs the same
+        prime→migrate pipeline the streaming path does, buffered — the
+        blocking caller gets the decode side's final result with the
+        full migration attribution on it."""
+        plan = self._disagg_plan()
+        if plan is not None:
+            final: Optional[GenerationResult] = None
+            for chunk in self._disagg_stream(request, *plan):
+                if chunk.done and chunk.result is not None:
+                    final = chunk.result
+            if final is None:
+                raise RuntimeError(
+                    "disaggregated dispatch yielded no final result"
+                )
+            return final
         tried: "tuple" = ()
         retried: Optional[str] = None
         wasted_j = 0.0
@@ -797,10 +968,27 @@ class Router:
         surfaces as the iterator's terminal exception (the front door
         turns it into a terminal SSE error event — no silent hang, no
         duplicate tokens). Closing the iterator cancels the
-        replica-side row."""
-        tried: "tuple" = ()
-        retried: Optional[str] = None
-        wasted_j = 0.0
+        replica-side row.
+
+        Disaggregated fleets (ISSUE 18): when the membership holds at
+        least one healthy prefill AND one healthy decode replica, the
+        ticket runs the prime→migrate pipeline instead
+        (:meth:`_disagg_stream`); any other fleet shape takes the plain
+        path, byte-identical to pre-disagg behavior."""
+        plan = self._disagg_plan()
+        if plan is not None:
+            yield from self._disagg_stream(request, *plan)
+            return
+        yield from self._dispatch_stream_plain(request)
+
+    def _dispatch_stream_plain(
+        self,
+        request: GenerationRequest,
+        tried: "tuple" = (),
+        retried: Optional[str] = None,
+        wasted_j: float = 0.0,
+        migrate_j: float = 0.0,
+    ) -> Iterator[GenerationChunk]:
         attempt = 0
         model = (
             request.model if request.model != protocol.AUTO_MODEL else None
@@ -816,19 +1004,32 @@ class Router:
             self._begin(replica, retried, attempt=attempt)
             chunks: Optional[Iterator[GenerationChunk]] = None
             streamed = False
+            evac_bundle: Optional[dict] = None
             try:
                 try:
                     chunks = replica.stream(request)
                     for chunk in chunks:
                         if chunk.done and chunk.result is not None:
+                            extras = chunk.result.extras or {}
+                            bundle = extras.get("migrate")
+                            if bundle is not None and extras.get(
+                                "evacuated"
+                            ):
+                                # drain evacuation (ISSUE 18): the row
+                                # left the replica mid-stream as a
+                                # bundle; seat it on a survivor and
+                                # keep THIS client stream going — the
+                                # marker record is never forwarded
+                                evac_bundle = dict(bundle)
+                                break
                             self._stamp(
                                 chunk.result, replica, retried,
-                                wasted_j=wasted_j, trace=request.trace,
+                                wasted_j=wasted_j, migrate_j=migrate_j,
+                                trace=request.trace,
                             )
                         yield chunk
                         if chunk.tokens or chunk.text:
                             streamed = True
-                    return
                 except BaseException as exc:  # noqa: BLE001
                     reason = _retry_reason(exc)
                     if reason is None or streamed or retried is not None:
@@ -838,12 +1039,246 @@ class Router:
                     )
                     tried = (replica.name,)
                     retried = reason
+                    continue
             finally:
                 self._end(replica)
                 if chunks is not None:
                     close = getattr(chunks, "close", None)
                     if close is not None:
                         close()
+            if evac_bundle is not None:
+                # drain evacuation relays OUTSIDE the victim's ticket
+                # scope: its outstanding count and stream are released
+                # FIRST, so a drain(migrate=True) caller unblocks at
+                # evacuation time, not at the relayed stream's end —
+                # the drain-latency win the pd_disagg bench measures
+                yield from self._relay_migrated(
+                    evac_bundle,
+                    request,
+                    reason="drain",
+                    src=replica,
+                    exclude=(replica.name,),
+                    retried=retried,
+                    wasted_j=wasted_j,
+                    migrate_j=migrate_j,
+                )
+            return
+
+    def _disagg_stream(
+        self, request: GenerationRequest, src: Replica, dst: Replica
+    ) -> Iterator[GenerationChunk]:
+        """The disaggregated pipeline (ISSUE 18 tentpole): prime on the
+        prefill replica (chunked-join prefill runs to completion with
+        NO client-visible output), ship the exported row to the decode
+        replica, relay its stream — one uninterrupted client stream
+        whose TTFT is stamped by the decode side's first pushed chunk.
+        Decays safely at every step: a prime that streams (window
+        scheduler, spec-active session, shared prefix pages) is relayed
+        as the answer; a prime leg dead before any output re-dispatches
+        plain; a migrate leg dead before any output falls back to
+        source-local decode, then to a full re-dispatch with the burned
+        prefill charged to the migration ledger. The ticket is never
+        dropped by a failed transfer."""
+        self._begin(src, None)
+        chunks: Optional[Iterator[GenerationChunk]] = None
+        final: Optional[GenerationChunk] = None
+        streamed = False
+        failed: Optional[BaseException] = None
+        try:
+            try:
+                chunks = src.prime(request)
+                for chunk in chunks:
+                    if chunk.done:
+                        final = chunk
+                        break
+                    # the prime decayed into a live local stream: the
+                    # prefill replica is serving the whole answer
+                    streamed = True
+                    yield chunk
+            except BaseException as exc:  # noqa: BLE001
+                if streamed or _retry_reason(exc) is None:
+                    raise
+                failed = exc
+        finally:
+            self._end(src)
+            if chunks is not None:
+                close = getattr(chunks, "close", None)
+                if close is not None:
+                    close()
+        if failed is not None:
+            reason = _retry_reason(failed) or "dead"
+            wasted = self._dispatch_failed(src, failed, reason, request)
+            yield from self._dispatch_stream_plain(
+                request, tried=(src.name,), retried=reason, wasted_j=wasted
+            )
+            return
+        bundle = None
+        if final is not None and final.result is not None and not streamed:
+            bundle = (final.result.extras or {}).get("migrate")
+        if bundle is None:
+            # no bundle: the prefill replica answered locally (decayed
+            # prime) — its final record is the client's final record
+            if final is not None:
+                if final.result is not None:
+                    self._stamp(
+                        final.result, src, None, trace=request.trace
+                    )
+                yield final
+            return
+        try:
+            yield from self._relay_migrated(
+                dict(bundle),
+                request,
+                reason="disagg",
+                src=src,
+                target=dst,
+                fallback=src,
+            )
+        except MigrateDispatchFailed:
+            # every seat (decode, source-local, survivors) failed
+            # before any output reached the client: re-dispatch from
+            # scratch. The already-burned prefill is re-prefill waste,
+            # charged to the migration ledger at the prompt's token
+            # count (same byte-tokenizer estimate as the retry path).
+            burned_tokens = len(request.prompt.encode("utf-8")) + 1
+            wasted = obs_energy.charge_wasted(
+                "migration", tokens=burned_tokens
+            )
+            yield from self._dispatch_stream_plain(
+                request,
+                tried=(),
+                retried="migrate_failed",
+                migrate_j=wasted,
+            )
+
+    def _relay_migrated(
+        self,
+        bundle: dict,
+        request: GenerationRequest,
+        reason: str,
+        src: Optional[Replica] = None,
+        target: Optional[Replica] = None,
+        fallback: Optional[Replica] = None,
+        exclude: "tuple" = (),
+        retried: Optional[str] = None,
+        wasted_j: float = 0.0,
+        migrate_j: float = 0.0,
+    ) -> Iterator[GenerationChunk]:
+        """Seat ``bundle`` on ``target`` (or the best survivor) and
+        relay the seated row's chunks. Each transfer moves the
+        ``llm_migrate_rows_total{reason=}`` counter and charges the
+        wasted-energy ledger (``cause="migration"``, 2× payload bytes
+        at SWAP_J_PER_BYTE — once out, once in), and a trace-linked
+        ``row_migrated`` flight event carries BOTH replica ids. A seat
+        that dies before relaying any output counts
+        ``llm_router_retries_total{reason=migrate_failed}`` and falls
+        back — source-local decode first (the bundle seats right back
+        where it came from), then any survivor; exhaustion raises
+        :class:`MigrateDispatchFailed`. A relayed row whose seat is
+        itself drained mid-stream re-seats onward (chained
+        evacuation)."""
+        excluded = set(exclude)
+        src_name = (
+            src.name if src is not None else str(bundle.get("src") or "")
+        )
+        if target is None:
+            target = self._pick_migrate_target(exclude=tuple(excluded))
+            if target is None:
+                target = fallback
+        while True:
+            if target is None:
+                raise MigrateDispatchFailed(
+                    f"no replica can seat the migrated row ({reason})"
+                )
+            seat = target
+            nbytes = bundle_nbytes(bundle)
+            bundle = {**bundle, "src": src_name, "dst": seat.name}
+            MIGRATE_ROWS_C.labels(reason=reason).inc()
+            migrate_j += obs_energy.charge_wasted(
+                "migration", nbytes=2.0 * nbytes
+            )
+            if obs_metrics.enabled():
+                FLIGHT.emit(
+                    EV_ROW_MIGRATED,
+                    direction="transfer",
+                    reason=reason,
+                    src=src_name,
+                    dst=seat.name,
+                    nbytes=nbytes,
+                    **trace_attrs(TRACER.current()),
+                )
+            self._begin(seat, retried)
+            chunks: Optional[Iterator[GenerationChunk]] = None
+            relayed = False
+            reseat: Optional[dict] = None
+            failed: Optional[BaseException] = None
+            try:
+                try:
+                    chunks = seat.migrate(bundle)
+                    for chunk in chunks:
+                        if chunk.done and chunk.result is not None:
+                            extras = chunk.result.extras or {}
+                            next_bundle = extras.get("migrate")
+                            if next_bundle is not None and extras.get(
+                                "evacuated"
+                            ):
+                                reseat = dict(next_bundle)
+                                break
+                            self._stamp(
+                                chunk.result, seat, retried,
+                                wasted_j=wasted_j, migrate_j=migrate_j,
+                                trace=request.trace,
+                            )
+                        yield chunk
+                        if chunk.tokens or chunk.text:
+                            relayed = True
+                except BaseException as exc:  # noqa: BLE001
+                    if relayed or _retry_reason(exc) is None:
+                        raise
+                    failed = exc
+            finally:
+                self._end(seat)
+                if chunks is not None:
+                    close = getattr(chunks, "close", None)
+                    if close is not None:
+                        close()
+            if failed is not None:
+                # receiver died before any output: the bundle is still
+                # the only live copy of the row — never drop it
+                _RETRIES_C.labels(reason="migrate_failed").inc()
+                if _retry_reason(failed) == "dead":
+                    self._set_health(
+                        seat, False, f"{type(failed).__name__}: {failed}"
+                    )
+                excluded.add(seat.name)
+                if (
+                    fallback is not None
+                    and fallback.name not in excluded
+                    and fallback.healthy
+                ):
+                    target = fallback
+                else:
+                    target = self._pick_migrate_target(
+                        exclude=tuple(excluded)
+                    )
+                    if target is None:
+                        raise MigrateDispatchFailed(
+                            f"{type(failed).__name__}: {failed}"
+                        ) from failed
+                continue
+            if reseat is not None:
+                # the seat itself was drained mid-stream: chain the row
+                # onward; the (now-draining but live) seat stays the
+                # fallback of last resort
+                src_name = seat.name
+                bundle = reseat
+                excluded = {seat.name}
+                fallback = seat
+                target = self._pick_migrate_target(exclude=(seat.name,))
+                if target is None:
+                    target = seat
+                continue
+            return
 
     # -- introspection ---------------------------------------------------------
     def healthy_count(self) -> int:
@@ -854,6 +1289,10 @@ class Router:
         with self._lock:
             replicas = list(self._replicas.values())
         healthy = sum(1 for r in replicas if r.healthy)
+        roles: Dict[str, int] = {}
+        for r in replicas:
+            if r.healthy and not r.draining:
+                roles[r.role] = roles.get(r.role, 0) + 1
         return {
             "status": "ok" if healthy else "degraded",
             "role": "router",
@@ -861,6 +1300,10 @@ class Router:
             "replicas": len(replicas),
             "healthy_replicas": healthy,
             "draining_replicas": sum(1 for r in replicas if r.draining),
+            # healthy dispatchable members by fleet role (ISSUE 18);
+            # the disagg pipeline engages when prefill and decode are
+            # both non-zero here
+            "replica_roles": roles,
         }
 
     def debug_state(self) -> Dict[str, object]:
@@ -1350,7 +1793,14 @@ class RouterServer:
                     )
 
             def do_POST(self):  # noqa: N802
-                if self.path != protocol.GENERATE_PATH:
+                path = self.path.split("?", 1)[0]
+                if path == protocol.ADMIN_DRAIN_PATH:
+                    self._handle_admin_drain()
+                    return
+                if path == protocol.ADMIN_ADD_REPLICA_PATH:
+                    self._handle_admin_add_replica()
+                    return
+                if path != protocol.GENERATE_PATH:
                     self._send_json(
                         404, {"error": f"unknown path {self.path}"}
                     )
@@ -1405,6 +1855,98 @@ class RouterServer:
                     self._send_error(exc)
                 else:
                     self._send_json(200, protocol.result_to_wire(result))
+
+            def _handle_admin_drain(self) -> None:
+                """``POST /admin/drain?replica=<name>[&migrate=1]
+                [&timeout=<s>]`` (ISSUE 18): the HTTP caller for
+                elastic scale-down. ``migrate=1`` evacuates in-flight
+                rows to survivors (live migration) before the idle
+                wait; default waits them out. The result — drained or
+                still-draining, and how many rows were evacuated —
+                rides the response body."""
+                from urllib.parse import parse_qs
+
+                query = parse_qs(self.path.partition("?")[2])
+                name = query.get("replica", [None])[0]
+                if not name:
+                    self._send_json(
+                        400, {"error": "drain requires ?replica=<name>"}
+                    )
+                    return
+                migrate = str(
+                    query.get("migrate", ["0"])[0]
+                ).lower() in ("1", "true", "yes")
+                try:
+                    timeout_s = float(query.get("timeout", ["30"])[0])
+                except ValueError:
+                    self._send_json(
+                        400, {"error": "timeout must be a number"}
+                    )
+                    return
+                evacuated = 0
+                try:
+                    if migrate:
+                        evacuated = server.router.evacuate_replica(
+                            name, timeout_s=timeout_s
+                        )
+                    drained = server.router.drain(
+                        name, timeout_s=timeout_s
+                    )
+                except KeyError:
+                    self._send_json(
+                        404, {"error": f"no replica named {name!r}"}
+                    )
+                    return
+                except Exception as exc:  # noqa: BLE001
+                    self._send_json(
+                        500, {"error": f"{type(exc).__name__}: {exc}"}
+                    )
+                    return
+                self._send_json(
+                    200,
+                    {
+                        "replica": name,
+                        "drained": drained,
+                        "migrate": migrate,
+                        "evacuated": evacuated,
+                    },
+                )
+
+            def _handle_admin_add_replica(self) -> None:
+                """``POST /admin/add_replica?target=<base_url>[&name=]``
+                (ISSUE 18): elastic scale-up over HTTP — attach a
+                RemoteReplica at ``target`` (its role self-reports via
+                /healthz on the immediate first probe)."""
+                from urllib.parse import parse_qs, urlparse
+
+                query = parse_qs(self.path.partition("?")[2])
+                target = query.get("target", [None])[0]
+                if not target:
+                    self._send_json(
+                        400,
+                        {"error": "add_replica requires ?target=<base_url>"},
+                    )
+                    return
+                if not str(target).startswith("http"):
+                    target = f"http://{target}"
+                name = query.get("name", [None])[0] or (
+                    urlparse(target).netloc or str(target)
+                )
+                replica = RemoteReplica(str(name), str(target))
+                try:
+                    server.router.add_replica(replica)
+                except ValueError as exc:  # duplicate name
+                    self._send_json(409, {"error": str(exc)})
+                    return
+                self._send_json(
+                    200,
+                    {
+                        "added": replica.name,
+                        "base_url": replica.base_url,
+                        "healthy": replica.healthy,
+                        "role": replica.role,
+                    },
+                )
 
             def _send_error(self, exc: BaseException) -> None:
                 if isinstance(exc, RemoteServerError):

@@ -97,6 +97,7 @@ class GenerationServer:
         slo_pairs=None,  # burn-rate window pairs override (tests/smoke)
         ts_interval_s: Optional[float] = None,  # time-series ring cadence
         ts_capacity: Optional[int] = None,  # time-series ring depth
+        role: Optional[str] = None,  # disagg fleet role (ISSUE 18)
     ) -> None:
         """``batch_window_ms > 0`` or an explicit ``scheduler`` enables
         batching: concurrent non-streaming generate requests coalesce
@@ -182,8 +183,29 @@ class GenerationServer:
         — evaluated on every sampler tick with multi-window burn-rate
         alerting (``slo_pairs`` overrides the (short, long, threshold)
         window pairs; tests/smoke use tiny ones). Under the kill switch
-        the sampler never starts and the endpoint 404s."""
+        the sampler never starts and the endpoint 404s.
+
+        Disaggregated prefill/decode (ISSUE 18): ``role`` (CLI
+        ``--role``, default "mixed") declares this replica's place in a
+        role fleet. "mixed" is byte-identical today-behavior; "prefill"
+        and "decode" only change what the replica REPORTS (/healthz
+        gains ``role``) — the router does the role-aware dispatch, the
+        server itself serves every endpoint under any role. Two new
+        POST endpoints ride along regardless of role:
+        ``/api/migrate`` accepts a serialized primed-row bundle
+        (serve/migrate.py) and answers with the seated row's SSE
+        stream; ``/admin/evacuate`` asks the continuous scheduler to
+        export every exportable in-flight row (drain-evacuation — each
+        row's bundle rides its own stream's final record) and returns
+        the count."""
         self.backend = backend
+        if role is None:
+            role = "mixed"
+        if role not in protocol.SERVER_ROLES:
+            raise ValueError(
+                f"role must be one of {protocol.SERVER_ROLES}, got {role!r}"
+            )
+        self.role = role
         self.default_priority = (
             int(default_priority)
             if default_priority is not None
@@ -527,6 +549,7 @@ class GenerationServer:
                     "status": "ok",
                     "backend": type(server.backend).__name__,
                     "scheduler": server.scheduler_mode,
+                    "role": server.role,
                     "queue_depth": 0,
                     "inflight_rows": 0,
                 }
@@ -613,6 +636,13 @@ class GenerationServer:
                     self._handle_generate(body)
                 elif self.path == protocol.LOAD_PATH:
                     self._handle_load(body)
+                elif self.path == protocol.MIGRATE_PATH:
+                    self._handle_migrate(body)
+                elif (
+                    self.path.split("?", 1)[0]
+                    == protocol.ADMIN_EVACUATE_PATH
+                ):
+                    self._handle_evacuate()
                 else:
                     self._send_json(404, {"error": f"unknown path {self.path}"})
 
@@ -648,13 +678,21 @@ class GenerationServer:
                     # parent link a timeline viewer stitches on
                     span_attrs["parent_hop"] = request.trace.parent
                 if body.get("stream"):
+                    # Disagg prime (ISSUE 18): x_prime rides the raw
+                    # body (request_from_wire ignores unknown keys) —
+                    # run prefill to completion, export the row, answer
+                    # with a final record carrying the bundle. Only the
+                    # continuous scheduler speaks it; anything else
+                    # decays to a normal stream (the router treats the
+                    # absence of a bundle as "serve it here").
+                    prime = bool(body.get(protocol.PRIME_KEY))
                     with TRACER.span(
                         "request",
                         trace_id=request.trace.trace_id,
                         stream=True,
                         **span_attrs,
                     ):
-                        self._handle_generate_stream(request)
+                        self._handle_generate_stream(request, prime=prime)
                     return
                 # The request's ROOT span: the scheduler's queue span and
                 # the engine's prefill/decode spans parent under it (the
@@ -734,7 +772,7 @@ class GenerationServer:
                 final["x_text"] = result.text
                 return final
 
-            def _handle_generate_stream(self, request) -> None:
+            def _handle_generate_stream(self, request, prime=False) -> None:
                 """``stream: true``: Server-Sent Events of incremental
                 ``response`` deltas ending with a ``done: true`` event
                 carrying the aggregate stats + extras (energy payload
@@ -747,11 +785,11 @@ class GenerationServer:
                     server._scheduler is not None
                     and server.scheduler_mode in ("continuous", "fleet")
                 ):
-                    self._stream_via_scheduler(request)
+                    self._stream_via_scheduler(request, prime=prime)
                 else:
                     self._stream_serial(request)
 
-            def _stream_via_scheduler(self, request) -> None:
+            def _stream_via_scheduler(self, request, prime=False) -> None:
                 """Streaming delivery (ISSUE 6): the scheduler's slice
                 loop produces into the bounded egress channel; this
                 handler drains it onto the SSE socket. A failed socket
@@ -759,10 +797,19 @@ class GenerationServer:
                 row within one decode slice (``reason="cancelled"``) and
                 its pages return to the pool."""
                 try:
-                    channel = server._scheduler.submit_stream(request)
+                    if prime and hasattr(server._scheduler, "submit_prime"):
+                        channel = server._scheduler.submit_prime(request)
+                    else:
+                        channel = server._scheduler.submit_stream(request)
                 except RuntimeError as exc:
                     self._send_json(503, {"error": str(exc)})
                     return
+                self._pump_channel(channel, request.model)
+
+            def _pump_channel(self, channel, model) -> None:
+                """Drain one egress channel onto the SSE socket — the
+                shared tail of /api/generate streaming and the migrate
+                endpoint's seated-row stream."""
                 events = channel.events(keepalive_s=STREAM_KEEPALIVE_S)
                 # Headers wait for the first REAL event, so fast
                 # pre-admission failures (bad prompt, unknown model,
@@ -789,7 +836,7 @@ class GenerationServer:
                         if event.kind == "delta":
                             self._write_sse_chunk(
                                 protocol.stream_chunk_to_wire(
-                                    request.model, event.text, event.tokens
+                                    model, event.text, event.tokens
                                 )
                             )
                         elif event.kind == "done":
@@ -928,6 +975,91 @@ class GenerationServer:
                     self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
                 else:
                     self._send_json(200, {"status": "loaded", "model": model})
+
+            def _handle_migrate(self, body) -> None:
+                """``POST /api/migrate`` (ISSUE 18): seat one serialized
+                primed/evacuated row (serve/migrate.py bundle) into the
+                continuous scheduler and answer with the row's SSE
+                stream — the same framing /api/generate streams, so the
+                router relays it to the waiting client unchanged."""
+                sched = server._scheduler
+                if sched is None or not hasattr(sched, "submit_migrate"):
+                    self._send_json(
+                        503,
+                        {
+                            "error": (
+                                "migrate requires the continuous "
+                                "scheduler (got "
+                                f"{server.scheduler_mode!r})"
+                            )
+                        },
+                    )
+                    return
+                # The bundle's embedded request carries the fleet-wide
+                # trace (x_trace) when the router stamped one — the
+                # seated row's spans and flight events join it, so one
+                # trace id covers both replicas' halves of the request.
+                span_kwargs = {"model": body.get("model", "")}
+                req_wire = body.get("request")
+                xt = (
+                    req_wire.get("x_trace")
+                    if isinstance(req_wire, dict)
+                    else None
+                )
+                if isinstance(xt, dict) and xt.get("id"):
+                    span_kwargs["trace_id"] = str(xt["id"])
+                with TRACER.span(
+                    "request", stream=True, migrated=True, **span_kwargs
+                ):
+                    try:
+                        channel = sched.submit_migrate(body)
+                    except (ValueError, KeyError, TypeError) as exc:
+                        self._send_json(
+                            400, {"error": f"bad migrate bundle: {exc}"}
+                        )
+                        return
+                    except RuntimeError as exc:
+                        self._send_json(503, {"error": str(exc)})
+                        return
+                    self._pump_channel(channel, body.get("model", ""))
+
+            def _handle_evacuate(self) -> None:
+                """``POST /admin/evacuate`` (ISSUE 18): export every
+                exportable in-flight row as a migrate bundle (each rides
+                its own stream's final record) and report the count —
+                the router's drain(migrate=True) calls this on remote
+                replicas before waiting out whatever refused to move."""
+                sched = server._scheduler
+                if sched is None or not hasattr(sched, "evacuate"):
+                    self._send_json(
+                        503,
+                        {
+                            "error": (
+                                "evacuate requires the continuous "
+                                "scheduler (got "
+                                f"{server.scheduler_mode!r})"
+                            )
+                        },
+                    )
+                    return
+                query = parse_qs(
+                    self.path.partition("?")[2], keep_blank_values=False
+                )
+                try:
+                    timeout_s = float(query.get("timeout", ["30"])[0])
+                except ValueError:
+                    self._send_json(
+                        400, {"error": "timeout must be a number"}
+                    )
+                    return
+                try:
+                    count = sched.evacuate(timeout_s=timeout_s)
+                except Exception as exc:  # noqa: BLE001 — admin probe
+                    self._send_json(
+                        500, {"error": f"{type(exc).__name__}: {exc}"}
+                    )
+                    return
+                self._send_json(200, {"status": "ok", "evacuated": count})
 
         return Handler
 

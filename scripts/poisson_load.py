@@ -533,6 +533,14 @@ def _record_result(rec, result, t_submit, t_done, start) -> None:
         rec["replica"] = router["replica"]
         if router.get("retried"):
             rec["retried"] = router["retried"]
+        # fleet-role attribution (ISSUE 18): the role of the replica
+        # that FINISHED the row — a disagg-migrated row lands on its
+        # decode side, so the per-role breakdown reads where tokens
+        # actually streamed from
+        if router.get("role") is not None:
+            rec["role"] = router["role"]
+    if sched.get("migrated"):
+        rec["migrated"] = True
     # per-request energy attribution when the serving path computed one
     # (window/solo scheduling): the client-side joules_per_token SLO
     # check (ISSUE 17) reads this
@@ -762,6 +770,39 @@ def summarize(records: List[Dict], slo=None) -> Dict:
         retried = sum(1 for r in ok if r.get("retried"))
         if retried:
             out["retried"] = retried
+    # disagg migration attribution (ISSUE 18): rows that prefilled on
+    # one replica and streamed from another — the count says how much
+    # of the trace actually exercised the transfer path
+    migrated = sum(1 for r in ok if r.get("migrated"))
+    if migrated:
+        out["migrated"] = migrated
+    # per-role percentile breakdown (ISSUE 18): present when a role
+    # fleet answered (any stamped role beyond plain "mixed") — the
+    # prefill/decode split of the SAME figures the per-replica block
+    # carries, so a disagg A/B reads TTFT-by-role from one summary
+    roles = sorted({r["role"] for r in ok if r.get("role") is not None})
+    if roles and (len(roles) > 1 or roles != ["mixed"]):
+        by_role = {}
+        for name in roles:
+            rl_recs = [r for r in ok if r.get("role") == name]
+            rl_done = [r for r in rl_recs if not r.get("cancelled")]
+            rl_ttfts = [
+                r["ttft_s"] for r in rl_recs if r.get("ttft_s") is not None
+            ]
+            rl_comps = [r["completion_s"] for r in rl_done]
+            entry = {
+                "requests": len(rl_recs),
+                "tokens": sum(r["tokens"] for r in rl_recs),
+                "migrated": sum(1 for r in rl_recs if r.get("migrated")),
+                "completion_p50_s": round(percentile(rl_comps, 50), 4),
+                "completion_p95_s": round(percentile(rl_comps, 95), 4),
+            }
+            if rl_ttfts:
+                entry["ttft_p50_s"] = round(percentile(rl_ttfts, 50), 4)
+                entry["ttft_p95_s"] = round(percentile(rl_ttfts, 95), 4)
+                entry["ttft_p99_s"] = round(percentile(rl_ttfts, 99), 4)
+            by_role[name] = entry
+        out["roles"] = by_role
     # Trace forensics (ISSUE 13): the trace ids of every request that
     # went wrong — paste one into the router's GET /debug/timeline?trace=
     # (or a replica's /debug/flight?trace=) to replay its whole

@@ -1844,6 +1844,142 @@ def main() -> int:
     finally:
         server15.stop()
 
+    # -- phase 16: disaggregated prefill/decode fleet (ISSUE 18) ---------------
+    # A real role fleet over HTTP: one fake prefill GenerationServer +
+    # one fake decode GenerationServer as RemoteReplicas behind the
+    # front-door router. A traced long-prompt ticket PRIMES on the
+    # prefill side, ships through POST /api/migrate and completes its
+    # FULL stream from the decode side — one uninterrupted client
+    # stream. Asserts: /healthz self-reported roles adopted by the
+    # router's probes; row_migrated flight events trace-linked on BOTH
+    # replicas' /debug/flight rings with the right src/dst; the
+    # llm_migrate_bytes_total out/in counters move symmetrically; and
+    # the wasted cause=migration Joules on the wire
+    # (x_extras.energy.wasted_J.migration) agree with the ledger's
+    # counter delta.
+    def wasted_migration_joules():
+        fam = REGISTRY.snapshot().get(
+            "llm_request_wasted_joules_total", {}
+        )
+        return float(fam.get("cause=migration", 0.0))
+
+    def migrate_counters():
+        snap = REGISTRY.snapshot()
+        rows = snap.get("llm_migrate_rows_total", {})
+        nbytes = snap.get("llm_migrate_bytes_total", {})
+        return (
+            float(rows.get("reason=disagg", 0.0)),
+            float(nbytes.get("direction=out", 0.0)),
+            float(nbytes.get("direction=in", 0.0)),
+        )
+
+    backend16_p = FakeBackend(tokens_per_s=400.0, simulate_delay=True)
+    backend16_d = FakeBackend(tokens_per_s=400.0, simulate_delay=True)
+    server16_p = GenerationServer(
+        backend16_p, host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous", role="prefill",
+    )
+    server16_d = GenerationServer(
+        backend16_d, host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous", role="decode",
+    )
+    server16_p.start()
+    server16_d.start()
+    base16_p = f"http://127.0.0.1:{server16_p.port}"
+    base16_d = f"http://127.0.0.1:{server16_d.port}"
+    router16 = Router(
+        [
+            RemoteReplica("pf", base16_p),
+            RemoteReplica("dc", base16_d),
+        ],
+        probe_interval_s=30.0,
+    )
+    server16 = RouterServer(router16, host="127.0.0.1", port=0, quiet=True)
+    server16.start()
+    try:
+        base16 = f"http://127.0.0.1:{server16.port}"
+        # each replica declares its role on /healthz; one probe sweep
+        # classifies the membership
+        hz16 = _get_json(base16_p, "/healthz")
+        assert hz16.get("role") == "prefill", hz16
+        assert _get_json(base16_d, "/healthz").get("role") == "decode"
+        router16.probe_now()
+        roles16 = _get_json(base16, "/healthz")["replica_roles"]
+        assert roles16 == {"prefill": 1, "decode": 1}, roles16
+
+        rows_0, out_0, in_0 = migrate_counters()
+        wasted_0 = wasted_migration_joules()
+
+        tid16 = mint_trace_id()
+        client16 = RemoteHTTPBackend(base16)
+        long_prompt16 = "the disaggregated long prompt " * 24
+        chunks16 = list(
+            client16.generate_stream(
+                _GenReq(
+                    "smoke:1b",
+                    long_prompt16,
+                    max_new_tokens=64,
+                    trace=TraceContext(trace_id=tid16),
+                )
+            )
+        )
+        final16 = chunks16[-1].result
+        assert final16 is not None, "disagg stream lost"
+        streamed16 = sum(len(c.tokens) for c in chunks16 if not c.done)
+        assert final16.generated_tokens == 64, final16.generated_tokens
+        assert streamed16 == 64, streamed16
+        sched16 = final16.extras["sched"]
+        route16 = final16.extras["router"]
+        assert sched16.get("migrated") is True, sched16
+        assert route16["replica"] == "dc", route16
+        assert route16["role"] == "decode", route16
+
+        rows_1, out_1, in_1 = migrate_counters()
+        assert rows_1 - rows_0 >= 1, (rows_0, rows_1)
+        moved16 = out_1 - out_0
+        assert moved16 > 0 and moved16 == in_1 - in_0, (
+            "migrate byte counters not symmetric",
+            out_0, out_1, in_0, in_1,
+        )
+
+        # wire-vs-ledger: the transfer Joules the client saw must agree
+        # with what the wasted-energy ledger charged this phase (the
+        # ledger counter quantizes at 1e-6 J; the wire stamp at 1e-9)
+        wire_j16 = final16.extras["energy"]["wasted_J"]["migration"]
+        ledger_j16 = wasted_migration_joules() - wasted_0
+        assert abs(wire_j16 - ledger_j16) < 1e-6, (wire_j16, ledger_j16)
+
+        # trace-linked row_migrated events visible on BOTH replicas'
+        # flight rings: export (out) on the prefill side, seat (in) on
+        # the decode side, each carrying the caller's trace id
+        ev16_p = _get_json(
+            base16_p, f"/debug/flight?type=row_migrated&trace={tid16}"
+        )["events"]
+        ev16_d = _get_json(
+            base16_d, f"/debug/flight?type=row_migrated&trace={tid16}"
+        )["events"]
+        dirs16_p = {e.get("direction") for e in ev16_p}
+        dirs16_d = {e.get("direction") for e in ev16_d}
+        assert "out" in dirs16_p, ev16_p
+        assert "in" in dirs16_d, ev16_d
+        seat16 = [e for e in ev16_d if e.get("direction") == "in"]
+        assert any(e.get("reason") == "disagg" for e in seat16), seat16
+        transfer16 = [
+            e
+            for e in _get_json(
+                base16, f"/debug/flight?type=row_migrated&trace={tid16}"
+            )["events"]
+            if e.get("direction") == "transfer"
+        ]
+        assert any(
+            e.get("src") == "pf" and e.get("dst") == "dc"
+            for e in transfer16
+        ), transfer16
+    finally:
+        server16.stop()
+        server16_p.stop()
+        server16_d.stop()
+
     print(
         json.dumps(
             {
@@ -1937,6 +2073,15 @@ def main() -> int:
                     "attainment": gauge15,
                     "replica_recompute_agrees": True,
                     "timeseries_dump": ts_out,
+                },
+                "pd_disagg": {
+                    "roles": roles16,
+                    "migrated_trace": tid16,
+                    "streamed_tokens_from_decode": streamed16,
+                    "migrate_bytes_moved": moved16,
+                    "bytes_symmetric": True,
+                    "wasted_migration_joules": round(wire_j16, 9),
+                    "wire_ledger_agrees": True,
                 },
             }
         )

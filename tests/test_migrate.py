@@ -1,0 +1,476 @@
+"""Live prefill/decode row migration (ISSUE 18).
+
+Three layers pinned here:
+
+- the bundle codec (serve/migrate.py): JSON-wire-safe round-trips,
+  export refusals for rows that must not leave their replica;
+- the router's disagg pipeline + drain evacuation + fallback machinery
+  over hermetic ``FakeBackend`` fleets: role-aware dispatch, one
+  uninterrupted client stream with exact token parity, the retry/
+  wasted-energy accounting, and the never-drop-a-ticket guarantees;
+- the real engine at session level: a row preempted on one engine,
+  shipped through the wire codec and seated on ANOTHER engine's
+  session produces the bit-exact solo token stream on every cache
+  layout, with both pools' page free counts restored exactly.
+"""
+
+import json
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+    FakeBackend,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+    JaxEngine,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+    MIGRATE_BYTES_C,
+    MIGRATE_ROWS_C,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import (
+    router as router_mod,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.migrate import (
+    MigrateRefused,
+    bundle_nbytes,
+    export_bundle,
+    import_bundle,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.router import (
+    LocalReplica,
+    Router,
+)
+
+def _req(prompt="migrate me", n=24, **kw):
+    return GenerationRequest("m", prompt, max_new_tokens=n, **kw)
+
+
+def _reference_tokens(request):
+    """Single-replica reference stream for exact-parity checks."""
+    ref = LocalReplica("ref", FakeBackend())
+    router = Router([ref], probe_interval_s=999)
+    try:
+        return [
+            t
+            for ch in router.dispatch_stream(request)
+            if not ch.done
+            for t in ch.tokens
+        ]
+    finally:
+        router.stop()
+
+
+def _collect(router, request):
+    toks, final = [], None
+    for ch in router.dispatch_stream(request):
+        if ch.done:
+            final = ch.result
+        else:
+            toks.extend(ch.tokens)
+    return toks, final
+
+
+def _rows(reason):
+    return MIGRATE_ROWS_C.labels(reason=reason).value
+
+
+def _bytes(direction):
+    return MIGRATE_BYTES_C.labels(direction=direction).value
+
+
+# -- bundle codec --------------------------------------------------------------
+
+
+def test_fake_bundle_json_roundtrip():
+    backend = FakeBackend()
+    req = _req(n=16, seed=3)
+    result = backend._result(req)
+    pr = {
+        "request": req,
+        "row": {"streamed": 4},
+        "generated": result.tokens[:9],
+        "prompt_len": 5,
+        "policy": "swap",
+        "host_bytes": 123,
+    }
+    bundle = json.loads(json.dumps(export_bundle(pr, reason="disagg")))
+    assert bundle["kind"] == "fake" and bundle_nbytes(bundle) == 123
+    # disagg primes override the stream watermark to 0 explicitly
+    assert bundle["streamed"] == 4
+    pr2 = import_bundle(bundle, backend)
+    assert pr2["generated"] == result.tokens[:9]
+    assert pr2["row"]["streamed"] == 4
+    assert pr2["host_bytes"] == 0 and pr2["discharged"]
+
+
+def test_export_refuses_shared_prefix_and_spec_rows():
+    class _Stub:
+        shared_pages = [1, 2]
+        draft_blob = None
+
+    with pytest.raises(MigrateRefused):
+        export_bundle(_Stub())
+
+    class _Spec:
+        shared_pages = []
+        draft_blob = object()
+
+    with pytest.raises(MigrateRefused):
+        export_bundle(_Spec())
+
+
+def test_import_rejects_unknown_version():
+    with pytest.raises(ValueError):
+        import_bundle({"version": 99, "kind": "fake"})
+
+
+# -- role-aware dispatch -------------------------------------------------------
+
+
+def test_decode_only_fleet_refuses_fresh_work():
+    router = Router(
+        [LocalReplica("d0", FakeBackend(), role="decode")],
+        probe_interval_s=999,
+    )
+    try:
+        with pytest.raises(RuntimeError, match="no healthy replica"):
+            router.dispatch(_req())
+    finally:
+        router.stop()
+
+
+def test_fresh_work_never_lands_on_decode_replica():
+    mixed = LocalReplica("mx", FakeBackend())
+    dec = LocalReplica("dc", FakeBackend(), role="decode")
+    router = Router([mixed, dec], probe_interval_s=999)
+    try:
+        before = router_mod._DISPATCH_C.labels(
+            replica="dc", policy=router.policy
+        ).value
+        for i in range(6):
+            router.dispatch(_req(prompt=f"p{i}", n=4))
+        after = router_mod._DISPATCH_C.labels(
+            replica="dc", policy=router.policy
+        ).value
+        # the decode replica services migrate-ins only; every fresh
+        # ticket of a pure-generate workload lands elsewhere. (A
+        # prefill+decode fleet WOULD dispatch to it via the relay —
+        # that path increments on the migrate seat, tested below.)
+        assert after == before
+        roles = router.health_state()["replica_roles"]
+        assert roles == {"mixed": 1, "decode": 1}
+    finally:
+        router.stop()
+
+
+def test_replica_role_validation():
+    with pytest.raises(ValueError):
+        LocalReplica("bad", FakeBackend(), role="bogus")
+
+
+# -- disagg pipeline (fake fleet) ----------------------------------------------
+
+
+def test_disagg_fleet_exact_parity_and_attribution():
+    """1 prefill + 1 decode: the client sees ONE uninterrupted stream
+    with the exact single-replica token sequence; attribution says the
+    row migrated; the energy ledger charged the transfer at 2x bundle
+    bytes; the byte counters are symmetric."""
+    req = _req(prompt="disagg parity probe", n=40, seed=11)
+    expect = _reference_tokens(req)
+    rows0, out0, in0 = _rows("disagg"), _bytes("out"), _bytes("in")
+    router = Router(
+        [
+            LocalReplica("p", FakeBackend(), role="prefill"),
+            LocalReplica("d", FakeBackend(), role="decode"),
+        ],
+        probe_interval_s=999,
+    )
+    try:
+        toks, final = _collect(router, req)
+        assert toks == expect and final is not None
+        ex = final.extras or {}
+        assert ex["router"]["replica"] == "d"
+        assert ex["sched"]["migrated"] is True
+        wasted = ex["energy"]["wasted_J"]["migration"]
+        moved_out, moved_in = _bytes("out") - out0, _bytes("in") - in0
+        assert moved_out == moved_in > 0
+        assert wasted == pytest.approx(2.0 * moved_out * 1e-9)
+        assert _rows("disagg") == rows0 + 1
+    finally:
+        router.stop()
+
+
+def test_receiver_death_falls_back_to_source_local_decode():
+    """The decode replica dies at seat time: the primed row decodes
+    locally on the prefill replica — exact parity, one migrate_failed
+    retry, never a dropped ticket."""
+    req = _req(prompt="fallback probe", n=24, seed=5)
+    expect = _reference_tokens(req)
+    dead = FakeBackend()
+    dead.fail_decode_open = True
+    retries0 = router_mod._RETRIES_C.labels(reason="migrate_failed").value
+    router = Router(
+        [
+            LocalReplica("p", FakeBackend(), role="prefill"),
+            LocalReplica("d", dead, role="decode"),
+        ],
+        probe_interval_s=999,
+    )
+    try:
+        toks, final = _collect(router, req)
+        assert toks == expect
+        assert final.extras["router"]["replica"] == "p"
+        assert (
+            router_mod._RETRIES_C.labels(reason="migrate_failed").value
+            == retries0 + 1
+        )
+    finally:
+        router.stop()
+
+
+def test_drain_migrate_evacuates_mid_stream_cursor_survives():
+    """``drain(migrate=True)`` mid-stream: the in-flight row moves to
+    the survivor and the CLIENT's stream continues where it stopped —
+    the spliced stream is the exact uninterrupted sequence."""
+    req = _req(prompt="drain evacuation probe", n=60, seed=9)
+    expect = _reference_tokens(req)
+    rows0 = _rows("drain")
+    fleet = [
+        LocalReplica(
+            "a", FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+        ),
+        LocalReplica(
+            "b", FakeBackend(tokens_per_s=200.0, simulate_delay=True)
+        ),
+    ]
+    router = Router(fleet, probe_interval_s=999)
+    toks, final, err = [], [None], [None]
+
+    def consume():
+        try:
+            for ch in router.dispatch_stream(req):
+                if ch.done:
+                    final[0] = ch.result
+                else:
+                    toks.extend(ch.tokens)
+        except BaseException as exc:  # noqa: BLE001
+            err[0] = exc
+
+    t = threading.Thread(target=consume)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while len(toks) < 10 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(toks) >= 10, "stream never started"
+        victim = next(r.name for r in fleet if r.outstanding > 0)
+        survivor = next(r.name for r in fleet if r.name != victim)
+        assert router.drain(victim, timeout_s=20.0, migrate=True)
+        t.join(timeout=30.0)
+        assert not t.is_alive() and err[0] is None
+        assert toks == expect, "spliced stream is not the solo sequence"
+        assert final[0].extras["router"]["replica"] == survivor
+        assert final[0].extras["sched"]["migrated"] is True
+        assert _rows("drain") == rows0 + 1
+        assert victim not in [r.name for r in router.replicas()]
+    finally:
+        t.join(timeout=5.0)
+        router.stop()
+
+
+def test_spec_active_prime_decays_to_local_stream():
+    """A speculating session never exports (draft state is engine-
+    bound): the prime decays to a normal local stream on the prefill
+    replica — full answer, no migration counters moved."""
+    req = _req(prompt="spec prime decay", n=24, seed=2)
+    ref = LocalReplica("sref", FakeBackend(spec_k=2))
+    ref_router = Router([ref], probe_interval_s=999)
+    try:
+        expect = [
+            t
+            for ch in ref_router.dispatch_stream(req)
+            if not ch.done
+            for t in ch.tokens
+        ]
+    finally:
+        ref_router.stop()
+    rows0 = _rows("disagg")
+    router = Router(
+        [
+            LocalReplica("p", FakeBackend(spec_k=2), role="prefill"),
+            LocalReplica("d", FakeBackend(spec_k=2), role="decode"),
+        ],
+        probe_interval_s=999,
+    )
+    try:
+        toks, final = _collect(router, req)
+        assert toks == expect
+        assert final.extras["router"]["replica"] == "p"
+        assert "migrated" not in (final.extras.get("sched") or {})
+        assert _rows("disagg") == rows0
+    finally:
+        router.stop()
+
+
+# -- real engine: cross-engine seating parity ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def engines():
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    cache = {}
+
+    def get(tag, paged, kvq):
+        key = (tag, paged, kvq)
+        if key not in cache:
+            cache[key] = JaxEngine(
+                registry=dict(registry),
+                dtype=jnp.float32,
+                paged_kv=paged,
+                kv_quantize=kvq,
+            )
+        return cache[key]
+
+    return get
+
+
+LAYOUTS = [
+    pytest.param(False, None, id="contig-bf16"),
+    pytest.param(False, "int8", id="contig-int8"),
+    pytest.param(True, None, id="paged-bf16"),
+    pytest.param(True, "int8", id="paged-int8"),
+]
+
+
+def _drain_into(sess, results):
+    # keyed by prompt: a migrated row's request is REBUILT from the
+    # bundle's wire form, so object identity does not survive the trip
+    while sess.active:
+        for res in sess.step(8):
+            results[res.request.prompt] = res
+
+
+@pytest.mark.parametrize("paged,kvq", LAYOUTS)
+def test_real_migrate_token_parity_all_layouts(engines, paged, kvq):
+    """A row preempted on the SOURCE engine, shipped through the JSON
+    wire codec and seated on a DIFFERENT engine's session finishes
+    with the bit-exact solo token stream; page free counts restore
+    exactly on BOTH pools; the source's swap ledger settles at export
+    (the import is charge-free)."""
+    src = engines("src", paged, kvq)
+    dst = engines("dst", paged, kvq)
+    anchor_s = GenerationRequest(
+        "tiny", "source anchor decodes on", max_new_tokens=24,
+        stop_at_eos=False,
+    )
+    anchor_d = GenerationRequest(
+        "tiny", "destination anchor row", max_new_tokens=24,
+        stop_at_eos=False,
+    )
+    victim = GenerationRequest(
+        "tiny", "the migrating row", max_new_tokens=20,
+        stop_at_eos=False, seed=13, priority=0,
+    )
+    solo = src.generate(victim).tokens
+    s_sess = src.decode_open([anchor_s, victim], reserve_rows=4)
+    d_sess = dst.decode_open([anchor_d], reserve_rows=4)
+    s_idle = s_sess.pool.n_pages - 1 if paged else None
+    d_idle = d_sess.pool.n_pages - 1 if paged else None
+    s_sess.step(4)
+    d_sess.step(2)
+    free_s = s_sess.pool.free_pages if paged else None
+    free_d = d_sess.pool.free_pages if paged else None
+
+    pr = s_sess.preempt(victim, policy="swap")
+    assert pr is not None
+    bundle = export_bundle(pr, reason="disagg", streamed=0)
+    s_sess.resume_discard(pr)  # the SOURCE settles the swap ledger
+    if paged:
+        # every page the victim held is back on the source free list
+        assert s_sess.pool.free_pages == free_s + pr.n_own_pages
+
+    # the wire trip: the bundle must survive JSON serialization intact
+    bundle = json.loads(json.dumps(bundle))
+    assert bundle["kind"] == "real" and bundle_nbytes(bundle) > 0
+    pr2 = import_bundle(bundle)
+    assert pr2.host_bytes == 0 and pr2.discharged
+    assert d_sess.can_resume(pr2)
+    pend = d_sess.resume_begin(pr2, 64)
+    while not d_sess.join_step(pend):
+        pass
+    d_sess.join_commit(pend)
+    if paged:
+        assert d_sess.pool.free_pages < free_d  # pages actually seated
+
+    results_s, results_d = {}, {}
+    _drain_into(s_sess, results_s)
+    _drain_into(d_sess, results_d)
+    assert results_d[victim.prompt].tokens == solo
+    assert results_s[anchor_s.prompt].tokens == src.generate(anchor_s).tokens
+    s_sess.close()
+    d_sess.close()
+    if paged:
+        assert s_sess.pool.free_pages == s_idle
+        assert d_sess.pool.free_pages == d_idle
+
+
+def test_real_receiver_failure_falls_back_to_source_seat(engines):
+    """Receiver dies mid-transfer: the destination pool never moves,
+    and the exported bundle seats back on the SOURCE session (the
+    router's fallback path) — exact parity, both pools restored."""
+    src = engines("src", True, None)
+    dst = engines("dst", True, None)
+    anchor = GenerationRequest(
+        "tiny", "anchor keeps the session open", max_new_tokens=28,
+        stop_at_eos=False,
+    )
+    victim = GenerationRequest(
+        "tiny", "fallback migrating row", max_new_tokens=18,
+        stop_at_eos=False, seed=21, priority=0,
+    )
+    solo = src.generate(victim).tokens
+    s_sess = src.decode_open([anchor, victim], reserve_rows=4)
+    d_sess = dst.decode_open(
+        [
+            GenerationRequest(
+                "tiny", "destination anchor", max_new_tokens=8,
+                stop_at_eos=False,
+            )
+        ],
+        reserve_rows=4,
+    )
+    s_idle = s_sess.pool.n_pages - 1
+    s_sess.step(4)
+    free_d = d_sess.pool.free_pages
+
+    pr = s_sess.preempt(victim, policy="swap")
+    bundle = json.loads(json.dumps(export_bundle(pr, reason="disagg")))
+    s_sess.resume_discard(pr)
+
+    # receiver "dies": nothing is ever seated on the destination
+    assert d_sess.pool.free_pages == free_d
+
+    pr_back = import_bundle(bundle)
+    assert s_sess.can_resume(pr_back)
+    pend = s_sess.resume_begin(pr_back, 64)
+    while not s_sess.join_step(pend):
+        pass
+    s_sess.join_commit(pend)
+    results = {}
+    _drain_into(s_sess, results)
+    assert results[victim.prompt].tokens == solo
+    s_sess.close()
+    d_sess.close()
+    assert s_sess.pool.free_pages == s_idle
+    assert d_sess.pool.free_pages == d_sess.pool.n_pages - 1
