@@ -92,6 +92,58 @@ class _FakePrefixStore:
             "host_budget_bytes": self.host_bytes,
         }
 
+    def digest(self, max_prefixes=None, max_hashes=None) -> dict:
+        """The hermetic twin of ``RadixPrefixStore.digest`` (ISSUE 19
+        affinity routing): published prompt byte-streams re-tokenized
+        with the ByteTokenizer convention (BOS + byte+3 — the id stream
+        a real byte-tokenizer engine would have published), chunk-hashed
+        at the fake page width. Same bounded shape, same hash, so the
+        router's probe-side estimator needs no fake-awareness."""
+        from .radix_store import (
+            DIGEST_MAX_HASHES,
+            DIGEST_MAX_PREFIXES,
+            prefix_chunk_hashes,
+        )
+
+        max_prefixes = (
+            DIGEST_MAX_PREFIXES if max_prefixes is None else max_prefixes
+        )
+        max_hashes = DIGEST_MAX_HASHES if max_hashes is None else max_hashes
+        ranked = sorted(
+            self._entries, key=lambda e: -e["stamp"]
+        )[: max(0, int(max_prefixes))]
+        entries = []
+        for e in ranked:
+            ids = [1] + [b + 3 for b in e["prompt"]]
+            entries.append(
+                {
+                    "model": None,  # the fake serves any model name
+                    "page": FAKE_PREFIX_PAGE,
+                    "h": prefix_chunk_hashes(
+                        ids, FAKE_PREFIX_PAGE, max_hashes
+                    ),
+                    "tokens": len(ids),
+                }
+            )
+        return {"v": 1, "entries": entries}
+
+    def peek(self, prompt: bytes) -> int:
+        """Read-only longest-common-prefix lookup — no publication, no
+        stamp refresh, no counters. The chunked-join prefill planner's
+        view: mapped prefix tokens are NOT re-prefilled (the real
+        session maps the shared pages and computes only the divergent
+        tail), while the probe/publication accounting stays at admit
+        time where an aborted join never reaches."""
+        best = 0
+        for e in self._entries:
+            pub = e["prompt"]
+            n = min(len(pub), len(prompt), len(prompt) - 1)
+            common = 0
+            while common < n and pub[common] == prompt[common]:
+                common += 1
+            best = max(best, common)
+        return best
+
     def probe(self, prompt: bytes) -> dict:
         """Longest published common prefix (cross-session), restoring a
         spilled entry on hit; then publish ``prompt`` and enforce the
@@ -231,6 +283,11 @@ class _FakeStepSession:
         )
         self.spec_active = self.spec_k > 0
         self.spec_fallback = False
+        # adaptive draft-k twin (ISSUE 19): the configured length —
+        # step() shrinks spec_k toward 1 below the floor instead of
+        # falling back, and restores toward spec_k0 on recovery
+        # (re-read acceptance each slice so tests can move it live)
+        self.spec_k0 = self.spec_k
         # streaming egress twins of SteppedDecodeSession's: the scheduler
         # flips stream_tokens on while any live ticket streams, and
         # retired rows buffer their unstreamed tails for the next
@@ -331,10 +388,20 @@ class _FakeStepSession:
             raise RuntimeError("request cannot join this session")
         chunk = max(1, int(chunk_tokens or 256))
         n_prompt = len(request.prompt.encode("utf-8")) + 1
+        # Store-mapped prefix tokens skip prefill (the real chunked
+        # join computes only the divergent tail) — a read-only peek, so
+        # hit/publication accounting still happens exactly once, at
+        # admit (which an aborted join never reaches).
+        store = self.backend.prefix_store
+        mapped = (
+            store.peek(request.prompt.encode("utf-8"))
+            if store is not None
+            else 0
+        )
         pending = {
             "request": request,
             "chunk_tokens": chunk,
-            "tokens_left": n_prompt,
+            "tokens_left": max(1, n_prompt - mapped),
         }
         self._pending.append(pending)
         return pending
@@ -466,6 +533,12 @@ class _FakeStepSession:
     def pending_joins(self) -> int:
         return len(self._pending)
 
+    @property
+    def free_slots(self) -> int:
+        """Open row slots (mirrors the real session's property — the
+        continuous scheduler's admission-headroom signal reads it)."""
+        return self.max_rows - len(self._rows) - len(self._pending)
+
     def debug_state(self) -> dict:
         """JSON-able session snapshot — the fake twin of
         ``SteppedDecodeSession.debug_state`` so ``GET /debug/state`` is
@@ -522,6 +595,31 @@ class _FakeStepSession:
             }
         return state
 
+    def _spec_k_event(
+        self, k_old: int, k_new: int, measured: float
+    ) -> None:
+        """Publish one adaptive draft-length move (counter + flight) —
+        the fake twin of SteppedDecodeSession._spec_set_k's obs tail."""
+        try:
+            from ..obs.flight import EV_SPEC_K_ADAPT, FLIGHT
+            from ..obs.metrics import SPEC_K_ADAPT_C
+
+            SPEC_K_ADAPT_C.labels(
+                source=self.spec_source,
+                direction="down" if k_new < k_old else "up",
+            ).inc()
+            FLIGHT.emit(
+                EV_SPEC_K_ADAPT,
+                model=self.model,
+                source=self.spec_source,
+                k_from=k_old,
+                k_to=k_new,
+                acceptance=round(measured, 4),
+                floor=self.spec_accept_floor,
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
     def step(self, max_steps: int = 16) -> List[GenerationResult]:
         if self.closed:
             raise RuntimeError("session is closed")
@@ -544,6 +642,18 @@ class _FakeStepSession:
         # spec_sampled_acceptance — the hermetic stand-in for rejection
         # resampling's acceptance rate (ISSUE 16).
         if self.spec_active and self._rows:
+            # live re-read (adaptive draft-k twin): tests move the
+            # backend's synthetic acceptance mid-session to walk the
+            # session through shrink → recover → restore
+            self.spec_acceptance = float(self.backend.spec_acceptance)
+            sampled_acc = getattr(
+                self.backend, "spec_sampled_acceptance", None
+            )
+            self.spec_sampled_acceptance = (
+                self.spec_acceptance
+                if sampled_acc is None
+                else float(sampled_acc)
+            )
             tot_accepted = tot_drafted = tot_rejected = 0
             for row in self._rows:
                 sampled = row["request"].temperature > 0
@@ -610,23 +720,46 @@ class _FakeStepSession:
             except Exception:  # noqa: BLE001 — telemetry only
                 pass
             floor = self.spec_accept_floor
-            if floor and tot_drafted and (tot_accepted / tot_drafted) < floor:
-                self.spec_active = False
-                self.spec_fallback = True
-                try:
-                    from ..obs.flight import EV_SPEC_FALLBACK, FLIGHT
-                    from ..obs.metrics import SPEC_FALLBACK_C
+            measured = (
+                tot_accepted / tot_drafted if tot_drafted else None
+            )
+            if floor and measured is not None and measured < floor:
+                if self.spec_k > 1:
+                    # adaptive draft-k (ISSUE 19): shrink before
+                    # abandoning — the real session's halving policy
+                    k_old = self.spec_k
+                    self.spec_k = max(1, self.spec_k // 2)
+                    self._spec_k_event(k_old, self.spec_k, measured)
+                else:
+                    self.spec_active = False
+                    self.spec_fallback = True
+                    try:
+                        from ..obs.flight import EV_SPEC_FALLBACK, FLIGHT
+                        from ..obs.metrics import SPEC_FALLBACK_C
 
-                    SPEC_FALLBACK_C.labels(source=self.spec_source).inc()
-                    FLIGHT.emit(
-                        EV_SPEC_FALLBACK,
-                        model=self.model,
-                        source=self.spec_source,
-                        acceptance=round(tot_accepted / tot_drafted, 4),
-                        floor=floor,
-                    )
-                except Exception:  # noqa: BLE001 — telemetry only
-                    pass
+                        SPEC_FALLBACK_C.labels(
+                            source=self.spec_source
+                        ).inc()
+                        FLIGHT.emit(
+                            EV_SPEC_FALLBACK,
+                            model=self.model,
+                            source=self.spec_source,
+                            acceptance=round(measured, 4),
+                            floor=floor,
+                        )
+                    except Exception:  # noqa: BLE001 — telemetry only
+                        pass
+            elif (
+                floor
+                and measured is not None
+                and self.spec_k < self.spec_k0
+                and measured >= min(0.95, floor + 0.15)
+            ):
+                # recovery: restore toward the configured length (the
+                # same hysteresis band the real session applies)
+                k_old = self.spec_k
+                self.spec_k = min(self.spec_k0, self.spec_k * 2)
+                self._spec_k_event(k_old, self.spec_k, measured)
         retired, keep = [], []
         for row in self._rows:
             row["cursor"] += row.pop("advance", max_steps)

@@ -2514,6 +2514,13 @@ class JaxEngine(GenerationBackend):
         from the target's)."""
         return None
 
+    def _dp_shards(self) -> int:
+        """dp extent of the engine's mesh (ISSUE 19 tp×dp row sharding):
+        1 on the single-device engine; the TP engine reports its mesh's
+        ``dp`` axis so a stepped session can pre-partition its page pool
+        into per-shard ranges matching the carry's row split."""
+        return 1
+
     def _place_carry(
         self, cfg: ModelConfig, carry, draft_cfg: Optional[ModelConfig] = None
     ):

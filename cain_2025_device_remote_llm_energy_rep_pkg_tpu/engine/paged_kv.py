@@ -146,6 +146,13 @@ class PagePool:
     _free: List[int] = dataclasses.field(default_factory=list)
     # page index -> live reference count; absent = on the free list
     _refs: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # dp row sharding (ISSUE 19): pages partition into ``dp_shards``
+    # contiguous equal ranges, aligned with the dp-sharded pool leaf's
+    # page-dim split, so shard-tagged allocations keep a row's pages on
+    # the device shard that owns the row. Locality is BEST-EFFORT — a
+    # starved shard spills into any free page and GSPMD still gathers
+    # correctly — so every refcount/exhaustion contract is unchanged.
+    dp_shards: int = 1
 
     @classmethod
     def create(
@@ -157,6 +164,7 @@ class PagePool:
         page_size: int = DEFAULT_PAGE_SIZE,
         dtype=jnp.bfloat16,
         quantized: bool = False,
+        dp_shards: int = 1,
     ) -> "PagePool":
         shape = (n_layers, n_pages, n_kv_heads, page_size, d_head)
 
@@ -173,9 +181,25 @@ class PagePool:
             v=leaf(),
             page_size=page_size,
             _free=list(range(n_pages)),
+            dp_shards=max(1, int(dp_shards)),
         )
         _publish_pool_gauges(pool._free, n_pages)
         return pool
+
+    def shard_of(self, page: int) -> int:
+        """dp shard owning ``page`` (contiguous equal ranges)."""
+        if self.dp_shards <= 1:
+            return 0
+        return min(
+            page // max(1, self.n_pages // self.dp_shards),
+            self.dp_shards - 1,
+        )
+
+    def free_pages_in(self, shard: int) -> int:
+        """Free pages inside one dp shard's range."""
+        if self.dp_shards <= 1:
+            return len(self._free)
+        return sum(1 for p in self._free if self.shard_of(p) == shard)
 
     @property
     def quantized(self) -> bool:
@@ -226,9 +250,12 @@ class PagePool:
             "fragmentation": round(_fragmentation(self._free), 4),
             "shared_pages": self.shared_pages,
             "payload_bytes": self.payload_nbytes(),
+            "dp_shards": self.dp_shards,
         }
 
-    def alloc(self, n_pages: int) -> List[int]:
+    def alloc(
+        self, n_pages: int, shard: "Optional[int]" = None
+    ) -> List[int]:
         if n_pages > len(self._free):
             _POOL_EXHAUSTED.inc()
             FLIGHT.emit(
@@ -241,19 +268,37 @@ class PagePool:
                 f"need {n_pages} pages, {len(self._free)} free of "
                 f"{self.n_pages} — evict a finished request or grow the pool"
             )
-        pages, self._free = self._free[:n_pages], self._free[n_pages:]
+        if shard is None or self.dp_shards <= 1:
+            # FIFO off the list head — the pre-dp behaviour, bit-exact.
+            pages, self._free = self._free[:n_pages], self._free[n_pages:]
+        else:
+            # Prefer the shard's own range, spill into any free page when
+            # the range is short; free-list order is preserved for the
+            # pages that stay.
+            pages = [p for p in self._free if self.shard_of(p) == shard][
+                :n_pages
+            ]
+            if len(pages) < n_pages:
+                taken = set(pages)
+                pages += [p for p in self._free if p not in taken][
+                    : n_pages - len(pages)
+                ]
+            taken = set(pages)
+            self._free = [p for p in self._free if p not in taken]
         for p in pages:
             self._refs[p] = 1
         _publish_pool_gauges(self._free, self.n_pages, self.shared_pages)
         return pages
 
-    def try_alloc(self, n_pages: int) -> "Optional[List[int]]":
+    def try_alloc(
+        self, n_pages: int, shard: "Optional[int]" = None
+    ) -> "Optional[List[int]]":
         """``alloc`` that returns ``None`` instead of raising when the
         pool is short — the admission-probe path (a continuous-batching
         join that doesn't fit should be deferred, not failed)."""
         if n_pages > len(self._free):
             return None
-        return self.alloc(n_pages)
+        return self.alloc(n_pages, shard=shard)
 
     def share(self, pages: List[int]) -> None:
         """Add one reader to each page (shared-prefix mapping): the page

@@ -103,6 +103,40 @@ STORE_EVICTIONS_C = REGISTRY.counter(
 )
 
 
+# -- prefix digest (ISSUE 19 affinity routing) ---------------------------------
+# Bounds on the /healthz-exported summary: entries are top-level radix
+# prefixes (most-recent first), each hashing at most DIGEST_MAX_HASHES
+# page-sized token chunks — the whole digest stays a few KB however
+# large the store grows (the router probes it once per probe interval).
+DIGEST_MAX_PREFIXES = 32
+DIGEST_MAX_HASHES = 16
+
+
+def prefix_chunk_hashes(
+    ids, page: int, max_hashes: Optional[int] = None
+) -> List[str]:
+    """Stable page-chunk hashes of a token-id sequence — THE digest
+    hash. The store's export and the router's probe-side estimator both
+    call this, so a replica's published chunk and the router's hashed
+    prompt chunk agree byte-for-byte (blake2b-64 over the ascii token
+    ids; only FULL pages hash — match resolution is one page)."""
+    import hashlib
+
+    n = len(ids) // max(1, page)
+    if max_hashes is not None:
+        n = min(n, max_hashes)
+    out: List[str] = []
+    for i in range(n):
+        chunk = ids[i * page : (i + 1) * page]
+        out.append(
+            hashlib.blake2b(
+                ",".join(str(int(t)) for t in chunk).encode("ascii"),
+                digest_size=8,
+            ).hexdigest()
+        )
+    return out
+
+
 def _host_slab(arr) -> np.ndarray:
     """Device (or host) array → an owned host copy."""
     import jax
@@ -302,6 +336,58 @@ class RadixPrefixStore:
             "host_budget_bytes": self.host_bytes,
             "models": per_model,
         }
+
+    def digest(
+        self,
+        max_prefixes: int = DIGEST_MAX_PREFIXES,
+        max_hashes: int = DIGEST_MAX_HASHES,
+    ) -> dict:
+        """Bounded JSON-able summary of the store's top-level prefixes
+        (ISSUE 19 affinity routing): one entry per root child — page-
+        chunk hashes of the child's most-recently-used SPINE plus the
+        spine's token depth — most-recent entries first, capped at
+        ``max_prefixes`` entries × ``max_hashes`` hashes. Exported on
+        ``/healthz`` and federated by ``Replica.probe`` so the router
+        can estimate the longest prefix match a candidate replica holds
+        WITHOUT shipping prompts or token ids around the fleet."""
+        entries: List[dict] = []
+        for model, tree in self._trees.items():
+            page = tree.page_size or 64
+            for child in tree.root.children.values():
+                # subtree recency for the LRU-most-recent entry cap
+                stamp = child.stamp
+                stack = list(child.children.values())
+                while stack:
+                    n = stack.pop()
+                    stamp = max(stamp, n.stamp)
+                    stack.extend(n.children.values())
+                # the spine: at each branch follow the freshest child —
+                # the path a repeat of the hottest prompt would walk
+                ids: List[int] = []
+                node: Optional[RadixNode] = child
+                while node is not None:
+                    ids.extend(node.edge)
+                    node = (
+                        max(
+                            node.children.values(), key=lambda c: c.stamp
+                        )
+                        if node.children
+                        else None
+                    )
+                entries.append(
+                    {
+                        "model": model,
+                        "page": int(page),
+                        "h": prefix_chunk_hashes(ids, page, max_hashes),
+                        "tokens": len(ids),
+                        "stamp": int(stamp),
+                    }
+                )
+        entries.sort(key=lambda e: (-e["stamp"], e["model"]))
+        entries = entries[: max(0, int(max_prefixes))]
+        for e in entries:
+            del e["stamp"]
+        return {"v": 1, "entries": entries}
 
     def _publish_gauges(self) -> None:
         if not _obs_enabled():

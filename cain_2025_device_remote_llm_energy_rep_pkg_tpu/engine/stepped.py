@@ -308,6 +308,13 @@ class SteppedDecodeSession:
         self.paged = bool(engine.paged_kv)
         self.carry: Dict[str, Any] = {}
         self.rows: List[Optional[_Row]] = []
+        # tp×dp row sharding (ISSUE 19): >1 when the mesh has a dp axis
+        # AND the bucket/page counts divide it (set by _open_paged; the
+        # carry shardings apply the same divisibility fallback). Rows
+        # map to contiguous shard blocks — r // (b_bucket / dp) — the
+        # exact split NamedSharding P("dp") makes on the row dim, so a
+        # shard-tagged page allocation keeps a row's pages device-local.
+        self.dp_shards = 1
         # Speculative draft-verify mode (ISSUE 9): `spec` is the ACTIVE
         # config ({draft, k, dcfg, floor}) or None; `spec_info` survives
         # an adaptive fallback so retiring rows still report their
@@ -524,6 +531,12 @@ class SteppedDecodeSession:
         self.spec = {
             "source": source, "draft": draft, "k": k, "dcfg": dcfg,
             "floor": floor,
+            # the CONFIGURED draft length: the adaptive policy (ISSUE
+            # 19) shrinks "k" below it under a failing acceptance window
+            # and restores toward it on recovery, but never above —
+            # every open-time allocation (scratch width, side-cache
+            # overshoot, contiguous margin) was sized from k0
+            "k0": k,
         }
         self.spec_info = {"draft_model": draft, "k": k, "source": source}
         self.spec_draft_len = draft_len
@@ -686,6 +699,14 @@ class SteppedDecodeSession:
 
         eng = self.engine
         cfg = self.cfg
+        # dp row sharding engages on the contiguous layout whenever the
+        # bucket divides the dp axis — the exact rule the carry
+        # shardings apply to the batch-position leaves. No pool here, so
+        # no page-count condition and no per-shard parking.
+        dp = int(getattr(eng, "_dp_shards", lambda: 1)())
+        self.dp_shards = (
+            dp if dp > 1 and self.b_bucket % dp == 0 else 1
+        )
         s_buckets = [_prompt_alloc(max(len(i), 1)) for i in all_ids]
         # spec sessions carry the rounds-overshoot margin (verify writes
         # up to offset+k; _spec_margin rounds 2k+2 to the lane tile) —
@@ -775,8 +796,17 @@ class SteppedDecodeSession:
         # ×2 page and table-width headroom over the initial fleet so
         # mid-flight joins have pages to allocate and slots to fit —
         # without it a lone anchor's session could never admit anyone
-        total = sum(rows_pages) + 1  # + the shared parking page
+        dp = int(getattr(eng, "_dp_shards", lambda: 1)())
+        total = sum(rows_pages) + max(1, dp)  # + per-shard parking pages
         n_pages = _pow2_at_least(2 * total, 4)
+        # dp engages only when the bucket AND page count divide it —
+        # the stepped_carry_shardings divisibility fallback, mirrored
+        # here so the host allocator and the GSPMD placement agree
+        self.dp_shards = (
+            dp
+            if dp > 1 and self.b_bucket % dp == 0 and n_pages % dp == 0
+            else 1
+        )
         self.jmax = _pow2_at_least(2 * max(rows_pages))
         self.d_pool = (
             -(-cfg.d_head // 128) * 128 if self.stacked else cfg.d_head
@@ -789,19 +819,25 @@ class SteppedDecodeSession:
             page_size=page,
             dtype=eng.dtype,
             quantized=self.quantized,
+            dp_shards=self.dp_shards,
         )
         # Retired/free slots park their table rows here: a done row
         # re-writes one frozen (page, slot) each step (legacy mode), and
         # that write must never land on pages a live or future row owns.
-        self.parking = self.pool.alloc(1)[0]
-        table_np = np.full(
-            (self.b_bucket, self.jmax), self.parking, dtype=np.int32
-        )
+        # One parking page PER dp shard so a parked table row keeps
+        # pointing at pages on the shard that owns the row.
+        self.parking_pages = [
+            self.pool.alloc(1, shard=s)[0] for s in range(self.dp_shards)
+        ]
+        self.parking = self.parking_pages[0]
+        table_np = np.empty((self.b_bucket, self.jmax), dtype=np.int32)
+        for r in range(self.b_bucket):
+            table_np[r, :] = self._parking_for(r)
         chunk_dest: List[int] = []
         chunks_k, chunks_v = [], []
         row_pages: List[List[int]] = []
         for r, (st, need) in enumerate(zip(states, rows_pages)):
-            pages = self.pool.alloc(need)
+            pages = self.pool.alloc(need, shard=self._row_shard(r))
             row_pages.append(pages)
             table_np[r, :need] = pages
             n_prompt_pages = -(-st["s_real"] // page)
@@ -895,6 +931,22 @@ class SteppedDecodeSession:
         # placement and after every slice)
         self.carry["pool_k"] = self.pool.k
         self.carry["pool_v"] = self.pool.v
+
+    def _row_shard(self, r: int) -> int:
+        """dp shard owning slot ``r`` — the contiguous-block split
+        ``NamedSharding(P("dp"))`` makes on the row dim."""
+        if self.dp_shards <= 1:
+            return 0
+        return min(
+            r // (self.b_bucket // self.dp_shards), self.dp_shards - 1
+        )
+
+    def _parking_for(self, r: int) -> int:
+        """Parking page on slot ``r``'s own dp shard."""
+        pages = getattr(self, "parking_pages", None)
+        if not pages:
+            return self.parking
+        return pages[self._row_shard(r)]
 
     def _pages_needed(self, s_real: int, max_new_tokens: int) -> int:
         """Pages one row pins: prompt-only in stacked mode (generated
@@ -1359,9 +1411,16 @@ class SteppedDecodeSession:
         # Adaptive policy: a rolling window of recent slices' (accepted,
         # drafted); once the window holds enough evidence (≥ 2 slices
         # and ≥ 2k drafts) and its acceptance sits below the floor,
-        # speculation is LOSING — every round paid k draft steps + a
-        # k+1-wide verify for ~1 emitted token — so the session falls
-        # back to plain decode.
+        # speculation at THIS draft length is losing — every round paid
+        # k draft steps + a k+1-wide verify for ~1 emitted token. The
+        # session first SHRINKS k (halving toward 1, ISSUE 19): a
+        # shorter draft has strictly higher per-token acceptance odds,
+        # so a source in a rough patch keeps some speedup instead of
+        # abandoning the armed draft outright. Full fallback is the
+        # k=1-still-failing endgame. A recovered window (comfortably
+        # above the floor — the +0.15 hysteresis band keeps the two
+        # thresholds from oscillating) restores k toward the
+        # configured k0, never past it (allocations were sized at k0).
         floor = self.spec["floor"]
         if floor > 0.0 and drafted_delta:
             self._spec_recent.append((acc_delta, drafted_delta))
@@ -1371,10 +1430,98 @@ class SteppedDecodeSession:
             if (
                 len(self._spec_recent) >= 2
                 and win_drafted >= 2 * self.spec["k"]
-                and win_acc / win_drafted < floor
             ):
-                self._spec_fall_back(win_acc / win_drafted)
+                measured = win_acc / win_drafted
+                if measured < floor:
+                    if self.spec["k"] > 1:
+                        self._spec_set_k(
+                            max(1, self.spec["k"] // 2), measured
+                        )
+                    else:
+                        self._spec_fall_back(measured)
+                elif (
+                    self.spec["k"] < self.spec["k0"]
+                    and measured >= min(0.95, floor + 0.15)
+                ):
+                    self._spec_set_k(
+                        min(self.spec["k0"], self.spec["k"] * 2),
+                        measured,
+                    )
         return slice_rounds
+
+    def _spec_set_k(
+        self, k_new: int, measured_acceptance: float
+    ) -> None:
+        """Move the session's live draft length (ISSUE 19 adaptive
+        draft-k). The compiled slice step is keyed on k, so the next
+        ``step()`` picks up (or compiles) the k_new variant; the
+        acceptance window resets so the new length earns its own
+        evidence. Parity is untouched — every k emits the target's own
+        accept/resample stream, k only moves the speedup."""
+        from ..runner import term
+
+        k_old = int(self.spec["k"])
+        k_new = int(k_new)
+        if k_new == k_old:
+            return
+        self.spec["k"] = k_new
+        if self.spec_info is not None:
+            self.spec_info["k"] = k_new
+        self._spec_recent = []
+        if (
+            self.paged
+            and not self.stacked
+            and self.carry.get("scratch_k") is not None
+        ):
+            # the kernel-less native verify's scratch leaves are shaped
+            # [L,B,Hkv,k+1,Dh] and the compiled commit scatters the
+            # WHOLE column dim — rebuild them at the new width
+            # (contents are per-round transients: each round writes its
+            # candidates before reading them, so zeros are correct) and
+            # re-place the carry so the new leaves join the committed
+            # SPMD layout
+            cfg = self.cfg
+            sshape = (
+                cfg.n_layers, self.b_bucket, cfg.n_kv_heads,
+                k_new + 1, cfg.d_head,
+            )
+            for key in ("scratch_k", "scratch_v"):
+                if self.quantized:
+                    self.carry[key] = {
+                        "q": jnp.zeros(sshape, jnp.int8),
+                        "s": jnp.zeros(sshape[:-1], jnp.float32),
+                    }
+                else:
+                    self.carry[key] = jnp.zeros(
+                        sshape, dtype=self.engine.dtype
+                    )
+            self._recommit_carry()
+        direction = "down" if k_new < k_old else "up"
+        source = self.spec["source"]
+        term.log_warn(
+            f"speculative session [{self.model}]: source {source} "
+            f"acceptance {measured_acceptance:.2f} — draft length "
+            f"k {k_old} -> {k_new} ({direction})"
+        )
+        if _obs_enabled():
+            try:
+                from ..obs.flight import EV_SPEC_K_ADAPT, FLIGHT
+                from ..obs.metrics import SPEC_K_ADAPT_C
+
+                SPEC_K_ADAPT_C.labels(
+                    source=source, direction=direction
+                ).inc()
+                FLIGHT.emit(
+                    EV_SPEC_K_ADAPT,
+                    model=self.model,
+                    source=source,
+                    k_from=k_old,
+                    k_to=k_new,
+                    acceptance=round(measured_acceptance, 4),
+                    floor=self.spec["floor"],
+                )
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
 
     def _spec_fall_back(self, measured_acceptance: float) -> None:
         """Switch the session to plain decode mid-flight: drop the draft
@@ -1493,7 +1640,7 @@ class SteppedDecodeSession:
             # park the slot's table row FIRST: the dead row's frozen
             # write slot (legacy mode) must stop aliasing pages we are
             # about to hand back to the free list
-            self.table = self.table.at[r].set(self.parking)
+            self.table = self.table.at[r].set(self._parking_for(r))
             self.pool.free(row.pages)
             row.pages = []
             self._recommit_carry()
@@ -1544,7 +1691,7 @@ class SteppedDecodeSession:
             self.done = self.done.at[r].set(True)
             self.remaining = self.remaining.at[r].set(0)
             if self.paged:
-                self.table = self.table.at[r].set(self.parking)
+                self.table = self.table.at[r].set(self._parking_for(r))
                 self.pool.free(row.pages)
                 row.pages = []
             self.rows[r] = None
@@ -1675,7 +1822,7 @@ class SteppedDecodeSession:
             pr.n_own_pages = len(own)
             # ordering discipline (same as _retire/cancel): park the
             # table row BEFORE any page returns to the free list
-            self.table = self.table.at[r].set(self.parking)
+            self.table = self.table.at[r].set(self._parking_for(r))
             if policy == "swap":
                 if self.stacked:
                     side = (
@@ -1830,14 +1977,18 @@ class SteppedDecodeSession:
         pages: List[int] = []
         if self.paged:
             if mode == "swap":
-                own = self.pool.alloc(pr.n_own_pages)
+                own = self.pool.alloc(
+                    pr.n_own_pages, shard=self._row_shard(r)
+                )
                 if pr.shared_pages:
                     self.pool.share(pr.shared_pages)
                     if plan.get("reshare") and self.store is not None:
                         self.store.touch(self.model, pr.ids)
                 pages = list(pr.shared_pages) + own
             else:
-                pages = self.pool.alloc(plan["need"])
+                pages = self.pool.alloc(
+                    plan["need"], shard=self._row_shard(r)
+                )
         if mode == "swap":
             ids, chunks, cache_len = pr.ids, [], 0
             k_cache = v_cache = None
@@ -1942,7 +2093,7 @@ class SteppedDecodeSession:
                     self.carry["pool_k"] = self.pool.k
                     self.carry["pool_v"] = self.pool.v
                 table_row = np.full(
-                    (self.jmax,), self.parking, dtype=np.int32
+                    (self.jmax,), self._parking_for(r), dtype=np.int32
                 )
                 table_row[: len(pending.pages)] = pending.pages
                 self.table = self.table.at[r].set(jnp.asarray(table_row))
@@ -2245,7 +2396,7 @@ class SteppedDecodeSession:
                 plan = self.store.page_plan(self.model, ids, common)
                 shared_ids = plan["hbm_lead"]
             shared = len(shared_ids)
-            pages = self.pool.alloc(need - shared)
+            pages = self.pool.alloc(need - shared, shard=self._row_shard(r))
             if shared:
                 # map the read-only prefix pages into this row: one
                 # reference per sharer — recycled only when the LAST
@@ -2613,7 +2764,7 @@ class SteppedDecodeSession:
         )
         self.pool.k = self.carry["pool_k"]
         self.pool.v = self.carry["pool_v"]
-        table_row = np.full((self.jmax,), self.parking, dtype=np.int32)
+        table_row = np.full((self.jmax,), self._parking_for(r), dtype=np.int32)
         table_row[: len(pages)] = pages
         self.table = self.table.at[r].set(jnp.asarray(table_row))
         if self.stacked:

@@ -65,6 +65,9 @@ EV_ROW_MIGRATED = "row_migrated"  # a live row moved between replicas
 EV_BATCH_FALLBACK = "batch_fallback"  # batch/session dispatch failed → bisection
 # Replica-fleet routing (ISSUE 12, serve/router.py):
 EV_DISPATCHED = "dispatched"  # the router sent a ticket to a replica
+EV_AFFINITY_ROUTE = "affinity_route"  # the affinity policy matched a
+# ticket's prompt prefix to a replica's probed radix-store digest
+# (est_tokens: the probe-side longest-match estimate that won the pick)
 #   (trace = ticket's root; replica, policy, retry flag ride along)
 EV_REPLICA_DOWN = "replica_down"  # a replica turned unhealthy (probe
 #   failure or a dispatch-observed death; error attr says which)
@@ -89,6 +92,8 @@ EV_PREFIX_RESTORE = "prefix_restore"  # a spilled prefix-store node was
 #   swapped back into fresh pool pages on a hit
 EV_SPEC_ROUND = "spec_round"  # one speculative window's rounds/acceptance
 EV_SPEC_FALLBACK = "spec_fallback"  # session acceptance fell below the floor
+EV_SPEC_K_ADAPT = "spec_k_adapt"  # adaptive draft length moved (ISSUE 19:
+#   k halves below the floor / restores toward the configured k on recovery)
 EV_STREAM_CHUNK = "stream_chunk"  # one egress push of a streaming row's
 #   new tokens into its per-request channel (the wire-visible moment of
 #   token delivery — the "stream chunks" phase of a /debug/timeline)

@@ -743,6 +743,14 @@ SPEC_FALLBACK_C = REGISTRY.counter(
     "model-draft failure)",
     labels=("source",),
 )
+SPEC_K_ADAPT_C = REGISTRY.counter(
+    "llm_spec_k_adapt_total",
+    "Adaptive draft-length moves (ISSUE 19): a below-floor acceptance "
+    "window first SHRINKS k (direction=down) instead of abandoning "
+    "speculation outright; a recovered window restores it toward the "
+    "configured k (direction=up). Full fallback only fires from k=1.",
+    labels=("source", "direction"),
+)
 SPEC_VERIFY_NATIVE_C = REGISTRY.counter(
     "llm_spec_verify_native_total",
     "Verify rounds run in the PAGE-RESIDENT native mode (ISSUE 10: "

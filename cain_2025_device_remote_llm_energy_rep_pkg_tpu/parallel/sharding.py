@@ -173,26 +173,82 @@ def stepped_carry_shardings(
       reads each step; the host mutates it between slices with O(B)
       scatters).
 
-    The returned dict matches ``carry`` leaf-for-leaf, so it is valid as
-    both a ``jax.jit`` in/out_shardings subtree and a ``device_put``
-    target.
+    When the mesh carries a ``dp`` axis (``MeshSpec.dp_tp`` — ISSUE 19's
+    tp×dp in-mesh row sharding), the ROW dimension additionally shards
+    over ``dp`` under the same divisibility discipline as the head rule:
+
+    - batch-position payload leaves (``k_cache``/``v_cache``,
+      ``side_k``/``side_v``, ``scratch_k``/``scratch_v``, the draft
+      cache) take ``cache_spec(batch_axis="dp")`` when the bucket width
+      B divides ``dp``;
+    - the page pool shards its page dim over ``dp`` when the page count
+      divides ``dp`` (pages are pre-partitioned into per-shard ranges by
+      ``PagePool.dp_shards`` so a row's pages live on the shard that
+      owns the row — best-effort locality; correctness never depends on
+      it because GSPMD treats the table gather globally);
+    - row-control leaves with a leading row dim B (tokens, offsets,
+      done, rngs, the page table, spec counters, n-gram history, …)
+      shard that dim over ``dp`` instead of replicating.
+
+    Any leaf that fails its divisibility check falls back to the tp-only
+    placement above — the exact analogue of the heads∤tp replicate rule,
+    so a dp mesh is always safe to request.
     """
-    spec = cache_spec(cfg, mesh)
+    dp = mesh.shape.get("dp", 1)
+    tok = carry.get("tokens")
+    b = int(tok.shape[0]) if tok is not None and getattr(tok, "ndim", 0) else 0
+    row_shard = dp > 1 and b > 0 and b % dp == 0
+    batch_axis = "dp" if row_shard else None
+
+    spec = cache_spec(cfg, mesh, batch_axis)
     payload = NamedSharding(mesh, spec)
     scale = NamedSharding(mesh, P(*tuple(spec)[:-1]))
     repl = NamedSharding(mesh, P())
+    pool_keys = ("pool_k", "pool_v")
     payload_keys = (
         "k_cache", "v_cache", "pool_k", "pool_v",
         "side_k", "side_v", "scratch_k", "scratch_v",
     )
     draft_payload = NamedSharding(
-        mesh, cache_spec(draft_cfg if draft_cfg is not None else cfg, mesh)
+        mesh,
+        cache_spec(draft_cfg if draft_cfg is not None else cfg, mesh, batch_axis),
     )
+    head_axis = tuple(cache_spec(cfg, mesh))[2]
+
+    def pool_place(leaf):
+        # Pool [L, P, Hkv, page, D]: the page dim sits in the batch-like
+        # position, but its extent is the page count, not B — check its
+        # own divisibility before engaging dp.
+        q = leaf["q"] if isinstance(leaf, dict) else leaf
+        n_pages = int(q.shape[1])
+        ax = "dp" if row_shard and n_pages % dp == 0 else None
+        pspec = P(None, ax, head_axis, None, None)
+        if isinstance(leaf, dict):
+            return {
+                "q": NamedSharding(mesh, pspec),
+                "s": NamedSharding(mesh, P(*tuple(pspec)[:-1])),
+            }
+        return NamedSharding(mesh, pspec)
+
+    def row_place(leaf):
+        # Row-control leaf [B, ...]: shard the row dim, replicate the rest.
+        nd = getattr(leaf, "ndim", 0)
+        return NamedSharding(mesh, P(*(("dp",) + (None,) * (nd - 1))))
 
     def place(key: str, leaf):
         if key in ("draft_k", "draft_v"):
             return draft_payload
+        if key in pool_keys and getattr(leaf, "ndim", 1) != 0:
+            if isinstance(leaf, dict) or getattr(leaf, "ndim", 0) == 5:
+                return pool_place(leaf)
         if key not in payload_keys:
+            if (
+                row_shard
+                and not isinstance(leaf, dict)
+                and getattr(leaf, "ndim", 0) >= 1
+                and int(leaf.shape[0]) == b
+            ):
+                return row_place(leaf)
             return repl
         if isinstance(leaf, dict):  # int8: codes + per-position scales
             return {"q": payload, "s": scale}

@@ -162,6 +162,12 @@ class TensorParallelEngine(JaxEngine):
     def _stepped_compute_ctx(self):
         return int4_kernel_disabled()
 
+    def _dp_shards(self) -> int:
+        """The mesh's ``dp`` extent (ISSUE 19): stepped sessions use it
+        to pre-partition their page pool into per-shard ranges aligned
+        with the carry's row split."""
+        return int(self.mesh.shape.get("dp", 1))
+
     def mesh_info(self) -> Optional[Dict]:
         dev = self.mesh.devices.flat[0]
         return {
@@ -215,6 +221,14 @@ class TensorParallelEngine(JaxEngine):
         # actually has, or every step pays a hidden reshard.
         if cfg is None or tuple(cache_spec(cfg, self.mesh))[2] != "tp":
             return None  # gather fallback: heads can't shard
+        if self._dp_shards() > 1:
+            # dp row sharding splits the pool's PAGE dim across the dp
+            # axis; the shard_map specs below claim a pure-tp pool, so
+            # under dp the kernel would force a per-step all-gather of
+            # the pool. The jnp gather fallback partitions under GSPMD
+            # (pages resolve shard-locally when the allocator's
+            # per-shard ranges hold) — use it.
+            return None
         from jax.sharding import PartitionSpec as P
 
         from .compat import shard_map

@@ -142,9 +142,14 @@ commands:
   prepare              validate the environment (JAX devices, RAPL access)
   serve [opts]         start the HTTP generation server (the framework-native
                        Ollama-equivalent): --host H --port N (default 11434),
-                       --backend jax|jax-tp|fake, --tp N, --models a,b,c
+                       --backend jax|jax-tp|fake, --tp N, --dp M,
+                       --models a,b,c
                        (--backend jax-tp --tp N serves from an N-device
-                       tensor-parallel mesh, and composes with
+                       tensor-parallel mesh; adding --dp M grows a dp
+                       axis that shards stepped sessions' ROW dim — KV
+                       payload, page pool and row control split over dp
+                       shards, so a tp×dp mesh serves dp× the rows of a
+                       tp-only mesh — and composes with
                        --scheduler continuous: stepped decode sessions
                        carry an explicitly-sharded SPMD pytree — KV
                        pool/caches sharded over heads when they divide
@@ -373,6 +378,7 @@ def serve_command(args: List[str]) -> None:
     host = "0.0.0.0"
     backend_kind = "jax"
     tp = -1
+    dp = 1  # >1 with --backend jax-tp: tp×dp mesh, rows sharded over dp
     models: Optional[List[str]] = None
     batch_window_ms = 0.0
     scheduler = None  # auto: continuous for real batched backends
@@ -416,6 +422,10 @@ def serve_command(args: List[str]) -> None:
             backend_kind = next(it, "jax")
         elif arg == "--tp":
             tp = int(next(it, "-1"))
+        elif arg == "--dp":
+            dp = int(next(it, "1"))
+            if dp < 1:
+                raise CommandError("serve: --dp expects a positive integer")
         elif arg == "--models":
             models = [m for m in next(it, "").split(",") if m]
         elif arg in ("--window-ms", "--batch-window-ms"):
@@ -764,8 +774,14 @@ def serve_command(args: List[str]) -> None:
             from ..parallel.mesh import MeshSpec, build_mesh
             from ..parallel.tp import TensorParallelEngine
 
+            # --dp M grows a dp axis next to tp (ISSUE 19): stepped
+            # sessions shard their carry's row dim (and page pool) over
+            # it, so idle mesh devices serve rows instead of replicating
+            mesh_spec = (
+                MeshSpec.dp_tp(dp, tp) if dp > 1 else MeshSpec.tp_only(tp)
+            )
             return TensorParallelEngine(
-                mesh=build_mesh(MeshSpec.tp_only(tp)),
+                mesh=build_mesh(mesh_spec),
                 decode_attention="auto",
                 hf_checkpoints=hf_checkpoints or None,
                 quantize=quantize,

@@ -77,11 +77,13 @@ from ..engine.backend import (
     GenerationRequest,
     GenerationResult,
 )
+from ..engine.radix_store import prefix_chunk_hashes
 from ..obs import energy as obs_energy
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import timeseries as obs_ts
 from ..obs.flight import (
+    EV_AFFINITY_ROUTE,
     EV_DISPATCHED,
     EV_REPLICA_DOWN,
     EV_REPLICA_DRAINED,
@@ -109,6 +111,7 @@ ROUTE_POLICIES = (
     "least-pages",  # lowest paged-pool occupancy (falls back to queue)
     "least-joules",  # lowest recent J/token (falls back to queue)
     "round-robin",  # membership order, rotating
+    "affinity",  # longest probed prefix match (falls back to queue)
 )
 
 # How often the background prober refreshes every replica's stats. The
@@ -140,6 +143,70 @@ _PROBE_H = REGISTRY.histogram(
     "llm_router_probe_seconds",
     "Wall time of one replica health/metrics probe",
 )
+_AFFINITY_C = REGISTRY.counter(
+    "llm_router_affinity_hits_total",
+    "Tickets routed by a positive prefix-affinity match (policy "
+    "affinity): the probed digest of the chosen replica's radix store "
+    "held the ticket's longest estimated prompt prefix",
+    labels=("replica",),
+)
+
+
+def _affinity_estimate(
+    digest, prompt: str, model: Optional[str] = None
+) -> int:
+    """Probe-side longest-match estimate (ISSUE 19): tokens of
+    ``prompt`` a replica's published prefix digest claims to hold warm.
+    The prompt is tokenized with the ByteTokenizer convention (BOS +
+    byte+3 — the same estimate `_dispatch_failed` prices waste with)
+    and chunk-hashed at each entry's page width via the ONE hash the
+    store exports (`engine/radix_store.prefix_chunk_hashes`), so a
+    replica on a different tokenizer simply never matches — the honest
+    degradation is the least-queue fallback, never a wrong match. The
+    estimate counts consecutive matching page hashes; when EVERY
+    exported hash matches, the claim extends to the entry's full token
+    depth (capped by the prompt's own length)."""
+    if not digest or not prompt:
+        return 0
+    entries = (
+        digest.get("entries") if isinstance(digest, dict) else None
+    ) or []
+    if not entries:
+        return 0
+    ids = [1] + [b + 3 for b in prompt.encode("utf-8")]
+    hashed: Dict[int, List[str]] = {}  # page width -> my chunk hashes
+    best = 0
+    for entry in entries:
+        try:
+            e_model = entry.get("model")
+            if (
+                model is not None
+                and e_model is not None
+                and e_model != model
+            ):
+                continue
+            page = int(entry.get("page") or 0)
+            want = entry.get("h") or []
+            if page <= 0 or not want:
+                continue
+            mine = hashed.get(page)
+            if mine is None:
+                mine = prefix_chunk_hashes(ids, page)
+                hashed[page] = mine
+            matched = 0
+            for a, b in zip(mine, want):
+                if a != b:
+                    break
+                matched += 1
+            est = matched * page
+            if matched and matched == len(want):
+                est = max(
+                    est, min(int(entry.get("tokens") or 0), len(ids))
+                )
+            best = max(best, est)
+        except Exception:  # noqa: BLE001 — a malformed entry scores 0
+            continue
+    return best
 
 
 def _retry_reason(exc: BaseException) -> Optional[str]:
@@ -383,6 +450,14 @@ class LocalReplica(Replica):
                 )
         except Exception:  # noqa: BLE001 — probe only
             pass
+        # bounded prefix digest (ISSUE 19 affinity): the same summary
+        # /healthz exports, read directly off the in-process store
+        try:
+            store = getattr(self.backend, "prefix_store", None)
+            if store is not None and hasattr(store, "digest"):
+                stats["prefix_digest"] = store.digest()
+        except Exception:  # noqa: BLE001 — probe only
+            pass
         # live J/token (least-joules): engines — real AND fake — publish
         # their most recent attribution as an attribute, so the policy
         # works in-process without a loopback /metrics scrape (ISSUE 13
@@ -529,6 +604,7 @@ class Router:
         replicas: List[Replica],
         policy: str = "least-queue",
         probe_interval_s: float = DEFAULT_PROBE_INTERVAL_S,
+        affinity_stale_s: Optional[float] = None,
     ) -> None:
         if policy not in ROUTE_POLICIES:
             raise ValueError(
@@ -536,6 +612,16 @@ class Router:
             )
         self.policy = policy
         self.probe_interval_s = float(probe_interval_s)
+        # affinity (ISSUE 19): a digest older than this is STALE — the
+        # store may have evicted/republished since, so the policy falls
+        # back to least-queue rather than chase a ghost prefix. Default:
+        # five missed probe ticks (floored so manual probe_now() tests
+        # aren't racing a sub-second staleness horizon).
+        self.affinity_stale_s = (
+            float(affinity_stale_s)
+            if affinity_stale_s is not None
+            else max(5.0, 5.0 * self.probe_interval_s)
+        )
         self._lock = threading.Lock()
         self._replicas: "Dict[str, Replica]" = {}
         self._rr = itertools.count()  # round-robin cursor
@@ -726,8 +812,26 @@ class Router:
                 return float(jpt) * 1e6 + queue_load
         return queue_load
 
+    def _admission_headroom(self, replica: Replica) -> Optional[float]:
+        """Cached admission headroom (ISSUE 19 fleet-wide admission):
+        the last probed ``max_admission_rows`` minus the tickets the
+        router has dispatched there since (outstanding moves per ticket;
+        probes are periodic — without the discount a burst between two
+        ticks could stampede a replica the probe saw empty). None when
+        the replica never reported the figure (old server, fake without
+        a scheduler) — unknown capacity must not exclude anyone."""
+        stats = replica.last_stats or {}
+        probed = stats.get("max_admission_rows")
+        if probed is None:
+            return None
+        return float(probed) - float(replica.outstanding)
+
     def _pick(
-        self, exclude: "tuple" = (), model: Optional[str] = None
+        self,
+        exclude: "tuple" = (),
+        model: Optional[str] = None,
+        request: Optional[GenerationRequest] = None,
+        decision: Optional[Dict[str, object]] = None,
     ) -> Optional[Replica]:
         with self._lock:
             # Role-aware membership (ISSUE 18): a decode-only replica
@@ -761,6 +865,56 @@ class Router:
                 ]
                 if warm:
                     candidates = warm
+            # Fleet-wide admission (ISSUE 19): skip replicas whose
+            # probed headroom is exhausted — consult capacity BEFORE
+            # dispatching instead of bouncing off a refusal. Like model
+            # placement this never empties the set: when EVERY candidate
+            # looks full the probes may simply be stale, so dispatch
+            # proceeds (the retry-once rule is still the backstop).
+            with_room = [
+                r
+                for r in candidates
+                if (lambda h: h is None or h > 0)(
+                    self._admission_headroom(r)
+                )
+            ]
+            if with_room:
+                candidates = with_room
+            # Prefix affinity (ISSUE 19): score candidates by the
+            # probe-side longest-match estimate of the ticket's prompt
+            # against each replica's published radix digest; the best
+            # positive match wins (ties break by load then name —
+            # deterministic). No match anywhere, stale digests, or no
+            # prompt: fall through to the least-queue pick below,
+            # byte-identical to the least-queue policy.
+            if self.policy == "affinity" and request is not None:
+                now = time.monotonic()
+                best, pool = 0, []
+                for r in candidates:
+                    est = 0
+                    fresh = (
+                        r.t_probe is not None
+                        and now - r.t_probe <= self.affinity_stale_s
+                    )
+                    if fresh:
+                        est = _affinity_estimate(
+                            (r.last_stats or {}).get("prefix_digest"),
+                            request.prompt,
+                            model,
+                        )
+                    if est > best:
+                        best, pool = est, [r]
+                    elif est == best and best > 0:
+                        pool.append(r)
+                if best > 0:
+                    if decision is not None:
+                        decision["affinity"] = "hit"
+                        decision["affinity_tokens"] = int(best)
+                    return min(
+                        pool, key=lambda r: (self._load_key(r), r.name)
+                    )
+                if decision is not None:
+                    decision["affinity"] = "fallback"
             if self.policy == "round-robin":
                 return candidates[next(self._rr) % len(candidates)]
             return min(
@@ -807,12 +961,19 @@ class Router:
 
     # -- dispatch --------------------------------------------------------------
     def _begin(
-        self, replica: Replica, retried: Optional[str], attempt: int = 1
+        self,
+        replica: Replica,
+        retried: Optional[str],
+        attempt: int = 1,
+        decision: Optional[Dict[str, object]] = None,
     ) -> None:
         with self._lock:
             replica.outstanding += 1
             replica.dispatched += 1
         _DISPATCH_C.labels(replica=replica.name, policy=self.policy).inc()
+        hit = bool(decision) and decision.get("affinity") == "hit"
+        if hit:
+            _AFFINITY_C.labels(replica=replica.name).inc()
         if obs_metrics.enabled():
             FLIGHT.emit(
                 EV_DISPATCHED,
@@ -822,6 +983,13 @@ class Router:
                 attempt=attempt,
                 **trace_attrs(TRACER.current()),
             )
+            if hit:
+                FLIGHT.emit(
+                    EV_AFFINITY_ROUTE,
+                    replica=replica.name,
+                    est_tokens=decision.get("affinity_tokens"),
+                    **trace_attrs(TRACER.current()),
+                )
 
     def _end(self, replica: Replica) -> None:
         with self._lock:
@@ -845,6 +1013,14 @@ class Router:
         the process-live figure). Returns the Joules charged so the
         caller can stamp them on the retried ticket's extras."""
         _RETRIES_C.labels(reason=reason).inc()
+        if reason == "refused":
+            # Believe the refusal NOW (ISSUE 19): zero the cached
+            # headroom so the admission gate stops offering this
+            # replica until its next probe says otherwise — one stale
+            # probe must not keep stampeding a full scheduler.
+            stats = replica.last_stats
+            if isinstance(stats, dict):
+                stats["max_admission_rows"] = 0
         if reason != "dead":
             return 0.0
         self._set_health(replica, False, f"{type(exc).__name__}: {exc}")
@@ -868,6 +1044,7 @@ class Router:
         wasted_j: float = 0.0,
         migrate_j: float = 0.0,
         trace: Optional[TraceContext] = None,
+        decision: Optional[Dict[str, object]] = None,
     ) -> None:
         """Route attribution onto the wire: ``extras["router"]`` rides
         ``x_extras`` so load generators and benches can split figures
@@ -885,6 +1062,17 @@ class Router:
             router_extras["trace"] = trace.trace_id
         if retried:
             router_extras["retried"] = retried
+        if decision and "affinity" in decision:
+            # per-ticket routing verdict (ISSUE 19): "hit" carries the
+            # estimator's token claim so load generators can split
+            # prefix-hit tokens per replica; "fallback" records that
+            # affinity ran and degraded to least-queue
+            if decision["affinity"] == "hit":
+                router_extras["affinity"] = {
+                    "est_tokens": decision.get("affinity_tokens")
+                }
+            else:
+                router_extras["affinity"] = "fallback"
         result.extras = {**(result.extras or {}), "router": router_extras}
         if wasted_j > 0 or migrate_j > 0:
             energy = dict(result.extras.get("energy") or {})
@@ -931,14 +1119,18 @@ class Router:
             request.model if request.model != protocol.AUTO_MODEL else None
         )
         while True:
-            replica = self._pick(exclude=tried, model=model)
+            decision: Dict[str, object] = {}
+            replica = self._pick(
+                exclude=tried, model=model,
+                request=request, decision=decision,
+            )
             if replica is None:
                 raise RuntimeError(
                     "no healthy replica available"
                     + (f" (after retry: {retried})" if retried else "")
                 )
             attempt += 1
-            self._begin(replica, retried, attempt=attempt)
+            self._begin(replica, retried, attempt=attempt, decision=decision)
             try:
                 result = replica.generate(request)
             except BaseException as exc:  # noqa: BLE001
@@ -956,6 +1148,7 @@ class Router:
             self._stamp(
                 result, replica, retried,
                 wasted_j=wasted_j, trace=request.trace,
+                decision=decision,
             )
             return result
 
@@ -994,14 +1187,18 @@ class Router:
             request.model if request.model != protocol.AUTO_MODEL else None
         )
         while True:
-            replica = self._pick(exclude=tried, model=model)
+            decision: Dict[str, object] = {}
+            replica = self._pick(
+                exclude=tried, model=model,
+                request=request, decision=decision,
+            )
             if replica is None:
                 raise RuntimeError(
                     "no healthy replica available"
                     + (f" (after retry: {retried})" if retried else "")
                 )
             attempt += 1
-            self._begin(replica, retried, attempt=attempt)
+            self._begin(replica, retried, attempt=attempt, decision=decision)
             chunks: Optional[Iterator[GenerationChunk]] = None
             streamed = False
             evac_bundle: Optional[dict] = None
@@ -1025,7 +1222,7 @@ class Router:
                             self._stamp(
                                 chunk.result, replica, retried,
                                 wasted_j=wasted_j, migrate_j=migrate_j,
-                                trace=request.trace,
+                                trace=request.trace, decision=decision,
                             )
                         yield chunk
                         if chunk.tokens or chunk.text:

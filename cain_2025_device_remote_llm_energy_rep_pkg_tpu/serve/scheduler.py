@@ -580,12 +580,18 @@ class _SchedulerBase:
         queue depth and in-flight rows. No telemetry dependency — it
         must answer under the obs kill switch — and best-effort like
         :meth:`debug_state` (a torn read costs a stale count, never an
-        exception)."""
+        exception). ``max_admission_rows`` is the LIVE admission
+        headroom (ISSUE 19 fleet-wide admission): how many more rows
+        this scheduler can take right now — the router consults the
+        probed value BEFORE dispatching instead of bouncing a request
+        off a full replica."""
+        queue = self._queue.qsize()
         return {
             "scheduler": "window",
             "running": self._running,
-            "queue_depth": self._queue.qsize(),
+            "queue_depth": queue,
             "inflight_rows": 0,
+            "max_admission_rows": max(0, int(self.max_batch) - queue),
         }
 
     def debug_state(self) -> Dict[str, object]:
@@ -1072,10 +1078,16 @@ class ContinuousScheduler(_SchedulerBase):
         state["scheduler"] = "continuous"
         dbg = self._dbg
         if dbg is not None:
-            _session, live, pending, parked = dbg
+            session, live, pending, parked = dbg
             try:
                 state["inflight_rows"] = (
                     len(live) + len(pending) + len(parked)
+                )
+                # LIVE headroom (ISSUE 19): the running session's free
+                # row slots minus the queue already waiting for them —
+                # sharper than the base max_batch-queue estimate
+                state["max_admission_rows"] = max(
+                    0, int(session.free_slots) - state["queue_depth"]
                 )
             except Exception:  # noqa: BLE001 — racing the loop is fine
                 pass

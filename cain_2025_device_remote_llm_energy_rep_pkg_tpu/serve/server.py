@@ -563,8 +563,24 @@ class GenerationServer:
                         state["inflight_rows"] = health.get(
                             "inflight_rows", 0
                         )
+                        # live admission headroom (ISSUE 19): remote
+                        # probes read capacity HERE, not from a
+                        # best-effort /metrics scrape
+                        if "max_admission_rows" in health:
+                            state["max_admission_rows"] = health[
+                                "max_admission_rows"
+                            ]
                         if not health.get("running", True):
                             state["status"] = "stopping"
+                except Exception:  # noqa: BLE001 — probe only
+                    pass
+                try:
+                    # bounded radix-store prefix summary (ISSUE 19
+                    # affinity routing) — absent when prefix sharing is
+                    # off or the backend has no store
+                    store = getattr(server.backend, "prefix_store", None)
+                    if store is not None and hasattr(store, "digest"):
+                        state["prefix_digest"] = store.digest()
                 except Exception:  # noqa: BLE001 — probe only
                     pass
                 self._send_json(200, state)

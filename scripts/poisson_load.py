@@ -533,6 +533,15 @@ def _record_result(rec, result, t_submit, t_done, start) -> None:
         rec["replica"] = router["replica"]
         if router.get("retried"):
             rec["retried"] = router["retried"]
+        # prefix-affinity attribution (ISSUE 19): a "hit" rode the
+        # estimator's longest-match claim to a warm replica; anything
+        # else under --route-policy affinity degraded to least-queue
+        aff = router.get("affinity")
+        if isinstance(aff, dict):
+            rec["affinity"] = "hit"
+            rec["affinity_tokens"] = int(aff.get("est_tokens") or 0)
+        elif aff is not None:
+            rec["affinity"] = str(aff)
         # fleet-role attribution (ISSUE 18): the role of the replica
         # that FINISHED the row — a disagg-migrated row lands on its
         # decode side, so the per-role breakdown reads where tokens
@@ -765,6 +774,21 @@ def summarize(records: List[Dict], slo=None) -> Dict:
             }
             if r_ttfts:
                 entry["ttft_p50_s"] = round(percentile(r_ttfts, 50), 4)
+            # affinity breakdown (ISSUE 19): how many of this replica's
+            # tickets the prefix estimator routed, the tokens its
+            # longest-match claims covered, and how many landed here
+            # via the least-queue degradation instead
+            hits = [r for r in r_recs if r.get("affinity") == "hit"]
+            if hits:
+                entry["affinity_routed"] = len(hits)
+                entry["prefix_hit_tokens"] = sum(
+                    r.get("affinity_tokens") or 0 for r in hits
+                )
+            falls = sum(
+                1 for r in r_recs if r.get("affinity") == "fallback"
+            )
+            if falls:
+                entry["affinity_fallbacks"] = falls
             per[name] = entry
         out["replicas"] = per
         retried = sum(1 for r in ok if r.get("retried"))

@@ -1639,9 +1639,14 @@ def main() -> int:
             wasted_draft14,
             wire_draft14,
         )
-        # rejected tokens priced at the DRAFT model's J/token (0.1)
+        # rejected tokens priced at the DRAFT model's J/token (0.1).
+        # Under the adaptive draft length (ISSUE 19) the hopeless row
+        # shrinks k 4 → 2 → 1 before falling back, so rounds draft at
+        # DIFFERENT k values — the invariant is on tokens, not rounds:
+        # at acceptance 0 every drafted token is rejected and billed.
         assert abs(
-            wire_draft14 - 0.1 * (spec14s["rejected"] * spec14s["k"])
+            wire_draft14
+            - 0.1 * (spec14s["drafted"] - spec14s["accepted"])
         ) < 1e-6, spec14s
 
         text14 = _scrape(base14)
@@ -1980,6 +1985,201 @@ def main() -> int:
         server16_p.stop()
         server16_d.stop()
 
+    # -- phase 17: prefix-affinity routing + fleet admission (ISSUE 19) --------
+    # A 3-replica local fleet behind the front door under
+    # --route-policy affinity: two prefix-sharing fakes plus one
+    # single-row replica that is FULL the whole phase (its only slot
+    # is occupied by a long off-router stream). Asserts: the first
+    # sharer seats the shared prefix on "afa" (affinity=fallback — all
+    # stores cold), a probe federates the radix digest, and the SECOND
+    # sharer routes back to the warm replica AGAINST the queue signal
+    # (afa is pinned busier) with llm_router_affinity_hits_total
+    # moving and a trace-linked affinity_route flight event; the full
+    # replica's probed max_admission_rows reads 0 and it receives ZERO
+    # dispatches while llm_router_retries_total{reason="refused"}
+    # stays flat (capacity consulted BEFORE dispatch, not bounced);
+    # once the occupant drains, a fresh probe shows the headroom
+    # recover — the gate is live capacity, never a blacklist.
+    def refused_retries(text_now):
+        for line in text_now.splitlines():
+            m = re.match(
+                r'^llm_router_retries_total\{reason="refused"\} '
+                r"([0-9.e+-]+)$",
+                line,
+            )
+            if m:
+                return float(m.group(1))
+        return 0.0
+
+    SHARED17 = "affinity smoke shared system prompt: " + "y" * 64
+    backend17_a = FakeBackend(
+        prefix_share=True, tokens_per_s=400.0, simulate_delay=True
+    )
+    backend17_b = FakeBackend(
+        prefix_share=True, tokens_per_s=400.0, simulate_delay=True
+    )
+    backend17_f = FakeBackend(
+        max_rows=1, tokens_per_s=200.0, simulate_delay=True
+    )
+    replica17_a = LocalReplica("afa", backend17_a)
+    replica17_b = LocalReplica("afb", backend17_b)
+    replica17_f = LocalReplica("full", backend17_f)
+    router17 = Router(
+        [replica17_a, replica17_b, replica17_f],
+        policy="affinity",
+        probe_interval_s=30.0,  # the smoke probes explicitly
+    )
+    server17 = RouterServer(router17, host="127.0.0.1", port=0, quiet=True)
+    server17.start()
+    occupant17 = threading.Thread()
+    try:
+        base17 = f"http://127.0.0.1:{server17.port}"
+        # warm every replica for the model OFF-router first so the
+        # model-placement preference never narrows the candidate set —
+        # this phase isolates the affinity + admission signals
+        for rep17 in (replica17_a, replica17_b, replica17_f):
+            rep17.generate(
+                _GenReq("smoke:1b", f"warm {rep17.name}", max_new_tokens=2)
+            )
+        # occupy the full replica's ONLY row with a long direct stream
+        occ_done17 = {}
+
+        def occupy_full():
+            chunks = list(
+                replica17_f.stream(
+                    _GenReq(
+                        "smoke:1b",
+                        "occupant holding the only row",
+                        max_new_tokens=640,
+                    )
+                )
+            )
+            occ_done17["tokens"] = sum(
+                len(c.tokens) for c in chunks if not c.done
+            )
+
+        occupant17 = threading.Thread(target=occupy_full)
+        occupant17.start()
+        # probe until the occupied replica self-reports ZERO headroom
+        deadline17 = time.monotonic() + 10.0
+        while True:
+            router17.probe_now()
+            if (replica17_f.last_stats or {}).get(
+                "max_admission_rows"
+            ) == 0:
+                break
+            assert time.monotonic() < deadline17, (
+                "full replica never reported zero admission headroom: "
+                f"{replica17_f.last_stats}"
+            )
+            time.sleep(0.05)
+
+        pre17 = _scrape(base17)
+        dispatch_pre17 = replica_dispatches(pre17)
+        refused_pre17 = refused_retries(pre17)
+        hits_pre17 = 0.0
+        try:
+            hits_pre17 = _metric_value(
+                pre17, "llm_router_affinity_hits_total"
+            )
+        except AssertionError:
+            pass
+
+        client17 = RemoteHTTPBackend(base17)
+        # first sharer: every store is cold on SHARED17 → the affinity
+        # policy falls back to least-queue, whose (load, name) tie-break
+        # seats it on afa — which publishes the prefix
+        first17 = client17.generate(
+            _GenReq("smoke:1b", SHARED17 + " first tail", max_new_tokens=8)
+        )
+        route17_1 = first17.extras["router"]
+        assert route17_1["replica"] == "afa", route17_1
+        assert route17_1["affinity"] == "fallback", route17_1
+        router17.probe_now()  # federate the published digest
+        assert (
+            (replica17_a.last_stats or {})
+            .get("prefix_digest", {})
+            .get("entries")
+        ), replica17_a.last_stats
+        # the occupant is still holding the full replica's slot
+        assert (replica17_f.last_stats or {}).get(
+            "max_admission_rows"
+        ) == 0, replica17_f.last_stats
+
+        # second sharer AGAINST the queue signal: afa is pinned busier,
+        # so least-queue alone would pick afb — the estimator's
+        # longest-match claim must override it, trace-linked
+        tid17 = mint_trace_id()
+        replica17_a.outstanding += 1
+        try:
+            second17 = client17.generate(
+                _GenReq(
+                    "smoke:1b",
+                    SHARED17 + " second tail",
+                    max_new_tokens=8,
+                    trace=TraceContext(trace_id=tid17),
+                )
+            )
+        finally:
+            replica17_a.outstanding -= 1
+        route17_2 = second17.extras["router"]
+        assert route17_2["replica"] == "afa", route17_2
+        aff17 = route17_2["affinity"]
+        assert isinstance(aff17, dict) and aff17["est_tokens"] >= 16, (
+            route17_2
+        )
+
+        # non-sharing fillers spread across the healthy pair — never
+        # onto the full replica, and never via a bounced refusal
+        for i in range(4):
+            body17 = _post_generate(base17, f"affinity filler {i}", 4)
+            assert body17.get("done"), body17
+            assert body17["x_extras"]["router"]["replica"] in (
+                "afa",
+                "afb",
+            ), body17["x_extras"]["router"]
+
+        text17 = _scrape(base17)
+        dispatch17 = replica_dispatches(text17)
+        full_disp17 = dispatch17.get("full", 0.0) - dispatch_pre17.get(
+            "full", 0.0
+        )
+        assert full_disp17 == 0, (
+            f"full replica was dispatched to: {dispatch17}"
+        )
+        refused17 = refused_retries(text17) - refused_pre17
+        assert refused17 == 0, (
+            f"admission gate let a refusal through: {refused17}"
+        )
+        hits17 = (
+            _metric_value(text17, "llm_router_affinity_hits_total")
+            - hits_pre17
+        )
+        assert hits17 >= 1, f"affinity hit counter never moved: {hits17}"
+        # the affinity decision is on the flight ring, trace-linked
+        ev17 = _get_json(
+            base17, f"/debug/flight?type=affinity_route&trace={tid17}"
+        )["events"]
+        assert any(
+            e.get("replica") == "afa"
+            and (e.get("est_tokens") or 0) >= 16
+            for e in ev17
+        ), ev17
+
+        # the occupant drains; a fresh probe must show the headroom
+        # RECOVER — admission is live capacity, not a blacklist
+        occupant17.join(timeout=40)
+        assert occ_done17.get("tokens") == 640, occ_done17
+        router17.probe_now()
+        recovered17 = (replica17_f.last_stats or {}).get(
+            "max_admission_rows"
+        )
+        assert recovered17 and recovered17 >= 1, replica17_f.last_stats
+    finally:
+        if occupant17.ident is not None:
+            occupant17.join(timeout=40)
+        server17.stop()
+
     print(
         json.dumps(
             {
@@ -2082,6 +2282,15 @@ def main() -> int:
                     "bytes_symmetric": True,
                     "wasted_migration_joules": round(wire_j16, 9),
                     "wire_ledger_agrees": True,
+                },
+                "affinity_admission": {
+                    "affinity_trace": tid17,
+                    "affinity_hits": hits17,
+                    "est_tokens": aff17["est_tokens"],
+                    "full_replica_dispatches": full_disp17,
+                    "refused_retries": refused17,
+                    "occupant_tokens": occ_done17.get("tokens"),
+                    "headroom_recovered": recovered17,
                 },
             }
         )

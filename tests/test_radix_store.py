@@ -317,3 +317,44 @@ def test_paged_hit_rebuilds_pages_from_seed_when_blob_gone():
             results[id(res.request)] = res
     assert results[id(joiner)].tokens == plain.generate(joiner).tokens
     sess2.close()
+
+
+# -- routing digest (ISSUE 19) -------------------------------------------------
+
+
+def test_digest_bounded_under_large_store():
+    """The /healthz digest must stay a bounded summary no matter how
+    big the store grows: ≤ DIGEST_MAX_PREFIXES entries of
+    ≤ DIGEST_MAX_HASHES chunk hashes each, freshest prefixes first —
+    a 10k-node store and a 16-node store publish the same shape."""
+    import json as _json
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.radix_store import (
+        DIGEST_MAX_HASHES,
+        DIGEST_MAX_PREFIXES,
+        prefix_chunk_hashes,
+    )
+
+    store = RadixPrefixStore(capacity=20_000)
+    k2, v2 = _seed(2)
+    for i in range(10_000):
+        store.publish("m", [i * 7 + 1, i * 7 + 2], k2, v2)
+    # one deep spine: 40 full pages — the hash list must cap at 16
+    deep = list(range(1, PAGE * 40 + 1))
+    kd, vd = _seed(len(deep))
+    store.publish("m", deep, kd, vd)
+    assert len(store._nodes_of("m")) > 10_000
+
+    d = store.digest()
+    assert d["v"] == 1
+    assert 0 < len(d["entries"]) <= DIGEST_MAX_PREFIXES
+    for e in d["entries"]:
+        assert len(e["h"]) <= DIGEST_MAX_HASHES
+        assert e["model"] == "m" and e["page"] >= 1
+    # the deep spine was published LAST → freshest → ranked first,
+    # its claim capped at the hash budget's coverage
+    first = d["entries"][0]
+    assert first["tokens"] == len(deep)
+    assert first["h"] == prefix_chunk_hashes(deep, first["page"], DIGEST_MAX_HASHES)
+    # the serialized digest must ride a /healthz body comfortably
+    assert len(_json.dumps(d)) < 16_384

@@ -117,12 +117,15 @@ def test_refused_admission_retries_once_elsewhere():
         results = [router.dispatch(_req(f"r{i}")) for i in range(2)]
         replicas = [r.extras["router"]["replica"] for r in results]
         assert replicas == ["ref_b", "ref_b"]
-        # a refusal is a capacity answer, not a death: ref_a stays in
-        # the rotation (and keeps refusing) until a probe sees it down
+        # a refusal is a capacity answer, not a death: ref_a stays
+        # healthy — but the refusal zeroes its CACHED admission
+        # headroom (ISSUE 19), so only the FIRST ticket pays a retry;
+        # the second is steered straight to the survivor by the
+        # admission gate without touching the full replica
         retried = [r for r in results if r.extras["router"].get("retried")]
-        assert len(retried) == 2
+        assert len(retried) == 1
         assert retried[0].extras["router"]["retried"] == "refused"
-        assert _retries("refused") - before == 2
+        assert _retries("refused") - before == 1
         # the probe notices the stopped scheduler; dispatch then goes
         # straight to the survivor with no retry
         router.probe_now()
@@ -130,7 +133,7 @@ def test_refused_admission_retries_once_elsewhere():
         clean = router.dispatch(_req("r2"))
         assert clean.extras["router"]["replica"] == "ref_b"
         assert "retried" not in clean.extras["router"]
-        assert _retries("refused") - before == 2
+        assert _retries("refused") - before == 1
     finally:
         router.stop()
 
@@ -579,3 +582,117 @@ def test_local_replica_probe_reports_store_pages():
         assert stats.get("prefix_store_hbm_pages", 0) > 0
     finally:
         replica.close()
+
+
+# -- prefix-affinity routing (ISSUE 19) ----------------------------------------
+
+
+SHARED = "affinity shared system prompt: " + "x" * 64  # 4+ full fake pages
+
+
+@pytest.fixture()
+def affinity_fleet():
+    replicas = [
+        LocalReplica("afa", FakeBackend(prefix_share=True)),
+        LocalReplica("afb", FakeBackend(prefix_share=True)),
+    ]
+    router = Router(replicas, policy="affinity")
+    yield router, replicas
+    router.stop()
+
+
+def test_affinity_routes_sharer_to_warm_replica(affinity_fleet):
+    router, (ra, rb) = affinity_fleet
+    # warm BOTH replicas for the model first (direct, off-router) so
+    # the model-placement preference never narrows the candidate set —
+    # this test isolates the affinity signal
+    rb.generate(_req("afb distinct local traffic"))
+    # first sharer: both stores cold on the SHARED prefix →
+    # least-queue tie-break (name order) seats it on afa, which
+    # publishes the prefix
+    first = router.dispatch(_req(SHARED + " first tail"))
+    assert first.extras["router"]["replica"] == "afa"
+    assert first.extras["router"]["affinity"] == "fallback"
+    hits0 = router_mod._AFFINITY_C.labels(replica="afa").value
+    router.probe_now()  # federate the published digest
+    assert (ra.last_stats or {}).get("prefix_digest", {}).get("entries")
+    # pin load on afa so least-queue alone would pick afb: the
+    # estimator's longest-match claim must override the queue signal
+    ra.outstanding += 1
+    try:
+        second = router.dispatch(_req(SHARED + " second tail"))
+    finally:
+        ra.outstanding -= 1
+    aff = second.extras["router"]["affinity"]
+    assert second.extras["router"]["replica"] == "afa"
+    assert isinstance(aff, dict) and aff["est_tokens"] >= 16
+    assert router_mod._AFFINITY_C.labels(replica="afa").value == hits0 + 1
+
+
+def test_affinity_stale_digest_falls_back_to_least_queue(affinity_fleet):
+    router, (ra, rb) = affinity_fleet
+    rb.generate(_req("afb warm"))  # both warm: no placement narrowing
+    router.dispatch(_req(SHARED + " warmup"))
+    router.probe_now()
+    # age every probe past the staleness horizon: the estimator must
+    # not trust a digest the store may have evicted since
+    for r in (ra, rb):
+        r.t_probe = time.monotonic() - router.affinity_stale_s - 1.0
+    ra.outstanding += 1  # least-queue now prefers afb
+    try:
+        res = router.dispatch(_req(SHARED + " sharer"))
+    finally:
+        ra.outstanding -= 1
+    assert res.extras["router"]["affinity"] == "fallback"
+    assert res.extras["router"]["replica"] == "afb"
+
+
+def test_affinity_tie_breaks_deterministically(affinity_fleet):
+    router, (ra, rb) = affinity_fleet
+    req = _req(SHARED + " tie")
+    # fabricate the tie: both replicas publish the IDENTICAL digest
+    digest = ra.backend.prefix_store.digest()
+    now = time.monotonic()
+    router.dispatch(_req(SHARED + " seed"))  # make the digest non-empty
+    digest = ra.backend.prefix_store.digest()
+    assert digest["entries"]
+    for r in (ra, rb):
+        r.last_stats = {"prefix_digest": digest, "max_admission_rows": 8}
+        r.t_probe = now
+    d1 = {}
+    pick1 = router._pick(request=req, decision=d1)
+    assert d1["affinity"] == "hit" and pick1.name == "afa"  # name order
+    rb_pick_expected = "afb"
+    ra.outstanding += 2  # equal estimates: load breaks the tie
+    try:
+        d2 = {}
+        pick2 = router._pick(request=req, decision=d2)
+    finally:
+        ra.outstanding -= 2
+    assert d2["affinity"] == "hit" and pick2.name == rb_pick_expected
+
+
+def test_affinity_cold_store_degrades_to_least_queue_exactly():
+    # replicas WITHOUT prefix stores: the affinity policy must pick
+    # byte-identically to least-queue in every load state
+    replicas = [
+        LocalReplica("ca", FakeBackend()),
+        LocalReplica("cb", FakeBackend()),
+    ]
+    router = Router(replicas, policy="affinity")
+    try:
+        req = _req("cold store prompt with no published prefixes")
+        for loads in [(0, 0), (1, 0), (0, 1), (2, 2), (3, 1)]:
+            replicas[0].outstanding, replicas[1].outstanding = loads
+            decision = {}
+            pick_aff = router._pick(request=req, decision=decision)
+            assert decision["affinity"] == "fallback"
+            router.policy = "least-queue"
+            try:
+                pick_lq = router._pick()
+            finally:
+                router.policy = "affinity"
+            assert pick_aff.name == pick_lq.name
+        replicas[0].outstanding = replicas[1].outstanding = 0
+    finally:
+        router.stop()

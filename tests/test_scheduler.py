@@ -802,3 +802,59 @@ def test_fake_backend_speaks_spec_protocol_with_fallback():
         sched.stop()
     assert res.extras["spec"]["fallback"] is True
     assert counter("llm_spec_fallback_total") == fallbacks0 + 1
+
+
+def test_fake_spec_adaptive_k_shrinks_then_restores():
+    """ISSUE 19 adaptive draft-k (hermetic twin): a below-floor slice
+    HALVES the session's live k instead of abandoning speculation —
+    the per-round advance (the acceptance step) follows the live k —
+    and a recovered acceptance restores k toward the configured
+    length. llm_spec_k_adapt_total{direction} moves both ways and the
+    session never falls back."""
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+        FakeBackend,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+        REGISTRY,
+    )
+
+    def adapt(direction):
+        return (
+            REGISTRY.snapshot()
+            .get("llm_spec_k_adapt_total", {})
+            .get(f"source=model,direction={direction}", 0)
+        )
+
+    fb = FakeBackend(
+        spec_k=4, spec_acceptance=0.75, spec_accept_floor=0.25
+    )
+    sess = fb.decode_open(
+        [GenerationRequest("m", "adaptive", max_new_tokens=512)]
+    )
+    down0, up0 = adapt("down"), adapt("up")
+    sess.step(4)  # healthy window: k stays at the configured 4
+    assert sess.spec_k == 4 and sess.spec_active
+    row = sess.debug_state()["rows"][0]
+    # acceptance 0.75 at k=4: each round advances 1 + 3 accepted
+    assert row["generated_tokens"] == 16
+
+    fb.spec_acceptance = 0.0  # rough patch: every draft rejected
+    before = sess.debug_state()["rows"][0]["generated_tokens"]
+    sess.step(4)
+    assert sess.spec_k == 2 and sess.spec_active  # shrink, no fallback
+    assert adapt("down") == down0 + 1
+    # the rough-patch acceptance step: all rejected → each round
+    # advanced exactly the target's own 1 token (k=4 during the slice;
+    # the shrink lands at its end)
+    assert sess.debug_state()["rows"][0]["generated_tokens"] == before + 4
+    sess.step(4)
+    assert sess.spec_k == 1 and sess.spec_active
+    assert adapt("down") == down0 + 2
+
+    fb.spec_acceptance = 0.75  # recovery: restore toward k0
+    sess.step(4)
+    assert sess.spec_k == 2 and adapt("up") == up0 + 1
+    sess.step(4)
+    assert sess.spec_k == 4 and adapt("up") == up0 + 2
+    assert sess.spec_active and not sess.spec_fallback
+    sess.close()

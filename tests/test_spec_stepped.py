@@ -358,36 +358,39 @@ def test_spec_session_admits_sampled_rows_and_joiners(registry):
 
 
 def test_spec_adaptive_fallback_preserves_parity(registry):
-    """The adaptive policy: a weak draft under a high floor falls the
-    session back to plain decode mid-flight — llm_spec_fallback_total
-    moves, extras mark fallback, and the stream is STILL the plain
-    greedy stream (both modes emit the target's argmax tokens)."""
+    """The adaptive policy: a weak draft under a high floor first
+    SHRINKS the draft length (llm_spec_k_adapt_total{direction=down},
+    ISSUE 19) and only falls the session back to plain decode from
+    k=1 — llm_spec_fallback_total moves, extras mark fallback, and the
+    stream is STILL the plain greedy stream at every k along the way
+    (both modes emit the target's argmax tokens)."""
     from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
         REGISTRY,
     )
+
+    def snap(name):
+        return sum(
+            v
+            for k, v in REGISTRY.snapshot().get(name, {}).items()
+            if "source=model" in k
+        )
 
     eng = _spec_engine(registry, spec_accept_floor=0.95)
     plain_eng = JaxEngine(registry=dict(registry), dtype=jnp.float32)
     req = GenerationRequest(
         "tiny", "long fallback run", max_new_tokens=120, stop_at_eos=False
     )
-    before = (
-        REGISTRY.snapshot()
-        .get("llm_spec_fallback_total", {})
-        .get("source=model", 0)
-    )
+    before = snap("llm_spec_fallback_total")
+    down0 = snap("llm_spec_k_adapt_total")
     sess = eng.decode_open([req])
-    assert sess.spec is not None
+    assert sess.spec is not None and sess.spec["k"] == 3
     res = _drain(sess, max_steps=4)[0]
     assert sess.spec is None and sess.spec_fallback
     assert res.extras["spec"]["fallback"] is True
     assert res.tokens == plain_eng._generate_plain(req).tokens
-    after = (
-        REGISTRY.snapshot()
-        .get("llm_spec_fallback_total", {})
-        .get("source=model", 0)
-    )
-    assert after == before + 1
+    assert snap("llm_spec_fallback_total") == before + 1
+    # the shrink stage ran before the fallback: k stepped 3 -> 1
+    assert snap("llm_spec_k_adapt_total") >= down0 + 1
 
 
 def test_spec_session_through_continuous_scheduler(registry):

@@ -360,6 +360,252 @@ def test_tp_cancel_restores_free_count_exactly(registry):
     sess.close()
 
 
+# -- tp×dp in-mesh row sharding (ISSUE 19) -------------------------------------
+
+
+def _dp_engine(registry, dp, tp, **kwargs):
+    mesh = build_mesh(
+        MeshSpec.dp_tp(dp, tp), devices=jax.devices()[: dp * tp]
+    )
+    return TensorParallelEngine(
+        mesh=mesh, registry=dict(registry), dtype=jnp.float32, **kwargs
+    )
+
+
+@pytest.mark.parametrize("paged,kv", LAYOUTS)
+def test_dp_stepped_parity_all_layouts(registry, paged, kv):
+    """The ISSUE-19 acceptance matrix: a 2×2 tp×dp mesh (4 virtual
+    devices), all four cache layouts — every row, a mid-flight joiner
+    included, emits the token stream bit-identical to its solo
+    generate() on the SAME dp-sharded engine."""
+    eng = _dp_engine(registry, 2, 2, paged_kv=paged, kv_quantize=kv)
+    anchor = GenerationRequest(
+        "tiny", "dp anchor runs long on the mesh", max_new_tokens=24,
+        stop_at_eos=False,
+    )
+    short = GenerationRequest(
+        "tiny", "dp short companion", max_new_tokens=6, seed=2
+    )
+    joiner = GenerationRequest(
+        "tiny", "dp late joiner lands here", max_new_tokens=10,
+        seed=3,
+    )
+    solo = {id(r): eng.generate(r) for r in (anchor, short, joiner)}
+    sess = eng.decode_open([anchor, short], reserve_rows=4)
+    assert sess.dp_shards == 2, "dp never engaged on the 2x2 mesh"
+    sess.step(4)
+    assert sess.can_join(joiner)
+    sess.join(joiner)
+    results = {id(r.request): r for r in _drain(sess)}
+    for req in (anchor, short, joiner):
+        assert results[id(req)].tokens == solo[id(req)].tokens, (
+            f"row diverged on dp=2 tp=2 paged={paged} kv={kv}"
+        )
+    sess.close()
+
+
+@pytest.mark.parametrize("dp,tp", [(4, 1), (2, 4), (4, 2)])
+def test_dp_mesh_shapes_paged_parity(registry, dp, tp):
+    """Mesh-shape sweep on the paged layout: pure-dp (4×1), wide-tp
+    (2×4) and the full 8-device 4×2 — the same session code engages
+    whatever dp the mesh offers and stays solo-identical."""
+    eng = _dp_engine(registry, dp, tp, paged_kv=True)
+    reqs = [
+        GenerationRequest(
+            "tiny", f"dp sweep row {i}", max_new_tokens=12, seed=i + 1,
+            stop_at_eos=False,
+        )
+        for i in range(3)
+    ]
+    solo = {id(r): eng.generate(r) for r in reqs}
+    sess = eng.decode_open(reqs, reserve_rows=4)
+    assert sess.dp_shards == dp
+    results = {id(r.request): r for r in _drain(sess)}
+    for req in reqs:
+        assert results[id(req)].tokens == solo[id(req)].tokens, (
+            f"row diverged on dp={dp} tp={tp}"
+        )
+    sess.close()
+    assert sess.pool.free_pages == sess.pool.n_pages - sess.dp_shards
+
+
+def test_dp_carry_shardings_declared_and_stable(registry):
+    """The dp contract, directly: payload leaves gain a 'dp' row/page
+    axis next to the tp head axis, row-control leaves shard their
+    leading row dim over dp instead of replicating, and one compiled
+    slice step returns the carry with the SAME placements."""
+    from jax.sharding import PartitionSpec as P
+
+    eng = _dp_engine(registry, 2, 2, paged_kv=True)
+    sess = eng.decode_open(
+        [
+            GenerationRequest(
+                "tiny", "dp sharding probe", max_new_tokens=20,
+                stop_at_eos=False,
+            )
+        ],
+        reserve_rows=4,
+    )
+    assert sess.dp_shards == 2
+
+    def specs():
+        out = {}
+        for key, leaf in sess.carry.items():
+            arr = leaf["q"] if isinstance(leaf, dict) else leaf
+            out[key] = arr.sharding.spec
+        return out
+
+    before = specs()
+    # pool payload: page dim over dp, heads over tp
+    assert before["pool_k"] == P(None, "dp", "tp", None, None)
+    assert before["pool_v"] == P(None, "dp", "tp", None, None)
+    # row control: leading row dim over dp (no longer replicated)
+    for key in ("tokens", "done", "remaining", "table", "presence"):
+        assert before[key][0] == "dp", (key, before[key])
+    sess.step(4)
+    assert specs() == before  # one slice later: placements unchanged
+    state = sess.debug_state()
+    assert state["mesh"]["devices"] == 4
+    assert state["mesh"]["axes"] == {"dp": 2, "tp": 2}
+    sess.close()
+
+
+def test_dp_per_shard_parking_and_page_locality(registry):
+    """The host allocator mirrors the GSPMD split: each dp shard keeps
+    its OWN parking page, and a row's pages come from the page range
+    its shard owns (best-effort locality — spillover is allowed, the
+    preference is what's pinned here on an empty pool)."""
+    eng = _dp_engine(registry, 2, 2, paged_kv=True)
+    reqs = [
+        GenerationRequest(
+            "tiny", f"locality row {i}", max_new_tokens=8, seed=i + 1
+        )
+        for i in range(4)
+    ]
+    sess = eng.decode_open(reqs, reserve_rows=4)
+    assert sess.dp_shards == 2
+    assert len(sess.parking_pages) == 2
+    half = sess.pool.n_pages // 2
+    shard_of = lambda p: 0 if p < half else 1  # noqa: E731
+    # parking pages live one per shard
+    assert sorted(shard_of(p) for p in sess.parking_pages) == [0, 1]
+    # every live row's pages sit on the shard that owns the row slot
+    for r, row in enumerate(sess.rows):
+        if row is None:
+            continue
+        want = sess._row_shard(r)
+        assert all(shard_of(p) == want for p in row.pages), (
+            r, want, row.pages,
+        )
+    # cancellation hands the pages back and keeps the exact-free
+    # invariant on the sharded pool
+    free_before = sess.pool.free_pages
+    victim = next(row for row in sess.rows if row is not None)
+    pages = len(victim.pages)
+    assert sess.cancel(victim.request)
+    assert sess.pool.free_pages == free_before + pages
+    sess.close()
+
+
+def test_dp_mid_flight_join_lands_on_row_shard(registry):
+    """A mid-flight joiner on the dp mesh allocates its pages on the
+    shard owning its seat — the join path routes through the same
+    shard-preferred allocator as open — and still matches solo."""
+    eng = _dp_engine(registry, 2, 2, paged_kv=True)
+    anchor = GenerationRequest(
+        "tiny", "dp join anchor", max_new_tokens=20, stop_at_eos=False
+    )
+    joiner = GenerationRequest(
+        "tiny", "dp joiner lands sharded", max_new_tokens=8, seed=5
+    )
+    solo_joiner = eng.generate(joiner)
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    assert sess.dp_shards == 2
+    sess.step(2)
+    sess.join(joiner)
+    half = sess.pool.n_pages // 2
+    r, row = next(
+        (r, row)
+        for r, row in enumerate(sess.rows)
+        if row is not None and row.request is joiner
+    )
+    want = sess._row_shard(r)
+    assert all(
+        (0 if p < half else 1) == want for p in row.pages
+    ), (r, want, row.pages)
+    results = {id(r_.request): r_ for r_ in _drain(sess)}
+    assert results[id(joiner)].tokens == solo_joiner.tokens
+    sess.close()
+
+
+def test_dp_indivisible_bucket_falls_back_to_tp_only(registry):
+    """A bucket width that does not divide dp must NOT engage row
+    sharding (the stepped_carry_shardings divisibility fallback) — the
+    session still serves, tp-only, instead of crashing the mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    eng = _dp_engine(registry, 4, 2, paged_kv=True)
+    req = GenerationRequest(
+        "tiny", "bucket of two on dp four", max_new_tokens=8
+    )
+    solo = eng.generate(req)
+    # b_bucket=2 (one row + reserve 1 → bucket 2) does not divide dp=4
+    sess = eng.decode_open([req], reserve_rows=1)
+    assert sess.b_bucket % 4 != 0
+    assert sess.dp_shards == 1
+    assert sess.carry["tokens"].sharding.spec == P()
+    results = _drain(sess)
+    assert results[0].tokens == solo.tokens
+    sess.close()
+
+
+def test_dp_continuous_scheduler_serves_sharded_rows(registry):
+    """The serve plumbing end-to-end in-process: a tp×dp engine behind
+    the continuous scheduler (what ``serve --backend jax-tp --tp N
+    --dp M`` builds) admits staggered rows, steps them on the sharded
+    session and retires both with solo-identical streams."""
+    import threading
+    import time
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.scheduler import (
+        ContinuousScheduler,
+    )
+
+    eng = _dp_engine(registry, 2, 2, paged_kv=True)
+    r1 = GenerationRequest(
+        "tiny", "sched dp row one", max_new_tokens=10, stop_at_eos=False
+    )
+    r2 = GenerationRequest(
+        "tiny", "sched dp row two", max_new_tokens=8, seed=2
+    )
+    solo = {id(r): eng.generate(r) for r in (r1, r2)}
+    sched = ContinuousScheduler(eng, slice_steps=2)
+    sched.start()
+    try:
+        done = {}
+
+        def run(req):
+            done[id(req)] = sched.submit(req)
+
+        threads = [
+            threading.Thread(target=run, args=(r,)) for r in (r1, r2)
+        ]
+        threads[0].start()
+        time.sleep(0.05)
+        threads[1].start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "dp scheduler row hung"
+        for req in (r1, r2):
+            assert done[id(req)].tokens == solo[id(req)].tokens
+        assert sched.debug_state()["backend_mesh"]["axes"] == {
+            "dp": 2,
+            "tp": 2,
+        }
+    finally:
+        sched.stop()
+
+
 def test_tp_deadline_reap_through_continuous_scheduler(registry):
     """Deadline reaping propagates into the sharded session: a
     mid-flight ``deadline_ms`` expiry retires the row through the
