@@ -43,6 +43,14 @@ class GenerationRequest:
     # serve/protocol.PRIORITY_TIERS (low=0, normal=1, high=2); any
     # non-negative integer is a valid tier.
     priority: int = 1
+    # Usage-accounting tenant (wire: x_tenant — ISSUE 20). Every request
+    # belongs to exactly one tenant; "default" when the caller names
+    # none. Terminal outcomes, served/generated tokens and attributed
+    # Joules are accounted per tenant (obs/tenants.py) — the substrate
+    # energy contracts and billing replay consume. Scrape-label
+    # cardinality is bounded THERE (overflow folds into "_other"); the
+    # request keeps the raw id.
+    tenant: str = "default"
     # Fleet-wide trace context (wire: x_trace — ISSUE 13): minted at the
     # front door (router/server) when absent, or accepted from the
     # caller; every hop the request touches (both attempts of a retry
@@ -81,6 +89,10 @@ class GenerationRequest:
             raise ValueError(
                 f"priority must be a non-negative integer tier, "
                 f"got {self.priority!r}"
+            )
+        if not self.tenant or not isinstance(self.tenant, str):
+            raise ValueError(
+                f"tenant must be a non-empty string, got {self.tenant!r}"
             )
 
 

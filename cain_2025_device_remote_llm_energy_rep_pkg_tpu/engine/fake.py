@@ -24,6 +24,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..obs.detect import observe_retired_tokens, observe_slice_tokens
+from ..obs.metrics import enabled as _obs_enabled
 from .backend import GenerationBackend, GenerationRequest, GenerationResult
 
 # Fake "page" granularity for the shared-prefix simulation: small enough
@@ -305,6 +306,14 @@ class _FakeStepSession:
         self._swap_bytes = 0
         self._swap_rows = 0
         self._slices_run = 0  # mid-stream death injection clock
+        # per-row slice attribution (ISSUE 20) — the hermetic twin of
+        # SteppedDecodeSession's: _attr_totals accumulates every wall
+        # second and synthetic Joule the session bills anywhere (slices
+        # + join chunks), _attr_dropped the accounts of rows that left
+        # without retiring (cancel / abort / close), so conservation —
+        # live + retired + dropped == totals — is testable exactly
+        self._attr_totals = {"wall": 0.0, "J": 0.0, "J_low": 0.0, "J_high": 0.0}
+        self._attr_dropped = {"wall": 0.0, "J": 0.0, "J_low": 0.0, "J_high": 0.0}
         for r in requests:
             self._admit(r)
 
@@ -351,6 +360,14 @@ class _FakeStepSession:
                 "spec_drafted": 0,
                 "spec_rejected": 0,
                 "draft_wasted_J": 0.0,
+                # slice-attribution account (ISSUE 20): lives on the row
+                # dict so it survives preempt/resume for free (the pr
+                # parks this same dict). attr_wasted_J is informational
+                # (swap mirrors), never folded into attr_J.
+                "attr_wall": 0.0,
+                "attr_J": 0.0,
+                "attr_slices": 0,
+                "attr_wasted_J": 0.0,
                 **self._prefix_probe(request),
             }
         )
@@ -402,17 +419,27 @@ class _FakeStepSession:
             "request": request,
             "chunk_tokens": chunk,
             "tokens_left": max(1, n_prompt - mapped),
+            "attr_wall": 0.0,
         }
         self._pending.append(pending)
         return pending
 
     def join_step(self, pending: dict) -> bool:
         """One prefill chunk; prefill streams ~8 tokens per decode-token
-        wall (it is parallel over positions) when simulating delay."""
+        wall (it is parallel over positions) when simulating delay. The
+        chunk's wall bills to the joiner's attribution account (ISSUE
+        20); the fake's synthetic energy model prices decode tokens
+        only, so chunks carry no Joules here (the real twin estimates
+        them from the prefill window)."""
         tokens = min(pending["chunk_tokens"], pending["tokens_left"])
+        t0 = time.monotonic()
         if self.backend.simulate_delay:
             time.sleep(max(1, tokens) / (self.backend.tokens_per_s * 8.0))
         pending["tokens_left"] -= tokens
+        if _obs_enabled():
+            dt = time.monotonic() - t0
+            self._attr_totals["wall"] += dt
+            pending["attr_wall"] = pending.get("attr_wall", 0.0) + dt
         return pending["tokens_left"] <= 0
 
     def join_commit(self, pending: dict) -> int:
@@ -423,12 +450,16 @@ class _FakeStepSession:
         if pr is not None:
             # re-seat the preempted row exactly where it stopped: the
             # cursor (and streamed watermark) carry over, so the final
-            # stream is identical to an uninterrupted run
+            # stream is identical to an uninterrupted run (the row dict
+            # carries its attribution account through the park; the
+            # re-prefill chunks' wall joins it here)
             row = pr["row"]
+            row["attr_wall"] += pending.get("attr_wall", 0.0)
             self._rows.append(row)
             self._swap_settle(pr, transfer=True)
             return len(self._rows) - 1
         self._admit(pending["request"])
+        self._rows[-1]["attr_wall"] += pending.get("attr_wall", 0.0)
         return len(self._rows) - 1
 
     # -- mid-flight preemption (the stepped session's ISSUE-11 twin) -----------
@@ -518,6 +549,7 @@ class _FakeStepSession:
             "chunk_tokens": max(1, int(chunk_tokens or 256)),
             "tokens_left": tokens_left,
             "resume": pr,
+            "attr_wall": 0.0,
         }
         self._pending.append(pending)
         return pending
@@ -528,6 +560,8 @@ class _FakeStepSession:
     def join_abort(self, pending: dict) -> None:
         if pending in self._pending:
             self._pending.remove(pending)
+            self._attr_dropped["wall"] += pending.get("attr_wall", 0.0)
+            pending["attr_wall"] = 0.0
 
     @property
     def pending_joins(self) -> int:
@@ -620,9 +654,69 @@ class _FakeStepSession:
         except Exception:  # noqa: BLE001 — telemetry only
             pass
 
+    def _attr_slice(self, counts: Dict[int, int], wall: float) -> None:
+        """Split one slice's wall and synthetic Joules across live rows
+        by token share (the hermetic twin of
+        ``SteppedDecodeSession._attr_slice``): the fake's energy model
+        is ``jpt × tokens``, so a row's slice share is exactly
+        ``jpt × its clamped new tokens`` and lifetime sums equal the
+        whole-request figure ``_observe_energy`` reports."""
+        slice_tokens = sum(counts.values())
+        if not slice_tokens:
+            return
+        jpt = self.backend._jpt_for(self.model)
+        j_slice = jpt * slice_tokens
+        self._attr_totals["wall"] += wall
+        self._attr_totals["J"] += j_slice
+        self._attr_totals["J_low"] += j_slice
+        self._attr_totals["J_high"] += j_slice
+        for i, cnt in counts.items():
+            if not cnt:
+                continue
+            row = self._rows[i]
+            row["attr_wall"] += wall * (cnt / slice_tokens)
+            row["attr_J"] += jpt * cnt
+            row["attr_slices"] += 1
+
+    def _attr_drop(self, account: dict) -> None:
+        """A row (or joiner) leaves without retiring: its account moves
+        to the dropped bucket so conservation still closes."""
+        self._attr_dropped["wall"] += account.get("attr_wall", 0.0)
+        j = account.get("attr_J", 0.0)
+        self._attr_dropped["J"] += j
+        self._attr_dropped["J_low"] += j
+        self._attr_dropped["J_high"] += j
+        account["attr_wall"] = 0.0
+        account["attr_J"] = 0.0
+
+    def _close_out_energy(self, row: dict, res: GenerationResult) -> None:
+        """Stamp the retiring row's accumulated slice account into
+        ``extras["energy_model"]`` (window ``slice``), overriding the
+        whole-request figure ``_observe_energy`` wrote — same wire shape
+        as the real session's close-out. Rounded at 9dp so the 1e-6
+        conservation invariant survives the wire."""
+        gen = res.generated_tokens
+        j = row["attr_J"]
+        em = {
+            "J": round(j, 9),
+            "J_low": round(j, 9),
+            "J_high": round(j, 9),
+            "J_per_token": round(j / gen, 9) if gen else 0.0,
+            "J_per_token_low": round(j / gen, 9) if gen else 0.0,
+            "J_per_token_high": round(j / gen, 9) if gen else 0.0,
+            "wall_attr_s": round(row["attr_wall"], 9),
+            "slices": row["attr_slices"],
+            "window": "slice",
+        }
+        wasted = row["attr_wasted_J"] + row["draft_wasted_J"]
+        if wasted:
+            em["wasted_J"] = round(wasted, 9)
+        res.extras = {**(res.extras or {}), "energy_model": em}
+
     def step(self, max_steps: int = 16) -> List[GenerationResult]:
         if self.closed:
             raise RuntimeError("session is closed")
+        t_slice = time.monotonic()
         # simulated mid-stream death (router/failure-path tests): the
         # session dies AFTER fail_after_slices slices completed — rows
         # may already have streamed tokens, so a front-door router must
@@ -760,6 +854,20 @@ class _FakeStepSession:
                 k_old = self.spec_k
                 self.spec_k = min(self.spec_k0, self.spec_k * 2)
                 self._spec_k_event(k_old, self.spec_k, measured)
+        # slice attribution (ISSUE 20) BEFORE the retire loop, so
+        # retiring rows carry the final slice's share: each live row's
+        # new tokens this slice, clamped to its remaining budget
+        if _obs_enabled() and self._rows:
+            try:
+                counts = {}
+                for i, row in enumerate(self._rows):
+                    gen = row["result"].generated_tokens
+                    old = min(row["cursor"], gen)
+                    adv = row.get("advance", max_steps)
+                    counts[i] = min(row["cursor"] + adv, gen) - old
+                self._attr_slice(counts, time.monotonic() - t_slice)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         retired, keep = [], []
         for row in self._rows:
             row["cursor"] += row.pop("advance", max_steps)
@@ -786,6 +894,13 @@ class _FakeStepSession:
                         res.extras["spec"]["draft_wasted_J"] = round(
                             row["draft_wasted_J"], 6
                         )
+                if _obs_enabled() and (
+                    row["attr_slices"] or row["attr_wall"]
+                ):
+                    try:
+                        self._close_out_energy(row, res)
+                    except Exception:  # noqa: BLE001 — telemetry only
+                        pass
                 if self.stream_tokens and row["streamed"] < len(res.tokens):
                     tail = res.tokens[row["streamed"] :]
                     self._stream_tail.append(
@@ -829,6 +944,7 @@ class _FakeStepSession:
             if row["request"] is request:
                 self._prefix_release(row)
                 self._rows.remove(row)
+                self._attr_drop(row)
                 return True
         return False
 
@@ -836,6 +952,9 @@ class _FakeStepSession:
         self.closed = True
         for row in self._rows:
             self._prefix_release(row)
+            self._attr_drop(row)
+        for pending in self._pending:
+            self._attr_drop(pending)
         self._rows = []
         self._pending = []
         self._stream_tail = []
@@ -1060,7 +1179,7 @@ class FakeBackend(GenerationBackend):
         ``_observe_result`` energy attribution, so llm_request_* energy
         families and extras["energy_model"] are CI-testable."""
         jpt = self._jpt_for(result.request.model)
-        if not jpt:
+        if not jpt or not _obs_enabled():
             return
         try:
             from ..obs import energy as obs_energy

@@ -1866,6 +1866,36 @@ class JaxEngine(GenerationBackend):
         except Exception:  # noqa: BLE001 — telemetry only
             pass
 
+    def _slice_energy(
+        self,
+        model: str,
+        cfg,
+        pairs,
+        duration_s: float,
+        steps: int,
+    ) -> "Optional[Dict[str, Any]]":
+        """Energy-model estimate for ONE continuous-decode slice (or one
+        join-prefill chunk) — ``slice_window_stats`` evaluated with this
+        engine's quantize modes and chip count (ISSUE 20). The stepped
+        sessions split the returned J/J_low/J_high across their rows by
+        token share. None when the model can't price it; never raises
+        past the callers' telemetry guards."""
+        from ..obs import energy as obs_energy
+
+        stats = obs_energy.slice_window_stats(
+            cfg,
+            pairs,
+            duration_s,
+            steps,
+            quantize=self._quant_mode(model),
+            kv_quantize=self.kv_quantize,
+        )
+        if stats is None:
+            return None
+        return obs_energy.estimate_from_stats(
+            stats, n_chips=max(1, getattr(self, "n_devices", 1))
+        )
+
     def _finish(
         self,
         request: GenerationRequest,

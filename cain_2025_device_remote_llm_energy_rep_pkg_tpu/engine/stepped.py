@@ -116,6 +116,7 @@ class _PendingJoin:
         "prefill_s", "t0", "hit_tokens", "shared_pages",
         "draft_k", "draft_v", "draft_chunks", "draft_next", "draft_ids",
         "resume", "resume_mode",
+        "attr_wall", "attr_J", "attr_J_low", "attr_J_high",
     )
 
     def __init__(
@@ -135,6 +136,13 @@ class _PendingJoin:
         self.logits = None
         self.pages: List[int] = pages
         self.prefill_s = 0.0  # sum of chunk walls (not the interleaved span)
+        # slice-attribution account of the chunk walls/Joules billed to
+        # this joiner so far (ISSUE 20) — transferred onto the _Row at
+        # commit, folded into _attr_dropped on abort
+        self.attr_wall = 0.0
+        self.attr_J = 0.0
+        self.attr_J_low = 0.0
+        self.attr_J_high = 0.0
         self.t0 = time.monotonic()
         self.hit_tokens = hit_tokens
         self.shared_pages = shared_pages
@@ -181,6 +189,8 @@ class PreemptedRow:
         "streamed", "t0", "t1", "policy", "paged", "stacked",
         "blob", "side_blob", "cache_blob", "draft_blob", "draft_offset",
         "shared_pages", "n_own_pages", "host_bytes", "discharged",
+        "attr_wall", "attr_J", "attr_J_low", "attr_J_high",
+        "attr_slices", "attr_wasted_J",
     )
 
     def __init__(self, request, ids, generated, prompt_len) -> None:
@@ -212,6 +222,16 @@ class PreemptedRow:
         self.n_own_pages = 0
         self.host_bytes = 0
         self.discharged = False  # swap ledger already settled
+        # slice-attribution account captured at preempt (ISSUE 20) —
+        # restored onto the re-seated row so attributed wall/Joules
+        # survive the park; the scheduler mirrors the victim's swap/
+        # migration waste charge into attr_wasted_J
+        self.attr_wall = 0.0
+        self.attr_J = 0.0
+        self.attr_J_low = 0.0
+        self.attr_J_high = 0.0
+        self.attr_slices = 0
+        self.attr_wasted_J = 0.0
 
 
 class _Row:
@@ -220,6 +240,8 @@ class _Row:
     __slots__ = (
         "request", "s_real", "generated", "budget", "t0", "t1",
         "t_decode0", "pages", "streamed", "shared",
+        "attr_wall", "attr_J", "attr_J_low", "attr_J_high",
+        "attr_slices", "attr_wasted_J",
     )
 
     def __init__(
@@ -239,6 +261,19 @@ class _Row:
         # leading table-row pages mapped read-only from the prefix store
         # (preemption releases these instead of swapping them)
         self.shared = shared
+        # slice-attribution account (ISSUE 20): this row's token-share
+        # of every decode slice's wall and modelled Joules (plus its
+        # join chunks), accumulated across preempt/resume and closed
+        # out into extras["energy_model"] at retirement. attr_wasted_J
+        # mirrors waste ALREADY on the wasted-energy ledger that this
+        # row caused (fully-rejected draft rounds, its own swap /
+        # migration) — informational, never double-counted into attr_J.
+        self.attr_wall = 0.0
+        self.attr_J = 0.0
+        self.attr_J_low = 0.0
+        self.attr_J_high = 0.0
+        self.attr_slices = 0
+        self.attr_wasted_J = 0.0
 
 
 def _carry_leaf(key: str) -> property:
@@ -362,6 +397,15 @@ class SteppedDecodeSession:
         # back exactly at their idle values.
         self._swap_bytes = 0
         self._swap_rows = 0
+        # Slice-attribution books (ISSUE 20): everything ever billed to
+        # rows of this session (slices + join chunks) and the accounts
+        # of rows that left without retiring (cancel / abort / close).
+        # Conservation invariant — live accounts + retired close-outs +
+        # dropped == totals, within float summation error — is what the
+        # tenant tests pin. Empty dicts when telemetry is off: the
+        # billing sites are all _obs_enabled()-gated.
+        self._attr_totals = {"wall": 0.0, "J": 0.0, "J_low": 0.0, "J_high": 0.0}
+        self._attr_dropped = {"wall": 0.0, "J": 0.0, "J_low": 0.0, "J_high": 0.0}
 
     # -- construction ---------------------------------------------------------
     @classmethod
@@ -1289,23 +1333,29 @@ class SteppedDecodeSession:
             self._spec_after_slice(live) if self.spec is not None else None
         )
         t2 = time.monotonic()
-        slice_tokens = 0
-        slice_steps = 0
-        retired: List[GenerationResult] = []
-        for r in live:
-            cnt = int(n_row_host[r])
-            slice_tokens += cnt
-            slice_steps = max(slice_steps, cnt)
-            if cnt:
-                self.rows[r].generated.extend(out_host[r][:cnt])
-            if done_host[r]:
-                retired.append(self._retire(r, t2))
+        counts = {r: int(n_row_host[r]) for r in live}
+        slice_tokens = sum(counts.values())
+        slice_steps = max(counts.values(), default=0)
         if spec_rounds_slice is not None:
             # in spec mode the device executed ROUNDS, not per-token
             # steps: one target weight-read per round for up to k+1
             # tokens — that is the amortization the whole mode exists
             # for, and what tokens-per-target-step measures
             slice_steps = spec_rounds_slice
+        if _obs_enabled() and slice_tokens:
+            # attribute BEFORE retiring: rows completing this slice must
+            # carry their share of ITS wall/Joules into their close-out
+            try:
+                self._attr_slice(counts, t2 - t1, max(1, slice_steps))
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        retired: List[GenerationResult] = []
+        for r in live:
+            cnt = counts[r]
+            if cnt:
+                self.rows[r].generated.extend(out_host[r][:cnt])
+            if done_host[r]:
+                retired.append(self._retire(r, t2))
         # Goodput accounting (obs/detect.py): the compiled slice steps
         # EVERY bucket row — live, finished-mid-slice, and padding rows
         # alike — so the device executed ~slice_steps × b_bucket row-
@@ -1320,6 +1370,135 @@ class SteppedDecodeSession:
             except Exception:  # noqa: BLE001 — telemetry only
                 pass
         return retired
+
+    # -- slice-level energy & wall attribution (ISSUE 20) ----------------------
+    def _attr_slice(
+        self, counts: "Dict[int, int]", wall: float, steps: int
+    ) -> None:
+        """Split ONE decode slice's wall clock and modelled Joules across
+        the resident rows by token share: a row that sampled ``cnt`` of
+        the slice's ``slice_tokens`` tokens owns ``cnt/slice_tokens`` of
+        both — the idle tail a narrow batch pays distributes over the
+        rows that were actually decoding, which is exactly the marginal-
+        cost question ("who pays the Joules for this content"). The
+        energy model prices the slice at each row's own context length
+        (``slice_window_stats``), so the split also reflects KV-stream
+        asymmetry in aggregate. Telemetry-only: the caller gates on
+        ``_obs_enabled()`` and wraps in try/except."""
+        slice_tokens = sum(counts.values())
+        if not slice_tokens or wall <= 0:
+            return
+        pairs = []
+        for r, cnt in counts.items():
+            row = self.rows[r]
+            pairs.append((row.s_real + len(row.generated), cnt))
+        est = self.engine._slice_energy(
+            self.model, self.cfg, pairs, wall, steps
+        )
+        j = jl = jh = 0.0
+        if est is not None:
+            j, jl, jh = est["J"], est["J_low"], est["J_high"]
+        tot = self._attr_totals
+        tot["wall"] += wall
+        tot["J"] += j
+        tot["J_low"] += jl
+        tot["J_high"] += jh
+        for r, cnt in counts.items():
+            if not cnt:
+                continue
+            row = self.rows[r]
+            share = cnt / slice_tokens
+            row.attr_wall += wall * share
+            row.attr_J += j * share
+            row.attr_J_low += jl * share
+            row.attr_J_high += jh * share
+            row.attr_slices += 1
+
+    def _attr_chunk(
+        self, pending: _PendingJoin, ctx: int, new: int, wall: float
+    ) -> None:
+        """Bill one join-prefill chunk's wall/Joules to the JOINER (the
+        in-flight rows stall for it, but the work is the joiner's — the
+        same single-owner rule as the slice split). ``ctx`` is the chunk
+        start offset, ``new`` its real token count."""
+        if wall <= 0 or new <= 0:
+            return
+        est = self.engine._slice_energy(
+            self.model, self.cfg, [(ctx, new)], wall, 1
+        )
+        j = jl = jh = 0.0
+        if est is not None:
+            j, jl, jh = est["J"], est["J_low"], est["J_high"]
+        tot = self._attr_totals
+        tot["wall"] += wall
+        tot["J"] += j
+        tot["J_low"] += jl
+        tot["J_high"] += jh
+        pending.attr_wall += wall
+        pending.attr_J += j
+        pending.attr_J_low += jl
+        pending.attr_J_high += jh
+
+    def _attr_drop(self, account) -> None:
+        """Move a departing account (cancelled row, aborted pending,
+        close-abandoned row) into the dropped books so the session-level
+        conservation invariant stays exact."""
+        d = self._attr_dropped
+        d["wall"] += account.attr_wall
+        d["J"] += account.attr_J
+        d["J_low"] += account.attr_J_low
+        d["J_high"] += account.attr_J_high
+
+    def _close_out_energy(
+        self, r: int, row: _Row, extras: Dict[str, Any], gen_tokens: int
+    ) -> None:
+        """Stamp the retiring row's accumulated attribution into
+        ``extras["energy_model"]`` (``window="slice"`` — the continuous-
+        path twin of the window/solo paths' shapes), publish it to the
+        llm_request_* energy families, and refresh the engine's live
+        J/token feed (the figure least-joules routing and auto model
+        policy read). 9-decimal rounding keeps the wire compact while
+        conserving against the session books well inside 1e-6."""
+        from ..obs.energy import observe_estimate
+
+        eng = self.engine
+        j, jl, jh = row.attr_J, row.attr_J_low, row.attr_J_high
+        jpt = j / gen_tokens if gen_tokens else 0.0
+        wasted = row.attr_wasted_J
+        if self._spec_draft_wasted and self._spec_draft_wasted[r]:
+            wasted += self._spec_draft_wasted[r]
+        extras["energy_model"] = {
+            "J": round(j, 9),
+            "J_low": round(jl, 9),
+            "J_high": round(jh, 9),
+            "J_per_token": round(jpt, 9),
+            "J_per_token_low": round(
+                jl / gen_tokens if gen_tokens else 0.0, 9
+            ),
+            "J_per_token_high": round(
+                jh / gen_tokens if gen_tokens else 0.0, 9
+            ),
+            "wall_attr_s": round(row.attr_wall, 9),
+            "slices": row.attr_slices,
+            "window": "slice",
+            **({"wasted_J": round(wasted, 9)} if wasted else {}),
+        }
+        observe_estimate(
+            {
+                "J": j,
+                "J_per_token": jpt,
+                "J_per_token_low": jl / gen_tokens if gen_tokens else None,
+                "J_per_token_high": jh / gen_tokens if gen_tokens else None,
+            }
+        )
+        if jpt > 0:
+            # the least-joules routing feed (ISSUE 20 satellite): under
+            # the continuous scheduler this is now refreshed on EVERY
+            # retire, not only by the window/solo attribution paths
+            eng.last_joules_per_token = jpt
+            by_model = getattr(eng, "last_joules_per_token_by_model", None)
+            if by_model is not None:
+                by_model[self.model] = jpt
 
     def _spec_after_slice(self, live: "List[int]") -> int:
         """Refresh the host mirrors of the carry's cumulative spec
@@ -1614,6 +1793,11 @@ class SteppedDecodeSession:
                 extras["spec"]["draft_wasted_J"] = round(
                     self._spec_draft_wasted[r], 6
                 )
+        if _obs_enabled() and (row.attr_slices or row.attr_wall):
+            try:
+                self._close_out_energy(r, row, extras, len(generated))
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         result = GenerationResult(
             request=req,
             tokens=generated,
@@ -1694,6 +1878,9 @@ class SteppedDecodeSession:
                 self.table = self.table.at[r].set(self._parking_for(r))
                 self.pool.free(row.pages)
                 row.pages = []
+            # the cancelled row's attributed wall/Joules never close out
+            # — settle them into the dropped books (ISSUE 20)
+            self._attr_drop(row)
             self.rows[r] = None
             self._recommit_carry()
             return True
@@ -1785,6 +1972,15 @@ class SteppedDecodeSession:
             pr.presence = jax.device_get(self.presence[r])
         pr.streamed = row.streamed
         pr.t0, pr.t1 = row.t0, row.t1
+        # the attribution account parks with the victim (ISSUE 20):
+        # restored by _commit_resume, so a preempted-and-resumed row's
+        # close-out still covers every slice it ever rode
+        pr.attr_wall = row.attr_wall
+        pr.attr_J = row.attr_J
+        pr.attr_J_low = row.attr_J_low
+        pr.attr_J_high = row.attr_J_high
+        pr.attr_slices = row.attr_slices
+        pr.attr_wasted_J = row.attr_wasted_J
         host_bytes = 0
         if (
             self.spec is not None
@@ -2196,6 +2392,15 @@ class SteppedDecodeSession:
             streamed=pr.streamed,
             shared=len(pr.shared_pages) if mode == "swap" else 0,
         )
+        # restore the parked attribution account + whatever the resume's
+        # own re-prefill chunks billed while pending (recompute mode)
+        row = self.rows[r]
+        row.attr_wall = pr.attr_wall + pending.attr_wall
+        row.attr_J = pr.attr_J + pending.attr_J
+        row.attr_J_low = pr.attr_J_low + pending.attr_J_low
+        row.attr_J_high = pr.attr_J_high + pending.attr_J_high
+        row.attr_slices = pr.attr_slices
+        row.attr_wasted_J = pr.attr_wasted_J
         return r
 
     def _recommit_carry(self) -> None:
@@ -2499,7 +2704,15 @@ class SteppedDecodeSession:
                 jax.block_until_ready(logits)
             pending.logits = logits
             pending.next_chunk += 1
-            pending.prefill_s += time.monotonic() - t0
+            dt = time.monotonic() - t0
+            pending.prefill_s += dt
+            if _obs_enabled():
+                # the chunk's wall/Joules bill to the JOINER (ISSUE 20):
+                # the in-flight rows only stalled for it
+                try:
+                    self._attr_chunk(pending, start, real, dt)
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
         elif (
             self.spec is not None
             and pending.draft_next < len(pending.draft_chunks)
@@ -2528,7 +2741,18 @@ class SteppedDecodeSession:
                 )
                 jax.block_until_ready(dlogits)
             pending.draft_next += 1
-            pending.prefill_s += time.monotonic() - t0
+            dt = time.monotonic() - t0
+            pending.prefill_s += dt
+            if _obs_enabled():
+                # draft chunks bill wall only: the draft model's Joules
+                # are priced per round by the spec waste machinery, and
+                # this session's cfg would misprice the small model
+                try:
+                    tot = self._attr_totals
+                    tot["wall"] += dt
+                    pending.attr_wall += dt
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
         # a session that fell back to plain decode mid-join simply stops
         # needing the draft chunks (the row decodes plainly from commit)
         draft_done = (
@@ -2585,7 +2809,13 @@ class SteppedDecodeSession:
             if use_rp:
                 presence = presence.at[jnp.arange(1), first].set(True)
             jax.block_until_ready(first)
-        pending.prefill_s += time.monotonic() - t0
+        dt = time.monotonic() - t0
+        pending.prefill_s += dt
+        if _obs_enabled():
+            # the first-token sample is the joiner's work too (wall
+            # only — sampling is not a weight/KV stream the model prices)
+            self._attr_totals["wall"] += dt
+            pending.attr_wall += dt
         if _obs_enabled():
             try:
                 from .jax_engine import _PREFILL_H
@@ -2640,6 +2870,13 @@ class SteppedDecodeSession:
             prefill_s=pending.prefill_s,
             shared_pages=pending.shared_pages,
         )
+        # the chunk walls/Joules billed while pending become the seated
+        # row's opening account (ISSUE 20)
+        row = self.rows[r]
+        row.attr_wall = pending.attr_wall
+        row.attr_J = pending.attr_J
+        row.attr_J_low = pending.attr_J_low
+        row.attr_J_high = pending.attr_J_high
         if self.store is not None:
             # publish at join-commit: the next sharer can seed from THIS
             # prompt's slab (the seeded prefix region is in the private
@@ -2657,6 +2894,7 @@ class SteppedDecodeSession:
         slot reservation lifts and its pages return to the pool. The
         private cache is garbage-collected with the object."""
         self._pending.pop(pending.slot, None)
+        self._attr_drop(pending)
         if self.paged and pending.pages:
             self.pool.free(pending.pages)
             pending.pages = []
@@ -2851,6 +3089,11 @@ class SteppedDecodeSession:
             clear = getattr(self.engine, "_spec_source_clear", None)
             if clear is not None:
                 clear(self.spec["source"], self.spec["draft"])
+        for row in self.rows:
+            if row is not None:
+                self._attr_drop(row)  # abandoned rows never close out
+        for pending in self._pending.values():
+            self._attr_drop(pending)
         if self.paged:
             for row in self.rows:
                 if row is not None and row.pages:

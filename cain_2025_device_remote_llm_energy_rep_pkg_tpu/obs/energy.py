@@ -166,6 +166,58 @@ def batch_window_stats(
     }
 
 
+def slice_window_stats(
+    cfg,
+    pairs,
+    duration_s: float,
+    steps: int,
+    quantize: Optional[str] = None,
+    kv_quantize: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """Energy-model inputs for ONE bounded decode slice of a continuous
+    session (ISSUE 20). ``pairs`` is ``[(ctx_tokens, new_tokens), ...]``
+    per live row: ``ctx_tokens`` the row's context length entering the
+    slice (prompt + already-generated), ``new_tokens`` what this slice
+    emitted for it; ``steps`` the device steps the slice actually ran
+    (== max new_tokens for plain decode, the verify-round count under
+    speculation).
+
+    Same accounting discipline as :func:`batch_window_stats` — the
+    weight stream bills ONCE per step across the shared batch, each row
+    streams its own KV at its own slice-mid context — but scoped to one
+    slice's marginal work, so per-slice estimates summed over a row's
+    lifetime converge to what one whole-window estimate would say."""
+    if duration_s <= 0 or steps <= 0:
+        return None
+    from ..utils.memory import (
+        decode_kv_stream_bytes,
+        decode_vpu_unpack_ops_per_step,
+        decode_weight_stream_bytes,
+    )
+
+    tokens = sum(new for _, new in pairs)
+    if not tokens:
+        return None
+    flops = sum(
+        cfg.flops_per_token(ctx + new) * new for ctx, new in pairs if new
+    )
+    hbm = decode_weight_stream_bytes(cfg, quantize) * steps + sum(
+        decode_kv_stream_bytes(
+            cfg, int(ctx + new / 2), kv_quantize=kv_quantize
+        )
+        * new
+        for ctx, new in pairs
+        if new
+    )
+    return {
+        "flops": flops,
+        "bytes": hbm,
+        "vpu_ops": decode_vpu_unpack_ops_per_step(cfg, quantize) * steps,
+        "duration_s": duration_s,
+        "generated_tokens": tokens,
+    }
+
+
 def observe_estimate(est: Optional[Dict[str, Any]]) -> None:
     """Record one request's estimate into the shared registry."""
     if est is None or not enabled():

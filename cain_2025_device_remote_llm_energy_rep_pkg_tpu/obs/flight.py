@@ -320,17 +320,23 @@ def trace_of(span) -> Optional[int]:
     return span.span_id if span is not None else None
 
 
-def trace_attrs(span) -> Dict[str, Any]:
+def trace_attrs(span, tenant: "str | None" = None) -> Dict[str, Any]:
     """BOTH trace keys of a span for ``FLIGHT.emit(**trace_attrs(s))``:
     the process-local span id (``trace``) and — when the request carried
     one — the fleet-wide wire trace (``trace_id``). One definition so
-    every emit site links events identically across processes."""
-    if span is None:
-        return {"trace": None}
-    out: Dict[str, Any] = {"trace": span.span_id}
-    tid = getattr(span, "trace_id", None)
-    if tid is not None:
-        out["trace_id"] = tid
+    every emit site links events identically across processes. Emit
+    sites with a request in hand pass its ``tenant`` (ISSUE 20) so the
+    flight story filters per tenant; the default tenant is omitted to
+    keep single-tenant event streams byte-identical."""
+    out: Dict[str, Any] = {"trace": None} if span is None else {
+        "trace": span.span_id
+    }
+    if span is not None:
+        tid = getattr(span, "trace_id", None)
+        if tid is not None:
+            out["trace_id"] = tid
+    if tenant is not None and tenant != "default":
+        out["tenant"] = tenant
     return out
 
 

@@ -352,6 +352,15 @@ commands:
                        merged loaded-models view serves on /api/ps and
                        the router's dispatch prefers replicas holding a
                        request's model warm
+                       Tenant accounting: requests may carry x_tenant
+                       (default "default"); terminal outcomes land in
+                       llm_tenant_* (bounded table, overflow folds to
+                       tenant="_other") and GET /debug/tenants serves
+                       per-tenant aggregates (the router's merges the
+                       fleet). --usage-ledger-dir DIR additionally
+                       appends one JSONL record per terminal request
+                       (monotonic seq, resumed across restarts) with a
+                       periodic snapshot — the billing artifact
   serve-fleet --targets host:port[,host:port...] [--route-policy P]
                        [--port N] [--models a,b] [--probe-interval-ms M]
                        [--slo 'ttft_p99_ms<=250,...'] (fleet-wide SLOs:
@@ -412,6 +421,7 @@ def serve_command(args: List[str]) -> None:
     slo = None  # SLO objectives spec (ISSUE 17)
     role = None  # disagg serving role: mixed|prefill|decode (ISSUE 18)
     roles = None  # per-replica roles for --replicas N fleets
+    usage_ledger_dir = None  # tenant usage ledger directory (ISSUE 20)
     it = iter(args)
     for arg in it:
         if arg == "--port":
@@ -705,6 +715,16 @@ def serve_command(args: List[str]) -> None:
                     "serve: --roles expects a comma list drawn from "
                     + "|".join(SERVER_ROLES)
                 )
+        elif arg == "--usage-ledger-dir":
+            # Tenant usage ledger (ISSUE 20): append-only JSONL of
+            # terminal request outcomes under this directory, with a
+            # periodic aggregate snapshot and seq resumption across
+            # restarts (billing replays never double-bill).
+            usage_ledger_dir = next(it, "")
+            if not usage_ledger_dir:
+                raise CommandError(
+                    "serve: --usage-ledger-dir expects a directory path"
+                )
         elif arg == "--access-log":
             access_log = True
         elif arg == "--no-telemetry":
@@ -769,6 +789,9 @@ def serve_command(args: List[str]) -> None:
                 prefix_share=prefix_share,
                 prefix_store_hbm_bytes=prefix_store_hbm_bytes,
                 prefix_store_host_bytes=prefix_store_host_bytes,
+                joules_per_token=float(
+                    os.environ.get("FAKE_JOULES_PER_TOKEN", "0.0")
+                ),
             )
         if backend_kind == "jax-tp":
             from ..parallel.mesh import MeshSpec, build_mesh
@@ -954,6 +977,7 @@ def serve_command(args: List[str]) -> None:
         escalate_max_tokens=escalate_max_tokens,
         slo=slo,
         role=role,
+        usage_ledger_dir=usage_ledger_dir,
     )
     server.serve_forever()
 

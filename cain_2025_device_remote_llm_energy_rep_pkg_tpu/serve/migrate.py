@@ -254,6 +254,13 @@ def _import_fake(bundle: Dict[str, Any], backend: Any) -> Dict[str, Any]:
         "draft_wasted_J": 0.0,
         "hit_tokens": 0,
         "shared_pages": 0,
+        # attribution restarts at the destination (ISSUE 20) — the real
+        # import's PreemptedRow does the same via its zeroed defaults,
+        # so the destination session's conservation ledger stays local
+        "attr_wall": 0.0,
+        "attr_J": 0.0,
+        "attr_slices": 0,
+        "attr_wasted_J": 0.0,
     }
     return {
         "request": request,

@@ -15,6 +15,7 @@ import json
 from typing import Any, Dict, Iterable, Iterator
 
 from ..engine.backend import GenerationRequest, GenerationResult
+from ..obs.tenants import DEFAULT_TENANT
 from ..obs.trace import TraceContext
 
 DEFAULT_PORT = 11434  # the port the reference's curl targets (README.md:31)
@@ -44,6 +45,8 @@ DEBUG_TIMELINE_PATH = "/debug/timeline"  # router: one request's full
 #   cross-process lifecycle, reassembled per trace id (?trace=, ISSUE 13)
 DEBUG_TIMESERIES_PATH = "/debug/timeseries"  # windowed rollups from the
 #   in-process time-series ring (?family=, ?window=, ?step=; ISSUE 17)
+DEBUG_TENANTS_PATH = "/debug/tenants"  # per-tenant usage snapshot
+#   (tokens/J/wasted-by-cause/outcomes; router merges replicas; ISSUE 20)
 # Live row migration (ISSUE 18 — disaggregated prefill/decode):
 MIGRATE_PATH = "/api/migrate"  # POST a serialized row bundle
 #   (serve/migrate.py); the receiver seats it through resume_begin/
@@ -240,6 +243,11 @@ def request_to_wire(
             else {}
         ),
         **(
+            {"x_tenant": request.tenant}
+            if request.tenant != DEFAULT_TENANT
+            else {}
+        ),
+        **(
             {"x_trace": trace_to_wire(request.trace)}
             if request.trace is not None
             else {}
@@ -282,8 +290,24 @@ def request_from_wire(
             if body.get("x_priority") is not None
             else int(default_priority)
         ),
+        # tenant parsing is NOT gated on the telemetry kill switch: the
+        # request field is protocol state; only the accounting is
+        # telemetry (obs/tenants.account_request no-ops when off)
+        tenant=_tenant_from_wire(body.get("x_tenant")),
         trace=trace_from_wire(body.get("x_trace")),
     )
+
+
+def _tenant_from_wire(value) -> str:
+    """``x_tenant`` body field → tenant id ("default" when absent).
+    Malformed values 400 at the wire like every other x_* field."""
+    if value is None:
+        return DEFAULT_TENANT
+    if not isinstance(value, str) or not value.strip():
+        raise ValueError(
+            f"x_tenant must be a non-empty string, got {value!r}"
+        )
+    return value.strip()
 
 
 def _stop_from_wire(value) -> "tuple[str, ...]":

@@ -81,6 +81,7 @@ from ..engine.radix_store import prefix_chunk_hashes
 from ..obs import energy as obs_energy
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
+from ..obs import tenants as obs_tenants
 from ..obs import timeseries as obs_ts
 from ..obs.flight import (
     EV_AFFINITY_ROUTE,
@@ -310,6 +311,12 @@ class Replica:
         replicas share the router's recorder, so their events are
         already in the router's own ring (return [] here)."""
         return []
+
+    def tenants_state(self) -> Optional[Dict[str, object]]:
+        """This replica's per-tenant usage snapshot (ISSUE 20). None
+        for in-process replicas — they share THIS process's tenant
+        table, which the router reports exactly once as ``local``."""
+        return None
 
     def close(self) -> None:
         """Release whatever this replica owns (local: stop its
@@ -586,6 +593,13 @@ class RemoteReplica(Replica):
             self.base_url, trace=trace, timeout_s=self.probe_timeout_s
         )
         return list(body.get("events") or [])
+
+    def tenants_state(self) -> Optional[Dict[str, object]]:
+        with urllib.request.urlopen(
+            f"{self.base_url}{protocol.DEBUG_TENANTS_PATH}",
+            timeout=self.probe_timeout_s,
+        ) as resp:
+            return json.loads(resp.read().decode("utf-8"))
 
     def debug_state(self) -> Dict[str, object]:
         state = super().debug_state()
@@ -1536,6 +1550,64 @@ class Router:
             "x_replicas": per_replica,
         }
 
+    def tenants_state(self) -> Dict[str, object]:
+        """The fleet's merged per-tenant usage (``GET /debug/tenants``
+        on the front door, ISSUE 20): each REMOTE replica's own
+        ``/debug/tenants`` pull, this process's tenant table exactly
+        once as ``local`` when any in-process replica is attached
+        (they all share it), and a summed ``fleet`` rollup per tenant —
+        the JSON twin of the ``llm_fleet_tenant_*`` scrape families."""
+        per_replica: Dict[str, object] = {}
+        saw_local = False
+        for replica in self.replicas():
+            if replica.kind == "local":
+                saw_local = True
+                continue
+            try:
+                snap = replica.tenants_state()
+            except Exception as exc:  # noqa: BLE001 — down/no-telemetry
+                per_replica[replica.name] = {
+                    "error": f"{type(exc).__name__}: {exc}"
+                }
+                continue
+            if snap is not None:
+                per_replica[replica.name] = snap
+        if saw_local:
+            per_replica["local"] = obs_tenants.snapshot()
+        fleet: Dict[str, Dict[str, object]] = {}
+        for snap in per_replica.values():
+            if not isinstance(snap, dict):
+                continue
+            for tenant, acct in (snap.get("tenants") or {}).items():
+                agg = fleet.setdefault(
+                    tenant,
+                    {
+                        "requests": {},
+                        "tokens_in": 0,
+                        "tokens_out": 0,
+                        "joules": 0.0,
+                        "wasted_J": {},
+                    },
+                )
+                for outcome, n in (acct.get("requests") or {}).items():
+                    agg["requests"][outcome] = agg["requests"].get(
+                        outcome, 0
+                    ) + int(n)
+                agg["tokens_in"] += int(acct.get("tokens_in") or 0)
+                agg["tokens_out"] += int(acct.get("tokens_out") or 0)
+                agg["joules"] = round(
+                    agg["joules"] + float(acct.get("joules") or 0.0), 6
+                )
+                for cause, j in (acct.get("wasted_J") or {}).items():
+                    agg["wasted_J"][cause] = round(
+                        agg["wasted_J"].get(cause, 0.0) + float(j), 6
+                    )
+        return {
+            "role": "router",
+            "fleet": fleet,
+            "replicas": per_replica,
+        }
+
     # -- metrics federation (ISSUE 13) -----------------------------------------
     def federation_sources(self) -> List[Tuple[str, str]]:
         """The per-replica scrape texts the fleet rollup merges: one
@@ -1963,6 +2035,20 @@ class RouterServer:
                         self._send_json(
                             200, server.router.timeline(trace)
                         )
+                    except Exception as exc:  # noqa: BLE001
+                        self._send_json(
+                            500,
+                            {"error": f"{type(exc).__name__}: {exc}"},
+                        )
+                elif path == protocol.DEBUG_TENANTS_PATH:
+                    if not obs_metrics.enabled():
+                        self._send_json(
+                            404,
+                            {"error": "telemetry disabled (TPU_LLM_OBS=0)"},
+                        )
+                        return
+                    try:
+                        self._send_json(200, server.router.tenants_state())
                     except Exception as exc:  # noqa: BLE001
                         self._send_json(
                             500,

@@ -73,6 +73,7 @@ from ..obs.metrics import (
     enabled as _obs_enabled,
     observe_migrate,
 )
+from ..obs.tenants import account_request
 from ..obs.trace import TRACER
 from .stream import (
     DeadlineExceeded,
@@ -245,7 +246,7 @@ class _Ticket:
         "request", "event", "result", "error", "t_submit", "t_first",
         "span", "queue_wait_s", "joined", "join_chunks", "stream",
         "priority", "preempts", "resumed", "wasted",
-        "prime", "prime_buf", "migrate_pr", "migrated",
+        "prime", "prime_buf", "migrate_pr", "migrated", "accounted",
     )
 
     def __init__(self, request: GenerationRequest) -> None:
@@ -285,6 +286,10 @@ class _Ticket:
         self.prime_buf: Optional[list] = None
         self.migrate_pr = None
         self.migrated = False
+        # Tenant usage accounting (ISSUE 20): flipped by the FIRST
+        # terminal accounting of this ticket so retry/reap races can
+        # never bill a tenant twice for one request.
+        self.accounted = False
 
 
 class _TierQueue:
@@ -399,6 +404,63 @@ def _pr_field(pr, name: str, default=None):
     return getattr(pr, name, default)
 
 
+def _pr_add_wasted(pr, joules: float) -> None:
+    """Mirror a preemption charge onto the parked ROW's attribution
+    account (ISSUE 20): the figure rides the park and surfaces in the
+    row's ``energy_model["wasted_J"]`` close-out. Informational — the
+    authoritative per-cause billing stays on the ticket's ledger."""
+    if not joules or pr is None:
+        return
+    if isinstance(pr, dict):  # fake backend's dict twin parks the row
+        row = pr.get("row")
+        if isinstance(row, dict):
+            row["attr_wasted_J"] = row.get("attr_wasted_J", 0.0) + joules
+    elif hasattr(pr, "attr_wasted_J"):
+        pr.attr_wasted_J += joules
+
+
+def _account_ticket(ticket: "_Ticket", outcome: str, result=None) -> None:
+    """Tenant usage accounting (ISSUE 20): every terminal ticket lands
+    in ``obs.tenants`` EXACTLY ONCE, from the scheduler's two funnels
+    (_finish_ticket / _fail_ticket). The completed path bills the
+    slice-attributed ``energy_model["J"]``; failures bill streamed
+    tokens only. Never raises, no-op under the kill switch."""
+    if ticket.accounted or not _obs_enabled():
+        return
+    ticket.accounted = True
+    try:
+        req = ticket.request
+        tokens_in = tokens_out = 0
+        joules = 0.0
+        wasted = dict(ticket.wasted) if ticket.wasted else {}
+        if result is not None:
+            tokens_in = int(result.prompt_tokens or 0)
+            tokens_out = int(result.generated_tokens or 0)
+            extras = result.extras or {}
+            em = extras.get("energy_model") or {}
+            joules = float(em.get("J") or 0.0)
+            # fully-rejected draft rounds: already on the process-wide
+            # wasted ledger (cause=draft); mirrored into the owning
+            # tenant's account here
+            dw = (extras.get("spec") or {}).get("draft_wasted_J")
+            if dw:
+                wasted["draft"] = wasted.get("draft", 0.0) + float(dw)
+        elif ticket.stream is not None and ticket.stream.tokens_pushed:
+            tokens_out = int(ticket.stream.tokens_pushed)
+        account_request(
+            getattr(req, "tenant", None),
+            outcome,
+            tokens_in=tokens_in,
+            tokens_out=tokens_out,
+            joules=joules,
+            wasted=wasted or None,
+            model=getattr(req, "model", None),
+            trace=trace_attrs(ticket.span).get("trace"),
+        )
+    except Exception:  # noqa: BLE001 — telemetry only
+        pass
+
+
 class _SchedulerBase:
     """Submit/lifecycle machinery shared by the window and continuous
     schedulers (one queue, one worker thread, shutdown that can never
@@ -509,6 +571,12 @@ class _SchedulerBase:
     def _fail_ticket(ticket: _Ticket, exc: BaseException) -> None:
         """Fail one ticket: the blocking caller unblocks with the error
         and a streaming consumer receives it as the terminal event."""
+        if isinstance(exc, StreamCancelled):
+            _account_ticket(ticket, "cancelled")
+        elif isinstance(exc, DeadlineExceeded):
+            _account_ticket(ticket, "deadline")
+        else:
+            _account_ticket(ticket, "error")
         ticket.error = exc
         if ticket.stream is not None:
             ticket.stream.fail(exc)
@@ -673,8 +741,13 @@ class _SchedulerBase:
             EV_REQUEST_REJECTED,
             reason=reason,
             wait_s=round(wait, 4),
-            **trace_attrs(ticket.span),
+            **trace_attrs(
+                ticket.span, tenant=getattr(request, "tenant", None)
+            ),
         )
+        # admission-edge refusal: its own tenant outcome, distinct from
+        # a mid-flight deadline (_fail_ticket sees accounted already)
+        _account_ticket(ticket, "rejected")
         self._fail_ticket(
             ticket,
             DeadlineExceeded(
@@ -754,6 +827,7 @@ class _SchedulerBase:
                 )
             energy["wasted_J"] = wasted
             result.extras["energy"] = energy
+        _account_ticket(ticket, "ok", result)
         ticket.result = result
         if ticket.stream is not None:
             # the final egress event carries the COMPLETE wire result —
@@ -1895,7 +1969,9 @@ class ContinuousScheduler(_SchedulerBase):
                 if ticket.stream is not None
                 else None
             ),
-            **trace_attrs(ticket.span),
+            **trace_attrs(
+                ticket.span, tenant=getattr(ticket.request, "tenant", None)
+            ),
         )
         if reason == "cancelled":
             self._fail_ticket(
@@ -1993,7 +2069,10 @@ class ContinuousScheduler(_SchedulerBase):
             EV_ROW_RETIRED,
             reason=reason,
             generated_tokens=result.generated_tokens,
-            **trace_attrs(ticket.span if ticket is not None else None),
+            **trace_attrs(
+                ticket.span if ticket is not None else None,
+                tenant=getattr(result.request, "tenant", None),
+            ),
         )
         if ticket is None:  # defensive: a row the session invented
             return
@@ -2110,6 +2189,7 @@ class ContinuousScheduler(_SchedulerBase):
                     ticket.wasted["recompute"] = (
                         ticket.wasted.get("recompute", 0.0) + j
                     )
+                    _pr_add_wasted(pr, j)
             FLIGHT.emit(
                 EV_ROW_RESUMED,
                 policy=_pr_field(pr, "policy"),
@@ -2188,6 +2268,7 @@ class ContinuousScheduler(_SchedulerBase):
                 victim.wasted["swap"] = (
                     victim.wasted.get("swap", 0.0) + j
                 )
+                _pr_add_wasted(pr, j)
             FLIGHT.emit(
                 EV_ROW_PREEMPTED,
                 by=trace_of(ticket.span),
@@ -2197,7 +2278,10 @@ class ContinuousScheduler(_SchedulerBase):
                 by_tier=tier,
                 generated_tokens=len(_pr_field(pr, "generated", ()) or ()),
                 swapped_bytes=host_bytes,
-                **trace_attrs(victim.span),
+                **trace_attrs(
+                    victim.span,
+                    tenant=getattr(victim.request, "tenant", None),
+                ),
             )
 
     def _admit_into(

@@ -25,6 +25,7 @@ from urllib.parse import parse_qs
 from ..engine.backend import GenerationBackend
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
+from ..obs import tenants as obs_tenants
 from ..obs import timeseries as obs_ts
 from ..obs.flight import FLIGHT
 from ..obs.trace import TRACER
@@ -98,6 +99,7 @@ class GenerationServer:
         ts_interval_s: Optional[float] = None,  # time-series ring cadence
         ts_capacity: Optional[int] = None,  # time-series ring depth
         role: Optional[str] = None,  # disagg fleet role (ISSUE 18)
+        usage_ledger_dir: Optional[str] = None,  # tenant ledger (ISSUE 20)
     ) -> None:
         """``batch_window_ms > 0`` or an explicit ``scheduler`` enables
         batching: concurrent non-streaming generate requests coalesce
@@ -197,7 +199,18 @@ class GenerationServer:
         stream; ``/admin/evacuate`` asks the continuous scheduler to
         export every exportable in-flight row (drain-evacuation — each
         row's bundle rides its own stream's final record) and returns
-        the count."""
+        the count.
+
+        Tenant usage accounting (ISSUE 20): every request may carry
+        ``x_tenant``; terminal outcomes land in the ``llm_tenant_*``
+        families and the bounded aggregate table served on
+        ``GET /debug/tenants``. ``usage_ledger_dir`` (CLI
+        ``--usage-ledger-dir``) additionally installs a crash-safe
+        append-only JSONL usage ledger there (one record per terminal
+        request, monotonic ``seq`` resumed across restarts so a billing
+        replay never double-bills) with a periodic aggregate snapshot
+        on the sampler tick and a final flush at stop(). Inert under
+        the telemetry kill switch."""
         self.backend = backend
         if role is None:
             role = "mixed"
@@ -330,6 +343,14 @@ class GenerationServer:
             interval_s=self.ts_ring.interval_s,
             name="serve-ts-sampler",
         )
+        # Tenant usage ledger (ISSUE 20): opened only while telemetry is
+        # ON (the accounting funnel is a no-op under the kill switch, so
+        # an open ledger would only ever hold an empty file).
+        self._usage_ledger: Optional[obs_tenants.UsageLedger] = None
+        self._ledger_snap_seq = -1
+        if usage_ledger_dir and obs_metrics.enabled():
+            self._usage_ledger = obs_tenants.UsageLedger(usage_ledger_dir)
+            obs_tenants.install_ledger(self._usage_ledger)
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
         # Set whenever a serve loop is live (threaded start() OR blocking
@@ -347,6 +368,30 @@ class GenerationServer:
         self.ts_ring.sample_once()
         if self.slo_engine is not None:
             self.slo_engine.evaluate()
+        # periodic usage-ledger aggregate snapshot (ISSUE 20): written
+        # only when new records landed since the last tick (atomic
+        # rename; a consumer catches up without replaying the ledger)
+        ledger = self._usage_ledger
+        if ledger is not None and ledger.seq != self._ledger_snap_seq:
+            try:
+                ledger.write_snapshot(obs_tenants.TABLE)
+                self._ledger_snap_seq = ledger.seq
+            except OSError:
+                pass
+
+    def _close_usage_ledger(self) -> None:
+        """Final ledger flush + snapshot (idempotent), detaching it from
+        the process-wide funnel only if it is still the installed one
+        (tests run several servers per process)."""
+        ledger, self._usage_ledger = self._usage_ledger, None
+        if ledger is None:
+            return
+        if obs_tenants.current_ledger() is ledger:
+            obs_tenants.install_ledger(None)
+        try:
+            ledger.close(obs_tenants.TABLE)
+        except OSError:
+            pass
 
     def _make_handler(self):
         server = self
@@ -538,6 +583,20 @@ class GenerationServer:
                     },
                 )
 
+            def _send_debug_tenants(self) -> None:
+                """Per-tenant usage aggregates (ISSUE 20): the bounded
+                tenant table's requests/tokens/Joules plus the ledger
+                position when one is installed. 404 while telemetry is
+                off — same contract as /metrics."""
+                if not obs_metrics.enabled():
+                    self._send_json(
+                        404, {"error": "telemetry disabled (TPU_LLM_OBS=0)"}
+                    )
+                    return
+                payload = obs_tenants.snapshot()
+                payload["role"] = server.role
+                self._send_json(200, payload)
+
             def _send_healthz(self) -> None:
                 """Cheap liveness probe (ISSUE 12): status, scheduler
                 kind and in-flight/queued row counts — the router's
@@ -616,6 +675,10 @@ class GenerationServer:
                     == protocol.DEBUG_TIMESERIES_PATH
                 ):
                     self._send_debug_timeseries()
+                elif (
+                    self.path.split("?", 1)[0] == protocol.DEBUG_TENANTS_PATH
+                ):
+                    self._send_debug_tenants()
                 elif self.path == protocol.HEALTH_PATH:
                     self._send_healthz()
                 elif self.path == protocol.TAGS_PATH:
@@ -1107,12 +1170,14 @@ class GenerationServer:
         finally:
             self._serving.clear()
             self._sampler.stop()
+            self._close_usage_ledger()
             self._httpd.server_close()
 
     def stop(self) -> None:
         self._sampler.stop()
         if self._scheduler is not None:
             self._scheduler.stop()
+        self._close_usage_ledger()
         # shutdown() blocks on an event only serve_forever() sets; skip it
         # when no serve loop ever started (e.g. setup failed before start).
         if self._serving.is_set():

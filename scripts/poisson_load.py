@@ -235,6 +235,52 @@ def draw_temperatures(
     return temps
 
 
+def parse_tenant_mix(spec: str) -> Dict[str, float]:
+    """``"a=0.7,b=0.3"`` → {"a": 0.7, "b": 0.3}. Tenant names are free
+    strings (the wire's ``x_tenant``); fractions need not sum to 1 —
+    the remainder draws "default", the unlabelled-traffic bucket the
+    server's tenant table aggregates under the same name."""
+    out: Dict[str, float] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, frac = entry.rpartition("=")
+        if not eq or not name:
+            raise ValueError(
+                f"tenant mix entry {entry!r} is not tenant=fraction"
+            )
+        out[name.strip()] = float(frac)
+    if sum(out.values()) > 1.0 + 1e-9:
+        raise ValueError(f"tenant mix fractions sum past 1: {spec!r}")
+    return out
+
+
+def draw_tenants(
+    n: int, tenant_mix: Optional[Dict[str, float]], seed: int = 0
+) -> List[str]:
+    """``n`` seeded per-request tenant names drawn from ``tenant_mix``
+    (uncovered fraction mass draws "default"). Uses its own derived
+    seed, INDEPENDENT of the arrival/length/tier/model/temperature
+    streams, so turning the mix on replays the SAME trace — the
+    tenant-accounting A/B (ISSUE 20) compares the per-tenant Joules
+    split against a solo run of the identical arrivals."""
+    if not tenant_mix:
+        return ["default"] * n
+    rng = random.Random((seed << 16) ^ 0x7E4A7)
+    names = sorted(tenant_mix)
+    tenants = []
+    for _ in range(n):
+        u, acc, drawn = rng.random(), 0.0, "default"
+        for name in names:
+            acc += tenant_mix[name]
+            if u < acc:
+                drawn = name
+                break
+        tenants.append(drawn)
+    return tenants
+
+
 def build_cancellations(
     n: int,
     cancel_frac: float,
@@ -296,6 +342,7 @@ def build_workload(
     tier_mix: Optional[Dict[str, float]] = None,
     model_mix: Optional[Dict[str, float]] = None,
     temperature_dist: Optional[Dict[float, float]] = None,
+    tenant_mix: Optional[Dict[str, float]] = None,
 ) -> List[Tuple[float, GenerationRequest]]:
     """``[(arrival_offset_s, request), ...]`` — Poisson arrivals (seeded
     exponential inter-arrival; the first request arrives at t=0) over a
@@ -342,6 +389,13 @@ def build_workload(
     across spec-on/spec-off arms; the summary gains a sampled/greedy
     split.
 
+    ``tenant_mix`` (ISSUE 20, :func:`parse_tenant_mix`'s shape) stamps
+    each request with a seeded TENANT (the wire ``x_tenant``; uncovered
+    fraction mass draws "default"). Independent of every other stream,
+    so the same trace replays with tenancy on or off; the summary gains
+    a per-tenant percentile + Joules breakdown cross-checkable against
+    the server's ``GET /debug/tenants``.
+
     Every request additionally carries a CALLER-MINTED ``x_trace``
     (ISSUE 13): the summary prints the trace ids of failed / retried /
     SLO-missed requests, so a bad run is immediately queryable via the
@@ -351,6 +405,7 @@ def build_workload(
     tiers = draw_tiers(n, tier_mix, seed=seed)
     models = draw_models(n, model_mix, model, seed=seed)
     temps = draw_temperatures(n, temperature_dist, seed=seed)
+    tenants = draw_tenants(n, tenant_mix, seed=seed)
     share_rng = random.Random((seed << 16) ^ 0x5F1C)
     prefixes = (
         shared_prefix_texts(max(1, prefix_pool), shared_prefix_tokens)
@@ -412,6 +467,7 @@ def build_workload(
                     stop_at_eos=stop_at_eos,
                     deadline_ms=deadline_ms,
                     priority=tiers[i],
+                    tenant=tenants[i],
                     trace=TraceContext(trace_id=mint_trace_id()),
                 ),
             )
@@ -457,6 +513,10 @@ def run_load(
             # fleet's resolved model overwrites this at completion so
             # the per-model breakdown attributes to who actually ran
             "model": request.model,
+            # tenant attribution (ISSUE 20): the summary's per-tenant
+            # Joules/percentile split keys on this stamp, and the
+            # /debug/tenants cross-check sums records by it
+            "tenant": getattr(request, "tenant", None) or "default",
             # the caller-minted wire trace (ISSUE 13): carried on every
             # record so the summary can name WHICH requests went wrong
             "trace": (
@@ -556,6 +616,11 @@ def _record_result(rec, result, t_submit, t_done, start) -> None:
     energy = (result.extras or {}).get("energy_model") or {}
     if energy.get("J_per_token") is not None:
         rec["j_per_token"] = energy["J_per_token"]
+    # total modelled Joules for the request (ISSUE 20): the per-tenant
+    # Joules breakdown sums these, and the /debug/tenants cross-check
+    # compares that sum against the server's own ledger
+    if energy.get("J") is not None:
+        rec["joules"] = energy["J"]
 
 
 def _consume_stream(chunks, cancel_after: int):
@@ -935,12 +1000,96 @@ def summarize(records: List[Dict], slo=None) -> Dict:
                 entry["slo"] = slo_block(slo, t_recs)
             by_tier[str(tier)] = entry
         out["tiers"] = by_tier
+    # per-tenant breakdown (ISSUE 20): the same percentile shape split
+    # by the tenant stamp, plus the Joules the serving path attributed
+    # to each tenant's rows (slice-level attribution summed over this
+    # tenant's completed requests). The totals are the CLIENT-side half
+    # of the /debug/tenants cross-check: the server's table must agree
+    # with these by-hand sums.
+    tenants = sorted(
+        {r.get("tenant") for r in records if r.get("tenant") is not None}
+    )
+    if len(tenants) > 1 or (tenants and tenants != ["default"]):
+        by_tenant = {}
+        for name in tenants:
+            tn_recs = [r for r in records if r.get("tenant") == name]
+            tn_ok = [r for r in tn_recs if "error" not in r]
+            tn_done = [r for r in tn_ok if not r.get("cancelled")]
+            tn_ttfts = [
+                r["ttft_s"] for r in tn_ok if r.get("ttft_s") is not None
+            ]
+            tn_comps = [r["completion_s"] for r in tn_done]
+            tn_tokens = sum(r["tokens"] for r in tn_ok)
+            tn_joules = [r["joules"] for r in tn_ok if r.get("joules")]
+            entry = {
+                "requests": len(tn_recs),
+                "errors": len(tn_recs) - len(tn_ok),
+                "cancelled": len(tn_ok) - len(tn_done),
+                "tokens": tn_tokens,
+                "completion_p50_s": round(percentile(tn_comps, 50), 4),
+                "completion_p95_s": round(percentile(tn_comps, 95), 4),
+            }
+            if tn_ttfts:
+                entry["ttft_p50_s"] = round(percentile(tn_ttfts, 50), 4)
+                entry["ttft_p95_s"] = round(percentile(tn_ttfts, 95), 4)
+            if tn_joules:
+                j_sum = sum(tn_joules)
+                entry["joules"] = round(j_sum, 6)
+                done_tokens = sum(
+                    r["tokens"] for r in tn_ok if r.get("joules")
+                )
+                if done_tokens:
+                    entry["j_per_token"] = round(j_sum / done_tokens, 6)
+            if slo:
+                entry["slo"] = slo_block(slo, tn_recs)
+            by_tenant[name] = entry
+        out["tenants"] = by_tenant
     # client-side SLO attainment (ISSUE 17): EXACT per-objective
     # fractions over the raw records — the cross-check against the
     # server's /debug/timeseries bucket estimate
     if slo:
         out["slo"] = slo_block(slo, records)
     return out
+
+
+def _tenants_server_view(args) -> Optional[Dict]:
+    """The server-side tenant table for the cross-check: the in-process
+    obs.tenants snapshot under --fake (the scheduler accounted into
+    this process's table), or a best-effort ``GET /debug/tenants`` from
+    --url / each --targets replica. None when unavailable (telemetry
+    disabled → the endpoint 404s; the summary simply omits the block)."""
+    if args.fake:
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import (
+            tenants as obs_tenants,
+        )
+        from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.metrics import (
+            enabled as obs_enabled,
+        )
+
+        return obs_tenants.snapshot() if obs_enabled() else None
+    import urllib.request
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.protocol import (
+        DEBUG_TENANTS_PATH,
+    )
+
+    def fetch(base: str) -> Optional[Dict]:
+        url = base if base.startswith("http") else f"http://{base}"
+        try:
+            with urllib.request.urlopen(
+                url + DEBUG_TENANTS_PATH, timeout=5.0
+            ) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except Exception:  # noqa: BLE001 — cross-check is best-effort
+            return None
+    if args.targets:
+        views = {
+            name: fetch(name)
+            for name in args.targets.split(",")
+            if name
+        }
+        return views if any(v is not None for v in views.values()) else None
+    return fetch(args.url) if args.url else None
 
 
 def main() -> int:
@@ -1024,9 +1173,27 @@ def main() -> int:
         "a sampled/greedy split",
     )
     ap.add_argument(
+        "--tenant-mix", default=None,
+        help="seeded per-request tenant assignment, e.g. 'a=0.7,b=0.3' "
+        "(ISSUE 20; each entry is tenant=fraction, uncovered fraction "
+        "mass draws 'default'); independent of every other stream, so "
+        "the same trace replays with tenancy on or off. The summary "
+        "gains a per-tenant percentile + Joules breakdown, and when "
+        "the target exposes GET /debug/tenants the server's table is "
+        "attached next to it (tenants_server) as the cross-check "
+        "against these client-side by-hand sums",
+    )
+    ap.add_argument(
         "--fake", action="store_true",
         help="drive an in-process fake-backend continuous scheduler "
         "instead of a live server (hermetic demo/CI)",
+    )
+    ap.add_argument(
+        "--fake-joules-per-token", type=float, default=0.0,
+        help="--fake: price the fake backend's decode tokens at this "
+        "many modelled Joules each, so the per-tenant Joules breakdown "
+        "and the /debug/tenants cross-check carry nonzero figures in "
+        "the hermetic demo",
     )
     ap.add_argument(
         "--sessions", type=int, default=1,
@@ -1106,6 +1273,9 @@ def main() -> int:
             if args.temperature_dist
             else None
         ),
+        tenant_mix=(
+            parse_tenant_mix(args.tenant_mix) if args.tenant_mix else None
+        ),
     )
     cancellations = None
     if args.cancel_frac > 0:
@@ -1128,6 +1298,7 @@ def main() -> int:
         backend = FakeBackend(
             tokens_per_s=500.0,
             simulate_delay=True,
+            joules_per_token=args.fake_joules_per_token,
             prefix_share=args.prefix_share,
             prefix_store_hbm_bytes=args.prefix_store_hbm_bytes,
         )
@@ -1247,6 +1418,14 @@ def main() -> int:
         ap.error("one of --url, --targets or --fake is required")
         return 2
     summary = summarize(records, slo=slo_objectives)
+    if args.tenant_mix:
+        server_view = _tenants_server_view(args)
+        if server_view is not None:
+            # the SERVER's tenant table next to the client-side by-hand
+            # sums (summary["tenants"]): the ISSUE-20 cross-check — the
+            # two must agree on requests/tokens, and joules must match
+            # the per-tenant sums within rounding
+            summary["tenants_server"] = server_view
     if prefix_counters0 is not None:
         after = prefix_store_counters()
         summary["prefix_store"] = {

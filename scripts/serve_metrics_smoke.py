@@ -2180,6 +2180,253 @@ def main() -> int:
             occupant17.join(timeout=40)
         server17.stop()
 
+    # -- phase 18: tenant accounting + usage ledger (ISSUE 20) -----------------
+    # One fake continuous server with a crash-safe usage ledger: two
+    # tenants' wire traffic (x_tenant) moves the llm_tenant_* counters;
+    # GET /debug/tenants matches a BY-HAND sum of the wire results
+    # (slice-level attribution: each result's energy_model.J); a
+    # mid-stream hang-up lands as outcome=cancelled; the JSONL ledger
+    # re-reads with strictly monotonic seqs and its per-tenant Joules
+    # sum agrees with the table; the kill switch 404s the endpoint on
+    # server AND router; and a 2-replica fleet behind the router
+    # federates llm_fleet_tenant_* equal to merging the replica scrapes
+    # by hand (the same merge_expositions the golden test pins).
+    import tempfile
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import (
+        metrics as obs_metrics,
+    )
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import (
+        tenants as obs_tenants,
+    )
+
+    def labelled_value(text_now, family, want):
+        total = 0.0
+        for line in text_now.splitlines():
+            m = re.match(
+                rf"^{re.escape(family)}\{{([^}}]*)\}} ([0-9.e+-]+)$", line
+            )
+            if not m:
+                continue
+            labels = {}
+            for part in m.group(1).split(","):
+                k, _, v = part.partition("=")
+                labels[k.strip()] = v.strip().strip('"')
+            if all(labels.get(k) == v for k, v in want.items()):
+                total += float(m.group(2))
+        return total
+
+    ledger_dir18 = tempfile.mkdtemp(prefix="usage_ledger_smoke_")
+    backend18 = FakeBackend(
+        tokens_per_s=400.0, simulate_delay=True, joules_per_token=0.25
+    )
+    server18 = GenerationServer(
+        backend18, host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous", usage_ledger_dir=ledger_dir18,
+    )
+    server18.start()
+    try:
+        base18 = f"http://127.0.0.1:{server18.port}"
+        client18 = RemoteHTTPBackend(base18)
+        # by-hand client-side sums the server's table must reproduce
+        hand18 = {}
+        for tenant18, n_pred, count in (("acme", 8, 2), ("beta", 4, 1)):
+            for k in range(count):
+                res18 = client18.generate(
+                    _GenReq(
+                        "smoke:1b",
+                        f"tenant {tenant18} req {k}",
+                        max_new_tokens=n_pred,
+                        tenant=tenant18,
+                    )
+                )
+                em18 = (res18.extras or {}).get("energy_model") or {}
+                # the continuous path stamped SLICE-window attribution
+                assert em18.get("window") == "slice", res18.extras
+                acct = hand18.setdefault(
+                    tenant18, {"ok": 0, "tokens_out": 0, "joules": 0.0}
+                )
+                acct["ok"] += 1
+                acct["tokens_out"] += res18.generated_tokens
+                acct["joules"] += em18["J"]
+        # a beta client hangs up mid-stream -> outcome=cancelled
+        stream18 = client18.generate_stream(
+            _GenReq(
+                "smoke:1b",
+                "tenant cancel stream",
+                max_new_tokens=400,
+                tenant="beta",
+            )
+        )
+        seen18 = 0
+        for chunk in stream18:
+            if not getattr(chunk, "done", False) and chunk.tokens:
+                seen18 += len(chunk.tokens)
+                if seen18 >= 4:
+                    break
+        stream18.close()
+        # wait for the server to retire + account the cancelled row
+        deadline18 = time.monotonic() + 10.0
+        while True:
+            tenants18 = _get_json(base18, "/debug/tenants")
+            beta18 = tenants18["tenants"].get("beta", {})
+            if beta18.get("requests", {}).get("cancelled"):
+                break
+            assert time.monotonic() < deadline18, tenants18
+            time.sleep(0.05)
+
+        # /debug/tenants reproduces the by-hand sums exactly (fake
+        # identity: J == joules_per_token * generated_tokens per row)
+        for tenant18, acct in hand18.items():
+            table18 = tenants18["tenants"][tenant18]
+            assert table18["requests"]["ok"] == acct["ok"], tenants18
+            if tenant18 == "acme":
+                assert table18["tokens_out"] == acct["tokens_out"], tenants18
+                assert abs(table18["joules"] - acct["joules"]) < 1e-6, (
+                    table18,
+                    acct,
+                )
+        assert tenants18["ledger"]["dir"] == ledger_dir18, tenants18
+        assert tenants18["role"] == "mixed", tenants18
+
+        # the metric families moved with the same figures
+        text18 = _scrape(base18)
+        assert labelled_value(
+            text18, "llm_tenant_requests_total",
+            {"tenant": "acme", "outcome": "ok"},
+        ) == hand18["acme"]["ok"], "llm_tenant_requests_total{acme} wrong"
+        assert labelled_value(
+            text18, "llm_tenant_tokens_total",
+            {"tenant": "acme", "direction": "out"},
+        ) == hand18["acme"]["tokens_out"]
+        assert (
+            abs(
+                labelled_value(
+                    text18, "llm_tenant_joules_total", {"tenant": "acme"}
+                )
+                - hand18["acme"]["joules"]
+            )
+            < 1e-6
+        )
+        assert labelled_value(
+            text18, "llm_tenant_requests_total",
+            {"tenant": "beta", "outcome": "cancelled"},
+        ) >= 1
+
+        # kill switch: the endpoint 404s and accounting goes inert
+        obs_metrics.disable()
+        try:
+            try:
+                _get_json(base18, "/debug/tenants")
+                raise AssertionError(
+                    "/debug/tenants served under the kill switch"
+                )
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 404, exc.code
+        finally:
+            obs_metrics.enable()
+    finally:
+        server18.stop()
+
+    # stopping the server flushed + closed the ledger and wrote the
+    # final aggregate snapshot; both artifacts must be re-readable and
+    # AGREE with the table the endpoint served
+    records18 = obs_tenants.read_ledger(ledger_dir18)
+    assert records18, "usage ledger empty"
+    seqs18 = [r["seq"] for r in records18]
+    assert seqs18 == sorted(seqs18) and len(set(seqs18)) == len(seqs18), (
+        seqs18
+    )
+    acme_ledger_J = sum(
+        r["joules"] for r in records18 if r["tenant"] == "acme"
+    )
+    assert abs(acme_ledger_J - hand18["acme"]["joules"]) < 1e-6, (
+        acme_ledger_J,
+        hand18["acme"],
+    )
+    with open(
+        os.path.join(ledger_dir18, "usage_snapshot.json"), encoding="utf-8"
+    ) as fh18:
+        snap18 = json.load(fh18)
+    assert snap18["seq"] == seqs18[-1], snap18
+    assert "acme" in snap18["tenants"], snap18
+
+    # 2-replica fleet federation: llm_fleet_tenant_* on the router's
+    # scrape equals merging the two replica scrapes by hand
+    backend18_a = FakeBackend(tokens_per_s=400.0, joules_per_token=0.2)
+    backend18_b = FakeBackend(tokens_per_s=400.0, joules_per_token=0.2)
+    server18_a = GenerationServer(
+        backend18_a, host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous",
+    )
+    server18_b = GenerationServer(
+        backend18_b, host="127.0.0.1", port=0, quiet=True,
+        scheduler="continuous",
+    )
+    server18_a.start()
+    server18_b.start()
+    base18_a = f"http://127.0.0.1:{server18_a.port}"
+    base18_b = f"http://127.0.0.1:{server18_b.port}"
+    router18 = Router(
+        [
+            RemoteReplica("t0", base18_a),
+            RemoteReplica("t1", base18_b),
+        ],
+        policy="round-robin",
+        probe_interval_s=30.0,
+    )
+    rserver18 = RouterServer(router18, host="127.0.0.1", port=0, quiet=True)
+    rserver18.start()
+    try:
+        rbase18 = f"http://127.0.0.1:{rserver18.port}"
+        rclient18 = RemoteHTTPBackend(rbase18)
+        for k in range(4):  # round-robin spreads fleetco over both
+            rclient18.generate(
+                _GenReq(
+                    "smoke:1b",
+                    f"fleet tenant req {k}",
+                    max_new_tokens=4,
+                    tenant="fleetco",
+                )
+            )
+        expected18 = merge_expositions(
+            [("t0", _scrape(base18_a)), ("t1", _scrape(base18_b))]
+        )
+        want_fleet_J = labelled_value(
+            expected18, "llm_fleet_tenant_joules_total",
+            {"tenant": "fleetco"},
+        )
+        got_fleet_J = labelled_value(
+            _scrape(rbase18), "llm_fleet_tenant_joules_total",
+            {"tenant": "fleetco"},
+        )
+        assert want_fleet_J > 0, "merged fleet tenant joules empty"
+        assert abs(got_fleet_J - want_fleet_J) < 1e-6, (
+            got_fleet_J,
+            want_fleet_J,
+        )
+        # the router's own tenant view: fleet rollup sums the replicas
+        rtenants18 = _get_json(rbase18, "/debug/tenants")
+        assert (
+            rtenants18["fleet"]["fleetco"]["requests"]["ok"] >= 4
+        ), rtenants18
+        # ...and 404s under the kill switch, same as a replica
+        obs_metrics.disable()
+        try:
+            try:
+                _get_json(rbase18, "/debug/tenants")
+                raise AssertionError(
+                    "router /debug/tenants served under the kill switch"
+                )
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 404, exc.code
+        finally:
+            obs_metrics.enable()
+    finally:
+        rserver18.stop()
+        server18_a.stop()
+        server18_b.stop()
+
     print(
         json.dumps(
             {
@@ -2291,6 +2538,16 @@ def main() -> int:
                     "refused_retries": refused17,
                     "occupant_tokens": occ_done17.get("tokens"),
                     "headroom_recovered": recovered17,
+                },
+                "tenant_accounting": {
+                    "acme_joules": round(hand18["acme"]["joules"], 6),
+                    "table_agrees_by_hand": True,
+                    "beta_cancelled": beta18["requests"]["cancelled"],
+                    "ledger_records": len(records18),
+                    "ledger_seq_monotonic": True,
+                    "fleet_tenant_joules": round(got_fleet_J, 6),
+                    "fleet_equals_merged_scrapes": True,
+                    "kill_switch_404": True,
                 },
             }
         )

@@ -1,0 +1,568 @@
+"""Per-row slice attribution + tenant accounting (ISSUE 20).
+
+Engine half: every wall second and modelled Joule a stepped session
+bills anywhere lands in exactly one of three books — a live row's
+account, a retired row's ``extras["energy_model"]`` close-out, or the
+session's dropped bucket (cancel / join-abort / close) — so
+``totals == retired + live + dropped`` holds to 1e-6 across cache
+layouts, chunked joiners, preempt/resume and cancellation, on the real
+engine AND its hermetic fake twin (whose synthetic energy model makes
+the identity ``J == joules_per_token × generated_tokens`` exact).
+
+Serve half: the bounded tenant table (overflow → ``_other``), the
+``account_request`` funnel (counters + table + ledger in one call), the
+append-only JSONL usage ledger's monotonic-seq resume across reopen
+(torn tails tolerated), the ``x_tenant`` wire field, and kill-switch
+inertness (no attribution, no close-out, no accounting, 404 endpoint).
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.backend import (
+    GenerationRequest,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.fake import (
+    FakeBackend,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.engine.jax_engine import (
+    JaxEngine,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.models.config import (
+    get_model_config,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import (
+    metrics as obs_metrics,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs import (
+    tenants as obs_tenants,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.obs.tenants import (
+    DEFAULT_TENANT,
+    OTHER_TENANT,
+    TenantTable,
+    UsageLedger,
+    read_ledger,
+)
+from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve import protocol
+
+TOL = 1e-6
+
+
+@pytest.fixture(scope="module")
+def engines():
+    registry = {"tiny": get_model_config("qwen2:1.5b").tiny()}
+    cache = {}
+
+    def get(paged, kvq):
+        key = (paged, kvq)
+        if key not in cache:
+            cache[key] = JaxEngine(
+                registry=dict(registry),
+                dtype=jnp.float32,
+                paged_kv=paged,
+                kv_quantize=kvq,
+            )
+        return cache[key]
+
+    return get
+
+
+def _em(res):
+    return (res.extras or {}).get("energy_model")
+
+
+def _books_real(sess, results):
+    """(totals, retired+live+dropped) per conserved key, real session."""
+    out = {}
+    ems = [e for e in (_em(r) for r in results) if e]
+    live = [row for row in sess.rows if row is not None]
+    for key, em_key, attr in (
+        ("wall", "wall_attr_s", "attr_wall"),
+        ("J", "J", "attr_J"),
+        ("J_low", "J_low", "attr_J_low"),
+        ("J_high", "J_high", "attr_J_high"),
+    ):
+        billed = (
+            sum(e[em_key] for e in ems)
+            + sum(getattr(row, attr) for row in live)
+            + sess._attr_dropped[key]
+        )
+        out[key] = (sess._attr_totals[key], billed)
+    return out
+
+
+def _assert_conserved_real(sess, results):
+    for key, (total, billed) in _books_real(sess, results).items():
+        assert abs(total - billed) < TOL, (key, total, billed)
+
+
+def _books_fake(sess, results):
+    ems = [e for e in (_em(r) for r in results) if e]
+    live = sess._rows + sess._pending
+    out = {}
+    for key, em_key, attr in (
+        ("wall", "wall_attr_s", "attr_wall"),
+        ("J", "J", "attr_J"),
+    ):
+        billed = (
+            sum(e[em_key] for e in ems)
+            + sum(row.get(attr, 0.0) for row in live)
+            + sess._attr_dropped[key]
+        )
+        out[key] = (sess._attr_totals[key], billed)
+    return out
+
+
+def _assert_conserved_fake(sess, results):
+    for key, (total, billed) in _books_fake(sess, results).items():
+        assert abs(total - billed) < TOL, (key, total, billed)
+
+
+def _drain(sess, max_steps=8, limit=200):
+    out = []
+    for _ in range(limit):
+        if not sess.active:
+            break
+        out.extend(sess.step(max_steps))
+    assert not sess.active, "session did not drain"
+    return out
+
+
+# -- real engine: conservation across layouts, joiners, drops ------------------
+
+
+@pytest.mark.parametrize(
+    "paged,kv",
+    [(False, None), (False, "int8"), (True, None), (True, "int8")],
+    ids=["contig-bf16", "contig-int8", "paged-bf16", "paged-int8"],
+)
+def test_conservation_all_layouts_with_chunked_joiner(engines, paged, kv):
+    """Everything the session bills — decode slices AND a chunked
+    joiner's prefill — closes out: totals == retired close-outs (+
+    nothing live, nothing dropped) on every cache layout."""
+    eng = engines(paged, kv)
+    anchor = GenerationRequest(
+        "tiny", "a" * 120, max_new_tokens=32, stop_at_eos=False, seed=1
+    )
+    short = GenerationRequest("tiny", "short row", max_new_tokens=8, seed=2)
+    sess = eng.decode_open([anchor, short], reserve_rows=4)
+    results = []
+    results.extend(sess.step(4))
+    joiner = GenerationRequest("tiny", "j" * 80, max_new_tokens=8, seed=3)
+    assert sess.can_join(joiner)
+    pj = sess.join_begin(joiner, chunk_tokens=32)
+    while not sess.join_step(pj):
+        # the companions keep decoding between prefill chunks
+        results.extend(sess.step(2))
+    sess.join_commit(pj)
+    results.extend(_drain(sess))
+    assert len(results) == 3
+    for res in results:
+        em = _em(res)
+        assert em is not None
+        assert em["window"] == "slice"
+        assert em["slices"] >= 1
+        assert em["wall_attr_s"] > 0
+        assert em["J_low"] <= em["J"] <= em["J_high"]
+        if res.generated_tokens:
+            assert (
+                abs(em["J_per_token"] - em["J"] / res.generated_tokens)
+                < TOL
+            )
+    assert sess._attr_dropped["wall"] == 0.0
+    _assert_conserved_real(sess, results)
+    sess.close()
+
+
+def test_conservation_cancel_moves_account_to_dropped(engines):
+    eng = engines(False, None)
+    keep = GenerationRequest(
+        "tiny", "keeps decoding", max_new_tokens=16, stop_at_eos=False
+    )
+    victim = GenerationRequest(
+        "tiny", "cancelled mid-flight", max_new_tokens=40,
+        stop_at_eos=False, seed=7,
+    )
+    sess = eng.decode_open([keep, victim], reserve_rows=4)
+    sess.step(4)
+    billed_before = next(
+        row for row in sess.rows
+        if row is not None and row.request is victim
+    ).attr_wall
+    assert billed_before > 0  # the victim had already been billed
+    assert sess.cancel(victim)
+    assert sess._attr_dropped["wall"] >= billed_before - TOL
+    results = _drain(sess)
+    # the cancelled row never closed out; the survivor did
+    assert [r.request for r in results] == [keep]
+    _assert_conserved_real(sess, results)
+    sess.close()
+
+
+def test_conservation_join_abort_drops_chunk_bill(engines):
+    eng = engines(True, None)
+    anchor = GenerationRequest(
+        "tiny", "anchor", max_new_tokens=16, stop_at_eos=False
+    )
+    sess = eng.decode_open([anchor], reserve_rows=4)
+    sess.step(2)
+    pj = sess.join_begin(
+        GenerationRequest("tiny", "j" * 90, max_new_tokens=8),
+        chunk_tokens=32,
+    )
+    sess.join_step(pj)  # one chunk billed to the pending account
+    assert pj.attr_wall > 0
+    sess.join_abort(pj)
+    assert sess._attr_dropped["wall"] >= pj.attr_wall - TOL
+    results = _drain(sess)
+    _assert_conserved_real(sess, results)
+    sess.close()
+
+
+@pytest.mark.parametrize(
+    "paged,kv,policy",
+    [(True, None, "swap"), (False, None, "recompute")],
+    ids=["paged-swap", "contig-recompute"],
+)
+def test_conservation_preempt_resume(engines, paged, kv, policy):
+    """A preempted row's account survives the park: pre-preempt slices
+    plus the resume re-prefill plus post-resume slices all land in ONE
+    close-out, and the session books still balance."""
+    eng = engines(paged, kv)
+    anchor = GenerationRequest(
+        "tiny", "anchor keeps decoding", max_new_tokens=24,
+        stop_at_eos=False,
+    )
+    victim = GenerationRequest(
+        "tiny", "victim row", max_new_tokens=16, stop_at_eos=False, seed=7
+    )
+    sess = eng.decode_open([anchor, victim], reserve_rows=4)
+    sess.step(4)
+    pr = sess.preempt(victim, policy=policy)
+    assert pr is not None
+    assert pr.attr_wall > 0  # the park carries the billed account
+    sess.step(2)
+    pend = sess.resume_begin(pr, 64)
+    while not sess.join_step(pend):
+        pass
+    sess.join_commit(pend)
+    results = _drain(sess)
+    by_req = {id(r.request): r for r in results}
+    em_v = _em(by_req[id(victim)])
+    assert em_v is not None
+    # the close-out covers at least what was billed before the park
+    assert em_v["wall_attr_s"] >= pr.attr_wall - TOL
+    assert sess._attr_dropped["wall"] == 0.0
+    _assert_conserved_real(sess, results)
+    sess.close()
+
+
+def test_conservation_close_abandons_live_rows(engines):
+    eng = engines(False, None)
+    reqs = [
+        GenerationRequest(
+            "tiny", "abandoned a", max_new_tokens=40, stop_at_eos=False
+        ),
+        GenerationRequest(
+            "tiny", "abandoned b", max_new_tokens=40, stop_at_eos=False,
+            seed=3,
+        ),
+    ]
+    sess = eng.decode_open(reqs)
+    sess.step(4)
+    assert sess._attr_totals["wall"] > 0
+    sess.close()
+    _assert_conserved_real(sess, [])  # everything moved to dropped
+    assert sess._attr_dropped["wall"] > 0
+
+
+# -- fake engine: exact synthetic identity + the same invariant ----------------
+
+
+def test_fake_identity_and_conservation():
+    """The fake's energy model is ``jpt × tokens``, so a retired row's
+    slice-summed J equals the whole-request figure EXACTLY — and the
+    joiner's prefill chunks bill wall only."""
+    jpt = 0.25
+    backend = FakeBackend(joules_per_token=jpt)
+    reqs = [
+        GenerationRequest("m", "row one", max_new_tokens=12),
+        GenerationRequest("m", "row two", max_new_tokens=30),
+    ]
+    sess = backend.decode_open(reqs)
+    results = []
+    results.extend(sess.step(4))
+    joiner = GenerationRequest("m", "j" * 64, max_new_tokens=8)
+    pj = sess.join_begin(joiner, chunk_tokens=16)
+    while not sess.join_step(pj):
+        results.extend(sess.step(2))
+    sess.join_commit(pj)
+    results.extend(_drain(sess, max_steps=4))
+    assert len(results) == 3
+    for res in results:
+        em = _em(res)
+        assert em is not None and em["window"] == "slice"
+        assert abs(em["J"] - jpt * res.generated_tokens) < TOL
+    _assert_conserved_fake(sess, results)
+    sess.close()
+
+
+def test_fake_cancel_and_close_drop_exactly():
+    jpt = 0.5
+    backend = FakeBackend(joules_per_token=jpt)
+    keep = GenerationRequest("m", "kept", max_new_tokens=8)
+    gone = GenerationRequest("m", "cancelled", max_new_tokens=40)
+    left = GenerationRequest("m", "abandoned at close", max_new_tokens=40)
+    sess = backend.decode_open([keep, gone, left])
+    sess.step(4)
+    assert sess.cancel(gone)
+    # 4 tokens were billed to the cancelled row before it left
+    assert abs(sess._attr_dropped["J"] - jpt * 4) < TOL
+    results = []
+    for _ in range(10):
+        results.extend(sess.step(4))
+        if any(r.request is keep for r in results):
+            break
+    sess.close()  # the long row dies live
+    _assert_conserved_fake(sess, results)
+    assert sess._attr_dropped["J"] > jpt * 4  # close added the live row
+
+
+def test_fake_preempt_resume_keeps_identity():
+    """The row dict parks through preempt, so the resumed row's
+    close-out is the FULL lifetime figure — pre-park tokens included —
+    under both policies."""
+    jpt = 0.125
+    for policy in ("swap", "recompute"):
+        backend = FakeBackend(joules_per_token=jpt)
+        anchor = GenerationRequest("m", "anchor", max_new_tokens=24)
+        victim = GenerationRequest("m", "victim", max_new_tokens=16)
+        sess = backend.decode_open([anchor, victim])
+        sess.step(4)
+        pr = sess.preempt(victim, policy=policy)
+        assert pr is not None
+        sess.step(4)
+        pend = sess.resume_begin(pr, 32)
+        while not sess.join_step(pend):
+            pass
+        sess.join_commit(pend)
+        results = _drain(sess, max_steps=4)
+        by_req = {id(r.request): r for r in results}
+        em_v = _em(by_req[id(victim)])
+        assert abs(em_v["J"] - jpt * 16) < TOL, policy
+        _assert_conserved_fake(sess, results)
+        sess.close()
+
+
+def test_fake_fully_rejected_spec_rounds_mirror_wasted():
+    """Cross-source spec at acceptance 0: every round fully rejects, the
+    draft burn mirrors into the owning row's close-out as ``wasted_J``
+    — and the PRIMARY books (attr_J) stay the clean jpt × tokens
+    figure, wasted never folds in."""
+    jpt, draft_jpt = 0.25, 0.05
+    backend = FakeBackend(
+        joules_per_token=jpt,
+        spec_k=4,
+        spec_acceptance=0.0,
+        spec_source="cross",
+        spec_draft="draft:1b",
+        model_joules={"m": jpt, "draft:1b": draft_jpt},
+    )
+    req = GenerationRequest("m", "rejected rows", max_new_tokens=12)
+    sess = backend.decode_open([req])
+    results = _drain(sess, max_steps=4)
+    em = _em(results[0])
+    assert abs(em["J"] - jpt * 12) < TOL
+    # 12 rounds × k=4 drafted tokens, all rejected, at the draft price
+    assert em.get("wasted_J", 0.0) == pytest.approx(
+        12 * 4 * draft_jpt, abs=1e-5
+    )
+    _assert_conserved_fake(sess, results)
+    sess.close()
+
+
+# -- tenant table, account funnel, ledger --------------------------------------
+
+
+def test_tenant_table_overflow_routes_to_other():
+    t = TenantTable(max_tenants=2)
+    assert t.resolve("a") == "a"
+    assert t.resolve("b") == "b"
+    assert t.resolve("c") == OTHER_TENANT  # past the bound
+    assert t.resolve("a") == "a"  # first-come mapping is sticky
+    # the default tenant and the overflow label never consume slots
+    assert t.resolve(None) == DEFAULT_TENANT
+    assert t.resolve(DEFAULT_TENANT) == DEFAULT_TENANT
+    assert t.resolve(OTHER_TENANT) == OTHER_TENANT
+    t.record("a", "ok", 10, 5, 1.5, {"retry": 0.25})
+    t.record(t.resolve("c"), "ok", 1, 2, 0.5, None)
+    t.record(t.resolve("d"), "error", 1, 0, 0.25, None)
+    snap = t.snapshot()
+    assert snap["a"] == {
+        "requests": {"ok": 1},
+        "tokens_in": 10,
+        "tokens_out": 5,
+        "joules": 1.5,
+        "wasted_J": {"retry": 0.25},
+    }
+    # everything past the bound aggregates under one label
+    assert snap[OTHER_TENANT]["requests"] == {"ok": 1, "error": 1}
+    assert snap[OTHER_TENANT]["joules"] == 0.75
+
+
+def _family_sum(name):
+    fam = obs_metrics.REGISTRY.snapshot().get(name) or {}
+    return sum(v for v in fam.values() if isinstance(v, (int, float)))
+
+
+def test_account_request_funnel_counters_table_ledger(tmp_path):
+    obs_tenants.reset_tenants()
+    led = UsageLedger(str(tmp_path))
+    prev = obs_tenants.install_ledger(led)
+    j0 = _family_sum("llm_tenant_joules_total")
+    try:
+        obs_tenants.account_request(
+            "acme", "ok", tokens_in=3, tokens_out=7, joules=1.25,
+            wasted={"retry": 0.5}, model="m",
+        )
+        obs_tenants.account_request("acme", "cancelled")
+        snap = obs_tenants.snapshot()
+        acct = snap["tenants"]["acme"]
+        assert acct["requests"] == {"ok": 1, "cancelled": 1}
+        assert acct["tokens_in"] == 3 and acct["tokens_out"] == 7
+        assert acct["joules"] == 1.25
+        assert acct["wasted_J"] == {"retry": 0.5}
+        assert snap["ledger"] == {"dir": str(tmp_path), "seq": 2}
+        assert _family_sum("llm_tenant_joules_total") == pytest.approx(
+            j0 + 1.25
+        )
+        records = read_ledger(str(tmp_path))
+        assert [r["seq"] for r in records] == [1, 2]
+        assert records[0]["tenant"] == "acme"
+        assert records[0]["joules"] == 1.25
+        assert records[0]["model"] == "m"
+    finally:
+        obs_tenants.install_ledger(prev)
+        led.close()
+        obs_tenants.reset_tenants()
+
+
+def test_ledger_seq_resumes_across_reopen_and_torn_tail(tmp_path):
+    led = UsageLedger(str(tmp_path))
+    led.append({"tenant": "a", "outcome": "ok"})
+    led.append({"tenant": "b", "outcome": "ok"})
+    assert led.seq == 2
+    led.close()
+    # simulate a crash mid-write: a torn, unparseable tail line
+    path = os.path.join(str(tmp_path), UsageLedger.LEDGER_NAME)
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"seq": 99, "tenant"')
+    led2 = UsageLedger(str(tmp_path))
+    assert led2.seq == 2  # torn line ignored, sequence resumed
+    led2.append({"tenant": "a", "outcome": "ok"})
+    records = read_ledger(str(tmp_path))
+    seqs = [r["seq"] for r in records]
+    assert seqs == [1, 2, 3]  # strictly monotonic, no double-billing
+    table = TenantTable()
+    table.record("a", "ok", 1, 1, 0.5, None)
+    led2.write_snapshot(table)
+    with open(
+        os.path.join(str(tmp_path), UsageLedger.SNAPSHOT_NAME),
+        encoding="utf-8",
+    ) as fh:
+        snap = json.load(fh)
+    assert snap["seq"] == 3
+    assert "a" in snap["tenants"]
+    led2.close(table)
+    # appends after close are dropped, not crashed
+    led2.append({"tenant": "a", "outcome": "ok"})
+    assert [r["seq"] for r in read_ledger(str(tmp_path))] == [1, 2, 3]
+
+
+# -- wire field ----------------------------------------------------------------
+
+
+def test_x_tenant_wire_roundtrip():
+    req = GenerationRequest("m", "p", max_new_tokens=4, tenant="acme")
+    wire = protocol.request_to_wire(req)
+    assert wire["x_tenant"] == "acme"
+    assert protocol.request_from_wire(wire).tenant == "acme"
+    # the default tenant stays off the wire entirely
+    plain = protocol.request_to_wire(
+        GenerationRequest("m", "p", max_new_tokens=4)
+    )
+    assert "x_tenant" not in plain
+    assert protocol.request_from_wire(plain).tenant == DEFAULT_TENANT
+    for bad in (7, "", ["a"]):
+        with pytest.raises(ValueError):
+            protocol.request_from_wire(
+                {"model": "m", "prompt": "p", "x_tenant": bad}
+            )
+
+
+# -- kill switch: zero-alloc inertness -----------------------------------------
+
+
+def test_kill_switch_disables_attribution_and_accounting():
+    obs_metrics.disable()
+    try:
+        backend = FakeBackend(joules_per_token=0.25)
+        sess = backend.decode_open(
+            [GenerationRequest("m", "dark row", max_new_tokens=8)]
+        )
+        results = _drain(sess, max_steps=4)
+        # no books were kept and no close-out was stamped
+        assert sess._attr_totals == {
+            "wall": 0.0, "J": 0.0, "J_low": 0.0, "J_high": 0.0
+        }
+        assert _em(results[0]) is None
+        sess.close()
+        obs_tenants.reset_tenants()
+        obs_tenants.account_request("ghost", "ok", tokens_out=5, joules=1.0)
+        assert obs_tenants.snapshot()["tenants"] == {}
+    finally:
+        obs_metrics.enable()
+        obs_tenants.reset_tenants()
+
+
+def test_debug_tenants_endpoint_404s_under_kill_switch():
+    import urllib.error
+    import urllib.request
+
+    from cain_2025_device_remote_llm_energy_rep_pkg_tpu.serve.server import (
+        GenerationServer,
+    )
+
+    server = GenerationServer(
+        FakeBackend(joules_per_token=0.1),
+        host="127.0.0.1",
+        port=0,
+        quiet=True,
+        scheduler="continuous",
+    )
+    server.start()
+    try:
+        url = (
+            f"http://127.0.0.1:{server.port}"
+            + protocol.DEBUG_TENANTS_PATH
+        )
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            payload = json.loads(resp.read())
+        assert "tenants" in payload and "table_max" in payload
+        assert payload["role"] == "mixed"
+        obs_metrics.disable()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(url, timeout=10)
+            assert err.value.code == 404
+        finally:
+            obs_metrics.enable()
+        # re-enabled: the endpoint serves again
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert "tenants" in json.loads(resp.read())
+    finally:
+        server.stop()
